@@ -1,0 +1,31 @@
+"""sparse_coding__tpu: TPU-native sparse-coding / sparse-autoencoder framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference
+`johnathan217/sparse_coding_` codebase (training ensembles of sparse
+autoencoders and other dictionary-learning methods on LM activations), designed
+TPU-first: stacked-ensemble vmap training under one jit, `jax.sharding` meshes
+for scale-out, Pallas kernels for the hot inner loops, and orbax checkpoints.
+
+Layout:
+  - `ensemble`   — stacked-ensemble runtime (vmap(grad) + optax under jit)
+  - `models`     — dictionary model zoo (SAE family, top-k, FISTA, LISTA, ...)
+  - `data`       — synthetic generators, activation chunk store, LM harvesting
+  - `lm`         — hook-capable JAX transformer (subject models)
+  - `parallel`   — device-mesh sharding of the ensemble/data/dict axes
+  - `train`      — sweep orchestrator, train loops, checkpointing
+  - `metrics`    — FVU / MMCS / sparsity / moments / perplexity metrics
+  - `interp`     — automated-interpretability pipeline
+"""
+
+from sparse_coding__tpu.ensemble import (
+    DictSignature,
+    Ensemble,
+    EnsembleState,
+    build_ensemble,
+    make_ensemble_step,
+    optim_str_to_func,
+    stack_pytrees,
+    unstack_pytree,
+)
+
+__version__ = "0.1.0"

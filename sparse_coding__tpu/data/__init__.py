@@ -1,0 +1,6 @@
+from sparse_coding__tpu.data.synthetic import (
+    RandomDatasetGenerator,
+    SparseMixDataset,
+    generate_corr_matrix,
+    generate_rand_feats,
+)

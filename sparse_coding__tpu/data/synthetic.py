@@ -1,0 +1,262 @@
+"""Synthetic sparse-feature datasets with known ground-truth dictionaries.
+
+JAX counterpart of the reference `sc_datasets/random_dataset.py:16-279`. These
+generators are the framework's primary regression fixtures: a trained SAE
+should recover the planted feature directions (MMCS → 1) on this data.
+
+Design: all sampling is pure-functional over `jax.random` keys and jitted, so a
+generator can run on-device and feed the train loop without host round-trips.
+The `Generator`-style classes keep API parity with the reference (call
+`next(gen)` / `gen.send(batch_size)`), advancing an internal key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate_rand_feats(key: jax.Array, feat_dim: int, num_feats: int) -> jax.Array:
+    """Random unit-norm feature directions.
+
+    Reference `random_dataset.py:248-261` (gaussian rows, L2-normalized).
+    """
+    feats = jax.random.normal(key, (num_feats, feat_dim))
+    return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+
+def generate_corr_matrix(key: jax.Array, num_feats: int) -> jax.Array:
+    """Random symmetric PSD "correlation" matrix.
+
+    Reference `random_dataset.py:264-279`: symmetrize a uniform matrix and
+    shift its spectrum positive.
+    """
+    m = jax.random.uniform(key, (num_feats, num_feats))
+    m = (m + m.T) / 2.0
+    min_eig = jnp.min(jnp.linalg.eigvalsh(m))
+    shift = jnp.where(min_eig < 0, -1.001 * min_eig, 0.0)
+    return m + shift * jnp.eye(num_feats)
+
+
+@partial(jax.jit, static_argnames=("n_components", "batch_size"))
+def sample_rand_dataset(
+    key: jax.Array,
+    feats: jax.Array,
+    component_probs: jax.Array,
+    n_components: int,
+    batch_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Uncorrelated sparse codes → activations.
+
+    Reference `generate_rand_dataset` (`random_dataset.py:160-188`): Bernoulli
+    gates (per-component prob) × uniform values × uniform strengths.
+    Returns (codes, data).
+    """
+    k_thresh, k_vals, k_strength = jax.random.split(key, 3)
+    thresh = jax.random.uniform(k_thresh, (batch_size, n_components))
+    values = jax.random.uniform(k_vals, (batch_size, n_components))
+    codes = jnp.where(thresh <= component_probs[None, :], values, 0.0)
+    strengths = jax.random.uniform(k_strength, (batch_size, n_components))
+    data = (codes * strengths) @ feats
+    return codes, data
+
+
+def chol_factor(cov: jax.Array) -> jax.Array:
+    """Cholesky factor of a covariance (jittered for PSD safety). Computed
+    once per generator lifetime — NOT in the per-batch hot path."""
+    n = cov.shape[0]
+    return jnp.linalg.cholesky(cov + 1e-6 * jnp.eye(n, dtype=cov.dtype))
+
+
+@partial(jax.jit, static_argnames=("n_components", "batch_size"))
+def sample_correlated_dataset(
+    key: jax.Array,
+    corr_chol: jax.Array,
+    feats: jax.Array,
+    frac_nonzero: float,
+    decay: jax.Array,
+    n_components: int,
+    batch_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Correlated sparse codes via the MVN-CDF trick.
+
+    Reference `generate_correlated_dataset` (`random_dataset.py:191-245`):
+    sample one MVN draw, push through the normal CDF to get correlated
+    per-component probabilities, decay + rescale to the target density, then
+    Bernoulli-gate uniform values; rows with no active feature get one random
+    active component. Takes the pre-factored Cholesky of the correlation
+    matrix (`chol_factor`).
+    """
+    k_mvn, k_thresh, k_vals, k_fix, k_strength = jax.random.split(key, 5)
+    corr_draw = corr_chol @ jax.random.normal(k_mvn, (n_components,))
+    cdf = jax.scipy.stats.norm.cdf(corr_draw)
+    component_probs = cdf * decay
+    component_probs = component_probs * (frac_nonzero / jnp.mean(component_probs))
+
+    thresh = jax.random.uniform(k_thresh, (batch_size, n_components))
+    values = jax.random.uniform(k_vals, (batch_size, n_components))
+    codes = jnp.where(thresh <= component_probs[None, :], values, 0.0)
+
+    # ensure no all-zero rows (reference `random_dataset.py:234-239`)
+    row_empty = (codes != 0).sum(axis=1) == 0
+    rand_idx = jax.random.randint(k_fix, (batch_size,), 0, n_components)
+    fix = jax.nn.one_hot(rand_idx, n_components, dtype=codes.dtype)
+    codes = jnp.where(row_empty[:, None], fix, codes)
+
+    strengths = jax.random.uniform(k_strength, (batch_size, n_components))
+    data = (codes * strengths) @ feats
+    return codes, data
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def sample_noise(
+    key: jax.Array, noise_chol: jax.Array, noise_magnitude_scale: float, batch_size: int
+) -> jax.Array:
+    """Correlated gaussian noise (reference `random_dataset.py:145-157`).
+    Takes the pre-factored Cholesky of the noise covariance."""
+    d = noise_chol.shape[0]
+    z = jax.random.normal(key, (batch_size, d))
+    return (z @ noise_chol.T) * noise_magnitude_scale
+
+
+class RandomDatasetGenerator:
+    """Decaying-Bernoulli sparse feature generator.
+
+    Reference `RandomDatasetGenerator` (`random_dataset.py:16-73`). ``next(g)``
+    yields a ``[batch_size, activation_dim]`` float32 batch on device; the
+    planted dictionary is ``g.feats``.
+    """
+
+    def __init__(
+        self,
+        activation_dim: int,
+        n_ground_truth_components: int,
+        batch_size: int,
+        feature_num_nonzero: int,
+        feature_prob_decay: float,
+        correlated: bool,
+        key: jax.Array,
+    ):
+        self.activation_dim = activation_dim
+        self.n_ground_truth_components = n_ground_truth_components
+        self.batch_size = batch_size
+        self.frac_nonzero = feature_num_nonzero / n_ground_truth_components
+        self.correlated = correlated
+
+        key, k_feats, k_corr = jax.random.split(key, 3)
+        self._key = key
+        self.decay = jnp.asarray(
+            [feature_prob_decay**i for i in range(n_ground_truth_components)]
+        )
+        self.feats = generate_rand_feats(k_feats, activation_dim, n_ground_truth_components)
+        if correlated:
+            self.corr_matrix = generate_corr_matrix(k_corr, n_ground_truth_components)
+            self.corr_chol = chol_factor(self.corr_matrix)
+            self.component_probs = None
+        else:
+            self.corr_matrix = None
+            self.corr_chol = None
+            self.component_probs = self.decay * self.frac_nonzero
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> jax.Array:
+        return self.send(None)
+
+    def send(self, _ignored=None) -> jax.Array:
+        self._key, k = jax.random.split(self._key)
+        if self.correlated:
+            _, data = sample_correlated_dataset(
+                k,
+                self.corr_chol,
+                self.feats,
+                self.frac_nonzero,
+                self.decay,
+                self.n_ground_truth_components,
+                self.batch_size,
+            )
+        else:
+            _, data = sample_rand_dataset(
+                k,
+                self.feats,
+                self.component_probs,
+                self.n_ground_truth_components,
+                self.batch_size,
+            )
+        return data
+
+
+class SparseMixDataset:
+    """Correlated sparse components + correlated gaussian noise.
+
+    Reference `SparseMixDataset` (`random_dataset.py:76-142`). ``send(bs)``
+    yields ``sparse + noise`` batches; ground truth in
+    ``self.sparse_component_dict``.
+    """
+
+    def __init__(
+        self,
+        activation_dim: int,
+        n_sparse_components: int,
+        batch_size: int,
+        feature_num_nonzero: int,
+        feature_prob_decay: float,
+        noise_magnitude_scale: float,
+        key: jax.Array,
+        sparse_component_dict: Optional[jax.Array] = None,
+        sparse_component_covariance: Optional[jax.Array] = None,
+        noise_covariance: Optional[jax.Array] = None,
+    ):
+        self.activation_dim = activation_dim
+        self.n_sparse_components = n_sparse_components
+        self.batch_size = batch_size
+        self.frac_nonzero = feature_num_nonzero / n_sparse_components
+        self.noise_magnitude_scale = noise_magnitude_scale
+
+        key, k_feats, k_corr = jax.random.split(key, 3)
+        self._key = key
+        self.sparse_component_dict = (
+            sparse_component_dict
+            if sparse_component_dict is not None
+            else generate_rand_feats(k_feats, activation_dim, n_sparse_components)
+        )
+        self.sparse_component_covariance = (
+            sparse_component_covariance
+            if sparse_component_covariance is not None
+            else generate_corr_matrix(k_corr, n_sparse_components)
+        )
+        self.noise_covariance = (
+            noise_covariance if noise_covariance is not None else jnp.eye(activation_dim)
+        )
+        self.corr_chol = chol_factor(self.sparse_component_covariance)
+        self.noise_chol = chol_factor(self.noise_covariance)
+        self.sparse_component_probs = jnp.asarray(
+            [feature_prob_decay**i for i in range(n_sparse_components)]
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.send(None)
+
+    def send(self, batch_size: Optional[int] = None) -> jax.Array:
+        bs = batch_size or self.batch_size
+        self._key, k_sparse, k_noise = jax.random.split(self._key, 3)
+        _, sparse = sample_correlated_dataset(
+            k_sparse,
+            self.corr_chol,
+            self.sparse_component_dict,
+            self.frac_nonzero,
+            self.sparse_component_probs,
+            self.n_sparse_components,
+            bs,
+        )
+        noise = sample_noise(k_noise, self.noise_chol, self.noise_magnitude_scale, bs)
+        return sparse + noise

@@ -1,0 +1,246 @@
+"""Pure-math evaluation metrics for learned dictionaries.
+
+JAX counterpart of the metric library in the reference `standard_metrics.py`
+(FVU `:308`, MMCS family `:268-300`, sparsity `:303`, moments `:444-509`,
+capacity `:354-360`, AUROC probes `:252-266`). Everything array-valued is jnp
+and jit-friendly; sklearn-backed probes stay host-side (they are offline
+diagnostics, exactly as in the reference).
+
+All dictionary arguments accept either a `LearnedDict` or a raw
+``[n_feats, activation_size]`` matrix where noted.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict
+
+
+# -- MMCS family (reference standard_metrics.py:268-300) ----------------------
+
+def _as_dict(d) -> jax.Array:
+    return d.get_learned_dict() if isinstance(d, LearnedDict) else d
+
+
+def mcs_duplicates(ground, model) -> jax.Array:
+    """Max cosine sim of each `model` atom against all `ground` atoms
+    (reference `:268-272`). Assumes unit-norm rows, as `get_learned_dict`
+    guarantees."""
+    cos = jnp.einsum("md,gd->mg", _as_dict(model), _as_dict(ground))
+    return cos.max(axis=-1)
+
+
+def mmcs(model, model2) -> jax.Array:
+    """Mean max cosine similarity (reference `:274`)."""
+    return mcs_duplicates(model2, model).mean()
+
+
+def mcs_to_fixed(model, truth: jax.Array) -> jax.Array:
+    return jnp.einsum("md,gd->mg", _as_dict(model), truth).max(axis=-1)
+
+
+def mmcs_to_fixed(model, truth: jax.Array) -> jax.Array:
+    """MMCS against a fixed ground-truth dictionary (reference `:280-282`)."""
+    return mcs_to_fixed(model, truth).mean()
+
+
+def mmcs_from_list(ld_list: List[Any]) -> jax.Array:
+    """Symmetric matrix of pairwise MMCS (reference `:285-295`)."""
+    n = len(ld_list)
+    out = np.eye(n, dtype=np.float32)
+    for i in range(n):
+        for j in range(i):
+            v = float(mmcs(ld_list[i], ld_list[j]))
+            out[i, j] = out[j, i] = v
+    return jnp.asarray(out)
+
+
+def representedness(features: jax.Array, model) -> jax.Array:
+    """For each ground-truth feature, its best match in the model
+    (reference `:297-300`)."""
+    cos = jnp.einsum("gd,md->gm", features, _as_dict(model))
+    return cos.max(axis=-1)
+
+
+def hungarian_matched_mcs(model, truth: jax.Array) -> Tuple[jax.Array, np.ndarray]:
+    """Optimal 1:1 assignment of model atoms to ground-truth atoms
+    (reference `run_mmcs_with_larger`, `standard_metrics.py:809-840`).
+
+    Returns (per-truth-atom matched cosine sims, assignment indices).
+    Host-side scipy Hungarian — offline diagnostic.
+    """
+    from scipy.optimize import linear_sum_assignment
+
+    cos = np.asarray(jnp.einsum("gd,md->gm", truth, _as_dict(model)))
+    rows, cols = linear_sum_assignment(-cos)
+    return jnp.asarray(cos[rows, cols]), cols
+
+
+# -- reconstruction quality (reference standard_metrics.py:303-360) -----------
+
+def mean_nonzero_activations(model: LearnedDict, batch: jax.Array) -> jax.Array:
+    """Per-feature activation frequency (reference `:303-306`)."""
+    c = model.encode(model.center(batch))
+    return (c != 0).astype(jnp.float32).mean(axis=0)
+
+
+def sparsity_l0(model: LearnedDict, batch: jax.Array) -> jax.Array:
+    """Mean number of active features per example (the sweep's L0 axis)."""
+    c = model.encode(model.center(batch))
+    return (c != 0).sum(axis=-1).astype(jnp.float32).mean()
+
+
+def fraction_variance_unexplained(model: LearnedDict, batch: jax.Array) -> jax.Array:
+    """FVU = E[(x - x_hat)^2] / E[(x - mean(x))^2] (reference `:308-312`)."""
+    x_hat = model.predict(batch)
+    residuals = jnp.mean((batch - x_hat) ** 2)
+    total = jnp.mean((batch - batch.mean(axis=0)) ** 2)
+    return residuals / total
+
+
+def fraction_variance_unexplained_top_activating(
+    model: LearnedDict, batch: jax.Array, n_top: int = 2
+) -> Tuple[jax.Array, jax.Array]:
+    """FVU split between the top-mean-activation features and the rest
+    (reference `:314-340`)."""
+    c = model.encode(model.center(batch))
+    mean_act = c.mean(axis=0)
+    order = jnp.argsort(-mean_act)
+    is_top = jnp.zeros(c.shape[-1], bool).at[order[:n_top]].set(True)
+    c_top = jnp.where(is_top[None, :], c, 0.0)
+    c_rest = jnp.where(is_top[None, :], 0.0, c)
+    x_hat_top = model.center(model.decode(c_top))
+    x_hat_rest = model.center(model.decode(c_rest))
+    variance = jnp.mean((batch - batch.mean(axis=0)) ** 2)
+    return (
+        jnp.mean((batch - x_hat_top) ** 2) / variance,
+        jnp.mean((batch - x_hat_rest) ** 2) / variance,
+    )
+
+
+def r_squared(model: LearnedDict, batch: jax.Array) -> jax.Array:
+    return 1.0 - fraction_variance_unexplained(model, batch)
+
+
+def neurons_per_feature(model) -> jax.Array:
+    """Mean Simpson-diversity count of neurons per learned feature
+    (reference `:345-352`)."""
+    c = _as_dict(model)
+    c = c / jnp.abs(c).sum(axis=-1, keepdims=True)
+    c = (c**2).sum(axis=-1)
+    return (1.0 / c).mean()
+
+
+def capacity_per_feature(model) -> jax.Array:
+    """Scherlis et al. 2022 capacity (reference `:354-360`)."""
+    d = _as_dict(model)
+    sq = jnp.einsum("md,nd->mn", d, d) ** 2
+    return jnp.diag(sq) / sq.sum(axis=-1)
+
+
+def interference_capacity(model) -> jax.Array:
+    """Sum of capacities (used by the sweep's in-loop metric dashboard,
+    reference `big_sweep.py:44-58`)."""
+    return capacity_per_feature(model).sum()
+
+
+# -- per-feature activation statistics (reference `:444-529`) ------------------
+
+def calc_feature_n_active(batch: jax.Array) -> jax.Array:
+    return (batch != 0).sum(axis=0)
+
+
+def batched_calc_feature_n_ever_active(
+    model: LearnedDict, activations: jax.Array, batch_size: int = 1000, threshold: int = 10
+) -> int:
+    """Number of features active more than `threshold` times over the data
+    (reference `:444-452`)."""
+    n = activations.shape[0]
+    count = jnp.zeros(model.n_feats)
+    for i in range(0, n, batch_size):
+        c = model.encode(activations[i : i + batch_size])
+        count = count + calc_feature_n_active(c)
+    return int((count > threshold).sum())
+
+
+def calc_feature_mean(batch):
+    return batch.mean(axis=0)
+
+
+def calc_feature_variance(batch):
+    return batch.var(axis=0, ddof=1)
+
+
+def calc_feature_skew(batch):
+    """Asymmetric skew centered at 0 (reference `:466-471`)."""
+    var = batch.var(axis=0, ddof=1)
+    return (batch**3).mean(axis=0) / jnp.clip(var**1.5, 1e-8, None)
+
+
+def calc_feature_kurtosis(batch):
+    """Asymmetric kurtosis centered at 0 (reference `:473-478`)."""
+    var = batch.var(axis=0, ddof=1)
+    return (batch**4).mean(axis=0) / jnp.clip(var**2, 1e-8, None)
+
+
+def calc_moments_streaming(
+    model: LearnedDict, activations: jax.Array, batch_size: int = 1000
+):
+    """Streaming per-feature moments over an activation store
+    (reference `calc_moments_streaming`, `standard_metrics.py:480-509`).
+
+    The reference's Python accumulation loop becomes a `lax.scan` over
+    equal-size batches — one compiled program, fully on-device.
+    Returns (times_active, mean, var, skew, kurtosis, m4).
+    """
+    n = activations.shape[0]
+    n_batches = n // batch_size
+    trimmed = activations[: n_batches * batch_size].reshape(n_batches, batch_size, -1)
+
+    def scan_body(carry, batch):
+        times_active, mean, m2, m3, m4, count = carry
+        c = model.encode(batch)
+        b_mean = c.mean(axis=0)
+        times_active = times_active + (b_mean != 0)
+        w_old = count / (count + batch_size)
+        w_new = batch_size / (count + batch_size)
+        mean = w_old * mean + w_new * b_mean
+        m2 = w_old * m2 + w_new * (c**2).mean(axis=0)
+        m3 = w_old * m3 + w_new * (c**3).mean(axis=0)
+        m4 = w_old * m4 + w_new * (c**4).mean(axis=0)
+        return (times_active, mean, m2, m3, m4, count + batch_size), None
+
+    zeros = jnp.zeros(model.n_feats)
+    init = (zeros, zeros, zeros, zeros, zeros, jnp.zeros(()))
+    (times_active, mean, m2, m3, m4, _), _ = jax.lax.scan(scan_body, init, trimmed)
+    var = m2 - mean**2
+    skew = m3 / jnp.clip(var**1.5, 1e-8, None)
+    kurtosis = m4 / jnp.clip(var**2, 1e-8, None)
+    return times_active, mean, var, skew, kurtosis, m4
+
+
+# -- probe AUROCs (reference standard_metrics.py:252-266, host/sklearn) -------
+
+def logistic_regression_auroc(activations, labels, **kwargs) -> float:
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import roc_auc_score
+
+    x, y = np.asarray(activations), np.asarray(labels)
+    clf = LogisticRegression(**kwargs)
+    clf.fit(x, y)
+    return float(roc_auc_score(y, clf.predict_proba(x)[:, 1]))
+
+
+def ridge_regression_auroc(activations, labels, **kwargs) -> float:
+    from sklearn.linear_model import RidgeClassifier
+    from sklearn.metrics import roc_auc_score
+
+    x, y = np.asarray(activations), np.asarray(labels)
+    clf = RidgeClassifier(**kwargs)
+    clf.fit(x, y)
+    return float(roc_auc_score(y, clf.predict(x)))

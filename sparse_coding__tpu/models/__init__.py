@@ -1,0 +1,21 @@
+from sparse_coding__tpu.models.learned_dict import (
+    AddedNoise,
+    Identity,
+    IdentityReLU,
+    LearnedDict,
+    RandomDict,
+    ReverseSAE,
+    Rotation,
+    TiedSAE,
+    UntiedSAE,
+)
+from sparse_coding__tpu.models.sae import (
+    FunctionalMaskedSAE,
+    FunctionalMaskedTiedSAE,
+    FunctionalReverseSAE,
+    FunctionalSAE,
+    FunctionalThresholdingSAE,
+    FunctionalTiedCenteredSAE,
+    FunctionalTiedSAE,
+)
+from sparse_coding__tpu.models.topk import TopKEncoder, TopKLearnedDict

@@ -1,0 +1,292 @@
+"""Inference-time dictionary interface + baseline dictionaries.
+
+JAX counterpart of the reference `autoencoders/learned_dict.py:13-274`. A
+`LearnedDict` is the *evaluation* view of a trained model: a (possibly
+normalized) dictionary matrix plus an `encode` map. All heavy math is jitted
+jnp; objects hold concrete `jax.Array` leaves and are registered as pytrees so
+they can be `jax.device_put` onto any device/sharding (the TPU replacement for
+the reference's `to_device`).
+
+Shapes follow the reference convention: dictionary `[n_feats, activation_size]`
+(rows are unit-norm feature directions), codes `[batch, n_feats]`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _norm_rows(m: jax.Array, eps: float = 1e-8) -> jax.Array:
+    """Row-normalize a dictionary matrix (reference `learned_dict.py:118-120`)."""
+    norms = jnp.linalg.norm(m, axis=-1, keepdims=True)
+    return m / jnp.clip(norms, eps, None)
+
+
+class LearnedDict:
+    """ABC: trained dictionary with `encode`/`decode`/`predict`.
+
+    Mirrors reference `LearnedDict` (`learned_dict.py:13-50`): `decode` is the
+    einsum ``nd,bn->bd`` against the normalized dictionary; `center`/`uncenter`
+    are overloadable affine hooks; `predict = uncenter∘decode∘encode∘center`.
+    """
+
+    n_feats: int
+    activation_size: int
+
+    def get_learned_dict(self) -> jax.Array:
+        raise NotImplementedError
+
+    def encode(self, batch: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def decode(self, code: jax.Array) -> jax.Array:
+        return jnp.einsum("nd,bn->bd", self.get_learned_dict(), code)
+
+    def center(self, batch: jax.Array) -> jax.Array:
+        return batch
+
+    def uncenter(self, batch: jax.Array) -> jax.Array:
+        return batch
+
+    def predict(self, batch: jax.Array) -> jax.Array:
+        return self.uncenter(self.decode(self.encode(self.center(batch))))
+
+    def n_dict_components(self) -> int:
+        return self.get_learned_dict().shape[0]
+
+    def to_device(self, device) -> "LearnedDict":
+        """`jax.device_put` every array leaf (device or `Sharding`)."""
+        leaves, treedef = jax.tree.flatten(self)
+        return jax.tree.unflatten(treedef, [jax.device_put(l, device) for l in leaves])
+
+
+def register_learned_dict(cls, array_fields: Tuple[str, ...], static_fields: Tuple[str, ...] = ()):
+    """Register a LearnedDict subclass as a pytree with given array leaves.
+
+    `n_feats`/`activation_size` travel in the static aux data so they survive
+    any tree round-trip (device_put, tree.map, jit argument passing) regardless
+    of the first child's type.
+    """
+    static_fields = static_fields + ("n_feats", "activation_size")
+
+    def flatten(obj):
+        children = tuple(getattr(obj, f) for f in array_fields)
+        aux = tuple(getattr(obj, f, None) for f in static_fields)
+        return children, aux
+
+    def unflatten(aux, children):
+        obj = cls.__new__(cls)
+        for f, v in zip(array_fields, children):
+            setattr(obj, f, v)
+        for f, v in zip(static_fields, aux):
+            setattr(obj, f, v)
+        return obj
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+class Identity(LearnedDict):
+    """Pass-through baseline (reference `learned_dict.py:53-65`)."""
+
+    def __init__(self, activation_size: int):
+        self.n_feats = activation_size
+        self.activation_size = activation_size
+
+    def get_learned_dict(self):
+        return jnp.eye(self.n_feats)
+
+    def encode(self, batch):
+        return batch
+
+
+class IdentityReLU(LearnedDict):
+    """ReLU(x + bias) baseline (reference `learned_dict.py:68-85`)."""
+
+    def __init__(self, activation_size: int, bias: Optional[jax.Array] = None):
+        self.n_feats = activation_size
+        self.activation_size = activation_size
+        self.bias = bias if bias is not None else jnp.zeros((activation_size,))
+        assert self.bias.shape == (activation_size,)
+
+    def get_learned_dict(self):
+        return jnp.eye(self.n_feats)
+
+    def encode(self, batch):
+        return jax.nn.relu(batch + self.bias)
+
+
+class RandomDict(LearnedDict):
+    """Random gaussian encoder baseline (reference `learned_dict.py:88-108`)."""
+
+    def __init__(self, activation_size: int, n_feats: Optional[int] = None, key: Optional[jax.Array] = None):
+        n_feats = n_feats or activation_size
+        self.n_feats = n_feats
+        self.activation_size = activation_size
+        key = key if key is not None else jax.random.PRNGKey(0)
+        self.encoder = jax.random.normal(key, (n_feats, activation_size))
+        self.encoder_bias = jnp.zeros((n_feats,))
+
+    def get_learned_dict(self):
+        return self.encoder
+
+    def encode(self, batch):
+        c = jnp.einsum("nd,bd->bn", self.encoder, batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+
+class UntiedSAE(LearnedDict):
+    """encoder/decoder SAE export (reference `learned_dict.py:111-131`)."""
+
+    def __init__(self, encoder: jax.Array, decoder: jax.Array, encoder_bias: jax.Array):
+        self.encoder = encoder
+        self.decoder = decoder
+        self.encoder_bias = encoder_bias
+        self.n_feats, self.activation_size = encoder.shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.decoder)
+
+    def encode(self, batch):
+        c = jnp.einsum("nd,bd->bn", self.encoder, batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+
+class TiedSAE(LearnedDict):
+    """Tied SAE with optional affine whitening centering
+    (reference `learned_dict.py:134-196`): center(x) = (R @ (x - t)) * s.
+    """
+
+    def __init__(
+        self,
+        encoder: jax.Array,
+        encoder_bias: jax.Array,
+        centering: Tuple[Optional[jax.Array], Optional[jax.Array], Optional[jax.Array]] = (None, None, None),
+        norm_encoder: bool = False,
+    ):
+        self.encoder = encoder
+        self.encoder_bias = encoder_bias
+        self.norm_encoder = norm_encoder
+        self.n_feats, self.activation_size = encoder.shape
+        t, r, s = centering
+        self.center_trans = t if t is not None else jnp.zeros((self.activation_size,))
+        self.center_rot = r if r is not None else jnp.eye(self.activation_size)
+        self.center_scale = s if s is not None else jnp.ones((self.activation_size,))
+
+    def center(self, batch):
+        return jnp.einsum("cu,bu->bc", self.center_rot, batch - self.center_trans[None, :]) * self.center_scale[None, :]
+
+    def uncenter(self, batch):
+        return jnp.einsum("cu,bc->bu", self.center_rot, batch / self.center_scale[None, :]) + self.center_trans[None, :]
+
+    def get_learned_dict(self):
+        return _norm_rows(self.encoder)
+
+    def encode(self, batch):
+        encoder = _norm_rows(self.encoder) if self.norm_encoder else self.encoder
+        c = jnp.einsum("nd,bd->bn", encoder, batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+
+class ReverseSAE(LearnedDict):
+    """Tied SAE that re-subtracts the bias for active features before decode
+    (reference `learned_dict.py:199-238`).
+    """
+
+    def __init__(self, encoder: jax.Array, encoder_bias: jax.Array, norm_encoder: bool = False):
+        self.encoder = encoder
+        self.encoder_bias = encoder_bias
+        self.norm_encoder = norm_encoder
+        self.n_feats, self.activation_size = encoder.shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.encoder)
+
+    def _encoder(self):
+        return _norm_rows(self.encoder) if self.norm_encoder else self.encoder
+
+    def encode(self, batch):
+        c = jnp.einsum("nd,bd->bn", self._encoder(), batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+    def decode(self, c):
+        c = jnp.where(c > 0.0, c - self.encoder_bias[None, :], c)
+        # NOTE: the reference decodes with einsum "dn,bn->bd" here
+        # (`learned_dict.py:237`) — i.e. the *transpose* of the usual decode;
+        # we reproduce the standard "nd,bn->bd" on the tied dictionary, which
+        # is what its encode/get_learned_dict geometry implies.
+        return jnp.einsum("nd,bn->bd", self._encoder(), c)
+
+
+class ThresholdingSAE_export(LearnedDict):
+    """Inference view of the thresholding SAE (reference
+    `sae_ensemble.py:290-303`, `ThresholdingSAE`): holds the raw param dict and
+    re-applies the smooth-threshold encode.
+    """
+
+    def __init__(self, params: dict):
+        self.params = params
+        self.n_feats, self.activation_size = params["encoder"].shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.params["encoder"])
+
+    def encode(self, batch):
+        from sparse_coding__tpu.models.sae import FunctionalThresholdingSAE
+
+        return FunctionalThresholdingSAE.encode(self.params, batch, self.get_learned_dict())
+
+
+class AddedNoise(LearnedDict):
+    """Identity + gaussian noise baseline (reference `learned_dict.py:241-255`).
+
+    Stateless JAX RNG: pass a key to `encode`, or it splits an internal seed.
+    """
+
+    def __init__(self, noise_mag: float, activation_size: int, key: Optional[jax.Array] = None):
+        self.noise_mag = noise_mag
+        self.activation_size = activation_size
+        self.n_feats = activation_size
+        self._key = key if key is not None else jax.random.PRNGKey(0)
+
+    def get_learned_dict(self):
+        return jnp.eye(self.activation_size)
+
+    def encode(self, batch, key: Optional[jax.Array] = None):
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        noise = jax.random.normal(key, batch.shape) * self.noise_mag
+        return batch + noise
+
+
+class Rotation(LearnedDict):
+    """Fixed rotation dictionary (reference `learned_dict.py:258-274`)."""
+
+    def __init__(self, matrix: jax.Array):
+        self.matrix = matrix
+        self.n_feats, self.activation_size = matrix.shape
+
+    def get_learned_dict(self):
+        return self.matrix
+
+    def encode(self, batch):
+        return jnp.einsum("nd,bd->bn", self.matrix, batch)
+
+
+register_learned_dict(Identity, ())
+register_learned_dict(IdentityReLU, ("bias",))
+register_learned_dict(AddedNoise, ("_key",), ("noise_mag",))
+register_learned_dict(RandomDict, ("encoder", "encoder_bias"))
+register_learned_dict(UntiedSAE, ("encoder", "decoder", "encoder_bias"))
+register_learned_dict(
+    TiedSAE,
+    ("encoder", "encoder_bias", "center_trans", "center_rot", "center_scale"),
+    ("norm_encoder",),
+)
+register_learned_dict(ReverseSAE, ("encoder", "encoder_bias"), ("norm_encoder",))
+register_learned_dict(Rotation, ("matrix",))
+register_learned_dict(ThresholdingSAE_export, ("params",))

@@ -1,0 +1,414 @@
+"""Sparse-autoencoder training signatures (the main model family).
+
+JAX counterparts of the reference `autoencoders/sae_ensemble.py:13-501`. Every
+class implements the `DictSignature` protocol (`ensemble.DictSignature`):
+pure ``init``/``loss``/``to_learned_dict`` staticmethods over plain pytrees.
+
+Loss conventions match the reference exactly for behavioral parity:
+  - reconstruction = mean squared error over *all* elements,
+  - l1 = mean over batch of per-example L1 norms of the code,
+  - bias_decay = L2 norm of the encoder bias,
+  - decoder rows are normalized inside the loss (so the learned dictionary is
+    always unit-norm, and gradient flow sees the normalization).
+
+TPU notes: every loss is two MXU matmuls (`bd,dn->bn` and `bn,nd->bd`) plus
+fused elementwise ops; under `vmap` over the ensemble axis XLA batches them
+into single larger matmuls. Masked variants use multiply-by-mask (not
+`masked_fill_`) so the same compiled program serves every dict size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import (
+    ReverseSAE,
+    ThresholdingSAE_export,
+    TiedSAE,
+    UntiedSAE,
+    _norm_rows,
+)
+
+_glorot = jax.nn.initializers.glorot_uniform()
+
+
+def _l1(c: jax.Array) -> jax.Array:
+    return jnp.abs(c).sum(axis=-1).mean()
+
+
+def _safe_l2(x: jax.Array) -> jax.Array:
+    """L2 norm with a zero (not NaN) gradient at x == 0, matching the
+    subgradient PyTorch uses for `torch.norm` (the biases are zero-initialized,
+    so the naive norm would poison the very first step with 0 * NaN)."""
+    return jnp.sqrt(jnp.maximum(jnp.sum(x**2), 1e-24))
+
+
+class FunctionalSAE:
+    """Untied SAE: ReLU(Ex + b) → normalized-decoder reconstruction.
+
+    Reference: `autoencoders/sae_ensemble.py:13-77`.
+    """
+
+    @staticmethod
+    def init(
+        key: jax.Array,
+        activation_size: int,
+        n_dict_components: int,
+        l1_alpha: float,
+        bias_decay: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+            "decoder": _glorot(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def encode(params, buffers, batch):
+        c = jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
+        return jax.nn.relu(c)
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        c = FunctionalSAE.encode(params, buffers, batch)
+        learned_dict = _norm_rows(params["decoder"])
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {
+            "loss": total,
+            "l_reconstruction": l_reconstruction,
+            "l_l1": l_l1,
+            "l_bias_decay": l_bias_decay,
+        }
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return UntiedSAE(params["encoder"], params["decoder"], params["encoder_bias"])
+
+
+class FunctionalTiedSAE:
+    """Tied SAE (encoder = normalized dictionary) with optional affine
+    whitening centering stored in buffers.
+
+    Reference: `autoencoders/sae_ensemble.py:80-160`. The default model for the
+    paper sweeps.
+    """
+
+    @staticmethod
+    def init(
+        key: jax.Array,
+        activation_size: int,
+        n_dict_components: int,
+        l1_alpha: float,
+        bias_decay: float = 0.0,
+        translation: Optional[jax.Array] = None,
+        rotation: Optional[jax.Array] = None,
+        scaling: Optional[jax.Array] = None,
+        dtype=jnp.float32,
+    ):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {
+            "center_rot": rotation if rotation is not None else jnp.eye(activation_size, dtype=dtype),
+            "center_trans": translation if translation is not None else jnp.zeros((activation_size,), dtype),
+            "center_scale": scaling if scaling is not None else jnp.ones((activation_size,), dtype),
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def center(buffers, batch):
+        return (
+            jnp.einsum("cu,bu->bc", buffers["center_rot"], batch - buffers["center_trans"][None, :])
+            * buffers["center_scale"][None, :]
+        )
+
+    @staticmethod
+    def uncenter(buffers, batch):
+        return (
+            jnp.einsum("cu,bc->bu", buffers["center_rot"], batch / buffers["center_scale"][None, :])
+            + buffers["center_trans"][None, :]
+        )
+
+    @staticmethod
+    def encode(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        batch = FunctionalTiedSAE.center(buffers, batch)
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        return jax.nn.relu(c)
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        batch_centered = FunctionalTiedSAE.center(buffers, batch)
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch_centered) + params["encoder_bias"]
+        c = jax.nn.relu(c)
+        x_hat_centered = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat_centered - batch_centered) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return TiedSAE(
+            params["encoder"],
+            params["encoder_bias"],
+            centering=(buffers["center_trans"], buffers["center_rot"], buffers["center_scale"]),
+            norm_encoder=True,
+        )
+
+
+class FunctionalTiedCenteredSAE:
+    """Tied SAE with a *learnable* center translation.
+
+    Reference: `autoencoders/sae_ensemble.py:162-228`.
+    """
+
+    @staticmethod
+    def init(
+        key: jax.Array,
+        activation_size: int,
+        n_dict_components: int,
+        l1_alpha: float,
+        center: Optional[jax.Array] = None,
+        dtype=jnp.float32,
+    ):
+        params = {
+            "center": center if center is not None else jnp.zeros((activation_size,), dtype),
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        batch_centered = batch - params["center"][None, :]
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch_centered) + params["encoder_bias"]
+        c = jax.nn.relu(c)
+        x_hat_centered = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat_centered - batch_centered) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return TiedSAE(
+            params["encoder"],
+            params["encoder_bias"],
+            centering=(params["center"], None, None),
+            norm_encoder=True,
+        )
+
+
+class FunctionalThresholdingSAE:
+    """Smooth relu6-based soft-thresholding encoder with learnable
+    per-feature scale/gain.
+
+    Reference: `autoencoders/sae_ensemble.py:230-287`. (The reference `encode`
+    subtracts a ``params["centering"]`` that its own `init` never creates —
+    `sae_ensemble.py:250` — we include it, zero-initialized, so encode works.)
+    """
+
+    @staticmethod
+    def init(key, activation_size, n_dict_components, l1_alpha, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "activation_scale": jnp.ones((n_dict_components,), dtype),
+            "activation_gain": jnp.zeros((n_dict_components,), dtype),
+            "centering": jnp.zeros((activation_size,), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, batch, learned_dict):
+        batch = batch - params["centering"][None, :]
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch)
+        a_sq = params["activation_scale"] ** 2
+        c = (c + params["activation_gain"]) / jnp.clip(a_sq, 1e-8, None)
+        relu6 = lambda x: jnp.clip(x, 0.0, 6.0)
+        c = relu6(60.0 * (c - 0.9)) / 6.0 + jax.nn.relu(c - 1.0)
+        return c * a_sq
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        c = FunctionalThresholdingSAE.encode(params, batch, learned_dict)
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return ThresholdingSAE_export(params)
+
+
+class FunctionalMaskedTiedSAE:
+    """Tied SAE padded to `n_components_stack` with a coefficient mask, so
+    *different dict sizes* can share one vmap stack.
+
+    Reference: `autoencoders/sae_ensemble.py:307-371`. The mask convention
+    matches the reference's `coef_mask` (True = masked OUT / unused); we apply
+    it as a multiply (`c * keep`) rather than `masked_fill_` — same math,
+    XLA-fusable, and vmap-friendly.
+    """
+
+    @staticmethod
+    def init(
+        key,
+        activation_size,
+        n_dict_components,
+        n_components_stack,
+        l1_alpha,
+        bias_decay: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        params = {
+            "encoder": _glorot(key, (n_components_stack, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_components_stack,), dtype),
+        }
+        keep = (jnp.arange(n_components_stack) < n_dict_components)
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "dict_size": jnp.asarray(n_dict_components, jnp.int32),
+            "coef_keep": keep.astype(dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        c = jax.nn.relu(c) * buffers["coef_keep"][None, :]
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        n = int(buffers["dict_size"])
+        return TiedSAE(params["encoder"][:n], params["encoder_bias"][:n], norm_encoder=True)
+
+
+class FunctionalMaskedSAE:
+    """Untied masked SAE (different dict sizes in one stack).
+
+    Reference: `autoencoders/sae_ensemble.py:375-442`.
+    """
+
+    @staticmethod
+    def init(
+        key,
+        activation_size,
+        n_dict_components,
+        n_components_stack,
+        l1_alpha,
+        bias_decay: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_components_stack, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_components_stack,), dtype),
+            "decoder": _glorot(k_dec, (n_components_stack, activation_size), dtype),
+        }
+        keep = (jnp.arange(n_components_stack) < n_dict_components)
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "dict_size": jnp.asarray(n_dict_components, jnp.int32),
+            "coef_keep": keep.astype(dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["decoder"])
+        c = jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
+        c = jax.nn.relu(c) * buffers["coef_keep"][None, :]
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        total = l_reconstruction + l_l1
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        n = int(buffers["dict_size"])
+        return UntiedSAE(params["encoder"][:n], params["decoder"][:n], params["encoder_bias"][:n])
+
+
+class FunctionalReverseSAE:
+    """Tied SAE that subtracts the bias again for active features pre-decode.
+
+    Reference: `autoencoders/sae_ensemble.py:445-501`. The boolean-indexed
+    in-place update of the reference (`:481-482`) becomes a `jnp.where` — same
+    values, trace-safe.
+    """
+
+    @staticmethod
+    def init(key, activation_size, n_dict_components, l1_alpha, bias_decay=0.0, dtype=jnp.float32):
+        params = {
+            "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        c = jax.nn.relu(c)
+        c = jnp.where(c > 0.0, c - params["encoder_bias"][None, :], c)
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {
+            "loss": total,
+            "l_reconstruction": l_reconstruction,
+            "l_l1": l_l1,
+            "l_bias_decay": l_bias_decay,
+        }
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return ReverseSAE(params["encoder"], params["encoder_bias"], norm_encoder=True)

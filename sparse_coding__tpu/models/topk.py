@@ -1,0 +1,88 @@
+"""k-sparse (top-k) encoder.
+
+Counterpart of the reference `autoencoders/topk_encoder.py:8-62`. The reference
+trains top-k models with `no_stacking=True` (a Python loop over models,
+`big_sweep_experiments.py:246-253`) because `torch.topk` takes a Python-int k
+that differs per ensemble member. Here the top-k selection is *vmappable with a
+traced k*: we compute each score's rank within its row (two argsorts — a fixed-
+shape sort network XLA maps well to TPU) and keep entries with rank < k. A whole
+sparsity sweep therefore runs as ONE stacked jit program — no Python loop, no
+padding bookkeeping. For static k (inference) `jax.lax.top_k` is used instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, _norm_rows, register_learned_dict
+
+
+def topk_mask_code(scores: jax.Array, k) -> jax.Array:
+    """Zero all but the top-`k` entries of each row. `k` may be traced.
+
+    Ties are broken by position (stable argsort), matching `torch.topk`'s
+    deterministic behavior closely enough for training parity.
+    """
+    ranks = jnp.argsort(jnp.argsort(-scores, axis=-1), axis=-1)
+    return jnp.where(ranks < k, scores, 0.0)
+
+
+def topk_mask_code_static(scores: jax.Array, k: int) -> jax.Array:
+    """Static-k fast path via `lax.top_k` + scatter."""
+    top_vals, top_idx = jax.lax.top_k(scores, k)
+    rows = jnp.arange(scores.shape[0])[:, None]
+    return jnp.zeros_like(scores).at[rows, top_idx].set(top_vals)
+
+
+class TopKEncoder:
+    """DictSignature for the k-sparse autoencoder.
+
+    Reference `TopKEncoder` (`topk_encoder.py:8-46`): scores = normed_dict @ x,
+    keep the top-k scores, ReLU, MSE-only loss. `sparsity` lives in buffers as
+    a 0-d int32 so it can vary across ensemble members under vmap.
+    """
+
+    @staticmethod
+    def init(key, d_activation, n_features, sparsity, dtype=jnp.float32):
+        params = {"dict": jax.random.normal(key, (n_features, d_activation), dtype)}
+        buffers = {"sparsity": jnp.asarray(sparsity, jnp.int32)}
+        return params, buffers
+
+    @staticmethod
+    def encode(batch, sparsity, normed_dict):
+        scores = jnp.einsum("ij,bj->bi", normed_dict, batch)
+        code = topk_mask_code(scores, sparsity)
+        return jax.nn.relu(code)
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        normed_dict = _norm_rows(params["dict"])
+        code = TopKEncoder.encode(batch, buffers["sparsity"], normed_dict)
+        x_hat = jnp.einsum("ij,bi->bj", normed_dict, code)
+        loss = jnp.mean((batch - x_hat) ** 2)
+        return loss, ({"loss": loss}, {"c": code})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return TopKLearnedDict(_norm_rows(params["dict"]), int(buffers["sparsity"]))
+
+
+class TopKLearnedDict(LearnedDict):
+    """Inference view (reference `topk_encoder.py:49-62`)."""
+
+    def __init__(self, dictionary: jax.Array, sparsity: int):
+        self.dict = dictionary
+        self.sparsity = int(sparsity)
+        self.n_feats, self.activation_size = dictionary.shape
+
+    def get_learned_dict(self):
+        return self.dict
+
+    def encode(self, x):
+        scores = jnp.einsum("ij,bj->bi", self.dict, x)
+        code = topk_mask_code_static(scores, self.sparsity)
+        return jax.nn.relu(code)
+
+
+register_learned_dict(TopKLearnedDict, ("dict",), ("sparsity",))

@@ -1,0 +1,174 @@
+"""Tests for the stacked-ensemble runtime (core of the framework).
+
+Covers what the reference never tested (SURVEY.md §4): the vmapped ensemble
+step itself — per-member independence, hyperparameter effect, stack/unstack
+round-trips, per-model batches, and the `lax.map` unstacked escape hatch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu import Ensemble, build_ensemble, stack_pytrees, unstack_pytree
+from sparse_coding__tpu.models import FunctionalSAE, FunctionalTiedSAE, TopKEncoder
+from sparse_coding__tpu.data import RandomDatasetGenerator
+
+D_ACT = 32
+N_DICT = 64
+
+
+def make_gen(batch_size=128, seed=0):
+    return RandomDatasetGenerator(
+        activation_dim=D_ACT,
+        n_ground_truth_components=48,
+        batch_size=batch_size,
+        feature_num_nonzero=4,
+        feature_prob_decay=0.99,
+        correlated=False,
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+def test_build_and_step_reduces_loss():
+    gen = make_gen()
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 3e-4}, {"l1_alpha": 1e-3}],
+        optimizer="adam",
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    batch = next(gen)
+    loss0, _ = ens.step_batch(batch)
+    for _ in range(50):
+        loss_dict, aux = ens.step_batch(next(gen))
+    assert loss_dict["loss"].shape == (3,)
+    assert aux["c"].shape == (3, 128, N_DICT)
+    assert np.all(np.asarray(loss_dict["loss"]) < np.asarray(loss0["loss"]))
+
+
+def test_members_independent():
+    """Training N stacked models == training them separately."""
+    gen = make_gen()
+    batches = [next(gen) for _ in range(5)]
+
+    key = jax.random.PRNGKey(42)
+    hps = [{"l1_alpha": 0.0}, {"l1_alpha": 1e-3}]
+    ens = build_ensemble(
+        FunctionalSAE,
+        key,
+        hps,
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    for b in batches:
+        ens.step_batch(b)
+    stacked_out = ens.unstack()
+
+    # train each member alone with identical init
+    keys = jax.random.split(key, 2)
+    for i, hp in enumerate(hps):
+        solo = Ensemble(
+            [FunctionalSAE.init(keys[i], D_ACT, N_DICT, **hp)],
+            FunctionalSAE,
+            optimizer_kwargs={"learning_rate": 1e-3},
+        )
+        for b in batches:
+            solo.step_batch(b)
+        solo_params, _ = solo.unstack()[0]
+        np.testing.assert_allclose(
+            np.asarray(solo_params["encoder"]),
+            np.asarray(stacked_out[i][0]["encoder"]),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+    # different l1 ⇒ different trained params
+    assert not np.allclose(
+        np.asarray(stacked_out[0][0]["encoder"]), np.asarray(stacked_out[1][0]["encoder"])
+    )
+
+
+def test_stack_unstack_roundtrip():
+    trees = [
+        {"a": jnp.arange(3.0), "b": {"c": jnp.ones((2, 2)) * i}} for i in range(4)
+    ]
+    stacked = stack_pytrees(trees)
+    assert stacked["a"].shape == (4, 3)
+    back = unstack_pytree(stacked, 4)
+    for orig, rec in zip(trees, back):
+        np.testing.assert_array_equal(np.asarray(orig["b"]["c"]), np.asarray(rec["b"]["c"]))
+
+
+def test_per_model_batches():
+    gen = make_gen(batch_size=64)
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(1),
+        [{"l1_alpha": 1e-4}] * 4,
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    per_model = jnp.stack([next(gen) for _ in range(4)])
+    loss_dict, _ = ens.step_batch(per_model, per_model=True)
+    assert loss_dict["loss"].shape == (4,)
+
+
+def test_unstacked_escape_hatch_matches_vmap():
+    batches = [next(make_gen(seed=3)) for _ in range(3)]
+    key = jax.random.PRNGKey(7)
+    models = [
+        FunctionalTiedSAE.init(k, D_ACT, N_DICT, l1_alpha=1e-4)
+        for k in jax.random.split(key, 2)
+    ]
+    ens_v = Ensemble(models, FunctionalTiedSAE, optimizer_kwargs={"learning_rate": 1e-3})
+    ens_u = Ensemble(
+        models, FunctionalTiedSAE, optimizer_kwargs={"learning_rate": 1e-3}, unstacked=True
+    )
+    for b in batches:
+        lv, _ = ens_v.step_batch(b)
+        lu, _ = ens_u.step_batch(b)
+    np.testing.assert_allclose(np.asarray(lv["loss"]), np.asarray(lu["loss"]), rtol=1e-5)
+
+
+def test_topk_heterogeneous_sparsity_in_one_stack():
+    """Different k per member trains in one vmapped program (the reference
+    needed a Python process/loop for this, `ensemble.py:100-116`)."""
+    gen = make_gen()
+    ens = build_ensemble(
+        TopKEncoder,
+        jax.random.PRNGKey(0),
+        [{"sparsity": 2}, {"sparsity": 8}, {"sparsity": 16}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        d_activation=D_ACT,
+        n_features=N_DICT,
+    )
+    for _ in range(3):
+        loss_dict, aux = ens.step_batch(next(gen))
+    l0 = np.asarray((aux["c"] != 0).sum(axis=-1).mean(axis=-1))
+    assert l0[0] <= 2 + 1e-6 and l0[1] <= 8 + 1e-6 and l0[2] <= 16 + 1e-6
+    # members with larger k should reconstruct no worse after the same steps
+    dicts = ens.to_learned_dicts()
+    assert dicts[0].sparsity == 2 and dicts[2].sparsity == 16
+
+
+def test_state_dict_roundtrip():
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(5),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    gen = make_gen(seed=9)
+    b0, b1 = next(gen), next(gen)
+    ens.step_batch(b0)
+    sd = ens.state_dict()
+    clone = Ensemble.from_state(sd)
+    l_a, _ = ens.step_batch(b1)
+    l_b, _ = clone.step_batch(b1)
+    np.testing.assert_allclose(np.asarray(l_a["loss"]), np.asarray(l_b["loss"]), rtol=1e-6)

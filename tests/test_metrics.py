@@ -1,0 +1,97 @@
+"""Metric-library tests, mirroring the reference's valuable patterns
+(SURVEY.md §4): streaming moments vs exact moments, plus MMCS sanity
+properties the reference never asserted.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.metrics import (
+    calc_moments_streaming,
+    capacity_per_feature,
+    fraction_variance_unexplained,
+    hungarian_matched_mcs,
+    mean_nonzero_activations,
+    mmcs,
+    mmcs_from_list,
+    mmcs_to_fixed,
+    neurons_per_feature,
+    representedness,
+    sparsity_l0,
+)
+from sparse_coding__tpu.models import Identity, Rotation, TiedSAE, UntiedSAE
+
+
+class _IdentityEncode:
+    """Inline fake LearnedDict — the analogue of the reference's only mock
+    (`test/test_stats_batched.py:15`)."""
+
+    n_feats = 1
+
+    def encode(self, x):
+        return x
+
+
+def test_streaming_moments_match_exact():
+    key = jax.random.PRNGKey(0)
+    data = jax.random.normal(key, (10000, 1)) * 2.0 + 0.5
+    _, mean, var, skew, kurt, m4 = calc_moments_streaming(_IdentityEncode(), data, batch_size=1000)
+    x = np.asarray(data)[:, 0]
+    np.testing.assert_allclose(float(mean[0]), x.mean(), rtol=1e-4)
+    np.testing.assert_allclose(float(var[0]), x.var(), rtol=1e-3)
+    exp_skew = (x**3).mean() / x.var() ** 1.5
+    exp_kurt = (x**4).mean() / x.var() ** 2
+    np.testing.assert_allclose(float(skew[0]), exp_skew, rtol=1e-3)
+    np.testing.assert_allclose(float(kurt[0]), exp_kurt, rtol=1e-3)
+
+
+def test_mmcs_self_is_one():
+    d = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    ld = Rotation(d / jnp.linalg.norm(d, axis=-1, keepdims=True))
+    assert float(mmcs(ld, ld)) > 0.999
+    m = mmcs_from_list([ld, ld, ld])
+    assert np.allclose(np.asarray(m), 1.0, atol=1e-3)
+
+
+def test_mmcs_to_fixed_permutation_invariant():
+    key = jax.random.PRNGKey(2)
+    d = jax.random.normal(key, (16, 8))
+    d = d / jnp.linalg.norm(d, axis=-1, keepdims=True)
+    perm = jax.random.permutation(key, 16)
+    assert float(mmcs_to_fixed(Rotation(d[perm]), d)) > 0.999
+    sims, _ = hungarian_matched_mcs(Rotation(d[perm]), d)
+    assert np.allclose(np.asarray(sims), 1.0, atol=1e-5)
+
+
+def test_representedness_detects_missing_feature():
+    d = jnp.eye(8)
+    model = Rotation(d[:4])  # only half the features represented
+    r = np.asarray(representedness(d, model))
+    assert np.allclose(r[:4], 1.0, atol=1e-6)
+    assert np.all(r[4:] < 0.5)
+
+
+def test_fvu_perfect_and_null():
+    batch = jax.random.normal(jax.random.PRNGKey(3), (256, 8))
+    ident = Identity(8)
+    assert float(fraction_variance_unexplained(ident, batch)) < 1e-6
+    # a dict that predicts ~0 has FVU ~ ||x||^2 / var(x) >= 1
+    zero_sae = UntiedSAE(jnp.zeros((4, 8)), jnp.zeros((4, 8)), jnp.zeros((4,)))
+    assert float(fraction_variance_unexplained(zero_sae, batch)) >= 0.99
+
+
+def test_sparsity_counts():
+    enc = jnp.eye(8)
+    sae = TiedSAE(enc, jnp.zeros((8,)))
+    batch = jnp.zeros((10, 8)).at[:, 0].set(1.0).at[:, 3].set(2.0)
+    assert float(sparsity_l0(sae, batch)) == 2.0
+    freq = np.asarray(mean_nonzero_activations(sae, batch))
+    assert freq[0] == 1.0 and freq[3] == 1.0 and freq[1] == 0.0
+
+
+def test_capacity_orthonormal_sums_to_n():
+    ld = Rotation(jnp.eye(8))
+    caps = np.asarray(capacity_per_feature(ld))
+    np.testing.assert_allclose(caps, 1.0, atol=1e-6)
+    assert abs(float(neurons_per_feature(ld)) - 1.0) < 1e-5

@@ -1,0 +1,61 @@
+"""Golden-metric regression: an SAE ensemble trained on synthetic sparse data
+must recover the planted dictionary (MMCS to ground truth high, FVU low).
+
+This is the ground-truth end-to-end test the survey recommends as the primary
+regression suite (SURVEY.md §4, §7 stage 2) — the reference computes these
+metrics but never asserts on them.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu import build_ensemble
+from sparse_coding__tpu.data import RandomDatasetGenerator
+from sparse_coding__tpu.metrics import (
+    fraction_variance_unexplained,
+    mmcs_to_fixed,
+    sparsity_l0,
+)
+from sparse_coding__tpu.models import FunctionalTiedSAE
+
+
+@pytest.mark.slow
+def test_tied_sae_recovers_planted_dictionary():
+    d_act, n_truth, n_dict = 64, 96, 128
+    gen = RandomDatasetGenerator(
+        activation_dim=d_act,
+        n_ground_truth_components=n_truth,
+        batch_size=1024,
+        feature_num_nonzero=5,
+        feature_prob_decay=1.0,
+        correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(1),
+        [{"l1_alpha": 1e-3}, {"l1_alpha": 3e-3}],
+        optimizer_kwargs={"learning_rate": 3e-3},
+        activation_size=d_act,
+        n_dict_components=n_dict,
+    )
+    for _ in range(800):
+        ens.step_batch(next(gen))
+
+    batch = next(gen)
+    scores = []
+    for ld in ens.to_learned_dicts():
+        m = float(mmcs_to_fixed(ld, gen.feats))
+        fvu = float(fraction_variance_unexplained(ld, batch))
+        l0 = float(sparsity_l0(ld, batch))
+        scores.append((m, fvu, l0))
+    best_mmcs = max(s[0] for s in scores)
+    best_fvu = min(s[1] for s in scores)
+    # random 128-atom dicts score ~0.4 MMCS against this ground truth; a
+    # correctly-training tied SAE plateaus ≈0.75-0.8 without dead-feature
+    # resampling (tracked upward as resampling lands)
+    assert best_mmcs > 0.70, f"dictionary not recovered: {scores}"
+    assert best_fvu < 0.25, f"poor reconstruction: {scores}"
+    # sparse codes, not dense: far fewer active features than dict size
+    assert all(s[2] < n_dict / 2 for s in scores), scores

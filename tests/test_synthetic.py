@@ -1,0 +1,53 @@
+"""Synthetic-generator tests (reference has none for `sc_datasets/`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.data import RandomDatasetGenerator, SparseMixDataset
+
+
+def test_random_generator_shapes_and_determinism():
+    gen_a = RandomDatasetGenerator(16, 32, 64, 4, 0.99, False, jax.random.PRNGKey(0))
+    gen_b = RandomDatasetGenerator(16, 32, 64, 4, 0.99, False, jax.random.PRNGKey(0))
+    a, b = next(gen_a), next(gen_b)
+    assert a.shape == (64, 16)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = next(gen_a)
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_ground_truth_feats_unit_norm():
+    gen = RandomDatasetGenerator(16, 32, 64, 4, 0.99, False, jax.random.PRNGKey(1))
+    norms = np.asarray(jnp.linalg.norm(gen.feats, axis=-1))
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+def test_sparsity_density_roughly_matches():
+    n_comp, nonzero = 64, 8
+    gen = RandomDatasetGenerator(32, n_comp, 4096, nonzero, 1.0, False, jax.random.PRNGKey(2))
+    from sparse_coding__tpu.data.synthetic import sample_rand_dataset
+
+    gen._key, k = jax.random.split(gen._key)
+    codes, _ = sample_rand_dataset(k, gen.feats, gen.component_probs, n_comp, 4096)
+    mean_active = float((np.asarray(codes) != 0).sum(axis=1).mean())
+    assert abs(mean_active - nonzero) < 1.0
+
+
+def test_correlated_generator_no_empty_rows():
+    gen = RandomDatasetGenerator(16, 32, 512, 4, 0.99, True, jax.random.PRNGKey(3))
+    from sparse_coding__tpu.data.synthetic import sample_correlated_dataset
+
+    gen._key, k = jax.random.split(gen._key)
+    codes, data = sample_correlated_dataset(
+        k, gen.corr_chol, gen.feats, gen.frac_nonzero, gen.decay, 32, 512
+    )
+    assert data.shape == (512, 16)
+    assert int(((np.asarray(codes) != 0).sum(axis=1) == 0).sum()) == 0
+
+
+def test_sparse_mix_dataset():
+    ds = SparseMixDataset(16, 32, 128, 4, 0.99, 0.05, jax.random.PRNGKey(4))
+    batch = next(ds)
+    assert batch.shape == (128, 16)
+    assert ds.send(64).shape == (64, 16)
