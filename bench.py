@@ -1,0 +1,82 @@
+"""Headline benchmark: ensemble-SAE training throughput on one TPU chip.
+
+Workload: the reference paper's core sweep shape (8-member L1-sweep ensemble of
+tied SAEs on Pythia-70M-sized activations: d_activation=512, 8x overcomplete
+dict=4096, batch 2048 — cf. `big_sweep_experiments.py:295-341` and
+BASELINE.json config 2), trained with the fused vmapped step. Data is
+generated on device so the number measures training compute throughput.
+
+Metric: activation vectors consumed per second per chip (each vector is
+processed by all 8 ensemble members — fwd+bwd+adam).
+
+vs_baseline: ratio against an analytic A100 estimate of the same workload,
+since the reference publishes no numbers (BASELINE.md): 8 members x 6
+matmul-FLOPs x 512 x 4096 x (fwd+2 bwd) ≈ 201 MFLOP per activation vector;
+A100 bf16 at a generous 50% MXU utilization ≈ 156 TFLOP/s → ~0.78M
+activations/sec. (The BASELINE.json north star is 3x this per chip on a
+v4-32 pod; this bench reports the single-chip number.)
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+N_MODELS, D_ACT, N_DICT, BATCH = 8, 512, 4096, 2048
+A100_BASELINE_ACTS_PER_SEC = 0.78e6
+
+
+def main():
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(N_MODELS)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT,
+        n_ground_truth_components=2 * D_ACT,
+        batch_size=BATCH,
+        feature_num_nonzero=8,
+        feature_prob_decay=0.996,
+        correlated=False,
+        key=jax.random.PRNGKey(1),
+    )
+    batches = [next(gen) for _ in range(8)]
+
+    # warmup / compile. NOTE: block_until_ready does not actually wait on
+    # tunneled TPU backends (axon) — fetching the value is the only reliable
+    # completion barrier, so we device_get the (tiny) loss vector.
+    for b in batches[:3]:
+        loss, _ = ens.step_batch(b)
+    jax.device_get(loss["loss"])
+
+    n_steps = 60
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        loss, _ = ens.step_batch(batches[i % len(batches)])
+    jax.device_get(loss["loss"])
+    dt = time.perf_counter() - t0
+
+    acts_per_sec = n_steps * BATCH / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ensemble_sae_train_throughput (8x tied-SAE 512->4096, batch 2048)",
+                "value": round(acts_per_sec, 1),
+                "unit": "activations/sec/chip",
+                "vs_baseline": round(acts_per_sec / A100_BASELINE_ACTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

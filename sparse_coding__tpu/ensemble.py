@@ -215,6 +215,25 @@ class Ensemble:
         self._step = jax.jit(step, donate_argnums=donate_argnums)
         self._step_pm = jax.jit(step_pm, donate_argnums=donate_argnums)
 
+    # -- scale-out -----------------------------------------------------------
+
+    def shard(self, mesh, shard_dict: bool = True) -> "Ensemble":
+        """Distribute the ensemble over a device mesh (in place).
+
+        Members go on the mesh's "model" axis, dictionary components
+        (optionally) on "dict"; subsequent `step_batch` calls shard incoming
+        batches on "data". This single call replaces the reference's
+        process-per-GPU dispatch (`cluster_runs.py:100-157`) — the jitted step
+        is SPMD-partitioned by XLA, with gradient/decode collectives over ICI.
+        """
+        from sparse_coding__tpu.parallel import mesh as mesh_lib
+
+        self.state = mesh_lib.shard_state(self.state, mesh, self.n_models, shard_dict)
+        self._mesh = mesh
+        self._batch_sharding = mesh_lib.batch_sharding(mesh)
+        self._pm_batch_sharding = mesh_lib.per_model_batch_sharding(mesh)
+        return self
+
     # -- training ------------------------------------------------------------
 
     def step_batch(self, batch: jax.Array, per_model: bool = False):
@@ -225,6 +244,9 @@ class Ensemble:
         host syncs in the hot loop (cf. the reference's per-batch `.item()`
         logging stall, `big_sweep.py:224-228`).
         """
+        if getattr(self, "_mesh", None) is not None:
+            sharding = self._pm_batch_sharding if per_model else self._batch_sharding
+            batch = jax.device_put(batch, sharding)
         fn = self._step_pm if per_model else self._step
         self.state, (loss_dict, aux) = fn(self.state, batch)
         return loss_dict, aux

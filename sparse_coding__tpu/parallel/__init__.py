@@ -1,0 +1,16 @@
+from sparse_coding__tpu.parallel.mesh import (
+    DATA_AXIS,
+    DICT_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    default_mesh_shape,
+    infer_state_specs,
+    make_mesh,
+    per_model_batch_sharding,
+    shard_state,
+)
+from sparse_coding__tpu.parallel.distributed import (
+    host_local_to_global,
+    initialize_distributed,
+    local_batch_slice,
+)

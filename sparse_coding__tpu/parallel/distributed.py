@@ -1,0 +1,66 @@
+"""Multi-host initialization and collective-layout helpers.
+
+TPU-native replacement for the reference's communication backends (SURVEY.md
+§2.4 P6): host shared memory + `mp.Value` flags (`cluster_runs.py:101-154`) and
+the gloo process group (`experiments/huge_batch_size.py:337-345`). On TPU pods
+there is one controller process per host; `jax.distributed.initialize` wires
+them into a single logical device set, and the `(model, data, dict)` mesh spans
+all hosts. Collectives ride ICI within a slice and DCN across slices — the mesh
+axis order in `parallel.mesh.make_mesh` puts the fastest-varying axis ("dict",
+the chattiest: per-matmul psums) innermost so it lands on ICI neighbors.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-host JAX if running in a pod; no-op single-host.
+
+    Safe to call unconditionally: when no coordinator is configured (env or
+    args) and the TPU runtime doesn't provide one, this returns False and the
+    framework runs single-host.
+    """
+    configured = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    in_tpu_pod = "TPU_WORKER_HOSTNAMES" in os.environ or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    if not configured and not in_tpu_pod:
+        return False
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    # a genuine init failure (unreachable coordinator, timeout) must propagate:
+    # swallowing it would silently split-brain the pod into independent
+    # single-host runs with no gradient sync.
+    jax.distributed.initialize(
+        coordinator_address=configured,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's slice of a globally-sharded batch (for host-side loaders
+    feeding `jax.make_array_from_process_local_data`)."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} hosts")
+    per_host = global_batch // n
+    start = jax.process_index() * per_host
+    return slice(start, start + per_host)
+
+
+def host_local_to_global(batch, mesh, spec):
+    """Assemble per-host batch shards into one global device array."""
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), batch
+    )

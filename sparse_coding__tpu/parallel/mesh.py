@@ -1,0 +1,130 @@
+"""Device-mesh conventions and sharding inference for stacked ensembles.
+
+This module replaces the reference's entire multi-device machinery — the
+process-per-ensemble-per-GPU dispatch with host shared memory
+(`cluster_runs.py:100-157`), the device-list popping placement
+(`big_sweep_experiments.py:49-66`), and the gloo DDP experiment
+(`experiments/huge_batch_size.py:259-345`) — with a single-controller JAX mesh
+(SURVEY.md §2.4 P1-P6):
+
+  axis "model" — ensemble/task parallelism (P1+P2): stacked ensemble members
+                 are sharded across devices; no processes, no shared memory.
+  axis "data"  — data parallelism (P3): the activation batch is sharded;
+                 XLA inserts the gradient psum over ICI (the DDP allreduce).
+                 Because SAE training data is a flattened (batch×seq)
+                 activation stream, this axis IS the sequence-parallel axis —
+                 there is no separate ring/Ulysses dimension to shard
+                 (SURVEY.md §5 "long-context: absent by construction").
+  axis "dict"  — tensor parallelism (P5): `n_dict_components` of each member
+                 is sharded for ≥32× overcomplete dictionaries; the decode
+                 einsum contracts over it, XLA inserts the psum.
+
+Multi-host: the same mesh spans hosts via `jax.distributed.initialize` (see
+`parallel/distributed.py`); ICI carries in-slice collectives, DCN cross-slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+DATA_AXIS = "data"
+DICT_AXIS = "dict"
+
+
+def make_mesh(
+    model: int = 1,
+    data: int = 1,
+    dict_: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a `(model, data, dict)` mesh over the given (default: all) devices.
+
+    Axis sizes must multiply to the device count. Axes of size 1 are kept in
+    the mesh (harmless) so downstream PartitionSpecs are uniform.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = model * data * dict_
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {model}x{data}x{dict_} needs {n} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(model, data, dict_)
+    return Mesh(dev_array, (MODEL_AXIS, DATA_AXIS, DICT_AXIS))
+
+
+def default_mesh_shape(n_devices: int, n_models: int = 1, want_dict: bool = False):
+    """Heuristic (model, data, dict) factorization of `n_devices`.
+
+    Greedy: give the model axis the largest divisor of `n_devices` that
+    divides `n_models` (ensemble members are embarrassingly parallel — the
+    cheapest axis); optionally carve a dict axis of 2; the rest is data.
+    """
+    model = 1
+    for cand in range(min(n_models, n_devices), 0, -1):
+        if n_devices % cand == 0 and n_models % cand == 0:
+            model = cand
+            break
+    rest = n_devices // model
+    dict_ = 2 if (want_dict and rest % 2 == 0) else 1
+    data = rest // dict_
+    return model, data, dict_
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a `[batch, d_activation]` batch shared by all members:
+    batch dim over the data axis, features replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def per_model_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a `[n_models, batch, d_activation]` per-member batch."""
+    return NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS, None))
+
+
+def infer_state_specs(state, n_models: int, mesh: Mesh, shard_dict: bool = True):
+    """PartitionSpec pytree for an `EnsembleState`.
+
+    Rules (per leaf):
+      - leading dim == n_models → that dim goes on the model axis;
+      - for rank≥2 leaves with the model axis assigned, the next dim goes on
+        the dict axis when divisible by its size (this captures encoder /
+        decoder / bias / optimizer moments, whose dim 1 is n_dict_components;
+        it also shards e.g. whitening matrices on their first non-model dim,
+        which is a valid, memory-saving layout);
+      - everything else replicated.
+
+    Optimizer state leaves (adam mu/nu) mirror the param shapes, so the same
+    shape rule shards them identically — keeping update math local.
+    """
+    dict_size = mesh.shape[DICT_AXIS] if shard_dict else 1
+    model_size = mesh.shape[MODEL_AXIS]
+    if n_models % model_size != 0:
+        raise ValueError(
+            f"n_models={n_models} must be divisible by the mesh model axis "
+            f"({model_size}); pad the ensemble or resize the mesh"
+        )
+
+    def leaf_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or shape[0] != n_models:
+            return P()
+        axes = [MODEL_AXIS]
+        if len(shape) >= 2 and dict_size > 1 and shape[1] % dict_size == 0:
+            axes.append(DICT_AXIS)
+        axes += [None] * (len(shape) - len(axes))
+        return P(*axes)
+
+    return jax.tree.map(leaf_spec, state)
+
+
+def shard_state(state, mesh: Mesh, n_models: int, shard_dict: bool = True):
+    """`device_put` an EnsembleState onto the mesh per `infer_state_specs`."""
+    specs = infer_state_specs(state, n_models, mesh, shard_dict)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), state, specs
+    )
