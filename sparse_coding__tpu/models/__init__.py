@@ -19,3 +19,26 @@ from sparse_coding__tpu.models.sae import (
     FunctionalTiedSAE,
 )
 from sparse_coding__tpu.models.topk import TopKEncoder, TopKLearnedDict
+from sparse_coding__tpu.models.fista import (
+    Fista,
+    FunctionalFista,
+    dictionary_update,
+    fista,
+    power_iteration_max_eig,
+    quadratic_basis_update,
+)
+from sparse_coding__tpu.models.lista import (
+    FunctionalLISTADenoisingSAE,
+    FunctionalResidualDenoisingSAE,
+    LISTADenoisingSAE,
+    LISTALayer,
+    ResidualDenoisingLayer,
+    ResidualDenoisingSAE,
+)
+from sparse_coding__tpu.models.positive import (
+    FunctionalPositiveTiedSAE,
+    TiedPositiveSAE,
+    UntiedPositiveSAE,
+)
+from sparse_coding__tpu.models.semilinear import FFLayer, SemiLinearSAE, SemiLinearSAE_export
+from sparse_coding__tpu.models.direct_coef import DirectCoefOptimizer, DirectCoefSearch

@@ -1,0 +1,271 @@
+"""FISTA sparse inference + Olshausen-style dictionary learning.
+
+TPU-native counterpart of the reference `autoencoders/fista.py` — the fork's
+central model (SURVEY.md §2.2, §3.2): an untied SAE whose decoder is refined by
+a FISTA sparse-coding step (iterative shrinkage with Nesterov momentum) plus a
+quadratic basis update with an EMA Hessian diagonal.
+
+TPU-first design decisions (vs the reference):
+  - The 500-iteration Python loop (`fista.py:116-125`) becomes a
+    `lax.fori_loop` with a static trip count — one compiled program, two MXU
+    matmuls per iteration, no host round-trips.
+  - The step size η = 1/λmax(D Dᵀ) is computed by **power iteration**
+    (~30 matvecs) instead of `torch.linalg.eigvalsh` (`fista.py:105-106`),
+    which XLA lowers poorly on TPU and wastes a full O(n³) eigendecomposition
+    for a single extreme eigenvalue.
+  - Buffers are immutable: `dictionary_update` *returns* the new
+    `hessian_diag` instead of mutating it in place (`fista.py:92`).
+  - The momentum scalars t_k are data-independent, so they ride in the loop
+    carry as cheap scalar ops.
+  - `quadraticBasisUpdate` renormalizes dictionary **rows** (atoms). The
+    reference normalizes dim 0 (`fista.py:137`, `learned_dict.norm(2, 0)`),
+    i.e. per-coordinate across atoms — a transposition slip inherited from the
+    original sparsenet code, where the basis is stored column-major. Atoms are
+    rows here and everywhere else in this framework (SURVEY.md §2.7 says not
+    to replicate drift bugs).
+
+Everything is vmappable over an ensemble axis, so a whole l1 sweep of FISTA
+models runs as one stacked jit program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import TiedSAE, _norm_rows, register_learned_dict
+from sparse_coding__tpu.models.sae import _safe_l2
+
+_glorot = jax.nn.initializers.glorot_uniform()
+
+# EMA horizon for the Hessian diagonal (reference `fista.py:91`).
+ACT_HISTORY_LEN = 300.0
+
+
+def power_iteration_max_eig(
+    learned_dict: jax.Array, n_iter: int = 30, eps: float = 1e-12
+) -> jax.Array:
+    """λmax of G = D Dᵀ via power iteration on the implicit operator.
+
+    Never materializes G: each step is two [n, d] matvecs, MXU-friendly and
+    O(n·d) instead of the O(n³) `eigvalsh` of the reference (`fista.py:105`).
+    Deterministic start vector (ones) — G is PSD with nonnegative-ish row sums,
+    so ones has overwhelming overlap with the top eigenspace in practice.
+    """
+    n = learned_dict.shape[0]
+    v0 = jnp.ones((n,), learned_dict.dtype) / jnp.sqrt(n)
+
+    def body(_, v):
+        w = learned_dict.T @ v
+        w = learned_dict @ w
+        return w / jnp.maximum(jnp.linalg.norm(w), eps)
+
+    v = jax.lax.fori_loop(0, n_iter, body, v0)
+    w = learned_dict @ (learned_dict.T @ v)
+    return jnp.vdot(v, w) / jnp.maximum(jnp.vdot(v, v), eps)
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def fista(
+    batch: jax.Array,
+    learned_dict: jax.Array,
+    l1_coef: jax.Array,
+    coefficients: jax.Array,
+    num_iter: int = 500,
+    eta: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Non-negative FISTA: argmin_c ½‖x - cD‖² + λ‖c‖₁, c ≥ 0.
+
+    Shapes: batch [b, d], learned_dict [n, d], coefficients [b, n] (warm
+    start). Returns (ahat, residual). Reference `fista.py:99-128`.
+    """
+    if eta is None:
+        # power iteration approaches λmax from below (measured ≤3.4% low at 30
+        # iters on 4096×512 dictionaries); FISTA needs η ≤ 1/λmax, so take a
+        # 5% margin on a 50-iteration estimate.
+        eta = 1.0 / (1.05 * power_iteration_max_eig(learned_dict, n_iter=50))
+    eta = jnp.asarray(eta, batch.dtype)
+
+    def body(_, carry):
+        ahat, ahat_y, tk = carry
+        tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
+        res = batch - ahat_y @ learned_dict
+        ahat_y = ahat_y + eta * (res @ learned_dict.T)
+        ahat_new = jnp.maximum(ahat_y - eta * l1_coef, 0.0)
+        ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
+        return ahat_new, ahat_y, tk_n
+
+    ahat, _, _ = jax.lax.fori_loop(
+        0, num_iter, body, (coefficients, coefficients, jnp.asarray(1.0, batch.dtype))
+    )
+    res = batch - ahat @ learned_dict
+    return ahat, res
+
+
+def quadratic_basis_update(
+    learned_dict: jax.Array,
+    res: jax.Array,
+    ahat: jax.Array,
+    lowest_activation: float,
+    hessian_diag: jax.Array,
+    step_size: float = 0.001,
+    noneg: bool = False,
+) -> jax.Array:
+    """Olshausen quadratic dictionary update with per-atom Hessian scaling.
+
+    Reference `quadraticBasisUpdate` (`fista.py:131-138`), with row (atom)
+    renormalization — see module docstring on the dim-0 norm slip.
+    """
+    d_basis = step_size * (res.T @ ahat) / ahat.shape[0]  # [d, n]
+    d_basis = d_basis / (hessian_diag + lowest_activation)[None, :]
+    new_dict = learned_dict + d_basis.T
+    if noneg:
+        new_dict = jnp.maximum(new_dict, 0.0)
+    return _norm_rows(new_dict)
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def dictionary_update(
+    learned_dict: jax.Array,
+    hessian_diag: jax.Array,
+    batch_centered: jax.Array,
+    coeffs: jax.Array,
+    l1_alpha: jax.Array,
+    num_iter: int = 500,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One FISTA-solve + basis-update step; returns (new_dict, new_hessian, res).
+
+    Pure counterpart of reference `FunctionalFista.dictionary_update`
+    (`fista.py:87-96`); the caller rebinds the returned hessian_diag.
+    """
+    coeffs_fista, res = fista(batch_centered, learned_dict, l1_alpha, coeffs, num_iter)
+    new_hessian = (
+        hessian_diag * ((ACT_HISTORY_LEN - 1.0) / ACT_HISTORY_LEN)
+        + (coeffs_fista**2).mean(axis=0) / ACT_HISTORY_LEN
+    )
+    new_dict = quadratic_basis_update(learned_dict, res, coeffs_fista, 0.001, new_hessian)
+    return new_dict, new_hessian, res
+
+
+class FunctionalFista:
+    """DictSignature: untied SAE loss + FISTA-refined decoder.
+
+    Reference `FunctionalFista` (`fista.py:18-205`). The gradient step trains
+    encoder/bias/decoder exactly like `FunctionalSAE`; the train loop then
+    overwrites the decoder with the FISTA basis step via
+    `train.loop.make_fista_decoder_update` (gated on the
+    `has_fista_decoder_update` flag below — cf. `big_sweep.py:176-198`).
+    """
+
+    has_fista_decoder_update = True
+
+    @staticmethod
+    def init(
+        key: jax.Array,
+        activation_size: int,
+        n_dict_components: int,
+        l1_alpha: float,
+        bias_decay: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+            "decoder": _glorot(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+            "hessian_diag": jnp.zeros((n_dict_components,), dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def encode(params, buffers, batch):
+        c = jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
+        return jax.nn.relu(c)
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        """SAE-style gradient loss (reference `fista.py:59-84`)."""
+        c = FunctionalFista.encode(params, buffers, batch)
+        learned_dict = _norm_rows(params["decoder"])
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {
+            "loss": total,
+            "l_reconstruction": l_reconstruction,
+            "l_l1": l_l1,
+            "l_bias_decay": l_bias_decay,
+        }
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def loss2(params, buffers, batch, fista_iters: int = 50):
+        """Tied-encoder hybrid: SAE reconstruction + FISTA-residual term
+        (reference `loss2`, `fista.py:141-172` — "FISTA-in-loss" regime of
+        `output_basic_test/filename_explanations.txt`).
+
+        Gradients flow through the unrolled FISTA iterations; keep
+        `fista_iters` modest (the reference uses 50).
+        """
+        learned_dict = _norm_rows(params["encoder"])
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        c = jax.nn.relu(c)
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        _, res = fista(batch, learned_dict, buffers["l1_alpha"], c, fista_iters)
+        fista_l_reconstruction = jnp.mean(res**2)
+        overall = l_reconstruction + fista_l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {
+            "loss": overall,
+            "l_reconstruction": l_reconstruction,
+            "l_fista_reconstruction": fista_l_reconstruction,
+            "l_l1": l_l1,
+        }
+        return overall, (loss_data, {"c": c})
+
+    @staticmethod
+    def fista_loss(params, buffers, batch, c, fista_iters: int = 50):
+        """Pure FISTA-residual loss (reference `fista_loss`, `fista.py:174-185`;
+        note the reference's version crashes on its undefined `Fista.center` —
+        SURVEY.md §2.7 — ours just skips the no-op centering)."""
+        learned_dict = _norm_rows(params["encoder"])
+        c_fista, res = fista(batch, learned_dict, buffers["l1_alpha"], c, fista_iters)
+        l_reconstruction = jnp.mean(res**2)
+        return l_reconstruction, ({"loss": l_reconstruction}, {"c_fista": c_fista})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        from sparse_coding__tpu.models.learned_dict import UntiedSAE
+
+        return UntiedSAE(params["encoder"], params["decoder"], params["encoder_bias"])
+
+
+class Fista(TiedSAE):
+    """Inference view: `TiedSAE` (affine-centered tied ReLU encoder) + a
+    `fista` method for exact sparse inference (reference `Fista`,
+    `fista.py:208-301` — whose body is itself a verbatim copy of its TiedSAE).
+
+    One deviation: `get_learned_dict` always row-normalizes, as the reference's
+    does (`fista.py:248-250`), which TiedSAE already guarantees.
+    """
+
+    def fista(self, batch, coefficients, l1_coef, num_iter: int = 500, eta=None):
+        return fista(batch, self.get_learned_dict(), l1_coef, coefficients, num_iter, eta)
+
+
+register_learned_dict(
+    Fista,
+    ("encoder", "encoder_bias", "center_trans", "center_rot", "center_scale"),
+    ("norm_encoder",),
+)

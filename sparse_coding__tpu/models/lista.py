@@ -1,0 +1,189 @@
+"""Learned-ISTA (LISTA) and residual-MLP denoising autoencoders.
+
+TPU-native counterpart of the reference
+`autoencoders/residual_denoising_autoencoder.py` (LISTA after
+arXiv 2008.02683, cited at reference `:14`).
+
+TPU-first design: the reference stores the K unrolled encoder layers as a
+Python *list* of param dicts and loops over them (`:59-61`, `:156-158`). Here
+the layers are a single **stacked pytree** (each leaf has a leading `[K, ...]`
+layer axis) consumed by `lax.scan` — one compiled loop body regardless of
+depth, and the ensemble vmap axis composes cleanly on top.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, _norm_rows, register_learned_dict
+
+_orthogonal = jax.nn.initializers.orthogonal()
+
+
+def shrinkage(r: jax.Array, theta: jax.Array) -> jax.Array:
+    """Soft-threshold: sign(r)·relu(|r| − θ) (reference `:9-11`)."""
+    return jnp.sign(r) * jax.nn.relu(jnp.abs(r) - theta[None, :])
+
+
+class LISTALayer:
+    """One unrolled ISTA-with-momentum layer (reference `LISTALayer`, `:16-37`)."""
+
+    @staticmethod
+    def init(key, d_activation, n_features, dtype=jnp.float32):
+        k_w, k_theta = jax.random.split(key)
+        return {
+            "W": _orthogonal(k_w, (n_features, d_activation), dtype),
+            "theta": jax.random.normal(k_theta, (n_features,), dtype) * 0.02,
+            "rho": jnp.asarray(0.1, dtype),
+        }
+
+    @staticmethod
+    def forward(params, y, b, x, A):
+        """One step of solving `c A ≈ b`; carries (y momentum-iterate, x)."""
+        m = jnp.clip(params["rho"], 0.0, 1.0)
+        Ay = jnp.einsum("ij,bi->bj", A, y)
+        r = y + jnp.einsum("ij,bj->bi", params["W"], b - Ay)
+        x_new = shrinkage(r, params["theta"])
+        y_new = x_new + m * (x_new - x)
+        return y_new, x_new
+
+
+class FunctionalLISTADenoisingSAE:
+    """DictSignature: K LISTA layers as encoder, normalized linear decoder.
+
+    Reference `FunctionalLISTADenoisingSAE` (`:39-104`).
+    """
+
+    @staticmethod
+    def init(key, d_activation, n_features, n_hidden_layers, l1_alpha, dtype=jnp.float32):
+        k_dec, *k_layers = jax.random.split(key, n_hidden_layers + 1)
+        layers = [LISTALayer.init(k, d_activation, n_features, dtype) for k in k_layers]
+        params = {
+            "decoder": _orthogonal(k_dec, (n_features, d_activation), dtype),
+            # stacked [K, ...] layer pytree, scanned in encode
+            "encoder_layers": jax.tree.map(lambda *ls: jnp.stack(ls), *layers),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, b, learned_dict):
+        y0 = jnp.einsum("ij,bj->bi", learned_dict, b)
+
+        def body(carry, layer_params):
+            y, x = carry
+            y_new, x_new = LISTALayer.forward(layer_params, y, b, x, learned_dict)
+            return (y_new, x_new), None
+
+        (y, _), _ = jax.lax.scan(body, (y0, y0), params["encoder_layers"])
+        return y
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["decoder"])
+        c = FunctionalLISTADenoisingSAE.encode(params, batch, learned_dict)
+        x_hat = jnp.einsum("ij,bi->bj", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_sparsity = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        total = l_reconstruction + l_sparsity
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_sparsity}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return LISTADenoisingSAE(params)
+
+
+class LISTADenoisingSAE(LearnedDict):
+    """Inference view (reference `LISTADenoisingSAE`, `:107-128`)."""
+
+    def __init__(self, params):
+        self.params = params
+        self.n_feats, self.activation_size = params["decoder"].shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.params["decoder"])
+
+    def encode(self, x):
+        return FunctionalLISTADenoisingSAE.encode(self.params, x, self.get_learned_dict())
+
+
+class ResidualDenoisingLayer:
+    """ReLU-shift + square mix + residual (reference `:131-142`)."""
+
+    @staticmethod
+    def init(key, n_features, dtype=jnp.float32):
+        k_w, k_theta = jax.random.split(key)
+        return {
+            "W": _orthogonal(k_w, (n_features, n_features), dtype),
+            "theta": jax.random.normal(k_theta, (n_features,), dtype) * 0.02,
+        }
+
+    @staticmethod
+    def forward(params, x):
+        h = jax.nn.relu(x + params["theta"][None, :])
+        h = jnp.einsum("ij,bj->bi", params["W"], h)
+        return h + x
+
+
+class FunctionalResidualDenoisingSAE:
+    """DictSignature: residual-MLP encoder variant (reference `:145-185`)."""
+
+    @staticmethod
+    def init(key, d_activation, n_features, n_hidden_layers, l1_alpha, dtype=jnp.float32):
+        k_dec, k_bias, *k_layers = jax.random.split(key, n_hidden_layers + 2)
+        layers = [ResidualDenoisingLayer.init(k, n_features, dtype) for k in k_layers]
+        params = {
+            "decoder": _orthogonal(k_dec, (n_features, d_activation), dtype),
+            "encoder_layers": jax.tree.map(lambda *ls: jnp.stack(ls), *layers),
+            "encoder_bias": jax.random.normal(k_bias, (n_features,), dtype) * 0.02,
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, b, learned_dict):
+        x0 = jnp.einsum("ij,bj->bi", learned_dict, b)
+
+        def body(x, layer_params):
+            return ResidualDenoisingLayer.forward(layer_params, x), None
+
+        x, _ = jax.lax.scan(body, x0, params["encoder_layers"])
+        return jax.nn.relu(x + params["encoder_bias"][None, :])
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["decoder"])
+        c = FunctionalResidualDenoisingSAE.encode(params, batch, learned_dict)
+        x_hat = jnp.einsum("ij,bi->bj", learned_dict, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_sparsity = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        total = l_reconstruction + l_sparsity
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_sparsity}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return ResidualDenoisingSAE(params)
+
+
+class ResidualDenoisingSAE(LearnedDict):
+    """Inference view. (The reference's `__init__` reads `params["dict"]`,
+    which is never created — `residual_denoising_autoencoder.py:188`,
+    SURVEY.md §2.7; we read `decoder`, the key `init` actually writes.)
+    """
+
+    def __init__(self, params):
+        self.params = params
+        self.n_feats, self.activation_size = params["decoder"].shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.params["decoder"])
+
+    def encode(self, x):
+        return FunctionalResidualDenoisingSAE.encode(self.params, x, self.get_learned_dict())
+
+
+register_learned_dict(LISTADenoisingSAE, ("params",))
+register_learned_dict(ResidualDenoisingSAE, ("params",))

@@ -1,0 +1,108 @@
+"""Non-negative ("positive") SAE variants.
+
+TPU-native counterpart of the reference `autoencoders/mlp_tests.py:8-125`:
+encoder weights constrained to be non-negative, inputs shifted by +0.18, bias
+initialized at −1. The reference enforces non-negativity by *mutating*
+`params["encoder"]` inside the loss (`mlp_tests.py:102`); here the constraint
+is a pure reparameterization — the loss reads `relu(encoder)` — which is the
+projected view of the same constraint and keeps the signature functional.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, TiedSAE, _norm_rows, register_learned_dict
+from sparse_coding__tpu.models.sae import _safe_l2
+
+_glorot = jax.nn.initializers.glorot_uniform()
+
+INPUT_SHIFT = 0.18  # reference `mlp_tests.py:106,113`
+
+
+class FunctionalPositiveTiedSAE:
+    """DictSignature (reference `FunctionalPositiveTiedSAE`, `mlp_tests.py:70-125`)."""
+
+    @staticmethod
+    def init(key, activation_size, n_dict_components, l1_alpha, bias_decay=0.0, dtype=jnp.float32):
+        params = {
+            "encoder": jnp.abs(_glorot(key, (n_dict_components, activation_size), dtype)),
+            "encoder_bias": jnp.full((n_dict_components,), -1.0, dtype),
+        }
+        buffers = {
+            "l1_alpha": jnp.asarray(l1_alpha, dtype),
+            "bias_decay": jnp.asarray(bias_decay, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        encoder = jax.nn.relu(params["encoder"])
+        learned_dict = _norm_rows(encoder)
+        c = jnp.einsum("nd,bd->bn", learned_dict, batch + INPUT_SHIFT)
+        c = jax.nn.relu(c + params["encoder_bias"])
+        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
+        l_reconstruction = jnp.mean(((x_hat - INPUT_SHIFT) - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {
+            "loss": total,
+            "l_reconstruction": l_reconstruction,
+            "l_l1": l_l1,
+            "l_bias_decay": l_bias_decay,
+        }
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return TiedSAE(
+            jax.nn.relu(params["encoder"]), params["encoder_bias"], norm_encoder=True
+        )
+
+
+class TiedPositiveSAE(LearnedDict):
+    """Inference view with |encoder| projection at construction
+    (reference `TiedPositiveSAE`, `mlp_tests.py:8-36`)."""
+
+    def __init__(self, encoder, encoder_bias, norm_encoder=False):
+        self.encoder = jnp.abs(encoder)
+        self.encoder_bias = encoder_bias
+        self.norm_encoder = norm_encoder
+        self.n_feats, self.activation_size = encoder.shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.encoder)
+
+    def encode(self, batch):
+        encoder = _norm_rows(self.encoder) if self.norm_encoder else self.encoder
+        c = jnp.einsum("nd,bd->bn", encoder, batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+
+class UntiedPositiveSAE(LearnedDict):
+    """Untied inference view (reference `UntiedPositiveSAE`, `mlp_tests.py:39-67`;
+    its `encode` ignores `norm_encoder` and always uses the raw encoder —
+    `mlp_tests.py:62` — we honor the flag consistently instead)."""
+
+    def __init__(self, encoder, encoder_bias, decoder, norm_encoder=False):
+        self.encoder = jnp.abs(encoder)
+        self.decoder = decoder
+        self.encoder_bias = encoder_bias
+        self.norm_encoder = norm_encoder
+        self.n_feats, self.activation_size = encoder.shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.encoder)
+
+    def encode(self, batch):
+        encoder = _norm_rows(self.encoder) if self.norm_encoder else self.encoder
+        c = jnp.einsum("nd,bd->bn", encoder, batch) + self.encoder_bias
+        return jax.nn.relu(c)
+
+
+register_learned_dict(TiedPositiveSAE, ("encoder", "encoder_bias"), ("norm_encoder",))
+register_learned_dict(
+    UntiedPositiveSAE, ("encoder", "encoder_bias", "decoder"), ("norm_encoder",)
+)

@@ -1,0 +1,89 @@
+"""Semi-linear SAE: 2-layer MLP encoder, normalized linear decoder.
+
+TPU-native counterpart of the reference
+`autoencoders/semilinear_autoencoder.py:14-83`. The reference provides no
+`to_learned_dict` (SURVEY.md §2.2); we add a minimal export so trained
+semilinear models plug into the evaluation stack like every other signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, _norm_rows, register_learned_dict
+
+_glorot = jax.nn.initializers.glorot_uniform()
+
+
+class FFLayer:
+    """Affine + ReLU (reference `FFLayer`, `semilinear_autoencoder.py:14-29`)."""
+
+    @staticmethod
+    def init(key, input_size, output_size, dtype=jnp.float32):
+        return {
+            "weight": _glorot(key, (output_size, input_size), dtype),
+            "bias": jnp.zeros((output_size,), dtype),
+        }
+
+    @staticmethod
+    def forward(params, x):
+        return jax.nn.relu(jnp.einsum("ij,bj->bi", params["weight"], x) + params["bias"])
+
+
+class SemiLinearSAE:
+    """DictSignature (reference `SemiLinearSAE`, `semilinear_autoencoder.py:32-83`)."""
+
+    @staticmethod
+    def init(key, activation_size, n_dict_components, l1_alpha, hidden_size=None, dtype=jnp.float32):
+        if hidden_size is None:
+            hidden_size = n_dict_components
+        k1, k2, k_dec = jax.random.split(key, 3)
+        params = {
+            "encoder_layers": [
+                FFLayer.init(k1, activation_size, hidden_size, dtype),
+                FFLayer.init(k2, hidden_size, n_dict_components, dtype),
+            ],
+            "decoder": _glorot(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def encode(params, batch):
+        c = batch
+        for layer in params["encoder_layers"]:
+            c = FFLayer.forward(layer, c)
+        return c
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        c = SemiLinearSAE.encode(params, batch)
+        normed_weights = _norm_rows(params["decoder"])
+        x_hat = jnp.einsum("nd,bn->bd", normed_weights, c)
+        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        l_l1 = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean()
+        total = l_reconstruction + l_l1
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return SemiLinearSAE_export(params)
+
+
+class SemiLinearSAE_export(LearnedDict):
+    """Inference view (net-new — the reference has none)."""
+
+    def __init__(self, params):
+        self.params = params
+        self.n_feats, self.activation_size = params["decoder"].shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.params["decoder"])
+
+    def encode(self, x):
+        return SemiLinearSAE.encode(self.params, x)
+
+
+register_learned_dict(SemiLinearSAE_export, ("params",))

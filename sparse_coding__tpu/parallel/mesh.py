@@ -91,11 +91,15 @@ def infer_state_specs(state, n_models: int, mesh: Mesh, shard_dict: bool = True)
 
     Rules (per leaf):
       - leading dim == n_models → that dim goes on the model axis;
-      - for rank≥2 leaves with the model axis assigned, the next dim goes on
+      - for rank-2/3 leaves with the model axis assigned, the next dim goes on
         the dict axis when divisible by its size (this captures encoder /
         decoder / bias / optimizer moments, whose dim 1 is n_dict_components;
         it also shards e.g. whitening matrices on their first non-model dim,
-        which is a valid, memory-saving layout);
+        which is a valid, memory-saving layout). Rank≥4 leaves are replicated
+        past the model axis: their dim 1 is a structural axis (e.g. the
+        scanned layer stack of LISTA's `encoder_layers`,
+        `[n_models, K, n_feats, d]`), and sharding it would split every scan
+        step's weights across devices;
       - everything else replicated.
 
     Optimizer state leaves (adam mu/nu) mirror the param shapes, so the same
@@ -114,7 +118,7 @@ def infer_state_specs(state, n_models: int, mesh: Mesh, shard_dict: bool = True)
         if len(shape) == 0 or shape[0] != n_models:
             return P()
         axes = [MODEL_AXIS]
-        if len(shape) >= 2 and dict_size > 1 and shape[1] % dict_size == 0:
+        if 2 <= len(shape) <= 3 and dict_size > 1 and shape[1] % dict_size == 0:
             axes.append(DICT_AXIS)
         axes += [None] * (len(shape) - len(axes))
         return P(*axes)

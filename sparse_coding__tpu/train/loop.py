@@ -1,0 +1,115 @@
+"""Per-chunk ensemble training loop (the hot loop).
+
+TPU-native counterpart of the reference `ensemble_train_loop`
+(`big_sweep.py:161-243`) and its fork-specific FISTA dictionary update
+(`big_sweep.py:176-198`):
+
+  - Batches are sampled by a host-side permutation over the chunk (the
+    reference's custom `BatchSampler(RandomSampler(...))`,
+    `cluster_runs.py:26-32`) and fed to the fused `Ensemble.step_batch`.
+  - The FISTA decoder update — a per-model *Python loop* of 500-iteration
+    FISTA solves in the reference (`big_sweep.py:183-196`) — is ONE vmapped
+    jit program here (`make_fista_decoder_update`), and it only runs for
+    signatures that declare `has_fista_decoder_update` + a `decoder` param.
+    The reference applies it unconditionally and crashes on tied/topk models
+    (`big_sweep.py:180-198`, SURVEY.md §2.7).
+  - Loss logging is buffered (`utils.logging.MetricLogger`): no `.item()`
+    host sync per batch (the reference stalls on `big_sweep.py:224-228`).
+
+Deviation noted for parity auditors: the reference's per-model
+`dictionary_update` writes the EMA `hessian_diag` into a throwaway sliced dict
+(`big_sweep.py:185-193` rebinding in `separate_tensors` copies), so its EMA
+never actually persists across batches. Ours persists it in the ensemble
+buffers — the behavior the EMA code plainly intends.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.ensemble import Ensemble, EnsembleState
+from sparse_coding__tpu.models.fista import dictionary_update
+from sparse_coding__tpu.models.learned_dict import _norm_rows
+from sparse_coding__tpu.utils.logging import MetricLogger
+
+
+def make_fista_decoder_update(num_iter: int = 500) -> Callable:
+    """Build the jitted, ensemble-vmapped FISTA decoder update.
+
+    ``update(state, batch, c) -> state`` where ``c`` is the `aux["c"]` code
+    tensor from the gradient step (warm start for FISTA, exactly as the
+    reference reuses `aux_buffer["c"]`, `big_sweep.py:177`).
+    """
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def update(state: EnsembleState, batch: jax.Array, c: jax.Array) -> EnsembleState:
+        def one_model(params, buffers, c_m):
+            learned_dict = _norm_rows(params["decoder"])
+            new_dict, new_hessian, _ = dictionary_update(
+                learned_dict,
+                buffers["hessian_diag"],
+                batch,
+                c_m,
+                buffers["l1_alpha"],
+                num_iter,
+            )
+            return new_dict, new_hessian
+
+        new_dicts, new_hessians = jax.vmap(one_model)(state.params, state.buffers, c)
+        params = dict(state.params)
+        params["decoder"] = new_dicts
+        buffers = dict(state.buffers)
+        buffers["hessian_diag"] = new_hessians
+        return EnsembleState(
+            params=params, buffers=buffers, opt_state=state.opt_state, step=state.step
+        )
+
+    return update
+
+
+def ensemble_train_loop(
+    ensemble: Ensemble,
+    dataset: jax.Array,
+    batch_size: int,
+    key: jax.Array,
+    logger: Optional[MetricLogger] = None,
+    log_every: int = 16,
+    fista_update: Optional[bool] = None,
+    fista_iters: int = 500,
+    progress_callback: Optional[Callable[[int, int], None]] = None,
+) -> Dict[str, jax.Array]:
+    """Train the ensemble for one pass over `dataset` ([N, d] activations).
+
+    Returns the final on-device loss dict. `fista_update=None` auto-detects
+    from the signature (`has_fista_decoder_update`).
+    """
+    if fista_update is None:
+        fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
+    fista_fn = make_fista_decoder_update(fista_iters) if fista_update else None
+
+    n = dataset.shape[0]
+    n_batches = n // batch_size
+    # host-side permutation; the data itself stays wherever it lives (HBM)
+    perm = np.asarray(jax.random.permutation(key, n))
+
+    loss_dict: Dict[str, jax.Array] = {}
+    for i in range(n_batches):
+        idxs = perm[i * batch_size : (i + 1) * batch_size]
+        batch = dataset[idxs]
+        loss_dict, aux = ensemble.step_batch(batch)
+        if fista_fn is not None:
+            ensemble.state = fista_fn(ensemble.state, batch, aux["c"])
+        if logger is not None:
+            logger.log(int(i), loss_dict)
+            if (i + 1) % log_every == 0:
+                logger.flush()
+        if progress_callback is not None:
+            progress_callback(i, n_batches)
+    if logger is not None:
+        logger.flush()
+    return loss_dict

@@ -1,0 +1,105 @@
+"""Buffered metric logging: wandb when available, JSONL fallback otherwise.
+
+Replaces the reference's logging pattern — per-batch `wandb.log` of `.item()`'d
+scalars (`big_sweep.py:204-228`), which forces a host sync every step and would
+stall a TPU pipeline (SURVEY.md §7 "hard parts"). Here scalars stay on device
+in a ring buffer of pytrees; `flush()` does ONE `jax.device_get` for the whole
+window and emits per-model series.
+
+wandb is not part of this image's environment; when importable (and
+`use_wandb=True`) it is used, otherwise metrics append to a JSONL file — the
+same record schema either way, so analysis tooling reads both.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+def make_hyperparam_name(hyperparam_values: Dict[str, Any]) -> str:
+    """Stable per-model series name, e.g. ``l1_alpha_1e-03``
+    (reference `make_hyperparam_name`, `big_sweep.py:76-84`)."""
+    parts = []
+    for k in sorted(hyperparam_values):
+        v = hyperparam_values[k]
+        parts.append(f"{k}_{v:.0e}" if isinstance(v, float) else f"{k}_{v}")
+    return "_".join(parts)
+
+
+class MetricLogger:
+    """Buffered, host-sync-free metric logger.
+
+    `log(step, tree)` stores device scalars without transfer; `flush()` pulls
+    everything in one transfer and writes records
+    ``{"step": int, "series": str, "metric": str, "value": float}``.
+    """
+
+    def __init__(
+        self,
+        out_dir: Optional[str] = None,
+        run_name: str = "run",
+        use_wandb: bool = False,
+        wandb_project: str = "sparse_coding__tpu",
+        model_names: Optional[List[str]] = None,
+    ):
+        self.model_names = model_names
+        self._buffer: List = []
+        self._wandb = None
+        self._jsonl = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project=wandb_project, name=run_name)
+            except Exception:
+                self._wandb = None
+        if self._wandb is None and out_dir is not None:
+            path = Path(out_dir)
+            path.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(path / f"{run_name}_metrics.jsonl", "a")
+
+    def log(self, step: int, tree: Dict[str, jax.Array]):
+        """Queue a pytree of [n_models]-shaped device scalars. No host sync."""
+        self._buffer.append((step, tree))
+
+    def flush(self):
+        if not self._buffer:
+            return
+        steps = [s for s, _ in self._buffer]
+        trees = jax.device_get([t for _, t in self._buffer])  # ONE transfer
+        now = time.time()
+        for step, tree in zip(steps, trees):
+            for metric, values in tree.items():
+                vals = values.reshape(-1) if getattr(values, "ndim", 0) else [values]
+                for m, v in enumerate(vals):
+                    series = (
+                        self.model_names[m]
+                        if self.model_names and m < len(self.model_names)
+                        else f"model_{m}"
+                    )
+                    rec = {
+                        "step": int(step),
+                        "series": series,
+                        "metric": metric,
+                        "value": float(v),
+                        "ts": now,
+                    }
+                    if self._wandb is not None:
+                        self._wandb.log({f"{series}_{metric}": float(v)}, step=int(step))
+                    if self._jsonl is not None:
+                        self._jsonl.write(json.dumps(rec) + "\n")
+        if self._jsonl is not None:
+            self._jsonl.flush()
+        self._buffer.clear()
+
+    def close(self):
+        self.flush()
+        if self._jsonl is not None:
+            self._jsonl.close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -1,0 +1,77 @@
+"""Train loop: FISTA decoder update wiring + buffered logging."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalFista, FunctionalTiedSAE
+from sparse_coding__tpu.train import ensemble_train_loop
+from sparse_coding__tpu.utils import MetricLogger, make_hyperparam_name
+
+
+def _planted(key, n=32, d=16, rows=512):
+    k_d, k_c, k_m = jax.random.split(key, 3)
+    D = jax.random.normal(k_d, (n, d))
+    D = D / jnp.linalg.norm(D, axis=-1, keepdims=True)
+    codes = jax.random.uniform(k_c, (rows, n)) * jax.random.bernoulli(k_m, 0.15, (rows, n))
+    return D, codes @ D
+
+
+def test_fista_loop_updates_decoder_and_hessian(tmp_path):
+    D, data = _planted(jax.random.PRNGKey(0))
+    ens = build_ensemble(
+        FunctionalFista,
+        jax.random.PRNGKey(1),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=16,
+        n_dict_components=32,
+    )
+    dec_before = np.asarray(jax.device_get(ens.state.params["decoder"]))
+    hess_before = np.asarray(jax.device_get(ens.state.buffers["hessian_diag"]))
+    assert (hess_before == 0).all()
+
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="fista_test")
+    loss = ensemble_train_loop(
+        ens, data, batch_size=64, key=jax.random.PRNGKey(2),
+        logger=logger, log_every=4, fista_iters=50,
+    )
+    logger.close()
+
+    dec_after = jax.device_get(ens.state.params["decoder"])
+    hess_after = jax.device_get(ens.state.buffers["hessian_diag"])
+    assert not np.allclose(dec_before, dec_after), "FISTA update never touched decoder"
+    assert (np.asarray(hess_after) > 0).any(), "hessian EMA did not persist"
+    # FISTA basis update keeps decoder rows unit-norm
+    norms = np.linalg.norm(np.asarray(dec_after), axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-5)
+    assert np.isfinite(jax.device_get(loss["loss"])).all()
+
+    # JSONL logging wrote per-model series without per-step host syncs
+    records = [json.loads(l) for l in open(tmp_path / "fista_test_metrics.jsonl")]
+    assert {r["series"] for r in records} == {"model_0", "model_1"}
+    assert {r["metric"] for r in records} >= {"loss", "l_reconstruction", "l_l1"}
+
+
+def test_loop_skips_fista_for_tied_sae():
+    """Signatures without a decoder must not hit the FISTA path (the reference
+    crashes here, big_sweep.py:180-198 / SURVEY.md §2.7)."""
+    _, data = _planted(jax.random.PRNGKey(3))
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(4),
+        [{"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=16,
+        n_dict_components=32,
+    )
+    loss = ensemble_train_loop(ens, data, batch_size=64, key=jax.random.PRNGKey(5))
+    assert np.isfinite(jax.device_get(loss["loss"])).all()
+
+
+def test_make_hyperparam_name():
+    assert make_hyperparam_name({"l1_alpha": 1e-3}) == "l1_alpha_1e-03"
+    assert make_hyperparam_name({"k": 4, "l1_alpha": 1e-2}) == "k_4_l1_alpha_1e-02"
