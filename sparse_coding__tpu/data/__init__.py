@@ -4,3 +4,9 @@ from sparse_coding__tpu.data.synthetic import (
     generate_corr_matrix,
     generate_rand_feats,
 )
+from sparse_coding__tpu.data.chunks import (
+    ChunkStore,
+    chunk_path,
+    generate_synthetic_chunks,
+    save_chunk,
+)

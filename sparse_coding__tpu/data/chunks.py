@@ -1,0 +1,138 @@
+"""On-disk activation chunk store with double-buffered host→device prefetch.
+
+The framework's only data contract, inherited from the reference: a folder of
+numbered chunk files, each an `[N, d_activation]` half-precision array
+(reference: torch-saved `{i}.pt`, `activation_dataset.py:393-397`; here:
+`{i}.npy` float16 — numpy-native, mmap-able, no torch dependency on the load
+path).
+
+TPU-first: the reference loads a chunk into shared host memory and every GPU
+worker re-reads it per batch (`cluster_runs.py:101-104`, `big_sweep.py:170`).
+Here a chunk is `jax.device_put` once into HBM and batches are on-device
+slices; `iter_chunks` overlaps the next chunk's disk read + H2D transfer with
+the current chunk's training via a background thread (the double-buffering
+called for in SURVEY.md §7 stage 4).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def chunk_path(folder, i: int) -> Path:
+    return Path(folder) / f"{i}.npy"
+
+
+def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
+    """Write chunk `i` as `[N, d]` half-precision .npy."""
+    path = chunk_path(folder, i)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.save(path, np.asarray(jax.device_get(array)).astype(dtype))
+    return path
+
+
+class ChunkStore:
+    """A folder of `{i}.npy` activation chunks."""
+
+    def __init__(self, folder):
+        self.folder = Path(folder)
+        self.folder.mkdir(parents=True, exist_ok=True)
+
+    def __len__(self) -> int:
+        return len([p for p in self.folder.iterdir() if p.suffix == ".npy"])
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self)
+
+    def n_datapoints(self) -> int:
+        """Total rows across chunks — header-only reads, no data loaded
+        (the reference loads every full chunk just to count,
+        `big_sweep.py:306-309`)."""
+        total = 0
+        for i in range(len(self)):
+            with open(chunk_path(self.folder, i), "rb") as f:
+                version = np.lib.format.read_magic(f)
+                shape, _, _ = np.lib.format._read_array_header(f, version)
+            total += shape[0]
+        return total
+
+    def load(self, i: int, dtype=jnp.float32, device=None, sharding=None) -> jax.Array:
+        """Load chunk `i` to device (defaults to JAX's default device)."""
+        arr = np.load(chunk_path(self.folder, i))
+        x = jnp.asarray(arr, dtype=dtype)
+        if sharding is not None:
+            x = jax.device_put(x, sharding)
+        elif device is not None:
+            x = jax.device_put(x, device)
+        return x
+
+    def iter_chunks(
+        self,
+        order: Sequence[int],
+        dtype=jnp.float32,
+        sharding=None,
+        center: Optional[jax.Array] = None,
+    ) -> Iterator[jax.Array]:
+        """Yield chunks in `order`, prefetching the next one on a background
+        thread while the caller trains on the current one."""
+        q: "queue.Queue" = queue.Queue(maxsize=1)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for i in order:
+                    if stop.is_set():
+                        return
+                    x = self.load(int(i), dtype=dtype, sharding=sharding)
+                    if center is not None:
+                        x = x - center[None, :]
+                    q.put(("ok", x))
+                q.put(("done", None))
+            except Exception as e:  # surface loader errors in the consumer
+                q.put(("err", e))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "done":
+                    return
+                if kind == "err":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            # drain so the producer isn't blocked on put()
+            while not q.empty():
+                q.get_nowait()
+
+
+def generate_synthetic_chunks(
+    generator,
+    folder,
+    n_chunks: int,
+    chunk_size_gb: float = 2.0,
+    activation_width: Optional[int] = None,
+    dtype=np.float16,
+) -> ChunkStore:
+    """Materialize a generator into chunk files
+    (reference `generate_synthetic_dataset`, `big_sweep.py:272-281`)."""
+    store = ChunkStore(folder)
+    width = activation_width or generator.activation_dim
+    bytes_per_row = width * np.dtype(dtype).itemsize
+    rows_per_chunk = int(chunk_size_gb * 1024**3 // bytes_per_row)
+    batches_per_chunk = max(1, rows_per_chunk // generator.batch_size)
+    for i in range(n_chunks):
+        parts = [np.asarray(jax.device_get(next(generator))) for _ in range(batches_per_chunk)]
+        save_chunk(folder, i, np.concatenate(parts, axis=0), dtype=dtype)
+    return store

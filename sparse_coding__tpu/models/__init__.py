@@ -42,3 +42,13 @@ from sparse_coding__tpu.models.positive import (
 )
 from sparse_coding__tpu.models.semilinear import FFLayer, SemiLinearSAE, SemiLinearSAE_export
 from sparse_coding__tpu.models.direct_coef import DirectCoefOptimizer, DirectCoefSearch
+from sparse_coding__tpu.models.pca import (
+    BatchedMean,
+    BatchedPCA,
+    PCAEncoder,
+    calc_mean,
+    calc_pca,
+)
+from sparse_coding__tpu.models.ica import ICAEncoder
+from sparse_coding__tpu.models.nmf import NMFEncoder
+from sparse_coding__tpu.models.rica import RICA, RICADict

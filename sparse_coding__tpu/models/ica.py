@@ -1,0 +1,51 @@
+"""FastICA baseline (host-side sklearn, JAX array boundary).
+
+Counterpart of the reference `autoencoders/ica.py:15-53`. ICA is an offline
+baseline fit once per layer (reference `sweep_baselines.py:60-66`); sklearn on
+host is the right tool — there is no hot path to port to TPU (SURVEY.md §7
+stage 1 explicitly keeps ICA/NMF on host).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict
+from sparse_coding__tpu.models.topk import TopKLearnedDict
+
+
+class ICAEncoder(LearnedDict):
+    """StandardScaler + FastICA (reference `ICAEncoder`, `ica.py:15-53`)."""
+
+    def __init__(self, activation_size: int, n_components: int = 0, **ica_kwargs):
+        from sklearn.decomposition import FastICA
+        from sklearn.preprocessing import StandardScaler
+
+        self.activation_size = activation_size
+        self.n_feats = n_components if n_components else activation_size
+        if n_components:
+            ica_kwargs.setdefault("n_components", n_components)
+        self.ica = FastICA(**ica_kwargs)
+        self.scaler = StandardScaler()
+
+    def train(self, dataset: jax.Array) -> np.ndarray:
+        assert dataset.shape[1] == self.activation_size
+        rescaled = self.scaler.fit_transform(np.asarray(dataset, dtype=np.float64))
+        return self.ica.fit_transform(rescaled)
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        assert x.shape[1] == self.activation_size
+        x_std = self.scaler.transform(np.asarray(x, dtype=np.float64))
+        return jnp.asarray(self.ica.transform(x_std), dtype=jnp.float32)
+
+    def get_learned_dict(self) -> jax.Array:
+        components = jnp.asarray(self.ica.components_, dtype=jnp.float32)
+        return components / jnp.linalg.norm(components, axis=-1, keepdims=True)
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        """± components → top-k dict (reference `ica.py:49-53`)."""
+        pos = np.asarray(self.ica.components_)
+        comps = jnp.asarray(np.concatenate([pos, -pos], axis=0), dtype=jnp.float32)
+        return TopKLearnedDict(comps, sparsity)

@@ -1,0 +1,57 @@
+"""NMF baseline (host-side sklearn, JAX array boundary).
+
+Counterpart of the reference `autoencoders/nmf.py:26-62`: non-negative matrix
+factorization with a shift-to-positive preprocessing step. Offline baseline —
+sklearn on host, like ICA (SURVEY.md §7 stage 1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict
+from sparse_coding__tpu.models.topk import TopKLearnedDict
+
+
+class NMFEncoder(LearnedDict):
+    """Shift-to-positive + sklearn NMF (reference `NMFEncoder`, `nmf.py:26-62`)."""
+
+    def __init__(self, activation_size: int, n_components: int = 0, shift: float = 0.0, **nmf_kwargs):
+        from sklearn.decomposition import NMF
+
+        self.activation_size = activation_size
+        self.n_feats = n_components if n_components else activation_size
+        if n_components:
+            nmf_kwargs.setdefault("n_components", n_components)
+        self.nmf = NMF(**nmf_kwargs)
+        self.shift = shift
+
+    def train(self, dataset: jax.Array):
+        data = np.asarray(dataset, dtype=np.float64)
+        data_min = float(data.min())
+        if data_min < self.shift:
+            self.shift = data_min
+        self.nmf.fit(data - self.shift)
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        x_np = np.asarray(x, dtype=np.float64)
+        if x_np.min() < self.shift:
+            print("Warning: data has values below expected minimum for NMF.")
+        x_np = np.clip(x_np - self.shift, 0.0, None)
+        return jnp.asarray(self.nmf.transform(x_np), dtype=jnp.float32)
+
+    def get_learned_dict(self) -> jax.Array:
+        """Row-normalized components — the framework-wide `get_learned_dict`
+        contract (unit-norm rows) that the cosine metrics rely on. The
+        reference returns raw components here (`nmf.py:57-60`), silently
+        corrupting MMCS against NMF dicts. As in the reference: the proper
+        coefficient matrix H is NOT recovered by multiplying with this."""
+        components = jnp.asarray(self.nmf.components_, dtype=jnp.float32)
+        return components / jnp.clip(
+            jnp.linalg.norm(components, axis=-1, keepdims=True), 1e-8, None
+        )
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        return TopKLearnedDict(self.get_learned_dict(), sparsity)

@@ -1,0 +1,140 @@
+"""Streaming PCA / mean baselines.
+
+TPU-native counterpart of the reference `autoencoders/pca.py`. The streaming
+covariance update is a jitted pure function over a small state pytree
+(`cov, mean, n_samples`) — the thin class wrappers keep the reference's
+stateful API for the baseline-runner and eval tooling.
+
+The eigendecomposition happens once per fit (not per batch), so `jnp.eigh` is
+fine; the per-batch path is a single rank-b covariance update on the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, Rotation, register_learned_dict
+from sparse_coding__tpu.models.topk import TopKLearnedDict, topk_mask_code_static
+
+
+@jax.jit
+def _pca_update(cov, mean, n_samples, activations):
+    """Chan et al. streaming covariance/mean update
+    (reference `BatchedPCA.train_batch`, `pca.py:53-63`)."""
+    batch_size = activations.shape[0]
+    total = n_samples + batch_size
+    corrected = activations - mean[None, :]
+    new_mean = mean + corrected.mean(axis=0) * batch_size / total
+    cov_update = jnp.einsum("bi,bj->ij", corrected, activations - new_mean[None, :]) / batch_size
+    new_cov = cov * (n_samples / total) + cov_update * batch_size / total
+    return new_cov, new_mean, total
+
+
+class BatchedMean:
+    """Streaming mean (reference `BatchedMean`, `pca.py:24-39` — whose
+    `train_batch` forgets to increment `n_samples`, reducing it to the mean of
+    the *last* batch; we keep the running count, the behavior the code
+    intends)."""
+
+    def __init__(self, n_dims: int):
+        self.n_dims = n_dims
+        self.mean = jnp.zeros((n_dims,))
+        self.n_samples = 0.0
+
+    def train_batch(self, activations: jax.Array):
+        batch_size = activations.shape[0]
+        total = self.n_samples + batch_size
+        self.mean = self.mean * (self.n_samples / total) + activations.sum(axis=0) / total
+        self.n_samples = total
+
+    def get_mean(self) -> jax.Array:
+        return self.mean
+
+
+class BatchedPCA:
+    """Streaming PCA (reference `BatchedPCA`, `pca.py:41-105`)."""
+
+    def __init__(self, n_dims: int):
+        self.n_dims = n_dims
+        self.cov = jnp.zeros((n_dims, n_dims))
+        self.mean = jnp.zeros((n_dims,))
+        self.n_samples = jnp.zeros(())
+
+    def get_mean(self) -> jax.Array:
+        return self.mean
+
+    def train_batch(self, activations: jax.Array):
+        self.cov, self.mean, self.n_samples = _pca_update(
+            self.cov, self.mean, self.n_samples, activations
+        )
+
+    def get_pca(self) -> Tuple[jax.Array, jax.Array]:
+        cov_symm = (self.cov + self.cov.T) / 2
+        return jnp.linalg.eigh(cov_symm)
+
+    def get_centering_transform(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(translation, rotation, scaling) whitening triple — feeds
+        `FunctionalTiedSAE` centering (reference `pca.py:70-82`)."""
+        eigvals, eigvecs = self.get_pca()
+        scaling = 1.0 / jnp.sqrt(jnp.clip(eigvals, 1e-6, None))
+        return self.get_mean(), eigvecs, scaling
+
+    def get_dict(self) -> jax.Array:
+        """Eigvecs as rows, sorted by decreasing eigenvalue (reference `:84-87`)."""
+        eigvals, eigvecs = self.get_pca()
+        return eigvecs[:, jnp.argsort(-eigvals)].T
+
+    def to_learned_dict(self, sparsity: int) -> "PCAEncoder":
+        return PCAEncoder(self.get_dict(), sparsity)
+
+    def to_topk_dict(self, sparsity: int) -> TopKLearnedDict:
+        """± components → non-negative top-k dict (reference `:96-100`)."""
+        eigvecs = self.get_dict()
+        return TopKLearnedDict(jnp.concatenate([eigvecs, -eigvecs], axis=0), sparsity)
+
+    def to_rotation_dict(self, n_components: int) -> Rotation:
+        return Rotation(self.get_dict()[:n_components])
+
+
+def calc_pca(activations: jax.Array, batch_size: int = 512) -> BatchedPCA:
+    """Fit streaming PCA over an activation store (reference `pca.py:6-13`)."""
+    pca = BatchedPCA(activations.shape[1])
+    for i in range(0, activations.shape[0], batch_size):
+        pca.train_batch(activations[i : i + batch_size])
+    return pca
+
+
+def calc_mean(activations: jax.Array, batch_size: int = 512) -> jax.Array:
+    """Streaming mean of an activation store (reference `pca.py:15-22`)."""
+    mean = BatchedMean(activations.shape[1])
+    for i in range(0, activations.shape[0], batch_size):
+        mean.train_batch(activations[i : i + batch_size])
+    return mean.get_mean()
+
+
+class PCAEncoder(LearnedDict):
+    """Top-k-by-|score| PCA projection (reference `PCAEncoder`, `pca.py:108-131`).
+
+    Signed scores are kept for the selected components (unlike the ReLU'd SAE
+    codes) — PCA components explain variance in both directions.
+    """
+
+    def __init__(self, pca_dict: jax.Array, sparsity: int):
+        self.pca_dict = pca_dict / jnp.linalg.norm(pca_dict, axis=-1, keepdims=True)
+        self.sparsity = int(sparsity)
+        self.n_feats, self.activation_size = self.pca_dict.shape
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        scores = jnp.einsum("ij,bj->bi", self.pca_dict, x)
+        mask = topk_mask_code_static(jnp.abs(scores), self.sparsity) > 0
+        return jnp.where(mask, scores, 0.0)
+
+    def get_learned_dict(self) -> jax.Array:
+        return self.pca_dict
+
+
+register_learned_dict(PCAEncoder, ("pca_dict",), ("sparsity",))

@@ -1,0 +1,93 @@
+"""Reconstruction ICA (RICA) — Le et al., tied linear autoencoder with a
+smooth-L1 sparsity penalty.
+
+Counterpart of the reference `autoencoders/rica.py:9-60` (an nn.Module with
+its own `train_batch`). Here RICA is a plain `DictSignature`, so it trains
+under the stacked-ensemble runtime like every other model — the reference's
+bespoke Adam loop collapses into the shared fused step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu.models.learned_dict import LearnedDict, _norm_rows, register_learned_dict
+
+_glorot = jax.nn.initializers.glorot_uniform()
+
+
+def smooth_l1(x: jax.Array, beta: float = 1.0) -> jax.Array:
+    """Huber / torch `smooth_l1_loss` with reduction='mean'."""
+    ax = jnp.abs(x)
+    return jnp.where(ax < beta, 0.5 * x**2 / beta, ax - 0.5 * beta).mean()
+
+
+class RICA:
+    """DictSignature: x̂ = Wᵀ(Wx), loss = MSE + λ·sparsity(c)."""
+
+    @staticmethod
+    def init(
+        key: jax.Array,
+        activation_size: int,
+        n_dict_components: int,
+        sparsity_coef: float = 0.0,
+        sparsity_loss: str = "smooth_l1",
+        dtype=jnp.float32,
+    ):
+        params = {"weights": _glorot(key, (n_dict_components, activation_size), dtype)}
+        buffers = {
+            "sparsity_coef": jnp.asarray(sparsity_coef, dtype),
+            # static choice encoded as a flag buffer (0=smooth_l1, 1=l1)
+            "sparsity_is_l1": jnp.asarray(1.0 if sparsity_loss == "l1" else 0.0, dtype),
+        }
+        return params, buffers
+
+    @staticmethod
+    def forward(params, x):
+        c = jnp.einsum("ij,bj->bi", params["weights"], x)
+        x_hat = jnp.einsum("ij,bi->bj", params["weights"], c)
+        return x_hat, c
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        x_hat, c = RICA.forward(params, batch)
+        l_reconstruction = jnp.mean((batch - x_hat) ** 2)
+        # both penalties computed, flag-selected — keeps the loss vmappable
+        # across members with different sparsity_loss settings
+        l_sparsity = jnp.where(
+            buffers["sparsity_is_l1"] > 0.5, jnp.abs(c).mean(), smooth_l1(c)
+        )
+        total = l_reconstruction + buffers["sparsity_coef"] * l_sparsity
+        loss_data = {
+            "loss": total,
+            "l_reconstruction": l_reconstruction,
+            "l_l1": l_sparsity,
+        }
+        return total, (loss_data, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return RICADict(params["weights"])
+
+
+class RICADict(LearnedDict):
+    """Inference view (net-new — the reference exposes only `get_dict`)."""
+
+    def __init__(self, weights: jax.Array):
+        self.weights = weights
+        self.n_feats, self.activation_size = weights.shape
+
+    def get_learned_dict(self):
+        return _norm_rows(self.weights)
+
+    def encode(self, x):
+        return jnp.einsum("ij,bj->bi", self.weights, x)
+
+    def decode(self, c):
+        # raw (unnormalized) weights, matching the trained forward pass
+        # x̂ = Wᵀ(Wx); get_learned_dict stays normalized for cosine metrics
+        return jnp.einsum("ij,bi->bj", self.weights, c)
+
+
+register_learned_dict(RICADict, ("weights",))

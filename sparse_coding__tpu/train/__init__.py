@@ -1,1 +1,17 @@
 from sparse_coding__tpu.train.loop import ensemble_train_loop, make_fista_decoder_update
+from sparse_coding__tpu.train.sweep import (
+    filter_learned_dicts,
+    format_hyperparam_val,
+    init_model_dataset,
+    init_synthetic_dataset,
+    log_sweep_metrics,
+    sweep,
+    unstacked_to_learned_dicts,
+)
+from sparse_coding__tpu.train.checkpoint import (
+    latest_checkpoint,
+    load_learned_dicts,
+    restore_ensemble_checkpoint,
+    save_ensemble_checkpoint,
+    save_learned_dicts,
+)

@@ -1,0 +1,117 @@
+"""Checkpointing: full training-state save/resume + learned-dict exports.
+
+The reference only ever saves *outputs* — `(LearnedDict, hyperparams)` lists at
+exponential chunk counts (`big_sweep.py:421-427`) — and has no way to resume
+training (SURVEY.md §5 "checkpoint/resume: save-only"). Here:
+
+  - `save_ensemble_checkpoint` / `restore_ensemble_checkpoint`: orbax
+    checkpoints of every ensemble's FULL state (params + buffers + optimizer
+    state + step) plus the sweep cursor (chunk index, RNG seed), giving true
+    resume — the TPU failure-recovery story (multi-host preemption = restart
+    from checkpoint).
+  - `save_learned_dicts` / `load_learned_dicts`: the reference's on-disk
+    export format, re-expressed as a pickle of pytree-flattened LearnedDicts
+    with numpy leaves (portable, no framework pinning). All analysis tooling
+    consumes this format, exactly as everything in the reference consumes
+    `learned_dicts.pt`.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+# -- learned-dict export (the reference's learned_dicts.pt) -------------------
+
+def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
+    """Save a `[(LearnedDict, hyperparams), ...]` list.
+
+    LearnedDicts are registered pytrees: we store (class, static aux, numpy
+    leaves) so loading needs only this package, not jax array types.
+    """
+    records = []
+    for ld, hyperparams in learned_dicts:
+        leaves, treedef = jax.tree.flatten(ld)
+        records.append(
+            {
+                "treedef": pickle.dumps(treedef),
+                "leaves": [np.asarray(jax.device_get(l)) for l in leaves],
+                "hyperparams": hyperparams,
+            }
+        )
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(records, f)
+
+
+def load_learned_dicts(path) -> List[Tuple[Any, Dict[str, Any]]]:
+    with open(path, "rb") as f:
+        records = pickle.load(f)
+    out = []
+    for rec in records:
+        treedef = pickle.loads(rec["treedef"])
+        ld = jax.tree.unflatten(treedef, [jax.numpy.asarray(l) for l in rec["leaves"]])
+        out.append((ld, rec["hyperparams"]))
+    return out
+
+
+# -- full training-state checkpoints (orbax) ----------------------------------
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_ensemble_checkpoint(
+    ckpt_dir,
+    ensembles: List[Tuple[Any, Dict[str, Any], str]],
+    chunk_cursor: int = 0,
+    extra: Optional[Dict[str, Any]] = None,
+):
+    """Save full sweep state: every ensemble's `state_dict` + the cursor.
+
+    `ensembles` is the sweep's `[(Ensemble, args, name), ...]` list.
+    """
+    ckpt_dir = Path(ckpt_dir).absolute()
+    tree = {
+        "cursor": {"chunk": chunk_cursor, **(extra or {})},
+        "ensembles": {
+            name: ens.state_dict() for ens, _args, name in ensembles
+        },
+        "args": {name: _args for _ens, _args, name in ensembles},
+    }
+    _checkpointer().save(ckpt_dir, tree, force=True)
+
+
+def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = None):
+    """Restore the sweep tree saved by `save_ensemble_checkpoint`, or None if
+    no checkpoint exists. Caller rebuilds ensembles via `Ensemble.from_state`.
+
+    `template` is a same-structure pytree (e.g. built from freshly-initialized
+    ensembles) used to recover exact leaf *types* — without it orbax returns
+    plain dicts/lists, losing the `EnsembleState` dataclass and optax's
+    NamedTuple optimizer states that the compiled step expects.
+    """
+    ckpt_dir = Path(ckpt_dir).absolute()
+    if not ckpt_dir.exists():
+        return None
+    ckpt = _checkpointer()
+    if template is not None:
+        return ckpt.restore(ckpt_dir, item=template)
+    return ckpt.restore(ckpt_dir)
+
+
+def latest_checkpoint(output_folder) -> Optional[Path]:
+    """Most recent `ckpt_*` dir under the sweep output folder."""
+    root = Path(output_folder)
+    if not root.exists():
+        return None
+    ckpts = sorted(root.glob("ckpt_*"), key=lambda p: int(p.name.split("_")[1]))
+    return ckpts[-1] if ckpts else None

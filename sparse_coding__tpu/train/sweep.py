@@ -1,0 +1,346 @@
+"""Sweep orchestrator: train ensembles over an activation chunk store.
+
+TPU-native counterpart of the reference `big_sweep.py:341-428` (`sweep()`).
+The shape is the same — build/load dataset, init ensembles, iterate shuffled
+chunks, train, export learned dicts at an exponential save schedule — but the
+multi-device story is inverted (SURVEY.md §2.4): the reference spawns one
+process per ensemble per GPU and hands them shared-memory chunks
+(`cluster_runs.py:100-157`); here each ensemble's step is a single SPMD
+program over the device mesh, chunks are `device_put` once into HBM with
+background prefetch, and "dispatch" is a plain Python loop over ensembles —
+XLA queues their compiled steps back-to-back on the same devices.
+
+Additions over the reference:
+  - true resume (`resume=True`): orbax checkpoint of every ensemble's full
+    state + the chunk cursor (the reference can only save outputs, §5);
+  - save schedule and metric logging work without wandb (JSONL fallback).
+"""
+
+from __future__ import annotations
+
+import os
+from itertools import product
+from math import isclose
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu import metrics as sm
+from sparse_coding__tpu.data.chunks import ChunkStore, generate_synthetic_chunks
+from sparse_coding__tpu.data.synthetic import SparseMixDataset
+from sparse_coding__tpu.ensemble import Ensemble
+from sparse_coding__tpu.train import checkpoint as ckpt_lib
+from sparse_coding__tpu.train.loop import ensemble_train_loop
+from sparse_coding__tpu.utils.logging import (
+    MetricLogger,
+    format_hyperparam_val,
+    make_hyperparam_name,
+)
+
+SAVE_CHUNKS = {2**j for j in range(3, 10)}  # 8,16,...,512 (reference big_sweep.py:421)
+
+
+def filter_learned_dicts(
+    learned_dicts: List[Tuple[Any, Dict[str, Any]]], hyperparam_filters: Dict[str, Any]
+) -> List[Tuple[Any, Dict[str, Any]]]:
+    """Select dicts whose hyperparams match the filter; a dict missing a
+    filtered key simply doesn't match (reference `big_sweep.py:61-74`, which
+    instead KeyErrors)."""
+
+    def matches(hp, k, v):
+        if k not in hp:
+            return False
+        return isclose(hp[k], v, rel_tol=1e-3) if isinstance(v, float) else hp[k] == v
+
+    return [
+        (ld, hp)
+        for ld, hp in learned_dicts
+        if all(matches(hp, k, v) for k, v in hyperparam_filters.items())
+    ]
+
+
+def unstacked_to_learned_dicts(
+    ensemble: Ensemble,
+    args: Dict[str, Any],
+    ensemble_hyperparams: Sequence[str],
+    buffer_hyperparams: Sequence[str],
+) -> List[Tuple[Any, Dict[str, Any]]]:
+    """Export every member as `(LearnedDict, hyperparams)`
+    (reference `big_sweep.py:245-268`). Ensemble-level hyperparams come from
+    `args`; member-varying ones from each model's buffers."""
+    learned_dicts = []
+    for params, buffers in ensemble.unstack():
+        hp: Dict[str, Any] = {}
+        for ep in ensemble_hyperparams:
+            if ep not in args:
+                raise ValueError(f"Hyperparameter {ep} not found in args")
+            hp[ep] = args[ep]
+        for bp in buffer_hyperparams:
+            if bp not in buffers:
+                raise ValueError(f"Hyperparameter {bp} not found in buffers")
+            val = jax.device_get(buffers[bp])
+            hp[bp] = val.item() if np.ndim(val) == 0 else np.asarray(val)
+        learned_dicts.append((ensemble.sig.to_learned_dict(params, buffers), hp))
+    return learned_dicts
+
+
+def log_sweep_metrics(
+    learned_dicts: List[Tuple[Any, Dict[str, Any]]],
+    chunk: jax.Array,
+    chunk_num: int,
+    hyperparam_ranges: Dict[str, Sequence],
+    logger: Optional[MetricLogger],
+    output_folder: Optional[str] = None,
+    n_samples: int = 2000,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Per-save-point metric dashboard (reference `log_standard_metrics`,
+    `big_sweep.py:87-157`): feature-activity counts per dict, plus the
+    small-vs-larger-dict MMCS grid when the sweep spans dict sizes. Returns
+    the computed values; images are the plotting module's job (offline)."""
+    idx = np.random.default_rng(seed).choice(chunk.shape[0], size=min(n_samples, chunk.shape[0]), replace=False)
+    sample = chunk[idx]
+
+    results: Dict[str, Any] = {"n_active": {}, "mmcs_grids": {}}
+    for ld, setting in learned_dicts:
+        name = make_hyperparam_name(setting)
+        n_ever = sm.batched_calc_feature_n_ever_active(ld, sample, threshold=1)
+        results["n_active"][name] = {
+            "n_active": n_ever,
+            "prop_active": n_ever / ld.n_feats,
+        }
+
+    dict_sizes = list(hyperparam_ranges.get("dict_size", []))
+    l1_values = list(hyperparam_ranges.get("l1_alpha", []))
+    if len(dict_sizes) > 1 and l1_values:
+        grid_hyperparams = [
+            k for k in hyperparam_ranges if k not in ("l1_alpha", "dict_size")
+        ]
+        small = dict_sizes[0]
+        for combo in product(*[hyperparam_ranges[k] for k in grid_hyperparams]):
+            setting = dict(zip(grid_hyperparams, combo))
+            # untrained grid cells (e.g. l1 ranges differing across dict
+            # sizes) are NaN, not a crash mid-sweep
+            scores = np.full((len(l1_values), len(dict_sizes) - 1), np.nan)
+            for i, l1 in enumerate(l1_values):
+                small_matches = filter_learned_dicts(
+                    learned_dicts, {**setting, "l1_alpha": l1, "dict_size": small}
+                )
+                if not small_matches:
+                    continue
+                small_dict = small_matches[0][0]
+                for j, size in enumerate(dict_sizes[1:]):
+                    larger = filter_learned_dicts(
+                        learned_dicts, {**setting, "l1_alpha": l1, "dict_size": size}
+                    )
+                    if larger:
+                        scores[i, j] = float(
+                            sm.mcs_duplicates(small_dict, larger[0][0]).mean()
+                        )
+            results["mmcs_grids"][make_hyperparam_name(setting) or "default"] = scores
+
+    if logger is not None:
+        flat = {}
+        for name, vals in results["n_active"].items():
+            flat[f"{name}_n_active"] = jnp.asarray(float(vals["n_active"]))
+            flat[f"{name}_prop_active"] = jnp.asarray(vals["prop_active"])
+        logger.log(chunk_num, flat)
+        logger.flush()
+    if output_folder is not None and results["mmcs_grids"]:
+        out = Path(output_folder) / f"mmcs_grids_{chunk_num}.npz"
+        np.savez(out, **results["mmcs_grids"])
+    return results
+
+
+def init_synthetic_dataset(cfg) -> ChunkStore:
+    """Materialize the synthetic chunk store
+    (reference `init_synthetic_dataset`, `big_sweep.py:312-338`)."""
+    store = ChunkStore(cfg.dataset_folder)
+    if len(store) > 0:
+        print(f"Activations in {cfg.dataset_folder} already exist, loading them")
+        return store
+    print(f"Activations in {cfg.dataset_folder} do not exist, creating them")
+    generator = SparseMixDataset(
+        cfg.activation_width,
+        cfg.n_ground_truth_components,
+        cfg.gen_batch_size,
+        cfg.feature_num_nonzero,
+        cfg.feature_prob_decay,
+        cfg.noise_magnitude_scale,
+        key=jax.random.PRNGKey(cfg.seed),
+        sparse_component_covariance=(
+            None
+            if cfg.correlated_components
+            else jnp.eye(cfg.n_ground_truth_components)
+        ),
+    )
+    generate_synthetic_chunks(
+        generator,
+        cfg.dataset_folder,
+        n_chunks=cfg.n_chunks,
+        chunk_size_gb=cfg.chunk_size_gb,
+        activation_width=cfg.activation_width,
+    )
+    # persist ground truth for MMCS-to-truth evaluation
+    np.save(
+        Path(cfg.output_folder) / "ground_truth_dict.npy",
+        np.asarray(jax.device_get(generator.sparse_component_dict)),
+    )
+    return store
+
+
+def init_model_dataset(cfg) -> ChunkStore:
+    """Build/load the LM-activation chunk store
+    (reference `init_model_dataset`, `big_sweep.py:283-309`)."""
+    store = ChunkStore(cfg.dataset_folder)
+    if len(store) > 0:
+        print(f"Activations in {cfg.dataset_folder} already exist, loading them")
+        return store
+    print(f"Activations in {cfg.dataset_folder} do not exist, creating them")
+    try:
+        from sparse_coding__tpu.data.activations import setup_data  # lazy: LM stack
+    except ImportError as e:
+        raise ImportError(
+            "LM activation harvesting (data/activations.py) is required to "
+            "build a model dataset; either point cfg.dataset_folder at "
+            "pre-built chunks or set cfg.use_synthetic_dataset=True"
+        ) from e
+
+    setup_data(
+        model_name=cfg.model_name,
+        dataset_name=cfg.dataset_name,
+        dataset_folder=cfg.dataset_folder,
+        layer=cfg.layer,
+        layer_loc=cfg.layer_loc,
+        n_chunks=cfg.n_chunks,
+        chunk_size_gb=cfg.chunk_size_gb,
+        center_dataset=cfg.center_dataset,
+    )
+    return store
+
+
+def sweep(
+    ensemble_init_func: Callable,
+    cfg,
+    resume: bool = False,
+) -> List[Tuple[Any, Dict[str, Any]]]:
+    """Run the full sweep; returns the final `(LearnedDict, hyperparams)` list.
+
+    `ensemble_init_func(cfg) -> (ensembles, ensemble_hyperparams,
+    buffer_hyperparams, hyperparam_ranges)` with `ensembles` a list of
+    `(Ensemble, args, name)` — the reference contract (`big_sweep.py:374-379`).
+    """
+    np.random.seed(cfg.seed)
+    os.makedirs(cfg.dataset_folder, exist_ok=True)
+    os.makedirs(cfg.output_folder, exist_ok=True)
+
+    store = (
+        init_synthetic_dataset(cfg)
+        if getattr(cfg, "use_synthetic_dataset", False)
+        else init_model_dataset(cfg)
+    )
+
+    print("Initialising ensembles...", end=" ")
+    ensembles, ensemble_hyperparams, buffer_hyperparams, hyperparam_ranges = (
+        ensemble_init_func(cfg)
+    )
+    print("Ensembles initialised.")
+
+    logger = MetricLogger(
+        out_dir=cfg.output_folder,
+        run_name=f"sweep_{Path(cfg.output_folder).name}",
+        use_wandb=getattr(cfg, "use_wandb", False),
+    )
+
+    n_chunks = len(store)
+    chunk_order = np.random.permutation(n_chunks)
+    reps = cfg.n_repetitions if getattr(cfg, "n_repetitions", None) else cfg.n_epochs
+    chunk_order = np.tile(chunk_order, max(1, reps))
+
+    start_chunk = 0
+    if resume:
+        latest = ckpt_lib.latest_checkpoint(cfg.output_folder)
+        if latest is not None:
+            template = {
+                "cursor": {"chunk": 0},
+                "ensembles": {name: ens.state_dict() for ens, _a, name in ensembles},
+                "args": {name: _a for _e, _a, name in ensembles},
+            }
+            tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
+            start_chunk = int(tree["cursor"]["chunk"]) + 1
+            restored = []
+            for ens, args, name in ensembles:
+                sd = tree["ensembles"][name]
+                restored.append((Ensemble.from_state(sd, sig=ens.sig), args, name))
+            ensembles = restored
+            print(f"Resumed from {latest} at chunk {start_chunk}")
+
+    means: Optional[jax.Array] = None
+    means_path = Path(cfg.output_folder) / "means.npy"
+    if getattr(cfg, "center_activations", False) and means_path.exists():
+        means = jnp.asarray(np.load(means_path))
+
+    learned_dicts: List[Tuple[Any, Dict[str, Any]]] = []
+    rng_key = jax.random.PRNGKey(cfg.seed)
+    for i in range(start_chunk, len(chunk_order)):
+        chunk_idx = int(chunk_order[i])
+        print(f"Chunk {i+1}/{len(chunk_order)} (file {chunk_idx})")
+        chunk = store.load(chunk_idx, dtype=jnp.float32)
+        if getattr(cfg, "center_activations", False):
+            if means is None:
+                print("Centring activations")
+                means = chunk.mean(axis=0)
+                np.save(means_path, np.asarray(jax.device_get(means)))
+            chunk = chunk - means[None, :]
+
+        for ensemble, args, name in ensembles:
+            rng_key, k = jax.random.split(rng_key)
+            ensemble_train_loop(
+                ensemble,
+                chunk,
+                batch_size=args.get("batch_size", cfg.batch_size),
+                key=k,
+                logger=logger,
+            )
+
+        # export learned dicts only when something consumes them (save point
+        # or metric log) — unstack + export per chunk is pure waste otherwise
+        want_metrics = getattr(cfg, "wandb_images", False) and i % 10 == 0
+        want_save = i == len(chunk_order) - 1 or (i + 1) in SAVE_CHUNKS
+        if want_metrics or want_save:
+            learned_dicts = []
+            for ensemble, args, _name in ensembles:
+                learned_dicts.extend(
+                    unstacked_to_learned_dicts(
+                        ensemble, args, ensemble_hyperparams, buffer_hyperparams
+                    )
+                )
+
+        if want_metrics:
+            log_sweep_metrics(
+                learned_dicts, chunk, i, hyperparam_ranges, logger, cfg.output_folder
+            )
+
+        if want_save:
+            iter_folder = Path(cfg.output_folder) / f"_{i}"
+            iter_folder.mkdir(parents=True, exist_ok=True)
+            ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
+            if hasattr(cfg, "save_yaml"):
+                cfg.save_yaml(iter_folder / "config.yaml")
+            ckpt_lib.save_ensemble_checkpoint(
+                Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
+            )
+
+    if not learned_dicts:
+        # resumed past the last chunk: export straight from the restored state
+        for ensemble, args, _name in ensembles:
+            learned_dicts.extend(
+                unstacked_to_learned_dicts(
+                    ensemble, args, ensemble_hyperparams, buffer_hyperparams
+                )
+            )
+    logger.close()
+    return learned_dicts

@@ -1,1 +1,12 @@
-from sparse_coding__tpu.utils.logging import MetricLogger, make_hyperparam_name
+from sparse_coding__tpu.utils.logging import MetricLogger, format_hyperparam_val, make_hyperparam_name
+from sparse_coding__tpu.utils.config import (
+    BaseArgs,
+    EnsembleArgs,
+    ErasureArgs,
+    InterpArgs,
+    InterpGraphArgs,
+    InvestigateArgs,
+    SyntheticEnsembleArgs,
+    ToyArgs,
+    TrainArgs,
+)

@@ -1,0 +1,277 @@
+"""Validated config system: dataclasses with auto-generated CLI flags.
+
+Counterpart of the reference `config.py`, with the drift bugs fixed
+(SURVEY.md §2.7):
+  - `TrainArgs` actually declares every field `sweep()` reads —
+    `n_repetitions` and `center_activations` exist here, so entry points
+    don't crash with AttributeError (`big_sweep.py:394,402` vs
+    `config.py:29-58`).
+  - CLI parsing is explicit (`from_cli()`), not a side effect of
+    construction — the reference's `__post_init__` parses `sys.argv` on every
+    instantiation (`config.py:14-21`), which breaks library/test use.
+  - `as_dict()`/`save_yaml()` replace the reference's `dict(cfg)` calls that
+    only work on dict-like configs (`big_sweep.py:359,427`).
+
+Every field becomes `--field`; unknown flags raise; overrides print themselves
+(parity with `config.py:7-27`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+
+def _resolve_type(hint):
+    """Unwrap Optional[T] / string annotations to a concrete type."""
+    import typing
+
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        return args[0] if args else str
+    return hint
+
+
+def _cli_type(hint, default):
+    """Parser for a CLI flag. `hint` is the *resolved* annotation type —
+    required because `from __future__ import annotations` turns `f.type` into
+    a string, and Optional fields have `default=None` (so `type(default)`
+    would parse everything as str)."""
+    t = _resolve_type(hint)
+    if t is bool or isinstance(default, bool):
+        # accept "true"/"false"/"1"/"0"
+        return lambda s: s.lower() in ("1", "true", "yes")
+    if isinstance(t, type) and t is not type(None):
+        return t
+    if default is not None:
+        return type(default)
+    return str
+
+
+@dataclass
+class BaseArgs:
+    """Base: validation + explicit CLI overlay + (de)serialization."""
+
+    def __post_init__(self):
+        self.validate()
+
+    def validate(self):
+        """Hook for subclass invariants; called at construction and after
+        CLI/update overlays."""
+
+    # -- CLI -----------------------------------------------------------------
+
+    @classmethod
+    def from_cli(cls, argv: Optional[list] = None, **overrides) -> "BaseArgs":
+        """Build from defaults + keyword overrides + command-line flags."""
+        import typing
+
+        self = cls(**overrides)
+        hints = typing.get_type_hints(cls)
+        parser = argparse.ArgumentParser(description=cls.__name__)
+        for f in fields(self):
+            default = getattr(self, f.name)
+            parser.add_argument(
+                f"--{f.name}", type=_cli_type(hints[f.name], default), default=None
+            )
+        args = parser.parse_args(argv)
+        self.update(args)
+        return self
+
+    def update(self, args: Any):
+        """Overlay non-None attributes (reference `BaseArgs.update`,
+        `config.py:23-27`)."""
+        src = vars(args) if not isinstance(args, dict) else args
+        unknown = set(src) - {f.name for f in fields(self)}
+        if unknown:
+            raise ValueError(f"Unknown arguments: {unknown}")
+        for key, value in src.items():
+            if value is not None:
+                print(f"From command line, setting {key} to {value}")
+                setattr(self, key, value)
+        self.validate()
+
+    # -- (de)serialization ---------------------------------------------------
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def save_yaml(self, path):
+        import yaml
+
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(self.as_dict(), f, sort_keys=True)
+
+    @classmethod
+    def load_yaml(cls, path) -> "BaseArgs":
+        import yaml
+
+        with open(path) as f:
+            return cls(**yaml.safe_load(f))
+
+    @property
+    def jnp_dtype(self):
+        return DTYPES[getattr(self, "dtype", "float32")]
+
+
+@dataclass
+class TrainArgs(BaseArgs):
+    """Sweep/training config (reference `TrainArgs`, `config.py:29-51`)."""
+
+    layer: int = 2
+    layer_loc: str = "residual"
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    dataset_name: str = "openwebtext"
+    dataset_folder: str = ""
+    tied_ae: bool = False
+    seed: int = 0
+    learned_dict_ratio: float = 1.0
+    output_folder: str = "outputs"
+    dtype: str = "float32"
+    center_dataset: bool = False
+    n_chunks: int = 30
+    chunk_size_gb: float = 2.0
+    batch_size: int = 256
+    use_wandb: bool = False
+    wandb_images: bool = False
+    lr: float = 1e-3
+    l1_alpha: float = 1e-3
+    save_every: int = 5
+    n_epochs: int = 1
+    # fields sweep() reads that the reference forgot to declare (§2.7):
+    n_repetitions: Optional[int] = None  # None → use n_epochs
+    center_activations: bool = False
+
+    def validate(self):
+        if self.dtype not in DTYPES:
+            raise ValueError(f"dtype must be one of {sorted(DTYPES)}, got {self.dtype}")
+        if self.layer_loc not in ("residual", "mlp", "mlp_out", "attn", "attn_concat", "mlpout"):
+            raise ValueError(f"unknown layer_loc {self.layer_loc}")
+        if self.batch_size <= 0 or self.n_chunks <= 0:
+            raise ValueError("batch_size and n_chunks must be positive")
+
+
+@dataclass
+class EnsembleArgs(TrainArgs):
+    """(reference `EnsembleArgs`, `config.py:54-58`)"""
+
+    activation_width: int = 512
+    use_synthetic_dataset: bool = False
+    bias_decay: float = 0.0
+
+
+@dataclass
+class SyntheticEnsembleArgs(EnsembleArgs):
+    """(reference `SyntheticEnsembleArgs`, `config.py:60-69`)"""
+
+    noise_magnitude_scale: float = 0.0
+    feature_prob_decay: float = 0.99
+    feature_num_nonzero: int = 10
+    gen_batch_size: int = 4096
+    dataset_folder: str = "activation_data"
+    n_ground_truth_components: int = 512
+    correlated_components: bool = False
+
+
+@dataclass
+class ErasureArgs(BaseArgs):
+    """(reference `ErasureArgs`, `config.py:71-79`)"""
+
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layer: Optional[int] = None
+    count_cutoff: int = 10000
+    output_folder: str = "output_erasure_pca"
+    activation_filename: str = "activation_data_erasure.npz"
+    dict_filename: str = ""
+
+
+@dataclass
+class ToyArgs(BaseArgs):
+    """(reference `ToyArgs`, `config.py:81-110`)"""
+
+    layer: int = 2
+    layer_loc: str = "residual"
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    dataset_name: str = "openwebtext"
+    tied_ae: bool = False
+    seed: int = 0
+    learned_dict_ratio: float = 1.0
+    output_folder: str = "outputs"
+    dtype: str = "float32"
+    activation_dim: int = 256
+    feature_prob_decay: float = 0.99
+    feature_num_nonzero: int = 5
+    correlated_components: bool = False
+    n_ground_truth_components: int = 512
+    noise_std: float = 0.1
+    l1_exp_low: int = -12
+    l1_exp_high: int = -11
+    l1_exp_base: float = 10 ** (1 / 4)
+    dict_ratio_exp_low: int = 1
+    dict_ratio_exp_high: int = 7
+    dict_ratio_exp_base: float = 2.0
+    batch_size: int = 4096
+    lr: float = 1e-3
+    epochs: int = 1
+    noise_level: float = 0.0
+    n_components_dictionary: int = 512
+    l1_alpha: float = 1e-3
+
+
+@dataclass
+class InterpArgs(BaseArgs):
+    """(reference `InterpArgs`, `config.py:112-126`)"""
+
+    layer: int = 2
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layer_loc: str = "residual"
+    n_feats_explain: int = 10
+    load_interpret_autoencoder: str = ""
+    tied_ae: bool = False
+    interp_name: str = ""
+    sort_mode: str = "max"
+    use_decoder: bool = True
+    df_n_feats: int = 200
+    top_k: int = 50
+    save_loc: str = ""
+
+    def validate(self):
+        if self.sort_mode not in ("max", "mean"):
+            raise ValueError(f"sort_mode must be max|mean, got {self.sort_mode}")
+
+
+@dataclass
+class InterpGraphArgs(BaseArgs):
+    """(reference `InterpGraphArgs`, `config.py:129-135`)"""
+
+    layer: int = 1
+    model_name: str = "EleutherAI/pythia-70m-deduped"
+    layer_loc: str = "mlp"
+    score_mode: str = "all"
+    run_all: bool = False
+
+    def validate(self):
+        if self.score_mode not in ("top", "random", "top_random", "all"):
+            raise ValueError(f"bad score_mode {self.score_mode}")
+
+
+@dataclass
+class InvestigateArgs(BaseArgs):
+    """(reference `InvestigateArgs`, `config.py:137-140`)"""
+
+    threshold: float = 0.9
+    layer: int = 2

@@ -21,14 +21,18 @@ from typing import Any, Dict, List, Optional
 import jax
 
 
+def format_hyperparam_val(val) -> str:
+    """(reference `format_hyperparam_val`, `big_sweep.py:76-80`)"""
+    return f"{val:.2E}".replace("+", "") if isinstance(val, float) else str(val)
+
+
 def make_hyperparam_name(hyperparam_values: Dict[str, Any]) -> str:
-    """Stable per-model series name, e.g. ``l1_alpha_1e-03``
-    (reference `make_hyperparam_name`, `big_sweep.py:76-84`)."""
-    parts = []
-    for k in sorted(hyperparam_values):
-        v = hyperparam_values[k]
-        parts.append(f"{k}_{v:.0e}" if isinstance(v, float) else f"{k}_{v}")
-    return "_".join(parts)
+    """Stable per-model series name, e.g. ``l1_alpha_1.00E-03``
+    (reference `make_hyperparam_name`, `big_sweep.py:83-84`)."""
+    return "_".join(
+        f"{k}_{format_hyperparam_val(hyperparam_values[k])}"
+        for k in sorted(hyperparam_values)
+    )
 
 
 class MetricLogger:

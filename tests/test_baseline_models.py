@@ -1,0 +1,151 @@
+"""Offline baselines: streaming PCA, ICA identifiability, NMF, RICA.
+
+Covers the reference's `test/test_ica.py` identifiability properties and adds
+streaming-vs-exact PCA and whitening checks (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import (
+    BatchedMean,
+    BatchedPCA,
+    ICAEncoder,
+    NMFEncoder,
+    RICA,
+    calc_mean,
+    calc_pca,
+)
+
+
+@pytest.fixture(scope="module")
+def gauss_data():
+    key = jax.random.PRNGKey(0)
+    # anisotropic gaussian with nonzero mean
+    d = 12
+    A = jax.random.normal(key, (d, d)) * jnp.linspace(0.2, 2.0, d)[None, :]
+    x = jax.random.normal(jax.random.PRNGKey(1), (4096, d)) @ A + 3.0
+    return x
+
+
+def test_batched_mean_matches_exact(gauss_data):
+    m = BatchedMean(gauss_data.shape[1])
+    for i in range(0, gauss_data.shape[0], 300):  # uneven final batch
+        m.train_batch(gauss_data[i : i + 300])
+    np.testing.assert_allclose(
+        np.asarray(m.get_mean()), np.asarray(gauss_data.mean(axis=0)), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(calc_mean(gauss_data)), np.asarray(gauss_data.mean(axis=0)), rtol=1e-4
+    )
+
+
+def test_streaming_pca_matches_exact_cov(gauss_data):
+    pca = calc_pca(gauss_data, batch_size=512)
+    x = np.asarray(gauss_data)
+    exact_cov = np.cov(x.T, bias=True)
+    np.testing.assert_allclose(np.asarray(pca.cov), exact_cov, rtol=1e-3, atol=1e-3)
+
+    # principal directions match exact eigh (up to sign)
+    evals, evecs = np.linalg.eigh(exact_cov)
+    top_exact = evecs[:, np.argmax(evals)]
+    top_stream = np.asarray(pca.get_dict()[0])
+    assert abs(float(np.dot(top_exact, top_stream))) > 0.999
+
+
+def test_pca_whitening_transform(gauss_data):
+    """center→rotate→scale should whiten the data to identity covariance."""
+    pca = calc_pca(gauss_data)
+    trans, rot, scale = pca.get_centering_transform()
+    x = np.asarray(gauss_data)
+    centered = (x - np.asarray(trans)) @ np.asarray(rot) * np.asarray(scale)
+    cov = np.cov(centered.T, bias=True)
+    np.testing.assert_allclose(cov, np.eye(x.shape[1]), atol=0.05)
+
+
+def test_pca_encoder_topk(gauss_data):
+    pca = calc_pca(gauss_data)
+    ld = pca.to_learned_dict(sparsity=3)
+    c = ld.encode(gauss_data[:100])
+    assert c.shape == (100, gauss_data.shape[1])
+    assert (np.asarray((c != 0).sum(axis=-1)) <= 3).all()
+    # signed codes: PCA scores keep their sign
+    assert float(c.min()) < 0
+
+    tk = pca.to_topk_dict(sparsity=3)
+    assert tk.get_learned_dict().shape[0] == 2 * gauss_data.shape[1]
+    rot = pca.to_rotation_dict(4)
+    assert rot.get_learned_dict().shape == (4, gauss_data.shape[1])
+
+
+def test_ica_identifiability_laplace():
+    """Laplace (super-gaussian) sources are identifiable: fitted components
+    should recover the identity mixing (reference `test/test_ica.py:14-40`)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.laplace(size=(4000, 6)))
+    ica = ICAEncoder(6, random_state=0, max_iter=1000)
+    ica.train(x)
+    d = np.abs(np.asarray(ica.get_learned_dict()))
+    # each component ~ a one-hot: max entry dominates
+    assert (d.max(axis=1) > 0.95).all()
+    c = ica.encode(x[:50])
+    assert c.shape == (50, 6)
+
+
+def test_ica_gaussian_not_identifiable():
+    """Gaussian data: two fits differ (reference `test/test_ica.py:42-69`)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2000, 5)))
+    ica1 = ICAEncoder(5, random_state=1, max_iter=500)
+    ica2 = ICAEncoder(5, random_state=2, max_iter=500)
+    ica1.train(x)
+    ica2.train(x)
+    d1, d2 = np.asarray(ica1.get_learned_dict()), np.asarray(ica2.get_learned_dict())
+    # best-match cosine between the two fits is far from a permutation match
+    cos = np.abs(d1 @ d2.T).max(axis=1)
+    assert cos.mean() < 0.999
+
+
+def test_nmf_roundtrip():
+    rng = np.random.default_rng(0)
+    W = np.abs(rng.normal(size=(4, 10)))
+    H = np.abs(rng.normal(size=(500, 4))) * (rng.random((500, 4)) < 0.5)
+    x = jnp.asarray(H @ W)
+    nmf = NMFEncoder(10, n_components=4, max_iter=500, init="nndsvda")
+    nmf.train(x)
+    c = nmf.encode(x[:50])
+    assert c.shape == (50, 4)
+    assert float(c.min()) >= 0.0
+    # reconstruction pairs transform() coefficients with the RAW components
+    # (get_learned_dict is row-normalized for the cosine-metric contract)
+    recon = np.asarray(c) @ np.asarray(nmf.nmf.components_)
+    assert np.mean((recon - np.asarray(x[:50] - nmf.shift)) ** 2) < 0.05 * np.mean(
+        np.asarray(x) ** 2
+    )
+    norms = np.linalg.norm(np.asarray(nmf.get_learned_dict()), axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-5)
+
+
+def test_rica_trains_in_ensemble():
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (256, 12))
+    ens = build_ensemble(
+        RICA,
+        jax.random.PRNGKey(4),
+        [{"sparsity_coef": 0.0}, {"sparsity_coef": 0.1, "sparsity_loss": "l1"}],
+        optimizer_kwargs={"learning_rate": 1e-2},
+        activation_size=12,
+        n_dict_components=24,
+    )
+    first = None
+    for _ in range(60):
+        loss, _ = ens.step_batch(x)
+        if first is None:
+            first = jax.device_get(loss["loss"])
+    last = jax.device_get(loss["loss"])
+    assert (last < first).all()
+    ld = ens.to_learned_dicts()[0]
+    assert ld.predict(x).shape == x.shape

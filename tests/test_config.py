@@ -1,0 +1,61 @@
+"""Config system: CLI overlay, validation, YAML round-trip."""
+
+import jax.numpy as jnp
+import pytest
+
+from sparse_coding__tpu.utils import EnsembleArgs, SyntheticEnsembleArgs, TrainArgs
+
+
+def test_defaults_and_declared_sweep_fields():
+    cfg = TrainArgs()
+    # fields the reference forgot to declare (SURVEY.md §2.7) exist here
+    assert cfg.n_repetitions is None
+    assert cfg.center_activations is False
+    assert cfg.jnp_dtype == jnp.float32
+
+
+def test_cli_overlay():
+    cfg = TrainArgs.from_cli(["--layer", "5", "--l1_alpha", "0.01", "--use_wandb", "false"])
+    assert cfg.layer == 5
+    assert cfg.l1_alpha == 0.01
+    assert cfg.use_wandb is False
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(SystemExit):
+        TrainArgs.from_cli(["--nonexistent", "1"])
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        TrainArgs(dtype="float8")
+    with pytest.raises(ValueError):
+        TrainArgs(layer_loc="bogus")
+    cfg = TrainArgs()
+    with pytest.raises(ValueError):
+        cfg.update({"nonexistent": 3})
+
+
+def test_inheritance_and_yaml_roundtrip(tmp_path):
+    cfg = SyntheticEnsembleArgs(activation_width=128, feature_num_nonzero=7)
+    assert cfg.lr == 1e-3  # inherited TrainArgs default
+    p = tmp_path / "cfg.yaml"
+    cfg.save_yaml(p)
+    cfg2 = SyntheticEnsembleArgs.load_yaml(p)
+    assert cfg2.as_dict() == cfg.as_dict()
+
+
+def test_no_argv_parsing_at_construction(monkeypatch):
+    """Constructing a config must NOT read sys.argv (the reference's
+    __post_init__ does, breaking library use — config.py:14-21)."""
+    monkeypatch.setattr("sys.argv", ["prog", "--garbage-flag", "x"])
+    cfg = EnsembleArgs()  # must not raise / must not consume argv
+    assert cfg.activation_width == 512
+
+
+def test_cli_optional_and_typed_fields():
+    """Optional[int] flags parse as int, not str (n_repetitions drives
+    np.tile in sweep); float fields parse as float."""
+    cfg = TrainArgs.from_cli(["--n_repetitions", "3", "--chunk_size_gb", "0.5"])
+    assert cfg.n_repetitions == 3 and isinstance(cfg.n_repetitions, int)
+    assert cfg.chunk_size_gb == 0.5

@@ -73,5 +73,6 @@ def test_loop_skips_fista_for_tied_sae():
 
 
 def test_make_hyperparam_name():
-    assert make_hyperparam_name({"l1_alpha": 1e-3}) == "l1_alpha_1e-03"
-    assert make_hyperparam_name({"k": 4, "l1_alpha": 1e-2}) == "k_4_l1_alpha_1e-02"
+    # reference format: {:.2E} with "+" stripped (big_sweep.py:76-84)
+    assert make_hyperparam_name({"l1_alpha": 1e-3}) == "l1_alpha_1.00E-03"
+    assert make_hyperparam_name({"k": 4, "l1_alpha": 1e-2}) == "k_4_l1_alpha_1.00E-02"
