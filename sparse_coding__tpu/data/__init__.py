@@ -10,3 +10,12 @@ from sparse_coding__tpu.data.chunks import (
     generate_synthetic_chunks,
     save_chunk,
 )
+from sparse_coding__tpu.data.activations import (
+    chunk_and_tokenize_texts,
+    chunk_tokens,
+    harvest_folder_name,
+    make_activation_dataset,
+    setup_data,
+    setup_token_data,
+)
+from sparse_coding__tpu.data.ioi import generate_ioi_dataset

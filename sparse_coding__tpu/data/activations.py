@@ -1,0 +1,261 @@
+"""LM activation harvesting → chunked activation store.
+
+TPU-native counterpart of the reference `activation_dataset.py` (L0 of the
+layer map). Differences by design (SURVEY.md §7 "hard parts" #1):
+
+  - The reference runs the subject LM over batches of FOUR sentences
+    (`MODEL_BATCH_SIZE=4`, `activation_dataset.py:37`) — its harvest
+    bottleneck. Here the forward is one jitted program over large token
+    batches, with every requested (layer, hook) captured in a single pass
+    (the reference's multi-layer variant, `make_activation_dataset_hf`,
+    `:326-391`) and early exit at the deepest requested layer.
+  - Chunks are written through `data.chunks.save_chunk` (fp16 .npy), one
+    folder per (layer, location), same `{i}` numbering and `skip_chunks`
+    resume semantics (`:351-358`).
+  - Long sequences: pass a mesh to shard the sequence axis with ring
+    attention (`lm.ring_attention`) — the reference caps sequences at 256
+    tokens (`:39`); we don't have to.
+
+Tokenization follows the reference's GPT-style concatenate-and-chunk
+(Nora Belrose's `chunk_and_tokenize`, `:139-238`): join documents with EOS,
+split the token stream into exact `max_length` chunks, drop the ragged tail.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.data.chunks import ChunkStore, save_chunk
+from sparse_coding__tpu.lm import model as lm_model
+
+MODEL_BATCH_SIZE = 64  # sentences per forward (vs the reference's 4)
+MAX_SENTENCE_LEN = 256  # reference `activation_dataset.py:39`
+
+
+# -- tokenization -------------------------------------------------------------
+
+def chunk_tokens(token_stream: Sequence[int], max_length: int) -> np.ndarray:
+    """Split one long token stream into exact-`max_length` rows, dropping the
+    ragged tail (the reference drops its final batch too, `:205-208`)."""
+    n = (len(token_stream) // max_length) * max_length
+    return np.asarray(token_stream[:n], dtype=np.int32).reshape(-1, max_length)
+
+
+def chunk_and_tokenize_texts(
+    texts: Sequence[str],
+    encode: Callable[[str], List[int]],
+    eos_id: int,
+    max_length: int = MAX_SENTENCE_LEN,
+) -> np.ndarray:
+    """GPT-style chunking: EOS-joined documents → `[n, max_length]` int32.
+
+    `encode` is any text→ids callable (an HF tokenizer's `lambda t:
+    tok(t)["input_ids"]`, or a test stub) — keeps this logic testable without
+    network-fetched tokenizer files.
+    """
+    stream: List[int] = []
+    for t in texts:
+        stream.append(eos_id)
+        stream.extend(encode(t))
+    return chunk_tokens(stream, max_length)
+
+
+def make_sentence_dataset(dataset_name: str, max_lines: int = 20_000, start_line: int = 0):
+    """HF dataset load (network / local cache; reference `:124-134`)."""
+    from datasets import load_dataset
+
+    return load_dataset(dataset_name, split="train")
+
+
+def setup_token_data(dataset_name: str, tokenizer, max_length: int = MAX_SENTENCE_LEN,
+                     max_lines: int = 20_000) -> np.ndarray:
+    """Tokenized `[n, max_length]` rows from an HF dataset
+    (reference `setup_token_data`, `activation_dataset.py:463-467`)."""
+    ds = make_sentence_dataset(dataset_name, max_lines=max_lines)
+    texts = ds["text"][:max_lines]
+    return chunk_and_tokenize_texts(
+        texts, lambda t: tokenizer(t)["input_ids"], tokenizer.eos_token_id, max_length
+    )
+
+
+# -- harvesting ---------------------------------------------------------------
+
+def harvest_folder_name(base_folder, layer: int, layer_loc: str) -> Path:
+    """One folder per (layer, location), reference layout `{base}_l{layer}_{loc}`
+    (cf. `make_activation_dataset_hf` folder-per-layer, `:326-391`)."""
+    return Path(f"{base_folder}_l{layer}_{layer_loc}")
+
+
+def make_activation_dataset(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    tokens: np.ndarray,
+    dataset_folder: Union[str, Path],
+    layers: Sequence[int],
+    layer_locs: Sequence[str],
+    batch_size: int = MODEL_BATCH_SIZE,
+    chunk_size_gb: float = 2.0,
+    n_chunks: Optional[int] = None,
+    skip_chunks: int = 0,
+    center_dataset: bool = False,
+    mesh=None,
+    single_folder: bool = False,
+) -> Dict[Tuple[int, str], Path]:
+    """Run the subject LM over `tokens` `[N, S]`, capturing every requested
+    (layer, layer_loc) in one pass; write fp16 chunks per capture point.
+
+    Returns {(layer, loc): folder}. `skip_chunks` resumes after a partial run
+    (reference `:351-358`); `center_dataset` subtracts the first chunk's mean
+    from all chunks (reference `:308-311, 379-381`); `mesh` switches the
+    forward to ring-attention sequence parallelism.
+    """
+    names = {
+        (layer, loc): lm_model.make_tensor_name(layer, loc)
+        for layer in layers
+        for loc in layer_locs
+    }
+    stop_at = max(layers) + 1
+    d_sizes = {
+        (layer, loc): lm_model.get_activation_size(lm_cfg, loc) for layer, loc in names
+    }
+
+    if single_folder:
+        assert len(names) == 1, "single_folder requires exactly one capture point"
+        folders = {key: Path(dataset_folder) for key in names}
+    else:
+        folders = {
+            (layer, loc): harvest_folder_name(dataset_folder, layer, loc)
+            for layer, loc in names
+        }
+    for f in folders.values():
+        f.mkdir(parents=True, exist_ok=True)
+
+    if mesh is None:
+        capture = jax.jit(
+            lambda p, t: lm_model.run_with_cache(
+                p, t, lm_cfg, list(names.values()), stop_at_layer=stop_at
+            )[1]
+        )
+    else:
+        from sparse_coding__tpu.lm.ring_attention import sequence_parallel_forward
+
+        capture = lambda p, t: sequence_parallel_forward(
+            p, t, lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at
+        )[1]
+
+    seq_len = tokens.shape[1]
+    rows_per_chunk = {
+        key: int(chunk_size_gb * 1024**3 // (d * 2)) for key, d in d_sizes.items()
+    }
+    # all capture points fill at the same row rate; chunk row budget is the min
+    chunk_rows = min(rows_per_chunk.values())
+    batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
+
+    n_batches_total = tokens.shape[0] // batch_size
+    max_chunks = n_chunks if n_chunks is not None else math.inf
+
+    chunk_idx = 0
+    batch_cursor = 0
+    means: Dict[Tuple[int, str], np.ndarray] = {}
+    while chunk_idx < max_chunks and batch_cursor + batches_per_chunk <= n_batches_total:
+        if chunk_idx < skip_chunks:
+            # resume: skip the forward entirely, just advance the cursor
+            batch_cursor += batches_per_chunk
+            chunk_idx += 1
+            continue
+        buffers: Dict[Tuple[int, str], List[np.ndarray]] = {k: [] for k in names}
+        for b in range(batches_per_chunk):
+            rows = tokens[(batch_cursor + b) * batch_size : (batch_cursor + b + 1) * batch_size]
+            cache = capture(params, jnp.asarray(rows))
+            for key, name in names.items():
+                act = cache[name]
+                buffers[key].append(
+                    np.asarray(jax.device_get(act)).reshape(-1, act.shape[-1])
+                )
+        for key in names:
+            chunk = np.concatenate(buffers[key], axis=0)
+            if center_dataset:
+                if chunk_idx == 0 and key not in means:
+                    means[key] = chunk.mean(axis=0)
+                    np.save(folders[key] / "mean.npy", means[key])
+                elif key not in means:
+                    means[key] = np.load(folders[key] / "mean.npy")
+                chunk = chunk - means[key]
+            save_chunk(folders[key], chunk_idx, chunk)
+        batch_cursor += batches_per_chunk
+        chunk_idx += 1
+
+    return folders
+
+
+def setup_data(
+    model_name: str,
+    dataset_name: str,
+    dataset_folder: Union[str, Path],
+    layer: Union[int, Sequence[int]],
+    layer_loc: Union[str, Sequence[str]] = "residual",
+    n_chunks: int = 30,
+    chunk_size_gb: float = 2.0,
+    center_dataset: bool = False,
+    max_length: int = MAX_SENTENCE_LEN,
+    batch_size: int = MODEL_BATCH_SIZE,
+    max_lines: int = 100_000,
+    skip_chunks: int = 0,
+) -> int:
+    """Full pipeline: HF model + dataset → tokenize → harvest → chunk store
+    (reference `setup_data`, `activation_dataset.py:400-460`). Needs the HF
+    model/dataset locally cached or network access. Returns n_datapoints."""
+    import transformers
+
+    from sparse_coding__tpu.lm.convert import _canonical_hf_name, load_model
+
+    lm_cfg, params = load_model(model_name)
+    tok_name = model_name if "/" in model_name else _canonical_hf_name(model_name)
+    tokenizer = transformers.AutoTokenizer.from_pretrained(tok_name)
+    tokens = setup_token_data(dataset_name, tokenizer, max_length=max_length, max_lines=max_lines)
+
+    layers = [layer] if isinstance(layer, int) else list(layer)
+    locs = [layer_loc] if isinstance(layer_loc, str) else list(layer_loc)
+    single = len(layers) == 1 and len(locs) == 1
+    folders = make_activation_dataset(
+        params, lm_cfg, tokens, dataset_folder, layers, locs,
+        batch_size=batch_size, chunk_size_gb=chunk_size_gb, n_chunks=n_chunks,
+        skip_chunks=skip_chunks, center_dataset=center_dataset,
+        single_folder=single,
+    )
+    return sum(ChunkStore(f).n_datapoints() for f in folders.values())
+
+
+def main(argv=None):
+    """CLI: `python -m sparse_coding__tpu.data.activations --layers 2 3 ...`
+    (reference `generate_test_data.py:13-50`)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description="Generate LM activation chunks")
+    p.add_argument("--model_name", default="EleutherAI/pythia-70m-deduped")
+    p.add_argument("--dataset_name", default="NeelNanda/pile-10k")
+    p.add_argument("--dataset_folder", required=True)
+    p.add_argument("--layers", type=int, nargs="+", required=True)
+    p.add_argument("--layer_locs", nargs="+", default=["residual"])
+    p.add_argument("--n_chunks", type=int, default=10)
+    p.add_argument("--chunk_size_gb", type=float, default=2.0)
+    p.add_argument("--center_dataset", action="store_true")
+    p.add_argument("--skip_chunks", type=int, default=0)
+    args = p.parse_args(argv)
+    n = setup_data(
+        args.model_name, args.dataset_name, args.dataset_folder,
+        layer=args.layers, layer_loc=args.layer_locs, n_chunks=args.n_chunks,
+        chunk_size_gb=args.chunk_size_gb, center_dataset=args.center_dataset,
+        skip_chunks=args.skip_chunks,
+    )
+    print(f"wrote {n} datapoints")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,10 @@ class ChunkStore:
         self.folder.mkdir(parents=True, exist_ok=True)
 
     def __len__(self) -> int:
-        return len([p for p in self.folder.iterdir() if p.suffix == ".npy"])
+        # only numbered chunk files — the folder may also hold mean.npy etc.
+        return len(
+            [p for p in self.folder.iterdir() if p.suffix == ".npy" and p.stem.isdigit()]
+        )
 
     @property
     def n_chunks(self) -> int:

@@ -1,0 +1,14 @@
+from sparse_coding__tpu.lm.model import (
+    LMConfig,
+    config_for,
+    dense_attention,
+    forward,
+    get_activation_size,
+    init_params,
+    lm_loss,
+    make_tensor_name,
+    run_with_cache,
+    run_with_hooks,
+)
+from sparse_coding__tpu.lm.convert import config_from_hf, load_model, params_from_hf
+from sparse_coding__tpu.lm.ring_attention import ring_attention, sequence_parallel_forward

@@ -1,0 +1,342 @@
+"""Hook-capable decoder-only transformer (the "subject LM").
+
+The reference harvests activations through transformer_lens
+(`HookedTransformer.run_with_cache`, `activation_dataset.py:364`) and
+intervenes through `run_with_hooks` (`standard_metrics.py:689-697`). This
+module is the TPU-native equivalent: a plain-pytree functional transformer
+covering the two architectures the reference exercises — GPT-NeoX (the Pythia
+family, `big_sweep_experiments.py:854-910`) and GPT-2
+(`run_single_layer_gpt2`, `:1240-1275`) — with:
+
+  - `run_with_cache(..., names, stop_at_layer)`: capture any of the four hook
+    points of `make_tensor_name` (`activation_dataset.py:78-109`) under one
+    jit, with early exit at `stop_at_layer` (the reference's
+    `stop_at_layer=layer+1` trick, `:364`);
+  - `run_with_hooks(..., hooks={name: fn})`: intercept-and-replace at a hook
+    point for perplexity-under-reconstruction and ablation evals
+    (`standard_metrics.py:222-250, 619-707`);
+  - attention switchable between dense and ring/blockwise sequence-parallel
+    (`lm.ring_attention`) for long-context harvesting.
+
+Hook names are transformer_lens-compatible:
+  blocks.{i}.hook_resid_post       — residual after block i          ("residual")
+  blocks.{i}.mlp.hook_post         — MLP hidden post-activation      ("mlp")
+  blocks.{i}.hook_mlp_out          — MLP output in residual basis    ("mlpout")
+  blocks.{i}.attn.hook_z           — per-head attn out, flattened    ("attn")
+(The reference's `make_tensor_name` maps "attn" to `hook_resid_post` while
+`get_activation_size` sizes it as n_heads*d_head — `activation_dataset.py:51-76`
+vs `:99-103`, an inconsistency we do not replicate.)
+
+TPU notes: blocks are a static Python loop (small n_layers) inside one jit —
+XLA sees a flat graph and fuses per-block chains; weights live in bf16-friendly
+layouts ([heads, d_head, d_model] for attention) so every contraction is an
+MXU matmul.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    arch: str  # "neox" | "gpt2"
+    n_layers: int
+    d_model: int
+    n_heads: int
+    d_mlp: int
+    vocab_size: int
+    n_ctx: int = 2048
+    rotary_pct: float = 0.25  # neox
+    rotary_base: float = 10000.0
+    parallel_residual: bool = True  # neox (Pythia uses parallel residual)
+    layer_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False  # gpt2 ties; pythia does not
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# -- model registry (offline metadata for the reference's model names) --------
+
+_PYTHIA = {
+    # name: (n_layers, d_model, n_heads)
+    "pythia-14m": (6, 128, 4),
+    "pythia-70m": (6, 512, 8),
+    "pythia-160m": (12, 768, 12),
+    "pythia-410m": (24, 1024, 16),
+    "pythia-1b": (16, 2048, 8),
+    "pythia-1.4b": (24, 2048, 16),
+    "pythia-2.8b": (32, 2560, 32),
+    "pythia-6.9b": (32, 4096, 32),
+}
+_GPT2 = {
+    "gpt2": (12, 768, 12),
+    "gpt2-medium": (24, 1024, 16),
+    "gpt2-large": (36, 1280, 20),
+    "gpt2-xl": (48, 1600, 25),
+}
+
+
+def config_for(model_name: str) -> LMConfig:
+    """Offline LMConfig for the model names the reference uses (pythia-*
+    optionally '-deduped', EleutherAI/-prefixed; gpt2 family)."""
+    name = model_name.split("/")[-1].replace("-deduped", "")
+    if name in _PYTHIA:
+        L, d, h = _PYTHIA[name]
+        return LMConfig(
+            arch="neox", n_layers=L, d_model=d, n_heads=h, d_mlp=4 * d,
+            vocab_size=50304, n_ctx=2048, rotary_pct=0.25, parallel_residual=True,
+        )
+    if name in _GPT2:
+        L, d, h = _GPT2[name]
+        return LMConfig(
+            arch="gpt2", n_layers=L, d_model=d, n_heads=h, d_mlp=4 * d,
+            vocab_size=50257, n_ctx=1024, tie_word_embeddings=True,
+        )
+    raise ValueError(f"Unknown model name: {model_name}")
+
+
+def get_activation_size(model_name_or_cfg, layer_loc: str) -> int:
+    """(reference `get_activation_size`, `activation_dataset.py:51-69`)"""
+    cfg = (
+        model_name_or_cfg
+        if isinstance(model_name_or_cfg, LMConfig)
+        else config_for(model_name_or_cfg)
+    )
+    if layer_loc in ("residual", "mlpout"):
+        return cfg.d_model
+    if layer_loc == "mlp":
+        return cfg.d_mlp
+    if layer_loc == "attn":
+        return cfg.n_heads * cfg.d_head
+    raise ValueError(f"Layer location {layer_loc} not supported")
+
+
+def make_tensor_name(layer: int, layer_loc: str) -> str:
+    """(reference `make_tensor_name`, `activation_dataset.py:78-109`)"""
+    names = {
+        "residual": f"blocks.{layer}.hook_resid_post",
+        "mlp": f"blocks.{layer}.mlp.hook_post",
+        "mlpout": f"blocks.{layer}.hook_mlp_out",
+        "attn": f"blocks.{layer}.attn.hook_z",
+    }
+    if layer_loc not in names:
+        raise ValueError(f"Layer location {layer_loc} not supported")
+    return names[layer_loc]
+
+
+# -- init ---------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: LMConfig, dtype=jnp.float32) -> Pytree:
+    """Random-init params (test fixtures / toy models; real weights come from
+    `lm.convert.params_from_hf`)."""
+    k = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+    scale = 0.02
+    norm = lambda: {"w": jnp.ones((cfg.d_model,), dtype), "b": jnp.zeros((cfg.d_model,), dtype)}
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(next(k), (cfg.vocab_size, cfg.d_model), dtype) * scale,
+        "ln_f": norm(),
+        "blocks": [],
+    }
+    if cfg.arch == "gpt2":
+        params["pos_embed"] = jax.random.normal(next(k), (cfg.n_ctx, cfg.d_model), dtype) * scale
+    if not cfg.tie_word_embeddings:
+        params["unembed"] = jax.random.normal(next(k), (cfg.vocab_size, cfg.d_model), dtype) * scale
+    for _ in range(cfg.n_layers):
+        block = {
+            "ln1": norm(),
+            "ln2": norm(),
+            "attn": {
+                "w_qkv": jax.random.normal(
+                    next(k), (3, cfg.n_heads, cfg.d_head, cfg.d_model), dtype
+                ) * scale,
+                "b_qkv": jnp.zeros((3, cfg.n_heads, cfg.d_head), dtype),
+                "w_o": jax.random.normal(
+                    next(k), (cfg.d_model, cfg.n_heads, cfg.d_head), dtype
+                ) * scale,
+                "b_o": jnp.zeros((cfg.d_model,), dtype),
+            },
+            "mlp": {
+                "w_in": jax.random.normal(next(k), (cfg.d_mlp, cfg.d_model), dtype) * scale,
+                "b_in": jnp.zeros((cfg.d_mlp,), dtype),
+                "w_out": jax.random.normal(next(k), (cfg.d_model, cfg.d_mlp), dtype) * scale,
+                "b_out": jnp.zeros((cfg.d_model,), dtype),
+            },
+        }
+        params["blocks"].append(block)
+    return params
+
+
+# -- building blocks ----------------------------------------------------------
+
+def layer_norm(x: jax.Array, p: Dict[str, jax.Array], eps: float) -> jax.Array:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * p["w"] + p["b"]
+
+
+def _rope(x: jax.Array, positions: jax.Array, rotary_dims: int, base: float) -> jax.Array:
+    """Rotary embedding on the first `rotary_dims` of the head dim (NeoX
+    style: rotate-half pairing, not interleaved)."""
+    if rotary_dims == 0:
+        return x
+    rot, rest = x[..., :rotary_dims], x[..., rotary_dims:]
+    half = rotary_dims // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) * 2.0 / rotary_dims)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :]  # [1, S, 1, half]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = rot[..., :half], rot[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+    return jnp.concatenate([rotated, rest], axis=-1)
+
+
+def dense_attention(q, k, v, causal: bool = True):
+    """[B, S, H, Dh] attention, fp32 softmax accumulation."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S, K = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((S, K), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _gelu_new(x):
+    """GPT-2's tanh-approximated GELU."""
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * (x + 0.044715 * x**3)))
+
+
+def attention_block(
+    p, x_normed, cfg: LMConfig, attn_impl: Callable = dense_attention,
+    positions: Optional[jax.Array] = None,
+):
+    """Returns (attn_out [B,S,d_model], z [B,S,H*Dh]). `positions` are GLOBAL
+    token positions (needed when the sequence axis is sharded)."""
+    qkv = jnp.einsum("thdm,bsm->tbshd", p["w_qkv"], x_normed) + p["b_qkv"][:, None, None]
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    if cfg.arch == "neox":
+        rotary_dims = int(cfg.rotary_pct * cfg.d_head)
+        if positions is None:
+            positions = jnp.arange(x_normed.shape[1])
+        q = _rope(q, positions, rotary_dims, cfg.rotary_base)
+        k = _rope(k, positions, rotary_dims, cfg.rotary_base)
+    z = attn_impl(q, k, v)  # [B, S, H, Dh]
+    z_flat = z.reshape(*z.shape[:2], -1)
+    out = jnp.einsum("mhd,bshd->bsm", p["w_o"], z) + p["b_o"]
+    return out, z_flat
+
+
+def mlp_block(p, x_normed, cfg: LMConfig):
+    """Returns (mlp_out, hidden_post_act)."""
+    act = _gelu_new if cfg.arch == "gpt2" else jax.nn.gelu
+    h = act(jnp.einsum("fm,bsm->bsf", p["w_in"], x_normed) + p["b_in"])
+    out = jnp.einsum("mf,bsf->bsm", p["w_out"], h) + p["b_out"]
+    return out, h
+
+
+# -- forward with hooks -------------------------------------------------------
+
+HookFn = Callable[[jax.Array], jax.Array]
+
+
+def forward(
+    params: Pytree,
+    tokens: jax.Array,
+    cfg: LMConfig,
+    hooks: Optional[Dict[str, HookFn]] = None,
+    cache_names: Optional[Sequence[str]] = None,
+    stop_at_layer: Optional[int] = None,
+    attn_impl: Callable = dense_attention,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
+    """Run the model. Returns (logits | residual-at-stop, cache).
+
+    `hooks[name]` replaces the tensor at hook point `name`;
+    `cache_names` lists hook points to capture; `stop_at_layer=n` runs blocks
+    [0, n) and returns the residual instead of logits. `positions` overrides
+    the global token positions (sequence-sharded runs pass shard offsets).
+    """
+    hooks = hooks or {}
+    want = set(cache_names or [])
+    cache: Dict[str, jax.Array] = {}
+
+    def at_hook(name: str, tensor: jax.Array) -> jax.Array:
+        if name in hooks:
+            tensor = hooks[name](tensor)
+        if name in want:
+            cache[name] = tensor
+        return tensor
+
+    x = params["embed"][tokens]
+    if cfg.arch == "gpt2":
+        pos = positions if positions is not None else jnp.arange(tokens.shape[1])
+        x = x + params["pos_embed"][pos][None]
+
+    n_blocks = cfg.n_layers if stop_at_layer is None else min(stop_at_layer, cfg.n_layers)
+    for i in range(n_blocks):
+        p = params["blocks"][i]
+        if cfg.arch == "neox" and cfg.parallel_residual:
+            attn_out, z = attention_block(p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions)
+            z = at_hook(f"blocks.{i}.attn.hook_z", z)
+            mlp_out, h = mlp_block(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
+            h = at_hook(f"blocks.{i}.mlp.hook_post", h)
+            mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
+            mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
+            x = x + attn_out + mlp_out
+        else:  # serial residual (gpt2, non-parallel neox)
+            attn_out, z = attention_block(p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions)
+            z = at_hook(f"blocks.{i}.attn.hook_z", z)
+            x = x + attn_out
+            mlp_out, h = mlp_block(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
+            h = at_hook(f"blocks.{i}.mlp.hook_post", h)
+            mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
+            mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
+            x = x + mlp_out
+        x = at_hook(f"blocks.{i}.hook_resid_post", x)
+
+    if stop_at_layer is not None:
+        return x, cache
+
+    x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+    unembed = params["embed"] if cfg.tie_word_embeddings else params["unembed"]
+    logits = jnp.einsum("vm,bsm->bsv", unembed, x)
+    return logits, cache
+
+
+def run_with_cache(
+    params, tokens, cfg, names: Sequence[str], stop_at_layer: Optional[int] = None,
+    attn_impl: Callable = dense_attention,
+):
+    """transformer_lens-style capture (reference `activation_dataset.py:364`)."""
+    return forward(
+        params, tokens, cfg, cache_names=names, stop_at_layer=stop_at_layer,
+        attn_impl=attn_impl,
+    )
+
+
+def run_with_hooks(params, tokens, cfg, hooks: Dict[str, HookFn], attn_impl: Callable = dense_attention):
+    """transformer_lens-style intervention (reference `standard_metrics.py:689-697`)."""
+    logits, _ = forward(params, tokens, cfg, hooks=hooks, attn_impl=attn_impl)
+    return logits
+
+
+def lm_loss(params, tokens, cfg: LMConfig, attn_impl: Callable = dense_attention) -> jax.Array:
+    """Mean next-token cross-entropy (transformer_lens `return_type='loss'`)."""
+    logits, _ = forward(params, tokens, cfg, attn_impl=attn_impl)
+    logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    ll = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

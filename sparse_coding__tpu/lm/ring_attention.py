@@ -1,0 +1,130 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support for the subject LM (SURVEY.md §5 notes the reference has
+none by construction — sequences are capped at 256 tokens,
+`activation_dataset.py:39` — but long-context is first-class here). The
+sequence is sharded across a mesh axis; each device holds a `[B, S/p, H, Dh]`
+block of Q/K/V. K/V blocks rotate around the ring via `lax.ppermute` (ICI
+neighbor exchange) while each device accumulates its queries' attention with a
+numerically-stable online softmax — communication overlaps compute, memory is
+O(S/p), and the result is EXACTLY dense causal attention (verified by
+`tests/test_lm.py::test_ring_attention_matches_dense`).
+
+Use through `sequence_parallel_forward`, which shard_maps the full LM forward
+with `attn_impl=ring_attention(axis)` and global position offsets per shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparse_coding__tpu.lm import model as lm_model
+
+
+def ring_attention(axis_name: str) -> Callable:
+    """Build an `attn_impl(q, k, v, causal=True)` that runs ring attention
+    over `axis_name`. Must be called inside `shard_map` over that axis."""
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+        p = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        B, S_local, H, Dh = q.shape
+        scale = 1.0 / jnp.sqrt(Dh)
+        q_pos = idx * S_local + jnp.arange(S_local)
+
+        # online-softmax accumulators (fp32)
+        m = jnp.full((B, H, S_local), -jnp.inf, jnp.float32)
+        l = jnp.zeros((B, H, S_local), jnp.float32)
+        o = jnp.zeros((B, S_local, H, Dh), jnp.float32)
+
+        k_blk, v_blk = k, v
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        for t in range(p):  # p is static (mesh size)
+            blk_idx = (idx - t) % p
+            k_pos = blk_idx * S_local + jnp.arange(S_local)
+            scores = (
+                jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+            )
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            # guard fully-masked rows: exp(-inf - -inf) → use finite m
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+            probs = jnp.exp(scores - m_safe[..., None])
+            l = l * alpha + probs.sum(axis=-1)
+            o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, v_blk.astype(jnp.float32)
+            )
+            m = m_new
+            if t < p - 1:
+                k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+                v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+        l_safe = jnp.maximum(l, 1e-30)
+        out = o / l_safe.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    return attn
+
+
+def sequence_parallel_forward(
+    params,
+    tokens: jax.Array,
+    cfg: lm_model.LMConfig,
+    mesh: Mesh,
+    axis_name: str = "data",
+    cache_names: Optional[Sequence[str]] = None,
+    hooks: Optional[Dict[str, Callable]] = None,
+    stop_at_layer: Optional[int] = None,
+) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
+    """Full LM forward with the sequence dimension sharded over `axis_name`.
+
+    Tokens `[B, S]` are sharded on S; every hook tensor and the output keep
+    that sharding (`[B, S, ...]` on the same axis), so harvested activations
+    are born distributed — the activation store's natural layout. Hooks run on
+    local shards (positionwise hooks like SAE replacement are shard-local by
+    construction).
+    """
+    from jax.experimental.shard_map import shard_map
+
+    cache_names = tuple(cache_names or ())
+    n_shards = mesh.shape[axis_name]
+    S = tokens.shape[1]
+    if S % n_shards != 0:
+        raise ValueError(f"sequence length {S} not divisible by {n_shards} shards")
+    S_local = S // n_shards
+
+    def local_fn(params, tok_shard):
+        idx = jax.lax.axis_index(axis_name)
+        positions = idx * S_local + jnp.arange(S_local)
+        out, cache = lm_model.forward(
+            params,
+            tok_shard,
+            cfg,
+            hooks=hooks,
+            cache_names=cache_names,
+            stop_at_layer=stop_at_layer,
+            attn_impl=ring_attention(axis_name),
+            positions=positions,
+        )
+        return out, cache
+
+    seq_spec = P(None, axis_name)
+    out_spec = P(None, axis_name, None)
+    cache_specs = {name: out_spec for name in cache_names}
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(), seq_spec),
+        out_specs=(out_spec, cache_specs),
+        check_rep=False,
+    )
+    tokens = jax.device_put(tokens, NamedSharding(mesh, seq_spec))
+    return fn(params, tokens)

@@ -1,0 +1,52 @@
+"""Dictionary-vector clustering diagnostics (host-side sklearn/scipy).
+
+Counterpart of the reference `standard_metrics.py:532-577`: t-SNE + KMeans
+cluster listing and hierarchical (cosine-linkage) clustering. Offline
+analysis — numpy in, numpy out.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def cluster_vectors(
+    model,
+    n_clusters: int = 1000,
+    top_clusters: int = 10,
+    save_loc: Optional[str] = None,
+    random_state: int = 0,
+    perplexity: float = 30.0,
+) -> List[np.ndarray]:
+    """t-SNE → KMeans on the dictionary rows; returns the member indices of
+    the `top_clusters` most populous clusters
+    (reference `cluster_vectors`, `standard_metrics.py:533-566`)."""
+    from sklearn.cluster import KMeans
+    from sklearn.manifold import TSNE
+
+    vectors = np.asarray(model.get_learned_dict())
+    perplexity = min(perplexity, max(2.0, (vectors.shape[0] - 1) / 3))
+    tsne = TSNE(n_components=2, random_state=random_state, perplexity=perplexity)
+    embedded = tsne.fit_transform(vectors)
+    n_clusters = min(n_clusters, vectors.shape[0])
+    kmeans = KMeans(n_clusters=n_clusters, random_state=random_state, n_init=10).fit(embedded)
+    ids, counts = np.unique(kmeans.labels_, return_counts=True)
+    order = np.argsort(counts)[::-1]
+    top = [np.where(kmeans.labels_ == ids[i])[0] for i in order[:top_clusters]]
+    if save_loc:
+        with open(save_loc, "w") as f:
+            for cluster in top:
+                f.write(f"{list(cluster)}\n")
+    return top
+
+
+def hierarchical_cluster_vectors(vectors, n_clusters: int = 100) -> np.ndarray:
+    """Average-linkage cosine hierarchical clustering; returns cluster ids per
+    row (reference `hierarchical_cluster_vectors`, `standard_metrics.py:568-577`,
+    minus the interactive dendrogram display)."""
+    from scipy.cluster.hierarchy import cut_tree, linkage
+
+    linkage_matrix = linkage(np.asarray(vectors), "average", metric="cosine")
+    return cut_tree(linkage_matrix, n_clusters=n_clusters).reshape(-1)

@@ -1,0 +1,208 @@
+"""Model-intervention metrics: run the subject LM with dictionary-mediated
+edits at a hook point.
+
+Counterpart of the reference `standard_metrics.py:84-250` and `:619-707`:
+`cache_all_activations`, feature-ablation graphs (positional and
+non-positional), `perplexity_under_reconstruction`, and `calculate_perplexity`
+over `(LearnedDict, hyperparams)` lists. Interventions are pure hook functions
+into `lm.model.forward` — each (dict, location) pair compiles once and the
+whole edited forward runs as one XLA program.
+
+A `Location` is `(layer, layer_loc)` with `layer_loc` one of
+residual|mlp|mlpout|attn (reference `Location` + `get_model_tensor_name`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from itertools import product
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.lm import model as lm_model
+
+Location = Tuple[int, str]
+
+
+def get_model_tensor_name(location: Location) -> str:
+    return lm_model.make_tensor_name(location[0], location[1])
+
+
+def replace_with_reconstruction_hook(model) -> Callable[[jax.Array], jax.Array]:
+    """Hook: tensor [B, L, C] → dict reconstruction of it
+    (reference `perplexity_under_reconstruction.intervention`,
+    `standard_metrics.py:228-235`)."""
+
+    def hook(tensor: jax.Array) -> jax.Array:
+        B, L, C = tensor.shape
+        return model.predict(tensor.reshape(B * L, C)).reshape(B, L, C)
+
+    return hook
+
+
+def ablate_feature_intervention(model, feature: Tuple[int, int]) -> Callable:
+    """Positional ablation: subtract feature `idx`'s dictionary direction,
+    scaled by its activation, at sequence position `pos` only
+    (reference `ablate_feature_intervention`, used by `build_ablation_graph`)."""
+    pos, idx = feature
+
+    def hook(tensor: jax.Array) -> jax.Array:
+        B, L, C = tensor.shape
+        flat = tensor.reshape(B * L, C)
+        acts = model.encode(flat).reshape(B, L, -1)
+        direction = model.get_learned_dict()[idx]
+        delta = acts[:, pos, idx][:, None] * direction[None, :]
+        return tensor.at[:, pos, :].add(-delta)
+
+    return hook
+
+
+def ablate_feature_intervention_non_positional(model, feature_idx: int) -> Callable:
+    """Ablate feature `feature_idx` at every position
+    (reference `standard_metrics.py:163-177`)."""
+
+    def hook(tensor: jax.Array) -> jax.Array:
+        B, L, C = tensor.shape
+        flat = tensor.reshape(B * L, C)
+        acts = model.encode(flat)
+        ablation = acts[:, feature_idx][:, None] * model.get_learned_dict()[feature_idx][None, :]
+        return tensor - ablation.reshape(B, L, C)
+
+    return hook
+
+
+def cache_all_activations(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    models: Dict[Location, Any],
+    tokens: jax.Array,
+    hooks: Optional[Dict[str, Callable]] = None,
+) -> Dict[Location, jax.Array]:
+    """Per-location dictionary codes over the token batch
+    (reference `cache_all_activations`, `standard_metrics.py:84-108`).
+    Returns {location: [B, L, n_feats]}."""
+    names = [get_model_tensor_name(loc) for loc in models]
+    _, cache = lm_model.forward(params, tokens, lm_cfg, hooks=hooks, cache_names=names)
+    out = {}
+    for location, model in models.items():
+        tensor = cache[get_model_tensor_name(location)]
+        B, L, C = tensor.shape
+        out[location] = model.encode(tensor.reshape(B * L, C)).reshape(B, L, -1)
+    return out
+
+
+def _graph_from_ablations(
+    base_acts, models, params, lm_cfg, tokens, features_to_ablate, all_features,
+    make_hook, read_feature,
+):
+    graph = {}
+    for location, model in models.items():
+        name = get_model_tensor_name(location)
+        # a location may be target-only (the reference KeyErrors here)
+        for feature in features_to_ablate.get(location, []):
+            ablated = cache_all_activations(
+                params, lm_cfg, models, tokens, hooks={name: make_hook(model, feature)}
+            )
+            for location_, feature_ in all_features:
+                if location_ == location and feature_ == feature:
+                    continue
+                un = read_feature(base_acts[location_], feature_)
+                ab = read_feature(ablated[location_], feature_)
+                graph[((location, feature), (location_, feature_))] = float(
+                    jnp.abs(un - ab).mean()
+                )
+    return graph
+
+
+def build_ablation_graph(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    models: Dict[Location, Any],
+    tokens: jax.Array,
+    features_to_ablate: Optional[Dict[Location, List[Tuple[int, int]]]] = None,
+    target_features: Optional[Dict[Location, List[Tuple[int, int]]]] = None,
+):
+    """Positional ablation graph (reference `standard_metrics.py:115-161`):
+    edge weight = mean |Δ activation| of (pos, feat) under ablating another."""
+    B, L = tokens.shape
+    if not features_to_ablate:
+        features_to_ablate = {
+            loc: list(product(range(L), range(m.get_learned_dict().shape[0])))
+            for loc, m in models.items()
+        }
+    merged = {**features_to_ablate, **(target_features or {})}
+    all_features = [(loc, f) for loc, feats in merged.items() for f in feats]
+    base = cache_all_activations(params, lm_cfg, models, tokens)
+    return _graph_from_ablations(
+        base, models, params, lm_cfg, tokens, features_to_ablate, all_features,
+        ablate_feature_intervention,
+        read_feature=lambda acts, f: acts[:, f[0], f[1]],
+    )
+
+
+def build_ablation_graph_non_positional(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    models: Dict[Location, Any],
+    tokens: jax.Array,
+    features_to_ablate: Optional[Dict[Location, List[int]]] = None,
+    target_features: Optional[Dict[Location, List[int]]] = None,
+):
+    """Non-positional variant (reference `standard_metrics.py:179-220`);
+    edge weight = mean L2 over positions of the feature-activation change."""
+    if not features_to_ablate:
+        features_to_ablate = {
+            loc: list(range(m.get_learned_dict().shape[0])) for loc, m in models.items()
+        }
+    merged = {**features_to_ablate, **(target_features or {})}
+    all_features = [(loc, f) for loc, feats in merged.items() for f in feats]
+    base = cache_all_activations(params, lm_cfg, models, tokens)
+    return _graph_from_ablations(
+        base, models, params, lm_cfg, tokens, features_to_ablate, all_features,
+        ablate_feature_intervention_non_positional,
+        read_feature=lambda acts, f: jnp.linalg.norm(acts[:, :, f], axis=-1),
+    )
+
+
+def perplexity_under_reconstruction(
+    params, lm_cfg: lm_model.LMConfig, model, location: Location, tokens: jax.Array
+) -> jax.Array:
+    """LM loss with the hook tensor replaced by its dictionary reconstruction
+    (reference `standard_metrics.py:222-250`)."""
+    name = get_model_tensor_name(location)
+    hook = replace_with_reconstruction_hook(model)
+    logits, _ = lm_model.forward(params, tokens, lm_cfg, hooks={name: hook})
+    logprobs = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    ll = jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def calculate_perplexity(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    learned_dicts: Sequence[Tuple[Any, Dict[str, Any]]],
+    location: Location,
+    tokens: jax.Array,
+    batch_size: int = 16,
+) -> Tuple[float, List[Tuple[Dict[str, Any], float]]]:
+    """Baseline LM loss + loss under each dict's reconstruction
+    (reference `calculate_perplexity`, `standard_metrics.py:619-707`).
+    Batches the token set; one jitted edited-forward per dict."""
+    n = (tokens.shape[0] // batch_size) * batch_size
+    batches = np.asarray(tokens[:n]).reshape(-1, batch_size, tokens.shape[1])
+
+    loss_fn = jax.jit(partial(lm_model.lm_loss, cfg=lm_cfg))
+    base = float(np.mean([float(loss_fn(params, jnp.asarray(b))) for b in batches]))
+
+    results = []
+    for ld, hyperparams in learned_dicts:
+        ppl_fn = jax.jit(
+            lambda p, t, ld=ld: perplexity_under_reconstruction(p, lm_cfg, ld, location, t)
+        )
+        loss = float(np.mean([float(ppl_fn(params, jnp.asarray(b))) for b in batches]))
+        results.append((hyperparams, loss))
+    return base, results

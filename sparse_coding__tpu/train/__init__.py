@@ -15,3 +15,16 @@ from sparse_coding__tpu.train.checkpoint import (
     save_ensemble_checkpoint,
     save_learned_dicts,
 )
+from sparse_coding__tpu.train.baselines import (
+    load_baseline,
+    run_all_baselines,
+    run_layer_baselines,
+)
+from sparse_coding__tpu.train.big_batch import (
+    BigBatchState,
+    WorstExamples,
+    resurrect_dead_features,
+    train_big_batch,
+)
+from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+from sparse_coding__tpu.train import experiments

@@ -1,0 +1,76 @@
+"""Single-host FISTA l1-sweep driver.
+
+Counterpart of the reference `basic_l1_sweep.py`: a FunctionalFista ensemble
+over an l1 grid, trained on pre-dumped activation chunks, saving
+`(LearnedDict, hyperparams)` per epoch/chunk. The reference's tqdm
+ProgressBar shim and its parting `rundll32.exe powrprof.dll` Windows suspend
+call (`basic_l1_sweep.py:17-46, 121-123` — fork-author artifact flagged in
+SURVEY.md §2.7) are not replicated.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from sparse_coding__tpu.data.chunks import ChunkStore
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalFista
+from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+from sparse_coding__tpu.train.loop import ensemble_train_loop
+from sparse_coding__tpu.utils.logging import MetricLogger
+
+
+def basic_l1_sweep(
+    dataset_folder: str,
+    output_folder: str,
+    activation_width: int,
+    l1_values: Optional[Sequence[float]] = None,
+    dict_ratio: float = 4.0,
+    batch_size: int = 1024,
+    n_epochs: int = 1,
+    lr: float = 1e-3,
+    fista_iters: int = 500,
+    seed: int = 0,
+) -> List[Tuple[object, dict]]:
+    """Train a FISTA ensemble over `l1_values` on every chunk in
+    `dataset_folder`; save learned dicts per epoch (reference
+    `basic_l1_sweep.py:48-123`). Returns the final dict list."""
+    if l1_values is None:
+        l1_values = list(np.logspace(-4, -2, 8))
+    store = ChunkStore(dataset_folder)
+    assert len(store) > 0, f"no chunks in {dataset_folder}"
+    out = Path(output_folder)
+    out.mkdir(parents=True, exist_ok=True)
+
+    dict_size = int(activation_width * dict_ratio)
+    ens = build_ensemble(
+        FunctionalFista,
+        jax.random.PRNGKey(seed),
+        [{"l1_alpha": float(a)} for a in l1_values],
+        optimizer_kwargs={"learning_rate": lr},
+        activation_size=activation_width,
+        n_dict_components=dict_size,
+    )
+    logger = MetricLogger(out_dir=output_folder, run_name="basic_l1_sweep")
+
+    key = jax.random.PRNGKey(seed + 1)
+    learned_dicts: List[Tuple[object, dict]] = []
+    for epoch in range(n_epochs):
+        for chunk_idx in range(len(store)):
+            chunk = store.load(chunk_idx)
+            key, k = jax.random.split(key)
+            ensemble_train_loop(
+                ens, chunk, batch_size=batch_size, key=k,
+                logger=logger, fista_iters=fista_iters,
+            )
+        learned_dicts = [
+            (ld, {"l1_alpha": float(a), "dict_size": dict_size})
+            for ld, a in zip(ens.to_learned_dicts(), l1_values)
+        ]
+        save_learned_dicts(out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts)
+    logger.close()
+    return learned_dicts

@@ -1,0 +1,198 @@
+"""Huge-batch data-parallel SAE trainer with dead-feature resurrection.
+
+Counterpart of the reference `experiments/huge_batch_size.py`: one big SAE
+trained with very large batches under data parallelism, periodically
+re-initializing dead dictionary features from the worst-reconstructed
+examples (including the per-feature Adam-state reset, `:224-254`).
+
+TPU-native inversion of the reference's DDP machinery (`:259-345`): no
+process groups — the train step is jitted over a mesh with the batch sharded
+on the "data" axis, and XLA inserts the gradient psum over ICI (SURVEY.md
+§2.4 P3). Dead-feature resurrection, an in-place indexed mutation of params
+AND optimizer state in torch, is a pure `tree-map`/`.at[]` update here
+(SURVEY.md §7 noted this must be designed in from the start — it is: optax's
+adam state mirrors param shapes, so one function handles both).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sparse_coding__tpu.parallel.mesh import DATA_AXIS, batch_sharding
+
+Pytree = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BigBatchState:
+    params: Pytree
+    buffers: Pytree
+    opt_state: Pytree
+    c_totals: jax.Array  # per-feature activation sums since last reinit
+    step: jax.Array
+
+
+class WorstExamples:
+    """Track the k worst-reconstructed example indices (host-side ring of the
+    reference's `worst_indices` heap, `huge_batch_size.py:208-210`)."""
+
+    def __init__(self, k: int = 1024):
+        self.k = k
+        self.losses = np.full((k,), -np.inf)
+        self.indices = np.zeros((k,), dtype=np.int64)
+
+    def update(self, indices: np.ndarray, losses: np.ndarray):
+        all_l = np.concatenate([self.losses, losses])
+        all_i = np.concatenate([self.indices, indices])
+        order = np.argsort(-all_l)[: self.k]
+        self.losses, self.indices = all_l[order], all_i[order]
+
+    def get_worst(self, n: int) -> np.ndarray:
+        return self.indices[: min(n, self.k)]
+
+
+def make_big_batch_step(sig, tx: optax.GradientTransformation):
+    """Fused single-model step: grads + optimizer + code-activity totals.
+    Data parallelism comes from the CALLER placing the batch with a "data"-axis
+    sharding (`train_big_batch` does) — the jitted step then partitions and
+    XLA inserts the gradient psum."""
+
+    grad_fn = jax.grad(sig.loss, has_aux=True)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: BigBatchState, batch: jax.Array):
+        grads, (loss_dict, aux) = grad_fn(state.params, state.buffers, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        c = aux["c"]
+        c_totals = state.c_totals + (c != 0).sum(axis=0)
+        # per-example MSE for worst-example tracking (reference `:196-199`)
+        # recompute decode from the *code* — cheap vs the grad pass
+        new_state = BigBatchState(
+            params=params,
+            buffers=state.buffers,
+            opt_state=opt_state,
+            c_totals=c_totals,
+            step=state.step + 1,
+        )
+        return new_state, loss_dict, c
+
+    return step
+
+
+def per_example_mse(sig, params, buffers, batch) -> jax.Array:
+    """[B] reconstruction error per example."""
+    ld = sig.to_learned_dict(params, buffers)
+    x_hat = ld.predict(batch)
+    return ((x_hat - batch) ** 2).mean(axis=-1)
+
+
+def resurrect_dead_features(
+    state: BigBatchState,
+    replacement_vectors: jax.Array,
+    encoder_key: str = "encoder",
+    encoder_norm_ratio: float = 0.2,
+    threshold: int = 0,
+) -> Tuple[BigBatchState, int]:
+    """Re-init features with `c_totals <= threshold` from the worst-recon
+    examples; zero their Adam moments; reset activity counters.
+
+    Pure counterpart of reference `huge_batch_size.py:224-254`. All features
+    with count ≤ threshold are rewritten via a masked `jnp.where` — fixed
+    shapes, jit-safe. `replacement_vectors` is `[n_feats, d]` (rows for live
+    features are ignored; callers tile the worst examples to n_feats rows).
+    """
+    dead = state.c_totals <= threshold
+    n_dead = int(jax.device_get(dead.sum()))
+
+    enc = state.params[encoder_key]
+    av_norm = jnp.linalg.norm(enc, axis=-1).mean()
+    scale = encoder_norm_ratio * av_norm / jnp.clip(
+        jnp.linalg.norm(replacement_vectors, axis=-1, keepdims=True), 1e-8, None
+    )
+    new_enc = jnp.where(dead[:, None], replacement_vectors * scale, enc)
+
+    params = dict(state.params)
+    params[encoder_key] = new_enc
+    if "encoder_bias" in params:
+        params["encoder_bias"] = jnp.where(dead, 0.0, params["encoder_bias"])
+
+    def reset_moments(leaf, ref_leaf):
+        # zero adam mu/nu rows of dead features wherever the leaf mirrors a
+        # param with leading n_feats dim
+        if hasattr(leaf, "shape") and leaf.shape[:1] == dead.shape:
+            expand = dead.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            return jnp.where(expand, 0.0, leaf)
+        return leaf
+
+    opt_state = jax.tree.map(lambda l: reset_moments(l, None), state.opt_state)
+    return (
+        BigBatchState(
+            params=params,
+            buffers=state.buffers,
+            opt_state=opt_state,
+            c_totals=jnp.zeros_like(state.c_totals),
+            step=state.step,
+        ),
+        n_dead,
+    )
+
+
+def train_big_batch(
+    sig,
+    init_hparams: Dict[str, Any],
+    dataset: jax.Array,
+    batch_size: int,
+    n_steps: int,
+    key: jax.Array,
+    learning_rate: float = 1e-3,
+    mesh=None,
+    reinit_every: Optional[int] = 100,
+    worst_k: int = 1024,
+) -> Tuple[BigBatchState, Any]:
+    """Train one SAE with huge data-parallel batches + periodic dead-feature
+    resurrection. Returns (final state, sig) for `to_learned_dict` export."""
+    k_init, key = jax.random.split(key)
+    params, buffers = sig.init(k_init, **init_hparams)
+    tx = optax.adam(learning_rate)
+    n_feats = params["encoder"].shape[0]
+    state = BigBatchState(
+        params=params,
+        buffers=buffers,
+        opt_state=tx.init(params),
+        c_totals=jnp.zeros((n_feats,)),
+        step=jnp.zeros((), jnp.int32),
+    )
+    if mesh is not None:
+        sharding = batch_sharding(mesh)
+    step_fn = make_big_batch_step(sig, tx)
+    mse_fn = jax.jit(partial(per_example_mse, sig))
+
+    worst = WorstExamples(worst_k)
+    n = dataset.shape[0]
+    for i in range(n_steps):
+        key, k = jax.random.split(key)
+        idxs = np.asarray(jax.random.randint(k, (batch_size,), 0, n))
+        batch = dataset[idxs]
+        if mesh is not None:
+            batch = jax.device_put(batch, sharding)
+        state, loss_dict, _c = step_fn(state, batch)
+        mses = np.asarray(jax.device_get(mse_fn(state.params, state.buffers, batch)))
+        worst.update(idxs, mses)
+
+        if reinit_every and (i + 1) % reinit_every == 0:
+            worst_idx = worst.get_worst(n_feats)
+            reps = dataset[np.resize(worst_idx, n_feats)]
+            state, n_dead = resurrect_dead_features(state, jnp.asarray(reps))
+            worst = WorstExamples(worst_k)
+            if n_dead:
+                print(f"step {i+1}: resurrected {n_dead} dead features")
+    return state, sig

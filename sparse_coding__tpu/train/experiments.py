@@ -1,0 +1,330 @@
+"""Experiment catalog: ensemble builders + run entry points.
+
+Counterpart of the reference `big_sweep_experiments.py` (~20 `*_experiment`
+builders and `run_*` drivers, `:40-1286`). One deliberate TPU-first change
+(SURVEY.md §2.4 P1/P2): the reference splits each hyperparameter grid into
+8 ensembles because it places one ensemble per GPU and pops a `devices` list
+(`:49-66`); here a grid lives in ONE vmapped stack per dict size — the mesh
+(`Ensemble.shard`) distributes it across chips, so builders don't know about
+devices at all. Hyperparam ranges and model choices match the reference
+per-experiment (citations inline).
+
+Every builder returns the sweep contract:
+  (ensembles=[(Ensemble, args, name)...], ensemble_hyperparams,
+   buffer_hyperparams, hyperparam_ranges)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from sparse_coding__tpu.ensemble import Ensemble
+from sparse_coding__tpu.models import (
+    FunctionalLISTADenoisingSAE,
+    FunctionalMaskedTiedSAE,
+    FunctionalPositiveTiedSAE,
+    FunctionalSAE,
+    FunctionalThresholdingSAE,
+    FunctionalTiedSAE,
+    TopKEncoder,
+)
+from sparse_coding__tpu.train.sweep import sweep
+from sparse_coding__tpu.utils.config import EnsembleArgs, SyntheticEnsembleArgs
+
+
+def _ensemble(sig, models, cfg, dict_size, name, extra_args=None, mesh=None):
+    ens = Ensemble(models, sig, "adam", {"learning_rate": cfg.lr})
+    if mesh is not None:
+        ens.shard(mesh)
+    args = {"batch_size": cfg.batch_size, "dict_size": dict_size, **(extra_args or {})}
+    return ens, args, name
+
+
+def _key(cfg, salt=0):
+    return jax.random.PRNGKey(cfg.seed + salt)
+
+
+# -- builders (reference big_sweep_experiments.py) ----------------------------
+
+def tied_vs_not_experiment(cfg: EnsembleArgs, mesh=None):
+    """Untied vs tied SAEs over (l1 × bias_decay) at ratio 8
+    (reference `:40-132`)."""
+    l1_values = list(np.logspace(-3.5, -2, 4))
+    bias_decays = [0.0, 0.05, 0.1]
+    dict_size = cfg.activation_width * 8
+    from itertools import product
+
+    grids = list(product(l1_values, bias_decays))
+    ensembles = []
+    for tied, sig in ((False, FunctionalSAE), (True, FunctionalTiedSAE)):
+        keys = jax.random.split(_key(cfg, int(tied)), len(grids))
+        models = [
+            sig.init(k, cfg.activation_width, dict_size, l1, bias_decay=bd)
+            for k, (l1, bd) in zip(keys, grids)
+        ]
+        ensembles.append(
+            _ensemble(sig, models, cfg, dict_size,
+                      f"dict_ratio_8{'_tied' if tied else ''}",
+                      {"tied": tied}, mesh)
+        )
+    return (
+        ensembles,
+        ["dict_size", "tied"],
+        ["l1_alpha", "bias_decay"],
+        {"dict_size": [dict_size], "tied": [False, True],
+         "l1_alpha": l1_values, "bias_decay": bias_decays},
+    )
+
+
+def topk_experiment(cfg: EnsembleArgs, mesh=None):
+    """k-sparse sweep: sparsity 1..160 step 10 × dict ratios {0.5,1,2,4}
+    (reference `:233-264`). The reference needs `no_stacking` Python loops;
+    our top-k is vmappable with traced k, so each ratio is one stack."""
+    sparsity_levels = list(np.arange(1, 161, 10))
+    dict_ratios = [0.5, 1, 2, 4]
+    ensembles = []
+    dict_sizes = []
+    for r in dict_ratios:
+        dict_size = int(cfg.activation_width * r)
+        dict_sizes.append(dict_size)
+        keys = jax.random.split(_key(cfg, int(r * 2)), len(sparsity_levels))
+        models = [
+            TopKEncoder.init(k, cfg.activation_width, dict_size, min(s, dict_size))
+            for k, s in zip(keys, sparsity_levels)
+        ]
+        ensembles.append(
+            _ensemble(TopKEncoder, models, cfg, dict_size, f"topk_r{r}", mesh=mesh)
+        )
+    return (
+        ensembles,
+        ["dict_size"],
+        ["sparsity"],
+        {"dict_size": dict_sizes, "sparsity": sparsity_levels},
+    )
+
+
+def synthetic_linear_range(cfg: EnsembleArgs, mesh=None):
+    """32-point l1 logspace × dict ratios {0.5,1,2,4} on tied SAEs
+    (reference `:266-293`)."""
+    l1_vals = list(np.logspace(-4, -2, 32))
+    dict_ratios = [0.5, 1, 2, 4]
+    ensembles, dict_sizes = [], []
+    for r in dict_ratios:
+        dict_size = int(cfg.activation_width * r)
+        dict_sizes.append(dict_size)
+        keys = jax.random.split(_key(cfg, int(r * 2)), len(l1_vals))
+        models = [
+            FunctionalTiedSAE.init(k, cfg.activation_width, dict_size, l1)
+            for k, l1 in zip(keys, l1_vals)
+        ]
+        ensembles.append(
+            _ensemble(FunctionalTiedSAE, models, cfg, dict_size, f"linear_r{r}", mesh=mesh)
+        )
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": dict_sizes, "l1_alpha": l1_vals}
+
+
+def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None):
+    """16-point l1 logspace at cfg.learned_dict_ratio, tied per cfg.tied_ae
+    (reference `:295-341`) — the paper's main sweep shape."""
+    l1_values = list(np.logspace(-4, -2, 16))
+    dict_size = int(cfg.activation_width * cfg.learned_dict_ratio)
+    sig = FunctionalTiedSAE if cfg.tied_ae else FunctionalSAE
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        sig.init(k, cfg.activation_width, dict_size, l1, bias_decay=0.0)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [_ensemble(sig, models, cfg, dict_size, "l1_range", mesh=mesh)]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+def residual_denoising_experiment(cfg: EnsembleArgs, mesh=None):
+    """LISTA denoising SAEs, 16-point l1 in [1e-5, 1e-3], 3 hidden layers
+    (reference `:343-378`)."""
+    l1_values = list(np.logspace(-5, -3, 16))
+    dict_size = int(cfg.activation_width * cfg.learned_dict_ratio)
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        FunctionalLISTADenoisingSAE.init(k, cfg.activation_width, dict_size, 3, l1)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [
+        _ensemble(FunctionalLISTADenoisingSAE, models, cfg, dict_size, "residual_denoising", mesh=mesh)
+    ]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+def residual_denoising_comparison(cfg: EnsembleArgs, mesh=None):
+    """Tied-SAE control for the LISTA run (reference `:381-403`)."""
+    return dense_l1_range_experiment(cfg, mesh)
+
+
+def thresholding_experiment(cfg: EnsembleArgs, mesh=None):
+    """Smooth-thresholding SAEs at ratio 4, 16-point l1 (reference `:405-441`)."""
+    l1_values = list(np.logspace(-4, -2, 16))
+    dict_size = int(cfg.activation_width * 4)
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        FunctionalThresholdingSAE.init(k, cfg.activation_width, dict_size, l1)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [
+        _ensemble(FunctionalThresholdingSAE, models, cfg, dict_size, "thresholding", mesh=mesh)
+    ]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+def zero_l1_baseline(cfg: EnsembleArgs, mesh=None):
+    """Single l1=0 model at ratio 4 (reference `:499-545`)."""
+    dict_size = int(cfg.activation_width * 4)
+    sig = FunctionalTiedSAE if cfg.tied_ae else FunctionalSAE
+    models = [sig.init(_key(cfg), cfg.activation_width, dict_size, 0.0, bias_decay=0.0)]
+    ensembles = [_ensemble(sig, models, cfg, dict_size, "l1_range_zero_b", mesh=mesh)]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": [0.0]}
+
+
+def dict_ratio_experiment(cfg: EnsembleArgs, mesh=None):
+    """8 dict sizes (512..2560) × 12 repeats in ONE masked stack at l1=1e-3
+    (reference `:546-583`) — the masking trick that lets different dict sizes
+    share a vmap stack (`sae_ensemble.py:307-371`)."""
+    dict_sizes = [int(512 * x) for x in np.linspace(1, 5, 8)]
+    max_size = max(dict_sizes)
+    l1_value = 1e-3
+    n_repeats = 12
+    combos = [(s,) for _ in range(n_repeats) for s in dict_sizes]
+    keys = jax.random.split(_key(cfg), len(combos))
+    models = [
+        FunctionalMaskedTiedSAE.init(k, cfg.activation_width, s, max_size, l1_value)
+        for k, (s,) in zip(keys, combos)
+    ]
+    ensembles = [
+        _ensemble(FunctionalMaskedTiedSAE, models, cfg, max_size, "dict_ratio", mesh=mesh)
+    ]
+    return ensembles, [], ["l1_alpha", "dict_size"], {"dict_size": dict_sizes, "l1_alpha": [l1_value]}
+
+
+def long_mlp_sweep(cfg: EnsembleArgs, mesh=None):
+    """MLP-location long run: tied SAEs, 16-point l1 (reference `:960-1037`)."""
+    return dense_l1_range_experiment(cfg, mesh)
+
+
+def run_positive_experiment(cfg: EnsembleArgs, mesh=None):
+    """Non-negative tied SAEs, 16-point l1 (reference `run_positive`, `:1039-1097`)."""
+    l1_values = list(np.logspace(-4, -2, 16))
+    dict_size = int(cfg.activation_width * cfg.learned_dict_ratio)
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        FunctionalPositiveTiedSAE.init(k, cfg.activation_width, dict_size, l1)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [
+        _ensemble(FunctionalPositiveTiedSAE, models, cfg, dict_size, "positive", mesh=mesh)
+    ]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+def pythia_1_4_b_dict(cfg: EnsembleArgs, mesh=None):
+    """The largest reference workload: pythia-1.4B layer 6 resid, 6× dict,
+    4-point l1 (reference `:854-910`). At d=2048, ratio 6 → 12288 dict atoms;
+    shard the dict axis for this one (SURVEY.md §2.4 P5)."""
+    l1_values = list(np.logspace(-4, -3, 4))
+    dict_size = int(cfg.activation_width * 6)
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        FunctionalTiedSAE.init(k, cfg.activation_width, dict_size, l1)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [_ensemble(FunctionalTiedSAE, models, cfg, dict_size, "pythia_1_4_b", mesh=mesh)]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+# -- run drivers (reference run_* functions) ----------------------------------
+
+def run_sweep_synthetic(experiment=synthetic_linear_range, **overrides):
+    """Synthetic-data sweep driver (reference `run_dict_ratio` shape, `:585-628`)."""
+    cfg = SyntheticEnsembleArgs(
+        use_synthetic_dataset=True,
+        feature_num_nonzero=100,
+        gen_batch_size=4096,
+        activation_width=512,
+        noise_magnitude_scale=0.0,
+        n_ground_truth_components=2048,
+        feature_prob_decay=0.996,
+        n_chunks=10,
+        batch_size=1024,
+        output_folder="output_synthetic",
+        dataset_folder="activation_data_synthetic",
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return sweep(experiment, cfg)
+
+
+def run_single_layer(layer: int = 2, layer_loc: str = "residual", tied: bool = True,
+                     ratio: float = 4.0, **overrides):
+    """One-layer pythia-70m sweep (reference `run_single_layer`, `:1211-1238`)."""
+    from sparse_coding__tpu.lm.model import get_activation_size
+
+    model_name = overrides.pop("model_name", "EleutherAI/pythia-70m-deduped")
+    width = overrides.pop(
+        "activation_width", get_activation_size(model_name, layer_loc)
+    )
+    cfg = EnsembleArgs(
+        model_name=model_name,
+        activation_width=width,
+        dataset_name="NeelNanda/pile-10k",
+        layer=layer,
+        layer_loc=layer_loc,
+        tied_ae=tied,
+        learned_dict_ratio=ratio,
+        batch_size=2048,
+        n_chunks=20,
+        n_epochs=8,
+        output_folder=f"output_{'tied' if tied else 'untied'}_{layer_loc}_l{layer}_r{int(ratio)}",
+        dataset_folder=f"pilechunks_l{layer}_{layer_loc}",
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return sweep(dense_l1_range_experiment, cfg)
+
+
+def run_single_layer_gpt2(layer: int = 9, **overrides):
+    """(reference `run_single_layer_gpt2`, `:1240-1275`)"""
+    return run_single_layer(
+        layer=layer, model_name="gpt2", activation_width=768,
+        dataset_name="openwebtext", **overrides,
+    )
+
+
+def run_across_layers(layers=range(6), layer_locs=("residual",), **kwargs):
+    """Layer-loop runner (reference `run_across_layers*`, `:646-772`)."""
+    results = {}
+    for layer_loc in layer_locs:
+        for layer in layers:
+            results[(layer, layer_loc)] = run_single_layer(layer=layer, layer_loc=layer_loc, **kwargs)
+    return results
+
+
+def run_pythia_1_4_b_sweep(**overrides):
+    """(reference `run_pythia_1_4_b_sweep`, `:886-910`, the `__main__` entry)"""
+    cfg = EnsembleArgs(
+        model_name="EleutherAI/pythia-1.4b-deduped",
+        dataset_name="EleutherAI/pile",
+        layer=6,
+        layer_loc="residual",
+        activation_width=2048,
+        batch_size=2048,
+        n_chunks=30,
+        output_folder="output_pythia_1_4_b",
+        dataset_folder="pilechunks_1.4b_l6_residual",
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return sweep(pythia_1_4_b_dict, cfg)
+
+
+if __name__ == "__main__":
+    run_pythia_1_4_b_sweep()

@@ -285,10 +285,12 @@ def sweep(
 
     learned_dicts: List[Tuple[Any, Dict[str, Any]]] = []
     rng_key = jax.random.PRNGKey(cfg.seed)
-    for i in range(start_chunk, len(chunk_order)):
-        chunk_idx = int(chunk_order[i])
-        print(f"Chunk {i+1}/{len(chunk_order)} (file {chunk_idx})")
-        chunk = store.load(chunk_idx, dtype=jnp.float32)
+    # double-buffered prefetch: next chunk's disk read + H2D transfer overlap
+    # the current chunk's training (data.chunks.iter_chunks)
+    remaining_order = [int(c) for c in chunk_order[start_chunk:]]
+    chunk_iter = store.iter_chunks(remaining_order, dtype=jnp.float32)
+    for i, chunk in zip(range(start_chunk, len(chunk_order)), chunk_iter):
+        print(f"Chunk {i+1}/{len(chunk_order)} (file {int(chunk_order[i])})")
         if getattr(cfg, "center_activations", False):
             if means is None:
                 print("Centring activations")

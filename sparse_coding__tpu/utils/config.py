@@ -159,7 +159,8 @@ class TrainArgs(BaseArgs):
     def validate(self):
         if self.dtype not in DTYPES:
             raise ValueError(f"dtype must be one of {sorted(DTYPES)}, got {self.dtype}")
-        if self.layer_loc not in ("residual", "mlp", "mlp_out", "attn", "attn_concat", "mlpout"):
+        # exactly the set lm.model.make_tensor_name/get_activation_size accept
+        if self.layer_loc not in ("residual", "mlp", "mlpout", "attn"):
             raise ValueError(f"unknown layer_loc {self.layer_loc}")
         if self.batch_size <= 0 or self.n_chunks <= 0:
             raise ValueError("batch_size and n_chunks must be positive")

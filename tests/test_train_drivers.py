@@ -1,0 +1,147 @@
+"""Baselines runner, big-batch trainer + dead-feature resurrection,
+basic FISTA l1 sweep, and the experiment catalog's builder contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import RandomDatasetGenerator, save_chunk
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.train import (
+    basic_l1_sweep,
+    load_baseline,
+    resurrect_dead_features,
+    run_layer_baselines,
+    train_big_batch,
+)
+from sparse_coding__tpu.train import experiments as E
+from sparse_coding__tpu.train.big_batch import BigBatchState
+from sparse_coding__tpu.utils import EnsembleArgs
+
+
+@pytest.fixture(scope="module")
+def data():
+    gen = RandomDatasetGenerator(
+        activation_dim=24, n_ground_truth_components=48, batch_size=512,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    return jnp.concatenate([next(gen) for _ in range(4)])
+
+
+def test_run_layer_baselines(tmp_path, data):
+    save_chunk(tmp_path / "chunks" / "l0_residual", 0, np.asarray(data))
+    written = run_layer_baselines(
+        0, ["residual"], str(tmp_path / "chunks"), str(tmp_path / "out"),
+        sparsity=6, ica_max_samples=1000,
+    )
+    assert set(written["l0_residual"]) == {
+        "pca.pkl", "pca_topk.pkl", "ica.pkl", "ica_topk.pkl",
+        "random.pkl", "identity_relu.pkl",
+    }
+    pca_topk = load_baseline(str(tmp_path / "out"), 0, "residual", "pca_topk")
+    c = pca_topk.encode(data[:64])
+    assert (np.asarray((c != 0).sum(axis=-1)) <= 6).all()
+    # idempotent skip (remake=False)
+    again = run_layer_baselines(
+        0, ["residual"], str(tmp_path / "chunks"), str(tmp_path / "out"), sparsity=6
+    )
+    assert again["l0_residual"] == []
+
+
+def test_big_batch_resurrection(data):
+    state, sig = train_big_batch(
+        FunctionalTiedSAE,
+        dict(activation_size=24, n_dict_components=48, l1_alpha=3e-3),
+        data,
+        batch_size=256,
+        n_steps=30,
+        key=jax.random.PRNGKey(1),
+        reinit_every=10,
+    )
+    ld = sig.to_learned_dict(state.params, state.buffers)
+    x_hat = ld.predict(data[:64])
+    assert np.isfinite(np.asarray(x_hat)).all()
+
+
+def test_resurrect_dead_features_pure():
+    import optax
+
+    key = jax.random.PRNGKey(2)
+    params = {
+        "encoder": jax.random.normal(key, (8, 4)),
+        "encoder_bias": jnp.ones((8,)),
+    }
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    # poison adam moments so the reset is observable
+    opt_state = jax.tree.map(lambda l: l + 1.0 if hasattr(l, "shape") else l, opt_state)
+    c_totals = jnp.asarray([0, 5, 0, 3, 1, 0, 2, 4], jnp.float32)
+    state = BigBatchState(
+        params=params, buffers={}, opt_state=opt_state,
+        c_totals=c_totals, step=jnp.zeros((), jnp.int32),
+    )
+    reps = jnp.ones((8, 4)) * 2.0
+    new_state, n_dead = resurrect_dead_features(state, reps)
+    assert n_dead == 3
+    dead = np.asarray(c_totals == 0)
+    enc = np.asarray(new_state.params["encoder"])
+    old = np.asarray(params["encoder"])
+    # live rows untouched, dead rows rewritten (renormalized replacement)
+    np.testing.assert_array_equal(enc[~dead], old[~dead])
+    assert not np.allclose(enc[dead], old[dead])
+    # dead-row bias zeroed; adam moments zeroed exactly on dead rows
+    assert (np.asarray(new_state.params["encoder_bias"])[dead] == 0).all()
+    mu = jax.tree.leaves(new_state.opt_state)
+    poisoned = [l for l in mu if hasattr(l, "shape") and l.shape[:1] == (8,)]
+    assert poisoned, "no per-feature moment leaves found"
+    for leaf in poisoned:
+        assert (np.asarray(leaf)[dead] == 0).all()
+        assert (np.asarray(leaf)[~dead] != 0).all()
+    # counters reset
+    assert (np.asarray(new_state.c_totals) == 0).all()
+
+
+def test_basic_l1_sweep(tmp_path, data):
+    save_chunk(tmp_path / "chunks", 0, np.asarray(data))
+    dicts = basic_l1_sweep(
+        str(tmp_path / "chunks"), str(tmp_path / "out"),
+        activation_width=24, l1_values=[1e-4, 1e-3], dict_ratio=2,
+        batch_size=256, fista_iters=30,
+    )
+    assert len(dicts) == 2
+    assert (tmp_path / "out" / "epoch_0" / "learned_dicts.pkl").exists()
+
+
+BUILDERS = [
+    E.tied_vs_not_experiment,
+    E.topk_experiment,
+    E.synthetic_linear_range,
+    E.dense_l1_range_experiment,
+    E.residual_denoising_experiment,
+    E.thresholding_experiment,
+    E.zero_l1_baseline,
+    E.dict_ratio_experiment,
+    E.run_positive_experiment,
+    E.pythia_1_4_b_dict,
+]
+
+
+@pytest.mark.parametrize("builder", BUILDERS, ids=lambda b: b.__name__)
+def test_experiment_builders_contract(builder):
+    """Every builder returns the sweep contract and its ensembles step."""
+    cfg = EnsembleArgs(activation_width=16, batch_size=32, lr=1e-3)
+    ensembles, ens_hp, buf_hp, ranges = builder(cfg)
+    assert ensembles
+    assert isinstance(ranges, dict)
+    batch = jax.random.normal(jax.random.PRNGKey(0), (32, 16))
+    ens, args, name = ensembles[0]
+    assert "batch_size" in args and "dict_size" in args
+    loss, _ = ens.step_batch(batch)
+    assert np.isfinite(jax.device_get(loss["loss"])).all()
+    # hyperparam export works with the declared names
+    from sparse_coding__tpu.train import unstacked_to_learned_dicts
+
+    lds = unstacked_to_learned_dicts(ens, args, ens_hp, buf_hp)
+    assert len(lds) == ens.n_models
