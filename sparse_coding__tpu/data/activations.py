@@ -67,10 +67,11 @@ def chunk_and_tokenize_texts(
 
 
 def make_sentence_dataset(dataset_name: str, max_lines: int = 20_000, start_line: int = 0):
-    """HF dataset load (network / local cache; reference `:124-134`)."""
+    """HF dataset load, sliced to [start_line, start_line+max_lines)
+    (network / local cache; reference `:124-134`)."""
     from datasets import load_dataset
 
-    return load_dataset(dataset_name, split="train")
+    return load_dataset(dataset_name, split=f"train[{start_line}:{start_line + max_lines}]")
 
 
 def setup_token_data(dataset_name: str, tokenizer, max_length: int = MAX_SENTENCE_LEN,
@@ -143,11 +144,13 @@ def make_activation_dataset(
             )[1]
         )
     else:
-        from sparse_coding__tpu.lm.ring_attention import sequence_parallel_forward
+        from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
 
-        capture = lambda p, t: sequence_parallel_forward(
-            p, t, lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at
-        )[1]
+        # built ONCE: repeated calls reuse the compiled sharded program
+        seq_fn = make_sequence_parallel_fn(
+            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at
+        )
+        capture = lambda p, t: seq_fn(p, t)[1]
 
     seq_len = tokens.shape[1]
     rows_per_chunk = {

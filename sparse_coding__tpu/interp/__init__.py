@@ -1,0 +1,24 @@
+from sparse_coding__tpu.interp.records import (
+    ActivationRecord,
+    NeuronRecord,
+    OPENAI_FRAGMENT_LEN,
+    ScoredSimulation,
+    SequenceSimulation,
+    TOTAL_EXAMPLES,
+    aggregate_scored_sequence_simulations,
+    calculate_max_activation,
+)
+from sparse_coding__tpu.interp.clients import (
+    InterpClient,
+    OpenAIClient,
+    TokenLexiconClient,
+    default_client,
+)
+from sparse_coding__tpu.interp.pipeline import (
+    get_df,
+    interpret,
+    make_feature_activation_dataset,
+    read_results,
+    run,
+    select_records,
+)

@@ -1,0 +1,148 @@
+"""Explainer/simulator clients for autointerp.
+
+The reference calls GPT-4 (explain) and text-davinci-003 (simulate) through
+`neuron-explainer` with a `secrets.json` OpenAI key read at import time
+(`interpret.py:30-32, 334-358`). Here the LLM dependency sits behind a small
+protocol so the pipeline is runnable anywhere:
+
+  - `OpenAIClient` — the reference behavior (requires the `openai` package and
+    an API key; both absent in this image, so it raises a clear error).
+  - `TokenLexiconClient` — deterministic offline fallback: explains a feature
+    by its most activation-weighted tokens and simulates by lexicon lookup.
+    Not an LLM, but it exercises the full protocol (records → explanation →
+    simulation → correlation score) and gives a meaningful baseline score.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Protocol, Sequence
+
+import numpy as np
+
+from sparse_coding__tpu.interp.records import ActivationRecord, calculate_max_activation
+
+
+class InterpClient(Protocol):
+    def explain(self, records: Sequence[ActivationRecord], max_activation: float) -> str: ...
+
+    def simulate(self, explanation: str, tokens: List[str]) -> List[float]: ...
+
+
+EXPLAINER_MODEL_NAME = "gpt-4"  # reference `interpret.py:50`
+SIMULATOR_MODEL_NAME = "text-davinci-003"  # reference `interpret.py:51`
+
+
+class OpenAIClient:
+    """LLM explain/simulate via the OpenAI API (reference protocol)."""
+
+    def __init__(self, api_key: str, explainer_model: str = EXPLAINER_MODEL_NAME,
+                 simulator_model: str = SIMULATOR_MODEL_NAME):
+        try:
+            import openai
+        except ImportError as e:
+            raise ImportError(
+                "the `openai` package is not installed; use TokenLexiconClient "
+                "for offline autointerp or install openai"
+            ) from e
+        self._client = openai.OpenAI(api_key=api_key)
+        self.explainer_model = explainer_model
+        self.simulator_model = simulator_model
+
+    def explain(self, records, max_activation):
+        examples = "\n\n".join(
+            " ".join(
+                f"{tok} ({act:.1f})" if act > 0 else tok
+                for tok, act in zip(r.tokens, r.activations)
+            )
+            for r in records
+        )
+        resp = self._client.chat.completions.create(
+            model=self.explainer_model,
+            messages=[
+                {
+                    "role": "system",
+                    "content": (
+                        "You explain what pattern a neural-network feature "
+                        "responds to, given tokens annotated with activations. "
+                        "Reply with a short phrase."
+                    ),
+                },
+                {"role": "user", "content": examples},
+            ],
+        )
+        return resp.choices[0].message.content.strip()
+
+    def simulate(self, explanation, tokens):
+        prompt = (
+            f"A feature activates on: {explanation}\n"
+            "For each token below, output its activation 0-10, comma-separated.\n"
+            + " ".join(tokens)
+        )
+        resp = self._client.chat.completions.create(
+            model=self.simulator_model,
+            messages=[{"role": "user", "content": prompt}],
+        )
+        out = []
+        for part in resp.choices[0].message.content.replace("\n", ",").split(","):
+            try:
+                out.append(float(part.strip()))
+            except ValueError:
+                out.append(0.0)
+        out += [0.0] * (len(tokens) - len(out))
+        return out[: len(tokens)]
+
+
+class TokenLexiconClient:
+    """Deterministic offline explainer/simulator.
+
+    Explain: rank tokens by total activation mass across the train records;
+    the explanation IS the lexicon (top-k tokens, serialized). Simulate: a
+    token's predicted activation is its lexicon weight. A feature that
+    genuinely fires on specific tokens scores high; an unexplainable one
+    scores ≈ 0 — the same ordering the LLM scorer produces, minus semantics.
+    """
+
+    def __init__(self, top_k: int = 10):
+        self.top_k = top_k
+
+    def explain(self, records, max_activation):
+        import json
+
+        mass: Dict[str, float] = defaultdict(float)
+        for r in records:
+            for tok, act in zip(r.tokens, r.activations):
+                mass[tok] += max(act, 0.0)
+        top = sorted(mass.items(), key=lambda kv: -kv[1])[: self.top_k]
+        total = sum(w for _, w in top) or 1.0
+        lexicon = {tok: round(w / total, 4) for tok, w in top if w > 0}
+        # JSON body: survives tokens containing ',' ':' etc. (real BPE vocabs)
+        return "activates on tokens: " + json.dumps(lexicon)
+
+    def simulate(self, explanation, tokens):
+        import json
+
+        body = explanation.split("activates on tokens:", 1)[-1].strip()
+        try:
+            lexicon = json.loads(body)
+        except json.JSONDecodeError:
+            lexicon = {}
+        return [10.0 * float(lexicon.get(tok, 0.0)) for tok in tokens]
+
+
+def default_client() -> InterpClient:
+    """OpenAI if a key is configured (reference reads `secrets.json`,
+    `interpret.py:30-32`), else the offline lexicon client."""
+    import json
+    import os
+    from pathlib import Path
+
+    key = os.environ.get("OPENAI_API_KEY")
+    if not key and Path("secrets.json").exists():
+        key = json.load(open("secrets.json")).get("openai_key")
+    if key:
+        try:
+            return OpenAIClient(key)
+        except ImportError:
+            pass
+    return TokenLexiconClient()

@@ -1,0 +1,244 @@
+"""Autointerp pipeline: activation dataframe → explain → simulate → score.
+
+Counterpart of the reference `interpret.py` (L5): build a per-feature
+activation table over 64-token text fragments, select top + random activation
+records per feature, generate an explanation, simulate it, and score by
+correlation — saving per-feature folders exactly like the reference
+(`scored_simulation.pkl` / `neuron_record.pkl` / `explanation.txt`,
+`interpret.py:371-385`) so downstream plotting carries over.
+
+TPU changes: the fragment forward + dictionary encode is one jitted batched
+program (the reference runs fragment-at-a-time with a progress bar,
+`interpret.py:137-209`); the dataframe caches to parquet (pandas HDF needs
+pytables, absent here; reference `interpret.py:215-262` used HDF).
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from sparse_coding__tpu.interp.clients import InterpClient, default_client
+from sparse_coding__tpu.interp.records import (
+    ActivationRecord,
+    NeuronRecord,
+    OPENAI_FRAGMENT_LEN,
+    ScoredSimulation,
+    SequenceSimulation,
+    TOTAL_EXAMPLES,
+    aggregate_scored_sequence_simulations,
+    calculate_max_activation,
+)
+from sparse_coding__tpu.lm import model as lm_model
+
+
+def make_feature_activation_dataset(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    learned_dict,
+    layer: int,
+    layer_loc: str,
+    fragments: np.ndarray,
+    decode_tokens: Callable[[Sequence[int]], List[str]],
+    max_features: int = 0,
+    batch_size: int = 32,
+) -> pd.DataFrame:
+    """Per-fragment, per-feature activation table
+    (reference `make_feature_activation_dataset`, `interpret.py:82-212`).
+
+    `fragments` is `[n, fragment_len]` int tokens; `decode_tokens` maps a row
+    to per-token strings. Columns: `fragment_token_strs`,
+    `feature_{i}_activation_{j}`, `feature_{i}_max`, `feature_{i}_mean`.
+    """
+    n_feats = learned_dict.n_feats if not max_features else min(max_features, learned_dict.n_feats)
+    name = lm_model.make_tensor_name(layer, layer_loc)
+
+    @jax.jit
+    def encode_batch(tokens):
+        _, cache = lm_model.forward(
+            params, tokens, lm_cfg, cache_names=[name], stop_at_layer=layer + 1
+        )
+        acts = cache[name]
+        B, L, C = acts.shape
+        return learned_dict.encode(acts.reshape(B * L, C)).reshape(B, L, -1)
+
+    frag_len = fragments.shape[1]
+    rows = []
+    # pad the tail to a full batch (jit shape stability), then trim rows —
+    # no fragments are dropped
+    n_frags = fragments.shape[0]
+    pad = (-n_frags) % batch_size
+    if pad:
+        fragments = np.concatenate([fragments, np.zeros((pad, frag_len), fragments.dtype)])
+    for start in range(0, fragments.shape[0], batch_size):
+        batch = fragments[start : start + batch_size]
+        codes = np.asarray(jax.device_get(encode_batch(jnp.asarray(batch))))
+        for b in range(batch.shape[0]):
+            row = {"fragment_token_strs": decode_tokens(batch[b])}
+            feat = codes[b]  # [L, n_feats]
+            for i in range(n_feats):
+                for j in range(frag_len):
+                    row[f"feature_{i}_activation_{j}"] = float(feat[j, i])
+                row[f"feature_{i}_max"] = float(feat[:, i].max())
+                row[f"feature_{i}_mean"] = float(feat[:, i].mean())
+            rows.append(row)
+    return pd.DataFrame(rows[:n_frags])
+
+
+def get_df(
+    feature_dict,
+    params,
+    lm_cfg,
+    layer: int,
+    layer_loc: str,
+    fragments: np.ndarray,
+    decode_tokens,
+    n_feats: int,
+    save_loc,
+    force_refresh: bool = False,
+    **kwargs,
+) -> pd.DataFrame:
+    """Parquet-cached activation dataframe (reference `get_df`,
+    `interpret.py:215-262`, HDF→parquet)."""
+    save_loc = Path(save_loc)
+    save_loc.mkdir(parents=True, exist_ok=True)
+    df_loc = save_loc / "activation_df.parquet"
+    if df_loc.exists() and not force_refresh:
+        base_df = pd.read_parquet(df_loc)
+        if f"feature_{n_feats - 1}_activation_0" in base_df.columns:
+            return base_df
+        print("Cached dataframe lacks requested features, remaking")
+    base_df = make_feature_activation_dataset(
+        params, lm_cfg, feature_dict, layer, layer_loc, fragments, decode_tokens,
+        max_features=n_feats, **kwargs,
+    )
+    base_df.to_parquet(df_loc)
+    return base_df
+
+
+def select_records(df: pd.DataFrame, feat_n: int, fragment_len: int, seed: int = 0):
+    """Top-activating + nonzero-random records for one feature
+    (reference `interpret.py:282-316`). Returns None if too few activating
+    fragments exist (the reference writes a placeholder folder)."""
+    cols = [f"feature_{feat_n}_activation_{i}" for i in range(fragment_len)]
+    required = ["fragment_token_strs", f"feature_{feat_n}_max", *cols]
+    if not all(c in df.columns for c in required):
+        return None
+    sub = df[required]
+    top = sub.sort_values(by=f"feature_{feat_n}_max", ascending=False).head(TOTAL_EXAMPLES)
+    top_records = [
+        ActivationRecord(list(row["fragment_token_strs"]), [row[c] for c in cols])
+        for _, row in top.iterrows()
+    ]
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(sub))
+    random_records: List[ActivationRecord] = []
+    for i in order:
+        if len(random_records) >= TOTAL_EXAMPLES:
+            break
+        row = sub.iloc[int(i)]
+        if row[f"feature_{feat_n}_max"] == 0:
+            continue
+        random_records.append(
+            ActivationRecord(list(row["fragment_token_strs"]), [row[c] for c in cols])
+        )
+    if len(random_records) < TOTAL_EXAMPLES:
+        return None
+    return NeuronRecord(feat_n, top_records, random_records)
+
+
+def interpret(
+    base_df: pd.DataFrame,
+    save_folder,
+    n_feats_to_explain: int,
+    client: Optional[InterpClient] = None,
+    fragment_len: int = OPENAI_FRAGMENT_LEN,
+):
+    """Explain + simulate + score each feature; save per-feature folders
+    (reference `interpret`, `interpret.py:265-386`). Skips features whose
+    folder already exists (resume, `:267-269`)."""
+    client = client or default_client()
+    save_folder = Path(save_folder)
+    for feat_n in range(n_feats_to_explain):
+        folder = save_folder / f"feature_{feat_n}"
+        # complete = explanation written, or an explicit no-data placeholder;
+        # a bare folder from a crashed run is retried
+        if (folder / "explanation.txt").exists() or (folder / "no_data").exists():
+            print(f"Feature {feat_n} already exists, skipping")
+            continue
+        record = select_records(base_df, feat_n, fragment_len)
+        if record is None:
+            folder.mkdir(parents=True, exist_ok=True)
+            (folder / "no_data").touch()  # placeholder = don't recompute
+            print(f"Skipping feature {feat_n} due to lack of activating examples")
+            continue
+
+        train = record.train_records()
+        valid = record.valid_records()
+        explanation = client.explain(train, calculate_max_activation(train))
+
+        sims = [
+            SequenceSimulation(
+                tokens=r.tokens,
+                true_activations=r.activations,
+                simulated_activations=client.simulate(explanation, r.tokens),
+            )
+            for r in valid
+        ]
+        scored = ScoredSimulation(explanation, sims)
+        score = scored.get_preferred_score()
+        top_only = aggregate_scored_sequence_simulations(sims[: len(sims) // 2])
+        random_only = aggregate_scored_sequence_simulations(sims[len(sims) // 2 :])
+        print(f"Feature {feat_n}, score={score:.2f}, top={top_only:.2f}, random={random_only:.2f}")
+
+        folder.mkdir(parents=True, exist_ok=True)
+        with open(folder / "scored_simulation.pkl", "wb") as f:
+            pickle.dump(scored, f)
+        with open(folder / "neuron_record.pkl", "wb") as f:
+            pickle.dump(record, f)
+        with open(folder / "explanation.txt", "w") as f:
+            f.write(
+                f"{explanation}\nScore: {score:.2f}\n"
+                f"Top only score: {top_only:.2f}\nRandom only score: {random_only:.2f}\n"
+            )
+
+
+def read_results(save_folder) -> pd.DataFrame:
+    """Collect per-feature scores back into a dataframe
+    (reference `read_results`, `interpret.py:691-761` minus plotting — see
+    `plotting.autointerp` for the violins)."""
+    records = []
+    for folder in sorted(Path(save_folder).glob("feature_*")):
+        exp_file = folder / "explanation.txt"
+        if not exp_file.exists():
+            continue
+        lines = exp_file.read_text().splitlines()
+        rec = {"feature": int(folder.name.split("_")[1]), "explanation": lines[0]}
+        for line in lines[1:]:
+            if ":" in line:
+                k, _, v = line.partition(":")
+                try:
+                    rec[k.strip().lower().replace(" ", "_")] = float(v)
+                except ValueError:
+                    pass
+        records.append(rec)
+    return pd.DataFrame(records)
+
+
+def run(feature_dict, cfg, params, lm_cfg, fragments, decode_tokens,
+        client: Optional[InterpClient] = None):
+    """End-to-end autointerp for one dict (reference `run`, `interpret.py:388-399`)."""
+    assert cfg.df_n_feats >= cfg.n_feats_explain
+    df = get_df(
+        feature_dict, params, lm_cfg, cfg.layer, cfg.layer_loc,
+        fragments, decode_tokens, n_feats=cfg.df_n_feats, save_loc=cfg.save_loc,
+    )
+    interpret(df, cfg.save_loc, cfg.n_feats_explain, client=client,
+              fragment_len=fragments.shape[1])
+    return read_results(cfg.save_loc)

@@ -11,4 +11,8 @@ from sparse_coding__tpu.lm.model import (
     run_with_hooks,
 )
 from sparse_coding__tpu.lm.convert import config_from_hf, load_model, params_from_hf
-from sparse_coding__tpu.lm.ring_attention import ring_attention, sequence_parallel_forward
+from sparse_coding__tpu.lm.ring_attention import (
+    make_sequence_parallel_fn,
+    ring_attention,
+    sequence_parallel_forward,
+)

@@ -239,12 +239,11 @@ def attention_block(
     return out, z_flat
 
 
-def mlp_block(p, x_normed, cfg: LMConfig):
-    """Returns (mlp_out, hidden_post_act)."""
+def mlp_hidden(p, x_normed, cfg: LMConfig):
+    """MLP hidden post-activation ("mlp" hook point); the output projection
+    happens in `forward` AFTER the hook so replacements propagate."""
     act = _gelu_new if cfg.arch == "gpt2" else jax.nn.gelu
-    h = act(jnp.einsum("fm,bsm->bsf", p["w_in"], x_normed) + p["b_in"])
-    out = jnp.einsum("mf,bsf->bsm", p["w_out"], h) + p["b_out"]
-    return out, h
+    return act(jnp.einsum("fm,bsm->bsf", p["w_in"], x_normed) + p["b_in"])
 
 
 # -- forward with hooks -------------------------------------------------------
@@ -288,23 +287,18 @@ def forward(
     n_blocks = cfg.n_layers if stop_at_layer is None else min(stop_at_layer, cfg.n_layers)
     for i in range(n_blocks):
         p = params["blocks"][i]
-        if cfg.arch == "neox" and cfg.parallel_residual:
-            attn_out, z = attention_block(p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions)
-            z = at_hook(f"blocks.{i}.attn.hook_z", z)
-            mlp_out, h = mlp_block(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
-            h = at_hook(f"blocks.{i}.mlp.hook_post", h)
-            mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
-            mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
-            x = x + attn_out + mlp_out
-        else:  # serial residual (gpt2, non-parallel neox)
-            attn_out, z = attention_block(p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions)
-            z = at_hook(f"blocks.{i}.attn.hook_z", z)
+        parallel = cfg.arch == "neox" and cfg.parallel_residual
+        attn_out, z = attention_block(
+            p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions
+        )
+        z = at_hook(f"blocks.{i}.attn.hook_z", z)
+        if not parallel:  # serial (gpt2, non-parallel neox): attn lands first
             x = x + attn_out
-            mlp_out, h = mlp_block(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
-            h = at_hook(f"blocks.{i}.mlp.hook_post", h)
-            mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
-            mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
-            x = x + mlp_out
+        h = mlp_hidden(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
+        h = at_hook(f"blocks.{i}.mlp.hook_post", h)
+        mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
+        mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
+        x = x + attn_out + mlp_out if parallel else x + mlp_out
         x = at_hook(f"blocks.{i}.hook_resid_post", x)
 
     if stop_at_layer is not None:

@@ -74,6 +74,64 @@ def ring_attention(axis_name: str) -> Callable:
     return attn
 
 
+def make_sequence_parallel_fn(
+    cfg: lm_model.LMConfig,
+    mesh: Mesh,
+    axis_name: str = "data",
+    cache_names: Optional[Sequence[str]] = None,
+    hooks: Optional[Dict[str, Callable]] = None,
+    stop_at_layer: Optional[int] = None,
+) -> Callable:
+    """Build ONCE a reusable `fn(params, tokens) -> (out, cache)` that runs
+    the sequence-sharded forward. Calling the returned fn repeatedly hits
+    JAX's compilation cache (building a fresh `shard_map` closure per batch
+    would retrace + recompile the whole LM every call)."""
+    from jax.experimental.shard_map import shard_map
+
+    cache_names = tuple(cache_names or ())
+    n_shards = mesh.shape[axis_name]
+
+    def local_fn(params, tok_shard):
+        idx = jax.lax.axis_index(axis_name)
+        S_local = tok_shard.shape[1]
+        positions = idx * S_local + jnp.arange(S_local)
+        return lm_model.forward(
+            params,
+            tok_shard,
+            cfg,
+            hooks=hooks,
+            cache_names=cache_names,
+            stop_at_layer=stop_at_layer,
+            attn_impl=ring_attention(axis_name),
+            positions=positions,
+        )
+
+    seq_spec = P(None, axis_name)
+    out_spec = P(None, axis_name, None)
+    cache_specs = {name: out_spec for name in cache_names}
+    # jit is what makes reuse real: eager shard_map re-traces and runs
+    # primitive-by-primitive on every call
+    sharded = jax.jit(
+        shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(P(), seq_spec),
+            out_specs=(out_spec, cache_specs),
+            check_rep=False,
+        )
+    )
+
+    def fn(params, tokens):
+        if tokens.shape[1] % n_shards != 0:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} not divisible by {n_shards} shards"
+            )
+        tokens = jax.device_put(tokens, NamedSharding(mesh, seq_spec))
+        return sharded(params, tokens)
+
+    return fn
+
+
 def sequence_parallel_forward(
     params,
     tokens: jax.Array,
@@ -84,47 +142,16 @@ def sequence_parallel_forward(
     hooks: Optional[Dict[str, Callable]] = None,
     stop_at_layer: Optional[int] = None,
 ) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
-    """Full LM forward with the sequence dimension sharded over `axis_name`.
+    """One-shot convenience over `make_sequence_parallel_fn`.
 
     Tokens `[B, S]` are sharded on S; every hook tensor and the output keep
     that sharding (`[B, S, ...]` on the same axis), so harvested activations
     are born distributed — the activation store's natural layout. Hooks run on
     local shards (positionwise hooks like SAE replacement are shard-local by
-    construction).
+    construction). For repeated calls (harvest loops), build the fn once with
+    `make_sequence_parallel_fn`.
     """
-    from jax.experimental.shard_map import shard_map
-
-    cache_names = tuple(cache_names or ())
-    n_shards = mesh.shape[axis_name]
-    S = tokens.shape[1]
-    if S % n_shards != 0:
-        raise ValueError(f"sequence length {S} not divisible by {n_shards} shards")
-    S_local = S // n_shards
-
-    def local_fn(params, tok_shard):
-        idx = jax.lax.axis_index(axis_name)
-        positions = idx * S_local + jnp.arange(S_local)
-        out, cache = lm_model.forward(
-            params,
-            tok_shard,
-            cfg,
-            hooks=hooks,
-            cache_names=cache_names,
-            stop_at_layer=stop_at_layer,
-            attn_impl=ring_attention(axis_name),
-            positions=positions,
-        )
-        return out, cache
-
-    seq_spec = P(None, axis_name)
-    out_spec = P(None, axis_name, None)
-    cache_specs = {name: out_spec for name in cache_names}
-    fn = shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(P(), seq_spec),
-        out_specs=(out_spec, cache_specs),
-        check_rep=False,
+    fn = make_sequence_parallel_fn(
+        cfg, mesh, axis_name, cache_names, hooks, stop_at_layer
     )
-    tokens = jax.device_put(tokens, NamedSharding(mesh, seq_spec))
     return fn(params, tokens)

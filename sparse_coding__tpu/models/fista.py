@@ -127,7 +127,7 @@ def quadratic_basis_update(
     return _norm_rows(new_dict)
 
 
-@partial(jax.jit, static_argnames=("num_iter",))
+@partial(jax.jit, static_argnames=("num_iter", "solver"))
 def dictionary_update(
     learned_dict: jax.Array,
     hessian_diag: jax.Array,
@@ -135,13 +135,19 @@ def dictionary_update(
     coeffs: jax.Array,
     l1_alpha: jax.Array,
     num_iter: int = 500,
+    solver=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One FISTA-solve + basis-update step; returns (new_dict, new_hessian, res).
 
     Pure counterpart of reference `FunctionalFista.dictionary_update`
     (`fista.py:87-96`); the caller rebinds the returned hessian_diag.
+    `solver(batch, dict, l1, warm) -> (codes, res)` overrides the inner solve
+    (the train loop passes the Pallas kernel on TPU).
     """
-    coeffs_fista, res = fista(batch_centered, learned_dict, l1_alpha, coeffs, num_iter)
+    if solver is not None:
+        coeffs_fista, res = solver(batch_centered, learned_dict, l1_alpha, coeffs)
+    else:
+        coeffs_fista, res = fista(batch_centered, learned_dict, l1_alpha, coeffs, num_iter)
     new_hessian = (
         hessian_diag * ((ACT_HISTORY_LEN - 1.0) / ACT_HISTORY_LEN)
         + (coeffs_fista**2).mean(axis=0) / ACT_HISTORY_LEN

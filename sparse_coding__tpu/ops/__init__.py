@@ -1,0 +1,1 @@
+from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu

@@ -1,0 +1,12 @@
+from sparse_coding__tpu.plotting.plots import (
+    autointerp_violins,
+    bottleneck_plot,
+    fista_comparison_plot,
+    fvu_sparsity_pareto,
+    grid_heatmap,
+    histogram,
+    kl_div_plot,
+    n_active_plot,
+    save_figure,
+    sweep_scatter_grid,
+)

@@ -1,0 +1,215 @@
+"""Plotting suite: the reference's 18 standalone figure scripts as functions.
+
+Counterpart of `plotting/*.py` in the reference (~3.2k LoC of copy-pasted
+scripts with hard-coded cluster paths, `plot_sweep_results.py:24-26`).
+Consolidated: every figure the scripts produce is a function taking data +
+`(LearnedDict, hyperparams)` lists and returning a matplotlib Figure (callers
+save). Covered figures → reference source:
+
+  fvu_sparsity_pareto      — plotting/fvu_sparsity_plot.py (+ _gpt2sm/_mlp_center)
+  sweep_scatter_grid       — plotting/plot_sweep_results.py:29-120
+  n_active_plot            — plotting/plot_n_active*.py, num_dead_plot.py
+  autointerp_violins       — plotting/plot_autointerp_violins*.py, interpret.py:691-761
+  kl_div_plot              — plotting/plot_kl_div.py
+  bottleneck_plot          — plotting/bottleneck_plot.py
+  fista_comparison_plot    — plotting/fista_fvu_plot.py
+  grid_heatmap / histogram — standard_metrics.plot_grid/plot_hist (:512-531)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from sparse_coding__tpu.metrics.standard import (
+    fraction_variance_unexplained,
+    mean_nonzero_activations,
+    sparsity_l0,
+)
+
+LearnedDictList = List[Tuple[Any, Dict[str, Any]]]
+
+
+def _series_key(hyperparams: Dict[str, Any], group_by: Sequence[str]) -> str:
+    return ", ".join(f"{k}={hyperparams[k]}" for k in group_by if k in hyperparams)
+
+
+def fvu_sparsity_pareto(
+    learned_dicts: LearnedDictList,
+    batch,
+    group_by: Sequence[str] = ("dict_size",),
+    baselines: Optional[Dict[str, Any]] = None,
+    title: str = "FVU vs sparsity",
+):
+    """The paper's headline pareto: FVU (y) vs mean L0 (x), one curve per
+    group (dict size), with optional baseline dict markers (PCA etc.)."""
+    fig, ax = plt.subplots(figsize=(7, 5))
+    series: Dict[str, List[Tuple[float, float]]] = {}
+    for ld, hp in learned_dicts:
+        key = _series_key(hp, group_by) or "sweep"
+        series.setdefault(key, []).append(
+            (float(sparsity_l0(ld, batch)), float(fraction_variance_unexplained(ld, batch)))
+        )
+    for key, pts in sorted(series.items()):
+        pts.sort()
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, "o-", label=key, markersize=4)
+    for name, ld in (baselines or {}).items():
+        ax.plot(
+            float(sparsity_l0(ld, batch)),
+            float(fraction_variance_unexplained(ld, batch)),
+            "k*", markersize=12,
+        )
+        ax.annotate(name, (float(sparsity_l0(ld, batch)), float(fraction_variance_unexplained(ld, batch))))
+    ax.set_xlabel("mean L0 (active features/example)")
+    ax.set_ylabel("FVU")
+    ax.set_title(title)
+    ax.legend(fontsize=8)
+    return fig
+
+
+def sweep_scatter_grid(
+    learned_dicts: LearnedDictList,
+    batch,
+    x_hyperparam: str = "l1_alpha",
+    metrics: Sequence[str] = ("fvu", "l0"),
+):
+    """Metric-vs-hyperparam scatter grid (reference `plot_sweep_results.py`)."""
+    fns = {
+        "fvu": lambda ld: float(fraction_variance_unexplained(ld, batch)),
+        "l0": lambda ld: float(sparsity_l0(ld, batch)),
+    }
+    fig, axes = plt.subplots(1, len(metrics), figsize=(5 * len(metrics), 4))
+    if len(metrics) == 1:
+        axes = [axes]
+    for ax, metric in zip(axes, metrics):
+        xs = [hp[x_hyperparam] for _, hp in learned_dicts]
+        ys = [fns[metric](ld) for ld, _ in learned_dicts]
+        ax.scatter(xs, ys)
+        ax.set_xscale("log")
+        ax.set_xlabel(x_hyperparam)
+        ax.set_ylabel(metric)
+    fig.tight_layout()
+    return fig
+
+
+def n_active_plot(
+    learned_dicts: LearnedDictList,
+    batch,
+    threshold: float = 0.0,
+    x_hyperparam: str = "l1_alpha",
+):
+    """Active/dead feature counts per dict (reference `plot_n_active*.py`,
+    `num_dead_plot.py`)."""
+    fig, ax = plt.subplots(figsize=(6, 4))
+    xs, n_active, n_dead = [], [], []
+    for ld, hp in learned_dicts:
+        freq = np.asarray(mean_nonzero_activations(ld, batch))
+        xs.append(hp.get(x_hyperparam, 0))
+        n_active.append(int((freq > threshold).sum()))
+        n_dead.append(int((freq <= threshold).sum()))
+    ax.plot(xs, n_active, "o-", label="active")
+    ax.plot(xs, n_dead, "s--", label="dead")
+    ax.set_xscale("log")
+    ax.set_xlabel(x_hyperparam)
+    ax.set_ylabel("# features")
+    ax.legend()
+    return fig
+
+
+def autointerp_violins(scores_by_group: Dict[str, Sequence[float]], title: str = "Autointerp scores"):
+    """Violin plot of autointerp scores per group (reference
+    `plot_autointerp_violins.py`, `interpret.py:691-761`)."""
+    fig, ax = plt.subplots(figsize=(max(6, 1.5 * len(scores_by_group)), 4))
+    groups = sorted(scores_by_group)
+    data = [list(scores_by_group[g]) for g in groups]
+    if any(len(d) for d in data):
+        ax.violinplot([d or [0.0] for d in data], showmeans=True)
+    ax.set_xticks(range(1, len(groups) + 1))
+    ax.set_xticklabels(groups, rotation=30, ha="right", fontsize=8)
+    ax.set_ylabel("score")
+    ax.set_title(title)
+    fig.tight_layout()
+    return fig
+
+
+def kl_div_plot(kl_by_dict: Dict[str, float], title: str = "KL divergence under reconstruction"):
+    """(reference `plot_kl_div.py`)"""
+    fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(kl_by_dict)), 4))
+    names = sorted(kl_by_dict)
+    ax.bar(range(len(names)), [kl_by_dict[n] for n in names])
+    ax.set_xticks(range(len(names)))
+    ax.set_xticklabels(names, rotation=30, ha="right", fontsize=8)
+    ax.set_ylabel("KL divergence")
+    ax.set_title(title)
+    fig.tight_layout()
+    return fig
+
+
+def bottleneck_plot(scores: np.ndarray, labels: Sequence[str], title: str = "Bottleneck"):
+    """Per-dimension bottleneck scores (reference `bottleneck_plot.py`)."""
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for row, label in zip(np.atleast_2d(scores), labels):
+        ax.plot(row, label=label)
+    ax.set_xlabel("dimension")
+    ax.set_ylabel("score")
+    ax.legend(fontsize=8)
+    ax.set_title(title)
+    return fig
+
+
+def fista_comparison_plot(
+    fista_dicts: LearnedDictList, sae_dicts: LearnedDictList, batch,
+):
+    """FISTA-vs-SAE FVU comparison (reference `fista_fvu_plot.py` — the fork's
+    own analysis figure)."""
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for dicts, label, style in ((fista_dicts, "FISTA", "o-"), (sae_dicts, "SAE", "s--")):
+        pts = sorted(
+            (float(sparsity_l0(ld, batch)), float(fraction_variance_unexplained(ld, batch)))
+            for ld, _ in dicts
+        )
+        if pts:
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, style, label=label)
+    ax.set_xlabel("mean L0")
+    ax.set_ylabel("FVU")
+    ax.legend()
+    return fig
+
+
+def grid_heatmap(scores, x_tick_labels, y_tick_labels, x_label, y_label, **imshow_kwargs):
+    """Annotated heatmap (reference `standard_metrics.plot_grid`, `:512-531`)."""
+    fig, ax = plt.subplots()
+    im = ax.imshow(np.asarray(scores), **imshow_kwargs)
+    ax.set_xticks(np.arange(len(x_tick_labels)))
+    ax.set_yticks(np.arange(len(y_tick_labels)))
+    ax.set_xticklabels([f"{x:.3g}" if isinstance(x, float) else str(x) for x in x_tick_labels])
+    ax.set_yticklabels([f"{y:.3g}" if isinstance(y, float) else str(y) for y in y_tick_labels])
+    ax.set_xlabel(x_label)
+    ax.set_ylabel(y_label)
+    fig.colorbar(im)
+    return fig
+
+
+def histogram(values, x_label: str, y_label: str = "Frequency", bins: int = 20):
+    """(reference `standard_metrics.plot_hist`)"""
+    fig, ax = plt.subplots()
+    ax.hist(np.asarray(values), bins=bins)
+    ax.set_xlabel(x_label)
+    ax.set_ylabel(y_label)
+    return fig
+
+
+def save_figure(fig, path):
+    from pathlib import Path
+
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return path

@@ -28,3 +28,4 @@ from sparse_coding__tpu.train.big_batch import (
 )
 from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
 from sparse_coding__tpu.train import experiments
+from sparse_coding__tpu.train.toy_models import ToySAE, run_single_go, run_toy_grid

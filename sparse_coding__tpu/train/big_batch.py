@@ -88,10 +88,11 @@ def make_big_batch_step(sig, tx: optax.GradientTransformation):
     return step
 
 
-def per_example_mse(sig, params, buffers, batch) -> jax.Array:
-    """[B] reconstruction error per example."""
+def per_example_mse_from_codes(sig, params, buffers, batch, c) -> jax.Array:
+    """[B] reconstruction error per example, decoding the codes the train
+    step already computed (no second encode forward)."""
     ld = sig.to_learned_dict(params, buffers)
-    x_hat = ld.predict(batch)
+    x_hat = ld.uncenter(ld.decode(c))
     return ((x_hat - batch) ** 2).mean(axis=-1)
 
 
@@ -174,7 +175,7 @@ def train_big_batch(
     if mesh is not None:
         sharding = batch_sharding(mesh)
     step_fn = make_big_batch_step(sig, tx)
-    mse_fn = jax.jit(partial(per_example_mse, sig))
+    mse_fn = jax.jit(partial(per_example_mse_from_codes, sig))
 
     worst = WorstExamples(worst_k)
     n = dataset.shape[0]
@@ -184,9 +185,12 @@ def train_big_batch(
         batch = dataset[idxs]
         if mesh is not None:
             batch = jax.device_put(batch, sharding)
-        state, loss_dict, _c = step_fn(state, batch)
-        mses = np.asarray(jax.device_get(mse_fn(state.params, state.buffers, batch)))
-        worst.update(idxs, mses)
+        state, loss_dict, c = step_fn(state, batch)
+        if reinit_every:
+            # worst-example tracking (host sync) only if resurrection is on;
+            # decodes the codes the step already produced
+            mses = np.asarray(jax.device_get(mse_fn(state.params, state.buffers, batch, c)))
+            worst.update(idxs, mses)
 
         if reinit_every and (i + 1) % reinit_every == 0:
             worst_idx = worst.get_worst(n_feats)

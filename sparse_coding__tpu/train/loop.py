@@ -38,13 +38,33 @@ from sparse_coding__tpu.models.learned_dict import _norm_rows
 from sparse_coding__tpu.utils.logging import MetricLogger
 
 
-def make_fista_decoder_update(num_iter: int = 500) -> Callable:
+def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
     """Build the jitted, ensemble-vmapped FISTA decoder update.
 
     ``update(state, batch, c) -> state`` where ``c`` is the `aux["c"]` code
     tensor from the gradient step (warm start for FISTA, exactly as the
     reference reuses `aux_buffer["c"]`, `big_sweep.py:177`).
+
+    `use_pallas`: None → auto (the VMEM-resident `ops.fista_pallas` kernel on
+    TPU, plain jnp elsewhere). The kernel composes with the ensemble vmap —
+    the model axis becomes an extra grid dimension.
     """
+    if use_pallas is None:
+        from sparse_coding__tpu.ops.fista_pallas import on_tpu
+
+        use_pallas = on_tpu()
+
+    def solve(batch, learned_dict, l1_alpha, c_m):
+        if use_pallas:
+            from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu
+
+            return fista_pallas(
+                batch, learned_dict, l1_alpha, num_iter=num_iter, coefficients=c_m,
+                interpret=not on_tpu(),  # CPU: interpreter keeps tests honest
+            )
+        from sparse_coding__tpu.models.fista import fista
+
+        return fista(batch, learned_dict, l1_alpha, c_m, num_iter)
 
     @partial(jax.jit, donate_argnums=(0,))
     def update(state: EnsembleState, batch: jax.Array, c: jax.Array) -> EnsembleState:
@@ -57,6 +77,7 @@ def make_fista_decoder_update(num_iter: int = 500) -> Callable:
                 c_m,
                 buffers["l1_alpha"],
                 num_iter,
+                solver=solve,
             )
             return new_dict, new_hessian
 

@@ -256,7 +256,9 @@ def sweep(
     )
 
     n_chunks = len(store)
-    chunk_order = np.random.permutation(n_chunks)
+    # explicitly seeded: resume must reproduce the ORIGINAL run's permutation
+    # regardless of what consumed global numpy randomness in between
+    chunk_order = np.random.default_rng(cfg.seed).permutation(n_chunks)
     reps = cfg.n_repetitions if getattr(cfg, "n_repetitions", None) else cfg.n_epochs
     chunk_order = np.tile(chunk_order, max(1, reps))
 

@@ -1,0 +1,151 @@
+"""Superposition toy-model replication.
+
+Counterpart of the reference `replicate_toy_models.py:208-565`: train small
+SAEs on synthetic sparse data over an (l1_alpha × dict_ratio) grid and report
+MMCS-to-ground-truth and dead-neuron grids.
+
+TPU-first: the reference trains one `nn.Module` per grid cell in a Python
+loop; here each l1 row of the grid is one vmapped ensemble stack (per dict
+size), so the whole grid is a handful of fused jit programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.data.synthetic import RandomDatasetGenerator
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.metrics.standard import mmcs_to_fixed
+from sparse_coding__tpu.models.learned_dict import UntiedSAE, _norm_rows
+from sparse_coding__tpu.utils.config import ToyArgs
+
+_glorot = jax.nn.initializers.glorot_uniform()
+_orthogonal = jax.nn.initializers.orthogonal()
+
+
+class ToySAE:
+    """The toy AutoEncoder as a DictSignature (reference `AutoEncoder`,
+    `replicate_toy_models.py:208-229`): biased ReLU encoder, unit-norm
+    bias-free decoder (orthogonal init), loss = MSE + l1·‖c‖₁/n_dict (the
+    reference's per-dict-size l1 normalization, `:322`)."""
+
+    @staticmethod
+    def init(key, activation_size, n_dict_components, l1_alpha, dtype=jnp.float32):
+        k_enc, k_dec = jax.random.split(key)
+        params = {
+            "encoder": _glorot(k_enc, (n_dict_components, activation_size), dtype),
+            "encoder_bias": jnp.zeros((n_dict_components,), dtype),
+            "decoder": _orthogonal(k_dec, (n_dict_components, activation_size), dtype),
+        }
+        buffers = {"l1_alpha": jnp.asarray(l1_alpha, dtype)}
+        return params, buffers
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        c = jax.nn.relu(
+            jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
+        )
+        decoder = _norm_rows(params["decoder"])
+        x_hat = jnp.einsum("nd,bn->bd", decoder, c)
+        l_reconstruction = jnp.mean((batch - x_hat) ** 2)
+        l_l1 = buffers["l1_alpha"] * jnp.abs(c).sum(axis=-1).mean() / c.shape[-1]
+        total = l_reconstruction + l_l1
+        return total, ({"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}, {"c": c})
+
+    @staticmethod
+    def to_learned_dict(params, buffers):
+        return UntiedSAE(params["encoder"], params["decoder"], params["encoder_bias"])
+
+
+def get_n_dead_neurons(learned_dict, data_generator, n_batches: int = 10) -> int:
+    """Features whose mean activation over fresh batches is 0
+    (reference `get_n_dead_neurons`, `replicate_toy_models.py:256-271`)."""
+    outputs = [learned_dict.encode(next(data_generator)) for _ in range(n_batches)]
+    mean_acts = jnp.concatenate(outputs).mean(axis=0)
+    return int((mean_acts == 0).sum())
+
+
+def run_single_go(cfg: ToyArgs, data_generator: Optional[RandomDatasetGenerator] = None):
+    """Train one toy SAE; returns (learned_dict, mmcs, n_dead)
+    (reference `run_single_go`, `replicate_toy_models.py:280-361`)."""
+    if data_generator is None:
+        data_generator = RandomDatasetGenerator(
+            activation_dim=cfg.activation_dim,
+            n_ground_truth_components=cfg.n_ground_truth_components,
+            batch_size=cfg.batch_size,
+            feature_num_nonzero=cfg.feature_num_nonzero,
+            feature_prob_decay=cfg.feature_prob_decay,
+            correlated=cfg.correlated_components,
+            key=jax.random.PRNGKey(cfg.seed),
+        )
+    ens = build_ensemble(
+        ToySAE,
+        jax.random.PRNGKey(cfg.seed + 1),
+        [{"l1_alpha": cfg.l1_alpha}],
+        optimizer_kwargs={"learning_rate": cfg.lr},
+        activation_size=cfg.activation_dim,
+        n_dict_components=cfg.n_components_dictionary,
+    )
+    key = jax.random.PRNGKey(cfg.seed + 2)
+    for _ in range(cfg.epochs):
+        key, k = jax.random.split(key)
+        batch = next(data_generator)
+        if cfg.noise_level > 0:
+            batch = batch + cfg.noise_level * jax.random.normal(k, batch.shape)
+        ens.step_batch(batch)
+    ld = ens.to_learned_dicts()[0]
+    mmcs = float(mmcs_to_fixed(ld, data_generator.feats))
+    n_dead = get_n_dead_neurons(ld, data_generator)
+    return ld, mmcs, n_dead
+
+
+def run_toy_grid(cfg: ToyArgs) -> Dict[str, np.ndarray]:
+    """The replication grid: l1 ∈ base^[low..high] × dict_ratio ∈ base^[low..high]
+    → MMCS and dead-neuron matrices (reference `run_toy_models`/`plot_mmcs_grid`
+    flow, `replicate_toy_models.py:363-565`).
+
+    Each dict size is ONE ensemble with all l1 values stacked.
+    """
+    l1_range = [
+        cfg.l1_exp_base**exp for exp in range(cfg.l1_exp_low, cfg.l1_exp_high)
+    ]
+    ratio_range = [
+        cfg.dict_ratio_exp_base**exp
+        for exp in range(cfg.dict_ratio_exp_low, cfg.dict_ratio_exp_high)
+    ]
+    generator = RandomDatasetGenerator(
+        activation_dim=cfg.activation_dim,
+        n_ground_truth_components=cfg.n_ground_truth_components,
+        batch_size=cfg.batch_size,
+        feature_num_nonzero=cfg.feature_num_nonzero,
+        feature_prob_decay=cfg.feature_prob_decay,
+        correlated=cfg.correlated_components,
+        key=jax.random.PRNGKey(cfg.seed),
+    )
+    mmcs_grid = np.zeros((len(l1_range), len(ratio_range)))
+    dead_grid = np.zeros((len(l1_range), len(ratio_range)), dtype=int)
+    for j, ratio in enumerate(ratio_range):
+        dict_size = int(cfg.activation_dim * ratio)
+        ens = build_ensemble(
+            ToySAE,
+            jax.random.PRNGKey(cfg.seed + j),
+            [{"l1_alpha": float(a)} for a in l1_range],
+            optimizer_kwargs={"learning_rate": cfg.lr},
+            activation_size=cfg.activation_dim,
+            n_dict_components=dict_size,
+        )
+        for _ in range(cfg.epochs):
+            ens.step_batch(next(generator))
+        for i, ld in enumerate(ens.to_learned_dicts()):
+            mmcs_grid[i, j] = float(mmcs_to_fixed(ld, generator.feats))
+            dead_grid[i, j] = get_n_dead_neurons(ld, generator, n_batches=3)
+    return {
+        "l1_range": np.asarray(l1_range),
+        "ratio_range": np.asarray(ratio_range),
+        "mmcs": mmcs_grid,
+        "n_dead": dead_grid,
+    }

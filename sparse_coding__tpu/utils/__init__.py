@@ -10,3 +10,4 @@ from sparse_coding__tpu.utils.config import (
     ToyArgs,
     TrainArgs,
 )
+from sparse_coding__tpu.utils.trace import Progress, StepTimer, annotate, trace

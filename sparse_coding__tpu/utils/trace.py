@@ -1,0 +1,92 @@
+"""Profiling & progress utilities.
+
+The reference has no tracing at all (SURVEY.md §5): progress is shared-memory
+counters polled by `progressbar` (`cluster_runs.py:132-154`). Here:
+
+  - `trace(...)`: context manager around `jax.profiler` writing a
+    Perfetto/TensorBoard trace directory;
+  - `StepTimer`: wall-clock per-step timing with a device-sync fence only at
+    report time (no per-step host syncs);
+  - `annotate(...)`: `jax.profiler.TraceAnnotation` passthrough for labeling
+    train-loop phases inside a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/jax-trace", create_perfetto_link: bool = False):
+    """Profile the enclosed block; view with TensorBoard or ui.perfetto.dev."""
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Label a region inside an active trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock step timing without per-step device syncs.
+
+    `tick()` each step (host-side timestamps only); `report(fence=x)` fetches
+    `x` (any device array) once as the completion barrier, then returns
+    steps/sec statistics. Note: on the tunneled TPU backend
+    `block_until_ready` is a no-op — fetching a value is the only reliable
+    fence, hence the fence-array argument.
+    """
+
+    def __init__(self):
+        self._times: List[float] = []
+        self.reset()
+
+    def reset(self):
+        self._times = [time.perf_counter()]
+
+    def tick(self):
+        self._times.append(time.perf_counter())
+
+    def report(self, fence=None) -> Dict[str, float]:
+        n_steps = len(self._times) - 1  # ticks only; the fence is not a step
+        end = self._times[-1]
+        if fence is not None:
+            jax.device_get(fence)
+            end = time.perf_counter()  # extends total time, not the step count
+        if n_steps <= 0:
+            return {"steps": 0, "total_s": 0.0, "steps_per_sec": 0.0, "mean_step_ms": 0.0}
+        total = end - self._times[0]
+        return {
+            "steps": n_steps,
+            "total_s": total,
+            "steps_per_sec": n_steps / total if total > 0 else 0.0,
+            "mean_step_ms": 1000.0 * total / n_steps,
+        }
+
+
+class Progress:
+    """Minimal progress reporter replacing the reference's polled
+    shared-memory counters (`cluster_runs.py:145-154`): single-process, just
+    prints every `every` fraction."""
+
+    def __init__(self, total: int, label: str = "", every: float = 0.1):
+        self.total = max(total, 1)
+        self.label = label
+        self.every = every
+        self._last = -1.0
+
+    def update(self, i: int):
+        frac = (i + 1) / self.total
+        if frac - self._last >= self.every or i + 1 == self.total:
+            self._last = frac
+            print(f"{self.label} {i+1}/{self.total} ({100*frac:.0f}%)", flush=True)

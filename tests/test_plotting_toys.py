@@ -1,0 +1,77 @@
+"""Plotting suite + toy-model replication."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu import plotting
+from sparse_coding__tpu.data import RandomDatasetGenerator
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE, Identity
+from sparse_coding__tpu.train import run_single_go, run_toy_grid
+from sparse_coding__tpu.utils import ToyArgs
+
+
+@pytest.fixture(scope="module")
+def trained():
+    gen = RandomDatasetGenerator(
+        activation_dim=16, n_ground_truth_components=32, batch_size=256,
+        feature_num_nonzero=4, feature_prob_decay=0.99, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    ens = build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(1),
+        [{"l1_alpha": a} for a in (1e-4, 1e-3)],
+        optimizer_kwargs={"learning_rate": 3e-3},
+        activation_size=16, n_dict_components=32,
+    )
+    for _ in range(30):
+        ens.step_batch(next(gen))
+    lds = [
+        (ld, {"l1_alpha": a, "dict_size": 32})
+        for ld, a in zip(ens.to_learned_dicts(), (1e-4, 1e-3))
+    ]
+    return lds, next(gen)
+
+
+def test_all_figures_render(tmp_path, trained):
+    lds, batch = trained
+    figs = {
+        "pareto": plotting.fvu_sparsity_pareto(lds, batch, baselines={"identity": Identity(16)}),
+        "scatter": plotting.sweep_scatter_grid(lds, batch),
+        "n_active": plotting.n_active_plot(lds, batch),
+        "violins": plotting.autointerp_violins({"run_a": [0.1, 0.5, 0.3], "run_b": [0.2]}),
+        "kl": plotting.kl_div_plot({"sae": 0.2, "pca": 0.4}),
+        "bottleneck": plotting.bottleneck_plot(np.random.rand(2, 10), ["a", "b"]),
+        "fista_cmp": plotting.fista_comparison_plot(lds[:1], lds[1:], batch),
+        "grid": plotting.grid_heatmap(np.random.rand(3, 4), [1, 2, 3, 4], [0.1, 0.2, 0.3], "x", "y"),
+        "hist": plotting.histogram(np.random.rand(100), "value"),
+    }
+    for name, fig in figs.items():
+        path = plotting.save_figure(fig, tmp_path / f"{name}.png")
+        assert path.exists() and path.stat().st_size > 1000, name
+
+
+def test_toy_single_go():
+    cfg = ToyArgs(
+        activation_dim=16, n_ground_truth_components=32, batch_size=512,
+        feature_num_nonzero=4, feature_prob_decay=0.99, epochs=300,
+        n_components_dictionary=32, l1_alpha=3e-4, lr=3e-3,
+    )
+    ld, mmcs, n_dead = run_single_go(cfg)
+    assert 0.0 < mmcs <= 1.0
+    assert mmcs > 0.5, f"toy SAE failed to recover features (mmcs={mmcs})"
+    assert 0 <= n_dead <= 32
+
+
+def test_toy_grid_shapes():
+    cfg = ToyArgs(
+        activation_dim=8, n_ground_truth_components=16, batch_size=128,
+        feature_num_nonzero=3, feature_prob_decay=0.99, epochs=20,
+        l1_exp_low=-8, l1_exp_high=-6, dict_ratio_exp_low=0, dict_ratio_exp_high=2,
+    )
+    grids = run_toy_grid(cfg)
+    assert grids["mmcs"].shape == (2, 2)
+    assert grids["n_dead"].shape == (2, 2)
+    assert np.isfinite(grids["mmcs"]).all()
+    assert ((grids["mmcs"] >= -1) & (grids["mmcs"] <= 1)).all()

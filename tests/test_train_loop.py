@@ -76,3 +76,21 @@ def test_make_hyperparam_name():
     # reference format: {:.2E} with "+" stripped (big_sweep.py:76-84)
     assert make_hyperparam_name({"l1_alpha": 1e-3}) == "l1_alpha_1.00E-03"
     assert make_hyperparam_name({"k": 4, "l1_alpha": 1e-2}) == "k_4_l1_alpha_1.00E-02"
+
+
+def test_step_timer_and_trace(tmp_path):
+    from sparse_coding__tpu.utils import StepTimer, trace, annotate
+    import jax.numpy as jnp
+
+    t = StepTimer()
+    x = jnp.zeros((4,))
+    for _ in range(3):
+        x = x + 1
+        t.tick()
+    rep = t.report(fence=x)
+    assert rep["steps"] == 4 and rep["total_s"] >= 0  # 3 ticks + fence tick
+
+    with trace(str(tmp_path / "trace")):
+        with annotate("toy"):
+            jax.device_get(jnp.ones((8,)) * 2)
+    assert any((tmp_path / "trace").rglob("*")), "no trace files written"
