@@ -25,7 +25,7 @@ buffers — the behavior the EMA code plainly intends.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Dict, Optional
 
 import jax
@@ -39,7 +39,7 @@ from sparse_coding__tpu.utils.logging import MetricLogger
 
 
 def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
-    """Build the jitted, ensemble-vmapped FISTA decoder update.
+    """Build (or fetch the cached) jitted, ensemble-vmapped FISTA decoder update.
 
     ``update(state, batch, c) -> state`` where ``c`` is the `aux["c"]` code
     tensor from the gradient step (warm start for FISTA, exactly as the
@@ -48,12 +48,20 @@ def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
     `use_pallas`: None → auto (the VMEM-resident `ops.fista_pallas` kernel on
     TPU, plain jnp elsewhere). The kernel composes with the ensemble vmap —
     the model axis becomes an extra grid dimension.
+
+    Cached by `(num_iter, use_pallas)` so repeated `ensemble_train_loop` calls
+    across a sweep's chunks reuse one jit object (and XLA's compile cache)
+    instead of re-tracing the 500-iteration solve every chunk.
     """
     if use_pallas is None:
         from sparse_coding__tpu.ops.fista_pallas import on_tpu
 
         use_pallas = on_tpu()
+    return _cached_fista_decoder_update(num_iter, use_pallas)
 
+
+@lru_cache(maxsize=None)
+def _cached_fista_decoder_update(num_iter: int, use_pallas: bool) -> Callable:
     def solve(batch, learned_dict, l1_alpha, c_m):
         if use_pallas:
             from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu
