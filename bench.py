@@ -3,11 +3,19 @@
 Workload: the reference paper's core sweep shape (8-member L1-sweep ensemble of
 tied SAEs on Pythia-70M-sized activations: d_activation=512, 8x overcomplete
 dict=4096, batch 2048 — cf. `big_sweep_experiments.py:295-341` and
-BASELINE.json config 2), trained with the fused vmapped step. Data is
-generated on device so the number measures training compute throughput.
+BASELINE.json config 2), trained with the fused vmapped step.
+
+Round-2 throughput path (see THROUGHPUT.md for the profile that led here):
+  - bf16 mixed precision (`utils.precision`): MXU-native matmuls, fp32
+    master params/Adam, fp32 loss accumulation;
+  - `Ensemble.step_scan`: 128 steps per dispatch under one `lax.scan`, so
+    the ~10 ms tunneled-dispatch latency amortizes to ~0.08 ms/step;
+  - batches fed in bf16 (halves batch HBM traffic).
 
 Metric: activation vectors consumed per second per chip (each vector is
-processed by all 8 ensemble members — fwd+bwd+adam).
+processed by all 8 ensemble members — fwd+bwd+adam). MFU is reported against
+the actual matmul FLOPs of the tied-SAE step (5 matmul passes: 2 fwd + 3 bwd)
+and the chip's bf16 peak.
 
 vs_baseline: ratio against an analytic A100 estimate of the same workload,
 since the reference publishes no numbers (BASELINE.md): 8 members x 6
@@ -25,6 +33,8 @@ import jax.numpy as jnp
 
 N_MODELS, D_ACT, N_DICT, BATCH = 8, 512, 4096, 2048
 A100_BASELINE_ACTS_PER_SEC = 0.78e6
+SCAN_STEPS = 128
+TPU_PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
 
 
 def main():
@@ -39,6 +49,7 @@ def main():
         optimizer_kwargs={"learning_rate": 1e-3},
         activation_size=D_ACT,
         n_dict_components=N_DICT,
+        compute_dtype=jnp.bfloat16,
     )
     gen = RandomDatasetGenerator(
         activation_dim=D_ACT,
@@ -49,30 +60,38 @@ def main():
         correlated=False,
         key=jax.random.PRNGKey(1),
     )
-    batches = [next(gen) for _ in range(8)]
+    uniq = jnp.stack([next(gen) for _ in range(8)]).astype(jnp.bfloat16)
+    batches = jnp.tile(uniq, (SCAN_STEPS // 8, 1, 1))  # [SCAN_STEPS, BATCH, D_ACT]
 
     # warmup / compile. NOTE: block_until_ready does not actually wait on
     # tunneled TPU backends (axon) — fetching the value is the only reliable
     # completion barrier, so we device_get the (tiny) loss vector.
-    for b in batches[:3]:
-        loss, _ = ens.step_batch(b)
-    jax.device_get(loss["loss"])
+    losses = ens.step_scan(batches)
+    jax.device_get(losses["loss"])
 
-    n_steps = 60
+    reps = 3
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        loss, _ = ens.step_batch(batches[i % len(batches)])
-    jax.device_get(loss["loss"])
+    for _ in range(reps):
+        losses = ens.step_scan(batches)
+    jax.device_get(losses["loss"])
     dt = time.perf_counter() - t0
 
+    n_steps = reps * SCAN_STEPS
     acts_per_sec = n_steps * BATCH / dt
+    # true matmul work of the tied-SAE step: 5 passes (fwd c, fwd x_hat;
+    # bwd dc, and the two dictionary-gradient contractions)
+    flops_per_act = N_MODELS * 5 * 2 * D_ACT * N_DICT
+    peak = TPU_PEAK_TFLOPS.get(jax.devices()[0].device_kind, 197.0)
+    mfu = acts_per_sec * flops_per_act / (peak * 1e12)
     print(
         json.dumps(
             {
-                "metric": "ensemble_sae_train_throughput (8x tied-SAE 512->4096, batch 2048)",
+                "metric": "ensemble_sae_train_throughput (8x tied-SAE 512->4096, batch 2048, bf16+scan128)",
                 "value": round(acts_per_sec, 1),
                 "unit": "activations/sec/chip",
                 "vs_baseline": round(acts_per_sec / A100_BASELINE_ACTS_PER_SEC, 3),
+                "mfu": round(mfu, 3),
+                "device": jax.devices()[0].device_kind,
             }
         )
     )

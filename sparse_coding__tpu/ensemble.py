@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from sparse_coding__tpu.utils import precision as px
+
 Pytree = Any
 
 
@@ -110,6 +112,9 @@ def make_ensemble_step(
     tx: optax.GradientTransformation,
     per_model_batch: bool = False,
     unstacked: bool = False,
+    compute_dtype=None,
+    fused: bool = False,
+    fused_adam: Optional[Dict[str, float]] = None,
 ) -> Callable:
     """Build the fused train step for a stacked ensemble.
 
@@ -125,6 +130,14 @@ def make_ensemble_step(
       unstacked: run models sequentially with `lax.map` instead of `vmap`
         (escape hatch mirroring `no_stacking`, `ensemble.py:100-116`; use only
         for ops that fail under vmap — still a single compiled program).
+      compute_dtype: matmul compute dtype baked into the trace
+        (`utils.precision`); None = exact fp32. Params/optimizer stay fp32.
+      fused: compute grads via the signature's Pallas `fused_grads` kernel
+        (`ops/tied_sae_kernel.py`) instead of `jax.grad`. Implies the bf16
+        policy inside the kernel; no aux is returned on this path.
+      fused_adam: dict(lr, b1, b2, eps) — additionally run the optimizer
+        update inside the kernel (`fused_adam_step`); only valid when `tx`
+        IS optax.adam with those exact constants.
     """
 
     grad_fn = jax.grad(sig.loss, has_aux=True)
@@ -138,18 +151,50 @@ def make_ensemble_step(
     batch_axis = 0 if per_model_batch else None
 
     def step(state: EnsembleState, batch: jax.Array):
-        if unstacked:
-            if per_model_batch:
-                xs = (state.params, state.buffers, state.opt_state, batch)
-                f = lambda args: one_model(*args)
+        # `px.compute` is a trace-time policy: it runs while jit traces this
+        # body, so the chosen precision is baked into the compiled program.
+        with px.compute(compute_dtype):
+            # Fused Pallas path: one kernel launch for the whole stack (the
+            # model axis is a grid dim — vmapping the kernel would serialize
+            # it). Static trace-time condition; shared-batch only.
+            if fused and not per_model_batch and not unstacked and batch.shape[0] % 256 == 0:
+                if fused_adam is not None and hasattr(sig, "fused_adam_step"):
+                    params, opt_state, loss_dict = sig.fused_adam_step(
+                        state.params, state.buffers, batch, state.opt_state, **fused_adam
+                    )
+                    return (
+                        EnsembleState(
+                            params=params,
+                            buffers=state.buffers,
+                            opt_state=opt_state,
+                            step=state.step + 1,
+                        ),
+                        (loss_dict, {}),
+                    )
+                grads, loss_dict = sig.fused_grads_stacked(state.params, state.buffers, batch)
+                updates, opt_state = jax.vmap(tx.update)(grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
+                return (
+                    EnsembleState(
+                        params=params,
+                        buffers=state.buffers,
+                        opt_state=opt_state,
+                        step=state.step + 1,
+                    ),
+                    (loss_dict, {}),
+                )
+            if unstacked:
+                if per_model_batch:
+                    xs = (state.params, state.buffers, state.opt_state, batch)
+                    f = lambda args: one_model(*args)
+                else:
+                    xs = (state.params, state.buffers, state.opt_state)
+                    f = lambda args: one_model(*args, batch)
+                params, opt_state, loss_dict, aux = jax.lax.map(f, xs)
             else:
-                xs = (state.params, state.buffers, state.opt_state)
-                f = lambda args: one_model(*args, batch)
-            params, opt_state, loss_dict, aux = jax.lax.map(f, xs)
-        else:
-            params, opt_state, loss_dict, aux = jax.vmap(
-                one_model, in_axes=(0, 0, 0, batch_axis)
-            )(state.params, state.buffers, state.opt_state, batch)
+                params, opt_state, loss_dict, aux = jax.vmap(
+                    one_model, in_axes=(0, 0, 0, batch_axis)
+                )(state.params, state.buffers, state.opt_state, batch)
         new_state = EnsembleState(
             params=params,
             buffers=state.buffers,
@@ -159,6 +204,41 @@ def make_ensemble_step(
         return new_state, (loss_dict, aux)
 
     return step
+
+
+def make_ensemble_multi_step(
+    sig,
+    tx: optax.GradientTransformation,
+    per_model_batch: bool = False,
+    unstacked: bool = False,
+    compute_dtype=None,
+    fused: bool = False,
+    fused_adam: Optional[Dict[str, float]] = None,
+) -> Callable:
+    """K fused train steps under ONE compiled program via `lax.scan`.
+
+    ``multi_step(state, batches) -> (state, loss_dicts)`` where ``batches``
+    stacks K batches on a new leading axis and every returned loss leaf has
+    leading dim K. The per-step `aux` (the huge code tensor) is deliberately
+    dropped — stacking it over K would blow HBM; use the single `step` when
+    aux is needed (e.g. the FISTA warm start).
+
+    Rationale (THROUGHPUT.md): on the tunneled TPU backend each dispatch costs
+    ~10 ms of host/tunnel latency; scanning K steps amortizes it to 10/K ms
+    and lets XLA keep params/opt-state resident in HBM across steps.
+    """
+    step = make_ensemble_step(
+        sig, tx, per_model_batch, unstacked, compute_dtype, fused, fused_adam
+    )
+
+    def multi_step(state: EnsembleState, batches: jax.Array):
+        def body(s, b):
+            s, (loss_dict, _aux) = step(s, b)
+            return s, loss_dict
+
+        return jax.lax.scan(body, state, batches)
+
+    return multi_step
 
 
 class Ensemble:
@@ -180,12 +260,29 @@ class Ensemble:
         optimizer_kwargs: Optional[Dict[str, Any]] = None,
         unstacked: bool = False,
         donate: bool = True,
+        compute_dtype=None,
+        fused: Optional[bool] = None,
     ):
         if not models:
             raise ValueError("Ensemble requires at least one (params, buffers) model")
         self.sig = sig
         self.n_models = len(models)
         self.unstacked = unstacked
+        self.compute_dtype = None if compute_dtype is None else jnp.dtype(compute_dtype)
+        if fused is None:
+            # auto: Pallas fused step on real TPU when the signature supports
+            # this config and the caller opted into bf16 compute.
+            from sparse_coding__tpu.ops.tied_sae_kernel import on_tpu
+
+            fused = (
+                self.compute_dtype == jnp.bfloat16
+                and not unstacked
+                and hasattr(sig, "fused_grads")
+                and hasattr(sig, "fused_supported")
+                and sig.fused_supported(*models[0])
+                and on_tpu()
+            )
+        self.fused = bool(fused)
         if isinstance(optimizer, str):
             self.optimizer_name = optimizer
             self.optimizer_kwargs = dict(optimizer_kwargs or {})
@@ -209,11 +306,48 @@ class Ensemble:
             step=jnp.zeros((), jnp.int32),
         )
 
-        step = make_ensemble_step(sig, self.tx, per_model_batch=False, unstacked=unstacked)
-        step_pm = make_ensemble_step(sig, self.tx, per_model_batch=True, unstacked=unstacked)
+        self._build_steps(donate=donate)
+
+    def _build_steps(self, donate: bool = True):
+        fused_adam = None
+        if (
+            getattr(self, "fused", False)
+            and self.optimizer_name == "adam"
+            and hasattr(self.sig, "fused_adam_step")
+            and isinstance(self.optimizer_kwargs.get("learning_rate", 1e-3), (int, float))
+            # the in-kernel update is vanilla Adam: refuse kwargs that change
+            # optax.adam's semantics (nesterov, eps_root, mu_dtype, ...)
+            and set(self.optimizer_kwargs) <= {"learning_rate", "b1", "b2", "eps"}
+        ):
+            fused_adam = dict(
+                lr=float(self.optimizer_kwargs.get("learning_rate", 1e-3)),
+                b1=float(self.optimizer_kwargs.get("b1", 0.9)),
+                b2=float(self.optimizer_kwargs.get("b2", 0.999)),
+                eps=float(self.optimizer_kwargs.get("eps", 1e-8)),
+            )
+        kw = dict(
+            unstacked=self.unstacked,
+            compute_dtype=self.compute_dtype,
+            fused=getattr(self, "fused", False),
+            fused_adam=fused_adam,
+        )
         donate_argnums = (0,) if donate else ()
-        self._step = jax.jit(step, donate_argnums=donate_argnums)
-        self._step_pm = jax.jit(step_pm, donate_argnums=donate_argnums)
+        self._step = jax.jit(
+            make_ensemble_step(self.sig, self.tx, per_model_batch=False, **kw),
+            donate_argnums=donate_argnums,
+        )
+        self._step_pm = jax.jit(
+            make_ensemble_step(self.sig, self.tx, per_model_batch=True, **kw),
+            donate_argnums=donate_argnums,
+        )
+        self._multi = jax.jit(
+            make_ensemble_multi_step(self.sig, self.tx, per_model_batch=False, **kw),
+            donate_argnums=donate_argnums,
+        )
+        self._multi_pm = jax.jit(
+            make_ensemble_multi_step(self.sig, self.tx, per_model_batch=True, **kw),
+            donate_argnums=donate_argnums,
+        )
 
     # -- scale-out -----------------------------------------------------------
 
@@ -251,6 +385,27 @@ class Ensemble:
         self.state, (loss_dict, aux) = fn(self.state, batch)
         return loss_dict, aux
 
+    def step_scan(self, batches: jax.Array, per_model: bool = False):
+        """K fused updates in ONE dispatch (`lax.scan` over the leading axis).
+
+        ``batches``: [K, batch, d] (or [K, n_models, batch, d] with
+        ``per_model``). Returns the loss dict with leading dim K. This is the
+        throughput path: ~10 ms of tunnel dispatch latency is paid once per K
+        steps instead of per step (THROUGHPUT.md).
+        """
+        if getattr(self, "_mesh", None) is not None:
+            from sparse_coding__tpu.parallel import mesh as mesh_lib
+
+            sharding = (
+                mesh_lib.per_model_batch_sharding(self._mesh, leading=1)
+                if per_model
+                else mesh_lib.batch_sharding(self._mesh, leading=1)
+            )
+            batches = jax.device_put(batches, sharding)
+        fn = self._multi_pm if per_model else self._multi
+        self.state, loss_dicts = fn(self.state, batches)
+        return loss_dicts
+
     # -- export / checkpoint -------------------------------------------------
 
     def unstack(self) -> List[Tuple[Pytree, Pytree]]:
@@ -286,6 +441,8 @@ class Ensemble:
             "optimizer_name": self.optimizer_name,
             "optimizer_kwargs": self.optimizer_kwargs,
             "unstacked": self.unstacked,
+            "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
+            "fused": self.fused,
             "state": jax.device_get(self.state),
         }
 
@@ -307,12 +464,16 @@ class Ensemble:
         self.unstacked = state_dict["unstacked"]
         self.optimizer_name = state_dict["optimizer_name"]
         self.optimizer_kwargs = state_dict["optimizer_kwargs"]
+        cd = state_dict.get("compute_dtype")
+        self.compute_dtype = None if cd is None else jnp.dtype(cd)
+        # `fused` is a TPU-only execution strategy, not model state: a
+        # checkpoint trained fused on TPU must still load on a CPU host.
+        from sparse_coding__tpu.ops.tied_sae_kernel import on_tpu
+
+        self.fused = bool(state_dict.get("fused", False)) and on_tpu()
         self.tx = tx if tx is not None else optim_str_to_func(self.optimizer_name)(**self.optimizer_kwargs)
         self.state = jax.tree.map(jnp.asarray, state_dict["state"])
-        step = make_ensemble_step(sig, self.tx, per_model_batch=False, unstacked=self.unstacked)
-        step_pm = make_ensemble_step(sig, self.tx, per_model_batch=True, unstacked=self.unstacked)
-        self._step = jax.jit(step, donate_argnums=(0,))
-        self._step_pm = jax.jit(step_pm, donate_argnums=(0,))
+        self._build_steps()
         return self
 
 
@@ -322,6 +483,7 @@ def build_ensemble(
     hparams_list: Sequence[Dict[str, Any]],
     optimizer: str = "adam",
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compute_dtype=None,
     **common_hparams,
 ) -> Ensemble:
     """Convenience: init N models of `sig` (one per hparams dict) and stack them.
@@ -335,4 +497,4 @@ def build_ensemble(
     models = [
         sig.init(k, **common_hparams, **hp) for k, hp in zip(keys, hparams_list)
     ]
-    return Ensemble(models, sig, optimizer, optimizer_kwargs)
+    return Ensemble(models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype)

@@ -15,6 +15,11 @@ TPU notes: every loss is two MXU matmuls (`bd,dn->bn` and `bn,nd->bd`) plus
 fused elementwise ops; under `vmap` over the ensemble axis XLA batches them
 into single larger matmuls. Masked variants use multiply-by-mask (not
 `masked_fill_`) so the same compiled program serves every dict size.
+
+Mixed precision (`utils.precision`): when a compute dtype is active at trace
+time, matmul operands and the big code tensor run in bf16 (MXU-native, half
+the HBM traffic) while reductions and the returned losses accumulate in fp32.
+With the policy off (the default) the math is bit-for-bit the original fp32.
 """
 
 from __future__ import annotations
@@ -31,12 +36,34 @@ from sparse_coding__tpu.models.learned_dict import (
     UntiedSAE,
     _norm_rows,
 )
+from sparse_coding__tpu.utils import precision as px
 
 _glorot = jax.nn.initializers.glorot_uniform()
 
 
 def _l1(c: jax.Array) -> jax.Array:
-    return jnp.abs(c).sum(axis=-1).mean()
+    return px.acc_f32(jnp.abs(c)).sum(axis=-1).mean()
+
+
+def _encode_mm(dictionary: jax.Array, batch: jax.Array) -> jax.Array:
+    """`c = x D^T` on the MXU under the active precision policy (code tensor
+    stays in the compute dtype — it dominates HBM traffic)."""
+    return jnp.einsum("nd,bd->bn", px.cast_in(dictionary), px.cast_in(batch))
+
+
+def _decode_mm(dictionary: jax.Array, c: jax.Array) -> jax.Array:
+    """`x_hat = c D`, always accumulated/stored in fp32 for the loss."""
+    return jnp.einsum(
+        "nd,bn->bd",
+        px.cast_in(dictionary),
+        px.cast_in(c),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _mse_f32(x_hat: jax.Array, target: jax.Array) -> jax.Array:
+    diff = px.acc_f32(x_hat) - px.acc_f32(target)
+    return jnp.mean(diff * diff)
 
 
 def _safe_l2(x: jax.Array) -> jax.Array:
@@ -75,15 +102,15 @@ class FunctionalSAE:
 
     @staticmethod
     def encode(params, buffers, batch):
-        c = jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
+        c = _encode_mm(params["encoder"], batch) + px.cast_in(params["encoder_bias"])
         return jax.nn.relu(c)
 
     @staticmethod
     def loss(params, buffers, batch):
         c = FunctionalSAE.encode(params, buffers, batch)
         learned_dict = _norm_rows(params["decoder"])
-        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        x_hat = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat, batch)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
         total = l_reconstruction + l_l1 + l_bias_decay
@@ -124,10 +151,15 @@ class FunctionalTiedSAE:
             "encoder": _glorot(key, (n_dict_components, activation_size), dtype),
             "encoder_bias": jnp.zeros((n_dict_components,), dtype),
         }
+        # Absent centering components are stored as None (a structural pytree
+        # hole, not an identity matrix): the common un-whitened sweep then
+        # compiles without the dead [d,d] rotation matmul + affine ops that
+        # cost ~12% of the step (round-2 profile, THROUGHPUT.md). All members
+        # of one ensemble must agree on which components are present.
         buffers = {
-            "center_rot": rotation if rotation is not None else jnp.eye(activation_size, dtype=dtype),
-            "center_trans": translation if translation is not None else jnp.zeros((activation_size,), dtype),
-            "center_scale": scaling if scaling is not None else jnp.ones((activation_size,), dtype),
+            "center_rot": rotation,
+            "center_trans": translation,
+            "center_scale": scaling,
             "l1_alpha": jnp.asarray(l1_alpha, dtype),
             "bias_decay": jnp.asarray(bias_decay, dtype),
         }
@@ -135,33 +167,39 @@ class FunctionalTiedSAE:
 
     @staticmethod
     def center(buffers, batch):
-        return (
-            jnp.einsum("cu,bu->bc", buffers["center_rot"], batch - buffers["center_trans"][None, :])
-            * buffers["center_scale"][None, :]
-        )
+        if buffers["center_trans"] is not None:
+            batch = batch - buffers["center_trans"][None, :]
+        if buffers["center_rot"] is not None:
+            batch = jnp.einsum("cu,bu->bc", buffers["center_rot"], batch)
+        if buffers["center_scale"] is not None:
+            batch = batch * buffers["center_scale"][None, :]
+        return batch
 
     @staticmethod
     def uncenter(buffers, batch):
-        return (
-            jnp.einsum("cu,bc->bu", buffers["center_rot"], batch / buffers["center_scale"][None, :])
-            + buffers["center_trans"][None, :]
-        )
+        if buffers["center_scale"] is not None:
+            batch = batch / buffers["center_scale"][None, :]
+        if buffers["center_rot"] is not None:
+            batch = jnp.einsum("cu,bc->bu", buffers["center_rot"], batch)
+        if buffers["center_trans"] is not None:
+            batch = batch + buffers["center_trans"][None, :]
+        return batch
 
     @staticmethod
     def encode(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
         batch = FunctionalTiedSAE.center(buffers, batch)
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        c = _encode_mm(learned_dict, batch) + px.cast_in(params["encoder_bias"])
         return jax.nn.relu(c)
 
     @staticmethod
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
         batch_centered = FunctionalTiedSAE.center(buffers, batch)
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch_centered) + params["encoder_bias"]
+        c = _encode_mm(learned_dict, batch_centered) + px.cast_in(params["encoder_bias"])
         c = jax.nn.relu(c)
-        x_hat_centered = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat_centered - batch_centered) ** 2)
+        x_hat_centered = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat_centered, batch_centered)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
         total = l_reconstruction + l_l1 + l_bias_decay
@@ -175,6 +213,116 @@ class FunctionalTiedSAE:
             params["encoder_bias"],
             centering=(buffers["center_trans"], buffers["center_rot"], buffers["center_scale"]),
             norm_encoder=True,
+        )
+
+    # -- fused TPU step (ops/tied_sae_kernel.py) -----------------------------
+
+    @staticmethod
+    def fused_supported(params, buffers) -> bool:
+        """True when the Pallas fused gradient kernel covers this config:
+        no whitening centering, tile-divisible shapes (batch divisibility is
+        checked per-trace in the ensemble step)."""
+        n_dict_components, activation_size = params["encoder"].shape
+        return (
+            buffers.get("center_rot") is None
+            and buffers.get("center_trans") is None
+            and buffers.get("center_scale") is None
+            and n_dict_components % 512 == 0
+            and activation_size % 128 == 0
+        )
+
+    @staticmethod
+    def fused_grads_stacked(params, buffers, batch, interpret: bool = False):
+        """Stacked-ensemble gradients + loss dict via the fused Pallas kernels.
+
+        ``params``/``buffers`` leaves carry the leading model axis; ``batch``
+        [B, d] is shared across members. Same math as
+        ``vmap(jax.grad(loss))`` under the bf16 precision policy (the kernel
+        is inherently bf16); returns ``(grads, loss_dict)`` with leading model
+        axes. The aux code tensor is not returned — the fused path exists to
+        keep it out of HBM. Batch size must be a multiple of 256.
+        """
+        from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_grads_stacked
+
+        d = params["encoder"]
+        nrm = jnp.sqrt(jnp.sum(d * d, axis=-1))
+        d_hat = d / nrm[..., None]
+        g_enc, g_bias, l_rec, l_l1_raw = tied_sae_grads_stacked(
+            d_hat, nrm, params["encoder_bias"], batch, buffers["l1_alpha"], interpret=interpret
+        )
+        b = params["encoder_bias"]
+        bias_l2 = jnp.sqrt(jnp.maximum(jnp.sum(b * b, axis=-1), 1e-24))
+        l_bias_decay = buffers["bias_decay"] * bias_l2
+        g_bias = g_bias + (buffers["bias_decay"] / bias_l2)[:, None] * b
+        l_l1 = buffers["l1_alpha"] * l_l1_raw
+        total = l_rec + l_l1 + l_bias_decay
+        grads = {"encoder": g_enc, "encoder_bias": g_bias}
+        loss_data = {"loss": total, "l_reconstruction": l_rec, "l_l1": l_l1}
+        return grads, loss_data
+
+    @staticmethod
+    def fused_adam_step(params, buffers, batch, opt_state, lr, b1, b2, eps, interpret=False):
+        """Whole training step (grads + Adam) via the fully fused kernel.
+
+        The encoder's gradient/moment/param updates happen inside the bwd
+        Pallas kernel (`ops.tied_sae_kernel.tied_sae_adam_step_stacked`) — the
+        gradient never reaches HBM; the (tiny) bias Adam update replicates
+        optax's `scale_by_adam` formulas in jnp. ``opt_state`` must be the
+        optax.adam state tuple ``(ScaleByAdamState, ...)``; returns
+        ``(new_params, new_opt_state, loss_dict)`` matching one
+        ``tx.update`` + ``apply_updates`` step bit-for-bit in structure and
+        to bf16 tolerance in values.
+        """
+        from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
+
+        adam_st = opt_state[0]
+        t = adam_st.count + 1
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(b1, tf)
+        bc2 = 1.0 - jnp.power(b2, tf)
+        bc = jnp.stack([bc1, bc2], axis=-1)
+        d_new, mu_d, nu_d, g_bias, l_rec, l_l1_raw = tied_sae_adam_step_stacked(
+            params["encoder"],
+            params["encoder_bias"],
+            adam_st.mu["encoder"],
+            adam_st.nu["encoder"],
+            batch,
+            buffers["l1_alpha"],
+            bc,
+            float(lr),
+            float(b1),
+            float(b2),
+            float(eps),
+            interpret=interpret,
+        )
+        b = params["encoder_bias"]
+        bias_l2 = jnp.sqrt(jnp.maximum(jnp.sum(b * b, axis=-1), 1e-24))
+        l_bias_decay = buffers["bias_decay"] * bias_l2
+        g_bias = g_bias + (buffers["bias_decay"] / bias_l2)[:, None] * b
+        mu_b = b1 * adam_st.mu["encoder_bias"] + (1.0 - b1) * g_bias
+        nu_b = b2 * adam_st.nu["encoder_bias"] + (1.0 - b2) * g_bias * g_bias
+        bias_new = b - lr * (mu_b / bc1[:, None]) / (jnp.sqrt(nu_b / bc2[:, None]) + eps)
+        new_params = {"encoder": d_new, "encoder_bias": bias_new}
+        new_adam = adam_st._replace(
+            count=t,
+            mu={"encoder": mu_d, "encoder_bias": mu_b},
+            nu={"encoder": nu_d, "encoder_bias": nu_b},
+        )
+        new_opt_state = (new_adam,) + tuple(opt_state[1:])
+        l_l1 = buffers["l1_alpha"] * l_l1_raw
+        total = l_rec + l_l1 + l_bias_decay
+        loss_data = {"loss": total, "l_reconstruction": l_rec, "l_l1": l_l1}
+        return new_params, new_opt_state, loss_data
+
+    @staticmethod
+    def fused_grads(params, buffers, batch, interpret: bool = False):
+        """Single-model convenience wrapper over `fused_grads_stacked`."""
+        p1 = jax.tree.map(lambda x: x[None], params)
+        b1 = jax.tree.map(lambda x: x[None], buffers)
+        grads, loss_data = FunctionalTiedSAE.fused_grads_stacked(p1, b1, batch, interpret)
+        return (
+            jax.tree.map(lambda x: x[0], grads),
+            jax.tree.map(lambda x: x[0], loss_data),
         )
 
 
@@ -205,10 +353,10 @@ class FunctionalTiedCenteredSAE:
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
         batch_centered = batch - params["center"][None, :]
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch_centered) + params["encoder_bias"]
+        c = _encode_mm(learned_dict, batch_centered) + px.cast_in(params["encoder_bias"])
         c = jax.nn.relu(c)
-        x_hat_centered = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat_centered - batch_centered) ** 2)
+        x_hat_centered = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat_centered, batch_centered)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         total = l_reconstruction + l_l1
         loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
@@ -247,7 +395,7 @@ class FunctionalThresholdingSAE:
     @staticmethod
     def encode(params, batch, learned_dict):
         batch = batch - params["centering"][None, :]
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch)
+        c = px.acc_f32(_encode_mm(learned_dict, batch))
         a_sq = params["activation_scale"] ** 2
         c = (c + params["activation_gain"]) / jnp.clip(a_sq, 1e-8, None)
         relu6 = lambda x: jnp.clip(x, 0.0, 6.0)
@@ -258,8 +406,8 @@ class FunctionalThresholdingSAE:
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
         c = FunctionalThresholdingSAE.encode(params, batch, learned_dict)
-        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        x_hat = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat, batch)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         total = l_reconstruction + l_l1
         loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
@@ -306,10 +454,10 @@ class FunctionalMaskedTiedSAE:
     @staticmethod
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
-        c = jax.nn.relu(c) * buffers["coef_keep"][None, :]
-        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        c = _encode_mm(learned_dict, batch) + px.cast_in(params["encoder_bias"])
+        c = jax.nn.relu(c) * px.cast_in(buffers["coef_keep"])[None, :]
+        x_hat = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat, batch)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         total = l_reconstruction + l_l1
         loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
@@ -355,10 +503,10 @@ class FunctionalMaskedSAE:
     @staticmethod
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["decoder"])
-        c = jnp.einsum("nd,bd->bn", params["encoder"], batch) + params["encoder_bias"]
-        c = jax.nn.relu(c) * buffers["coef_keep"][None, :]
-        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        c = _encode_mm(params["encoder"], batch) + px.cast_in(params["encoder_bias"])
+        c = jax.nn.relu(c) * px.cast_in(buffers["coef_keep"])[None, :]
+        x_hat = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat, batch)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         total = l_reconstruction + l_l1
         loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
@@ -393,11 +541,11 @@ class FunctionalReverseSAE:
     @staticmethod
     def loss(params, buffers, batch):
         learned_dict = _norm_rows(params["encoder"])
-        c = jnp.einsum("nd,bd->bn", learned_dict, batch) + params["encoder_bias"]
+        c = _encode_mm(learned_dict, batch) + px.cast_in(params["encoder_bias"])
         c = jax.nn.relu(c)
-        c = jnp.where(c > 0.0, c - params["encoder_bias"][None, :], c)
-        x_hat = jnp.einsum("nd,bn->bd", learned_dict, c)
-        l_reconstruction = jnp.mean((x_hat - batch) ** 2)
+        c = jnp.where(c > 0.0, c - px.cast_in(params["encoder_bias"])[None, :], c)
+        x_hat = _decode_mm(learned_dict, c)
+        l_reconstruction = _mse_f32(x_hat, batch)
         l_l1 = buffers["l1_alpha"] * _l1(c)
         l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
         total = l_reconstruction + l_l1 + l_bias_decay

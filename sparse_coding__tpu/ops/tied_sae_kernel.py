@@ -1,0 +1,346 @@
+"""Fused Pallas TPU kernels for the tied-SAE training step (the hot loss).
+
+Why (THROUGHPUT.md): under plain jit the tied-SAE fwd+bwd lowers to ~6 XLA
+fusions whose intermediates round-trip HBM — in particular the fp32 code
+cotangent ``dc`` ([batch, n_dict], 268 MB/step on the bench ensemble) is
+written and re-read between the backward fusions. These kernels compute the
+gradient step of the WHOLE STACKED ENSEMBLE as two Pallas programs with the
+model axis as the outer grid dimension (vmapping a pallas_call would serialize
+it into per-model calls — measured 1.5x slower; the explicit grid keeps one
+launch):
+
+  fwd  (grid (M, batch-tiles)): c = relu(x·D_m^T + b_m) tile-by-tile with the
+       member dictionary resident in VMEM; writes c (bf16) and the
+       already-scaled reconstruction cotangent dxh = 2/(B·d)·(x_hat − x)
+       (bf16); loss partial sums accumulate in SMEM scalars per member. The
+       fp32 pre-activation never leaves VMEM.
+  bwd  (grid (M, dict-tiles)): dc = mask·(dxh·D_n + l1/B) is built per dict
+       tile in VMEM, consumed immediately by the two dictionary-gradient
+       contractions, and discarded — dc never touches HBM.
+
+The surrounding fp32 math (decoder-row normalization and its VJP, bias decay,
+loss assembly, Adam) stays in jnp where XLA handles it fine.
+
+Semantics match `models.sae.FunctionalTiedSAE.loss` under the bf16 precision
+policy (`utils.precision`), for the un-whitened centering=None case; parity is
+asserted in tests (interpret mode) against `jax.grad` of that loss.
+
+Reference being replaced: the torch autograd backward of
+`autoencoders/sae_ensemble.py:80-160` (no fused equivalent exists there).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+f32 = jnp.float32
+bf16 = jnp.bfloat16
+
+
+def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):
+    """One (member, batch-tile) program: encode all dict tiles, accumulate
+    x_hat, emit the scaled reconstruction cotangent.
+
+    x_ref [Tb, D] bf16 (shared across members); d_ref [1, N, D] bf16 (whole
+    member dictionary, VMEM-resident); b_ref [1, 1, N] f32; outputs
+    c_ref [1, Tb, N] bf16, dxh_ref [1, Tb, D] bf16, lrec/ll1 [M, 1] whole-
+    array SMEM buffers indexed by member, accumulated across batch tiles
+    (t is the fastest grid dim).
+    """
+    m = pl.program_id(0)
+    x = x_ref[:]
+    n = d_ref.shape[1]
+    xh = jnp.zeros(x.shape, f32)
+    ll1 = jnp.float32(0.0)
+    for j in range(n // n_tile):
+        sl = pl.ds(j * n_tile, n_tile)
+        dj = d_ref[0, sl, :]
+        cpre = (
+            jax.lax.dot_general(x, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+            + b_ref[0, 0, sl][None, :]
+        )
+        c = jnp.maximum(cpre, 0.0)
+        cb = c.astype(bf16)
+        c_ref[0, :, sl] = cb
+        xh = xh + jax.lax.dot_general(cb, dj, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+        ll1 += jnp.sum(c)
+    err = xh - x.astype(f32)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        lrec_ref[m, 0] = 0.0
+        ll1_ref[m, 0] = 0.0
+
+    lrec_ref[m, 0] += jnp.sum(err * err)
+    ll1_ref[m, 0] += ll1
+    dxh_ref[0, :, :] = (scale * err).astype(bf16)
+
+
+def _bwd_kernel(l1b_ref, x_ref, dxh_ref, d_ref, nrm_ref, c_ref, gd_ref, gb_ref):
+    """One (member, dict-tile) program: code cotangent in VMEM -> gradients,
+    with the row-normalization VJP applied in the epilogue (the raw d_hat
+    cotangent never leaves VMEM).
+
+    l1b_ref: scalar-prefetch [M] array of l1_alpha/B. Blocks: x [B, D] bf16
+    (shared), dxh [1, B, D] bf16, d_ref [1, Nt, D] bf16 (normalized rows),
+    nrm_ref [1, 1, Nt] f32 (row norms of the raw encoder), c_ref [1, B, Nt]
+    bf16; outputs gd [1, Nt, D] f32 (gradient w.r.t. the RAW encoder),
+    gb [1, 1, Nt] f32.
+    """
+    m = pl.program_id(0)
+    x = x_ref[:]
+    dxh = dxh_ref[0]
+    dj = d_ref[0]
+    cj = c_ref[0]
+    dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+    # mosaic has no bf16 vector compare on v5e; mask in f32
+    dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
+    dcb = dc.astype(bf16)
+    g_dhat = jax.lax.dot_general(
+        cj, dxh, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    ) + jax.lax.dot_general(dcb, x, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+    # normalization VJP: project out the radial component, divide by ||row||
+    djf = dj.astype(f32)
+    radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
+    gd_ref[0, :, :] = (g_dhat - djf * radial) / nrm_ref[0, 0, :][:, None]
+    gb_ref[0, 0, :] = jnp.sum(dc, axis=0)
+
+
+def _bwd_adam_kernel(
+    l1b_ref, hp_ref, bc_ref, x_ref, dxh_ref, dhat_ref, nrm_ref, c_ref,
+    draw_ref, mu_ref, nu_ref,
+    dnew_ref, munew_ref, nunew_ref, gb_ref,
+):
+    """`_bwd_kernel` + the Adam update for the encoder, all in VMEM: the
+    encoder gradient is consumed by the moment/param updates without ever
+    being written to HBM.
+
+    Extra prefetch: hp_ref [4] f32 = (lr, b1, b2, eps); bc_ref [M, 2] f32 =
+    per-member bias corrections (1-b1^t, 1-b2^t). Extra blocks: draw/mu/nu
+    [1, Nt, D] f32 (raw encoder + Adam moments), outputs dnew/munew/nunew.
+    """
+    m = pl.program_id(0)
+    x = x_ref[:]
+    dxh = dxh_ref[0]
+    dj = dhat_ref[0]
+    cj = c_ref[0]
+    dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+    dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
+    dcb = dc.astype(bf16)
+    g_dhat = jax.lax.dot_general(
+        cj, dxh, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    ) + jax.lax.dot_general(dcb, x, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+    djf = dj.astype(f32)
+    radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
+    g = (g_dhat - djf * radial) / nrm_ref[0, 0, :][:, None]
+    gb_ref[0, 0, :] = jnp.sum(dc, axis=0)
+
+    lr, b1, b2, eps = hp_ref[0], hp_ref[1], hp_ref[2], hp_ref[3]
+    mu = b1 * mu_ref[0] + (1.0 - b1) * g
+    nu = b2 * nu_ref[0] + (1.0 - b2) * g * g
+    mhat = mu / bc_ref[m, 0]
+    vhat = nu / bc_ref[m, 1]
+    munew_ref[0, :, :] = mu
+    nunew_ref[0, :, :] = nu
+    dnew_ref[0, :, :] = draw_ref[0] - lr * mhat / (jnp.sqrt(vhat) + eps)
+
+
+@partial(jax.jit, static_argnames=("batch_tile", "dict_tile", "interpret"))
+def tied_sae_adam_step_stacked(
+    d_raw: jax.Array,
+    bias: jax.Array,
+    mu_d: jax.Array,
+    nu_d: jax.Array,
+    batch: jax.Array,
+    l1_alpha: jax.Array,
+    bc: jax.Array,
+    lr: float,
+    b1: float,
+    b2: float,
+    eps: float,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+    interpret: bool = False,
+):
+    """Fused fwd + bwd + encoder-Adam for the stacked tied-SAE ensemble.
+
+    d_raw [M, N, D] f32 raw encoder; mu_d/nu_d its Adam moments; bc [M, 2]
+    bias corrections (1-b1^t, 1-b2^t) for THIS step. Returns
+    (d_new, mu_new, nu_new, g_bias, l_rec, l_l1_raw). The bias' own Adam
+    update (tiny) is left to the caller.
+    """
+    M, N, D = d_raw.shape
+    B = batch.shape[0]
+    if B % batch_tile or N % dict_tile:
+        raise ValueError(f"shapes ({B},{N}) not divisible by tiles ({batch_tile},{dict_tile})")
+    # the fwd kernel prefers 512-wide dict tiles (less loop overhead, no Adam
+    # VMEM pressure there) but must still cover N exactly
+    fwd_tile = 512 if N % 512 == 0 else dict_tile
+    nrm = jnp.sqrt(jnp.sum(d_raw * d_raw, axis=-1))
+    d_hat = d_raw / nrm[..., None]
+    xb = batch.astype(bf16)
+    db = d_hat.astype(bf16)
+    b3 = bias.astype(f32).reshape(M, 1, N)
+    scale = 2.0 / (B * D)
+
+    c, dxh, lrec, ll1 = pl.pallas_call(
+        partial(_fwd_kernel, n_tile=fwd_tile, scale=scale),
+        grid=(M, B // batch_tile),
+        in_specs=[
+            pl.BlockSpec((batch_tile, D), lambda m, t: (t, 0)),
+            pl.BlockSpec((1, N, D), lambda m, t: (m, 0, 0)),
+            pl.BlockSpec((1, 1, N), lambda m, t: (m, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, batch_tile, N), lambda m, t: (m, t, 0)),
+            pl.BlockSpec((1, batch_tile, D), lambda m, t: (m, t, 0)),
+            pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, B, N), bf16),
+            jax.ShapeDtypeStruct((M, B, D), bf16),
+            jax.ShapeDtypeStruct((M, 1), f32),
+            jax.ShapeDtypeStruct((M, 1), f32),
+        ],
+        interpret=interpret,
+    )(xb, db, b3)
+
+    l1_over_b = (jnp.asarray(l1_alpha, f32) / B).reshape(M)
+    hp = jnp.asarray([lr, b1, b2, eps], f32)
+    tile3 = lambda m, j, *_: (m, j, 0)
+    d_new, mu_new, nu_new, g_bias = pl.pallas_call(
+        _bwd_adam_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(M, N // dict_tile),
+            in_specs=[
+                pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
+                pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+                pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, dict_tile, D), tile3),
+                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, 1, N), f32),
+        ],
+        # write the new encoder/moments into the donated input buffers: inside
+        # a scanned train step the carry must live in fixed buffers, and
+        # without aliasing XLA inserts a 67 MB copy per array per step
+        # (indices count the scalar-prefetch operands)
+        input_output_aliases={8: 0, 9: 1, 10: 2},
+        interpret=interpret,
+    )(l1_over_b, hp, bc.astype(f32), xb, dxh, db, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d)
+
+    l_rec = lrec[:, 0] / (B * D)
+    l_l1_raw = ll1[:, 0] / B
+    return d_new, mu_new, nu_new, g_bias[:, 0, :], l_rec, l_l1_raw
+
+
+@partial(jax.jit, static_argnames=("batch_tile", "dict_tile", "interpret"))
+def tied_sae_grads_stacked(
+    d_hat: jax.Array,
+    nrm: jax.Array,
+    bias: jax.Array,
+    batch: jax.Array,
+    l1_alpha: jax.Array,
+    batch_tile: int = 256,
+    dict_tile: int = 512,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Stacked-ensemble tied-SAE gradient w.r.t. the RAW encoder and bias.
+
+    d_hat [M, N, D] fp32 row-normalized dictionaries; nrm [M, N] fp32 row
+    norms of the raw encoder; bias [M, N] fp32; batch [B, D] shared across
+    members; l1_alpha [M]. Returns (g_enc [M,N,D] f32 — already through the
+    normalization VJP, g_bias [M,N] f32, l_rec [M], l_l1_raw [M]) where
+    l_rec is the MSE and l_l1_raw the mean per-example L1 (multiply by
+    l1_alpha for the loss term). Requires B % batch_tile == 0 and
+    N % dict_tile == 0 (callers fall back to the jnp path otherwise).
+    """
+    M, N, D = d_hat.shape
+    B = batch.shape[0]
+    if B % batch_tile or N % dict_tile:
+        raise ValueError(f"shapes ({B},{N}) not divisible by tiles ({batch_tile},{dict_tile})")
+    xb = batch.astype(bf16)
+    db = d_hat.astype(bf16)
+    b3 = bias.astype(f32).reshape(M, 1, N)
+    scale = 2.0 / (B * D)
+
+    c, dxh, lrec, ll1 = pl.pallas_call(
+        partial(_fwd_kernel, n_tile=dict_tile, scale=scale),
+        grid=(M, B // batch_tile),
+        in_specs=[
+            pl.BlockSpec((batch_tile, D), lambda m, t: (t, 0)),
+            pl.BlockSpec((1, N, D), lambda m, t: (m, 0, 0)),
+            pl.BlockSpec((1, 1, N), lambda m, t: (m, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, batch_tile, N), lambda m, t: (m, t, 0)),
+            pl.BlockSpec((1, batch_tile, D), lambda m, t: (m, t, 0)),
+            pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, B, N), bf16),
+            jax.ShapeDtypeStruct((M, B, D), bf16),
+            jax.ShapeDtypeStruct((M, 1), f32),
+            jax.ShapeDtypeStruct((M, 1), f32),
+        ],
+        interpret=interpret,
+    )(xb, db, b3)
+
+    l1_over_b = (jnp.asarray(l1_alpha, f32) / B).reshape(M)
+    g_enc, g_bias = pl.pallas_call(
+        _bwd_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(M, N // dict_tile),
+            in_specs=[
+                pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
+                pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
+                pl.BlockSpec((1, dict_tile, D), lambda m, j, *_: (m, j, 0)),
+                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+                pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, dict_tile, D), lambda m, j, *_: (m, j, 0)),
+                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, 1, N), f32),
+        ],
+        interpret=interpret,
+    )(l1_over_b, xb, dxh, db, nrm.astype(f32).reshape(M, 1, N), c)
+
+    l_rec = lrec[:, 0] / (B * D)
+    l_l1_raw = ll1[:, 0] / B
+    return g_enc, g_bias[:, 0, :], l_rec, l_l1_raw
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

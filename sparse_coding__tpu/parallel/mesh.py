@@ -75,15 +75,17 @@ def default_mesh_shape(n_devices: int, n_models: int = 1, want_dict: bool = Fals
     return model, data, dict_
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
+def batch_sharding(mesh: Mesh, leading: int = 0) -> NamedSharding:
     """Sharding for a `[batch, d_activation]` batch shared by all members:
-    batch dim over the data axis, features replicated."""
-    return NamedSharding(mesh, P(DATA_AXIS, None))
+    batch dim over the data axis, features replicated. ``leading`` prepends
+    that many replicated axes (e.g. the scan-step axis of `step_scan`)."""
+    return NamedSharding(mesh, P(*([None] * leading), DATA_AXIS, None))
 
 
-def per_model_batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a `[n_models, batch, d_activation]` per-member batch."""
-    return NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS, None))
+def per_model_batch_sharding(mesh: Mesh, leading: int = 0) -> NamedSharding:
+    """Sharding for a `[n_models, batch, d_activation]` per-member batch
+    (``leading`` extra replicated axes prepended, e.g. scan steps)."""
+    return NamedSharding(mesh, P(*([None] * leading), MODEL_AXIS, DATA_AXIS, None))
 
 
 def infer_state_specs(state, n_models: int, mesh: Mesh, shard_dict: bool = True):

@@ -111,15 +111,23 @@ def ensemble_train_loop(
     fista_update: Optional[bool] = None,
     fista_iters: int = 500,
     progress_callback: Optional[Callable[[int, int], None]] = None,
+    scan_steps: int = 8,
 ) -> Dict[str, jax.Array]:
     """Train the ensemble for one pass over `dataset` ([N, d] activations).
 
     Returns the final on-device loss dict. `fista_update=None` auto-detects
     from the signature (`has_fista_decoder_update`).
+
+    `scan_steps`: batches dispatched per compiled call (`Ensemble.step_scan`)
+    — the throughput path that amortizes per-dispatch tunnel latency
+    (THROUGHPUT.md). Forced to 1 when the FISTA decoder update is active (it
+    needs each step's `aux["c"]` warm start between gradient steps).
     """
     if fista_update is None:
         fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
     fista_fn = make_fista_decoder_update(fista_iters) if fista_update else None
+    if fista_fn is not None:
+        scan_steps = 1
 
     n = dataset.shape[0]
     n_batches = n // batch_size
@@ -127,18 +135,29 @@ def ensemble_train_loop(
     perm = np.asarray(jax.random.permutation(key, n))
 
     loss_dict: Dict[str, jax.Array] = {}
-    for i in range(n_batches):
-        idxs = perm[i * batch_size : (i + 1) * batch_size]
-        batch = dataset[idxs]
-        loss_dict, aux = ensemble.step_batch(batch)
-        if fista_fn is not None:
-            ensemble.state = fista_fn(ensemble.state, batch, aux["c"])
-        if logger is not None:
-            logger.log(int(i), loss_dict)
-            if (i + 1) % log_every == 0:
-                logger.flush()
+    i = 0
+    while i < n_batches:
+        k = scan_steps if n_batches - i >= scan_steps else 1
+        if k > 1:
+            idxs = perm[i * batch_size : (i + k) * batch_size].reshape(k, batch_size)
+            losses = ensemble.step_scan(dataset[idxs])
+            loss_dict = {name: v[-1] for name, v in losses.items()}
+            if logger is not None:
+                for j in range(k):
+                    logger.log(i + j, {name: v[j] for name, v in losses.items()})
+        else:
+            idxs = perm[i * batch_size : (i + 1) * batch_size]
+            batch = dataset[idxs]
+            loss_dict, aux = ensemble.step_batch(batch)
+            if fista_fn is not None:
+                ensemble.state = fista_fn(ensemble.state, batch, aux["c"])
+            if logger is not None:
+                logger.log(i, loss_dict)
+        i += k
+        if logger is not None and (i // log_every) != ((i - k) // log_every):
+            logger.flush()
         if progress_callback is not None:
-            progress_callback(i, n_batches)
+            progress_callback(i - 1, n_batches)
     if logger is not None:
         logger.flush()
     return loss_dict

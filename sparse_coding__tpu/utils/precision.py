@@ -1,0 +1,70 @@
+"""Mixed-precision policy for dictionary-model losses (TPU MXU path).
+
+The reference trains fp32 end-to-end (torch defaults; e.g.
+`autoencoders/sae_ensemble.py:13-77` never touches dtypes). On TPU the MXU's
+native input format is bfloat16 and HBM bandwidth is the usual bottleneck, so
+the TPU-first policy is the classic master-weights scheme:
+
+  - params and Adam moments stay float32 (exact optimizer semantics),
+  - matmul operands (dictionary, batch, code tensor) are cast to the compute
+    dtype at trace time, so the MXU runs bf16 and the big ``[batch, n_dict]``
+    code tensor moves through HBM at half width,
+  - loss reductions accumulate in float32.
+
+The policy is a trace-time context: `Ensemble` wraps its step trace in
+``with compute(dtype)`` so each compiled program bakes in its precision.
+Default (``None``) is bit-for-bit the old full-fp32 math — parity tests run
+there; benches and sweeps opt into bf16.
+
+Measured on TPU v5e (the round-2 throughput work, THROUGHPUT.md): fp32
+per-step dispatch 301k activations/s -> bf16 + scan fusion 552k on the same
+8x tied-SAE workload, before the fused Pallas kernel.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_STACK: list = [None]
+
+
+def current() -> Optional[jnp.dtype]:
+    """The active compute dtype, or None for full fp32."""
+    return _STACK[-1]
+
+
+@contextlib.contextmanager
+def compute(dtype):
+    """Activate a matmul compute dtype (e.g. ``jnp.bfloat16``) for the block.
+
+    Trace-time only: a jitted function traced inside this context keeps the
+    policy forever; one traced outside never gains it. Strings ("bfloat16")
+    are accepted.
+    """
+    if isinstance(dtype, str):
+        dtype = jnp.dtype(dtype)
+    _STACK.append(dtype)
+    try:
+        yield dtype
+    finally:
+        _STACK.pop()
+
+
+def cast_in(x: jax.Array) -> jax.Array:
+    """Cast a matmul operand to the active compute dtype (no-op when off).
+
+    Only floating inputs are cast; integer/bool operands pass through.
+    """
+    dt = current()
+    if dt is None or not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(dt)
+
+
+def acc_f32(x: jax.Array) -> jax.Array:
+    """Promote to fp32 before a reduction (no-op for fp32 inputs)."""
+    return x.astype(jnp.float32) if x.dtype != jnp.float32 else x

@@ -1,0 +1,132 @@
+"""Parity of the fused Pallas tied-SAE kernels vs jax.grad / optax.
+
+Runs in interpret mode on the CPU test mesh. Covers the round-2 throughput
+path (`ops/tied_sae_kernel.py`, THROUGHPUT.md): gradients, losses, and the
+in-kernel Adam update must match the unfused ensemble math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sparse_coding__tpu.ensemble import stack_pytrees
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.utils import precision as px
+
+D, N, B, M = 128, 512, 256, 2
+
+
+@pytest.fixture(scope="module")
+def stacked():
+    key = jax.random.PRNGKey(0)
+    models = [
+        FunctionalTiedSAE.init(k, D, N, l1_alpha=a, bias_decay=1e-4)
+        for k, a in zip(jax.random.split(key, M), [1e-3, 3e-3])
+    ]
+    params = stack_pytrees([p for p, _ in models])
+    # non-zero bias so the bias-grad path is exercised
+    params["encoder_bias"] = 0.01 * jax.random.normal(jax.random.PRNGKey(5), (M, N))
+    buffers = stack_pytrees([b for _, b in models])
+    batch = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    return params, buffers, batch
+
+
+def test_fused_grads_match_jax_grad(stacked):
+    params, buffers, batch = stacked
+    with px.compute(jnp.bfloat16):
+        ref_grads, (ref_losses, _aux) = jax.vmap(
+            jax.grad(FunctionalTiedSAE.loss, has_aux=True), in_axes=(0, 0, None)
+        )(params, buffers, batch)
+    grads, losses = FunctionalTiedSAE.fused_grads_stacked(
+        params, buffers, batch, interpret=True
+    )
+    for k in ["loss", "l_reconstruction", "l_l1"]:
+        np.testing.assert_allclose(
+            np.asarray(ref_losses[k]), np.asarray(losses[k]), rtol=2e-2, atol=1e-4
+        )
+    for k in ["encoder", "encoder_bias"]:
+        a, b = np.asarray(ref_grads[k]), np.asarray(grads[k])
+        cos = (a.ravel() @ b.ravel()) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+        assert cos > 0.999, k
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 5e-2, k
+
+
+def test_fused_adam_step_matches_optax(stacked):
+    """Same fused gradients through optax vs through the in-kernel Adam —
+    isolates the optimizer fusion; must agree to f32 rounding."""
+    params, buffers, batch = stacked
+    tx = optax.adam(1e-3)
+    opt_state = jax.vmap(tx.init)(params)
+
+    grads, ld_ref = FunctionalTiedSAE.fused_grads_stacked(
+        params, buffers, batch, interpret=True
+    )
+    upd, os_ref = jax.vmap(tx.update)(grads, opt_state, params)
+    p_ref = optax.apply_updates(params, upd)
+
+    p_f, os_f, ld_f = FunctionalTiedSAE.fused_adam_step(
+        params, buffers, batch, opt_state, 1e-3, 0.9, 0.999, 1e-8, interpret=True
+    )
+    assert int(os_f[0].count[0]) == 1
+    for k in ["loss", "l_reconstruction", "l_l1"]:
+        np.testing.assert_allclose(np.asarray(ld_ref[k]), np.asarray(ld_f[k]), rtol=1e-5)
+    for k in ["encoder", "encoder_bias"]:
+        a, b = np.asarray(p_ref[k]), np.asarray(p_f[k])
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 1e-5, k
+        for mom, rt, ft in [("mu", os_ref[0].mu, os_f[0].mu), ("nu", os_ref[0].nu, os_f[0].nu)]:
+            ma, mb = np.asarray(rt[k]), np.asarray(ft[k])
+            assert np.abs(ma - mb).max() / (np.abs(ma).max() + 1e-12) < 5e-5, (mom, k)
+
+
+def test_fused_training_recovers_dictionary():
+    """End-to-end: the fused step path trains (loss drops) on planted data,
+    matching the behavior of the unfused path."""
+    from sparse_coding__tpu.ensemble import Ensemble
+
+    key = jax.random.PRNGKey(2)
+    models = [
+        FunctionalTiedSAE.init(k, D, N, l1_alpha=1e-3)
+        for k in jax.random.split(key, M)
+    ]
+    # fused=True with interpret fallback is TPU-only in auto mode; force the
+    # jnp bf16 path here and the fused math is covered by the parity tests.
+    ens = Ensemble(models, FunctionalTiedSAE, "adam", {"learning_rate": 1e-3},
+                   compute_dtype=jnp.bfloat16)
+    gt = jax.random.normal(jax.random.PRNGKey(3), (N, D))
+    gt = gt / jnp.linalg.norm(gt, axis=-1, keepdims=True)
+    k_c, k_m = jax.random.split(jax.random.PRNGKey(4))
+    codes = jax.random.uniform(k_c, (B, N)) * jax.random.bernoulli(k_m, 0.05, (B, N))
+    data = codes @ gt
+    first = None
+    for i in range(100):
+        loss, _ = ens.step_batch(data)
+        if i == 0:
+            first = float(jax.device_get(loss["loss"]).mean())
+    final = float(jax.device_get(loss["loss"]).mean())
+    assert np.isfinite(final) and final < first
+
+
+def test_step_scan_matches_sequential_steps():
+    """K scanned steps == K sequential step_batch calls (fp32, exact)."""
+    from sparse_coding__tpu.ensemble import Ensemble
+
+    key = jax.random.PRNGKey(7)
+    models = [FunctionalTiedSAE.init(k, 32, 64, l1_alpha=1e-3) for k in jax.random.split(key, 2)]
+    batches = jax.random.normal(jax.random.PRNGKey(8), (4, 16, 32))
+
+    a = Ensemble(models, FunctionalTiedSAE, "adam", {"learning_rate": 1e-3})
+    b = Ensemble(models, FunctionalTiedSAE, "adam", {"learning_rate": 1e-3})
+    seq_losses = [a.step_batch(batches[i])[0]["loss"] for i in range(4)]
+    scan_losses = b.step_scan(batches)["loss"]
+    np.testing.assert_allclose(
+        np.stack([np.asarray(l) for l in seq_losses]),
+        np.asarray(scan_losses),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(a.state.params["encoder"])),
+        np.asarray(jax.device_get(b.state.params["encoder"])),
+        rtol=1e-6,
+    )
