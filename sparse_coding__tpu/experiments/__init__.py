@@ -1,0 +1,26 @@
+"""One-off analysis experiments (the paper's analysis deliverables).
+
+TPU-native re-expressions of the reference `experiments/` scripts
+(`pca_perplexity.py`, `check_l0_tokens.py`, `interp_moment_corrs.py`,
+`investigate.py`): each is a runnable module with a pure function core that
+consumes sweep outputs (`learned_dicts.pkl`, chunks, autointerp result
+folders) and produces a figure + CSV, and an argparse `main` for the CLI.
+The reference scripts hard-code cluster paths and eager per-dict GPU loops;
+here every score loop shares one jitted program per dict shape.
+"""
+
+from sparse_coding__tpu.experiments.pca_perplexity import run_pca_perplexity
+from sparse_coding__tpu.experiments.check_l0_tokens import run_embedding_cosine_check
+from sparse_coding__tpu.experiments.interp_moment_corrs import run_moment_corrs
+from sparse_coding__tpu.experiments.investigate import (
+    run_investigate,
+    random_feature_diversity,
+)
+
+__all__ = [
+    "run_pca_perplexity",
+    "run_embedding_cosine_check",
+    "run_moment_corrs",
+    "run_investigate",
+    "random_feature_diversity",
+]

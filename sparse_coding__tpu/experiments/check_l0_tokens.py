@@ -1,0 +1,110 @@
+"""Are layer-0 residual SAE features just token (un)embeddings?
+
+Counterpart of reference `experiments/check_l0_tokens.py`: per layer and dict
+ratio, mean max-cosine-similarity of the learned dictionary against the LM's
+normalized embedding and unembedding matrices; two-panel line plot.
+"""
+
+from __future__ import annotations
+
+import csv
+from pathlib import Path
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.metrics.standard import mcs_to_fixed
+
+
+def run_embedding_cosine_check(
+    lm_params,
+    dict_sets: Dict[int, List[Tuple[str, Any]]],
+    out_dir,
+    tie_word_embeddings: bool = False,
+) -> Dict[int, List[Tuple[str, float, float]]]:
+    """dict_sets: {layer: [(ratio_label, LearnedDict), ...]}.
+
+    Returns {layer: [(ratio_label, embed_mcs, unembed_mcs), ...]}; writes
+    `embed_unembed.png` + CSV. Works on any LM params pytree with "embed"
+    (and "unembed" unless tied).
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    embed = jnp.asarray(lm_params["embed"])
+    unembed = embed if tie_word_embeddings else jnp.asarray(lm_params["unembed"])
+    embed = embed / jnp.linalg.norm(embed, axis=1, keepdims=True)
+    unembed = unembed / jnp.linalg.norm(unembed, axis=1, keepdims=True)
+
+    data: Dict[int, List[Tuple[str, float, float]]] = {}
+    for layer, entries in dict_sets.items():
+        layer_data = []
+        for ratio_label, ld in entries:
+            e = float(mcs_to_fixed(ld, embed).mean())
+            u = float(mcs_to_fixed(ld, unembed).mean())
+            layer_data.append((ratio_label, e, u))
+        data[layer] = layer_data
+
+    with open(out_dir / "embed_unembed.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["layer", "ratio", "embed_mcs", "unembed_mcs"])
+        for layer, rows in data.items():
+            for ratio, e, u in rows:
+                w.writerow([layer, ratio, e, u])
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, 2, figsize=(10, 5))
+    for layer, rows in data.items():
+        ratios = [r for r, _, _ in rows]
+        ax[0].plot([e for _, e, _ in rows], label=layer)
+        ax[1].plot([u for _, _, u in rows], label=layer)
+        ax[0].set_xticks(range(len(ratios)))
+        ax[0].set_xticklabels(ratios)
+        ax[1].set_xticks(range(len(ratios)))
+        ax[1].set_xticklabels(ratios)
+    ax[0].set_title("Embedding")
+    ax[1].set_title("Unembedding")
+    for a in ax:
+        a.legend()
+        a.set_xlabel("Dict ratio")
+        a.set_ylabel("Mean cosine similarity")
+    fig.savefig(out_dir / "embed_unembed.png", dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return data
+
+
+def main(argv=None):
+    import argparse
+    import pickle
+
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lm-params", required=True)
+    ap.add_argument(
+        "--dicts", nargs="+", required=True,
+        help="entries layer:ratio:path_to_learned_dicts.pkl (first dict of each file)",
+    )
+    ap.add_argument("--out", default="outputs/check_l0_tokens")
+    args = ap.parse_args(argv)
+
+    with open(args.lm_params, "rb") as f:
+        params, lm_cfg = pickle.load(f)
+    dict_sets: Dict[int, List] = {}
+    for spec in args.dicts:
+        layer_s, ratio, path = spec.split(":", 2)
+        ld, _hp = load_learned_dicts(path)[0]
+        dict_sets.setdefault(int(layer_s), []).append((ratio, ld))
+    run_embedding_cosine_check(
+        params, dict_sets, args.out,
+        tie_word_embeddings=getattr(lm_cfg, "tie_word_embeddings", False),
+    )
+
+
+if __name__ == "__main__":
+    main()

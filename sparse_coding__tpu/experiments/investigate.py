@@ -1,0 +1,135 @@
+"""Do converged features differ systematically from un-converged ones?
+
+Counterpart of reference `experiments/investigate.py:1-109`: compare a
+smaller dictionary's features against a larger one via max cosine similarity
+(MCS), then correlate each feature's "convergence" (its MCS) with how
+distributed the feature is — entropy of its normalized absolute weights and
+the effective number of neurons (ENN). Also the random-direction diversity
+sanity check (`test_diversity_of_random_features`).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.metrics.standard import mcs_to_fixed
+
+
+def feature_entropy(learned_dict: jax.Array) -> jax.Array:
+    """Entropy of each row's normalized |weights| (reference `entropy`)."""
+    d = jnp.abs(learned_dict / jnp.linalg.norm(learned_dict, axis=1, keepdims=True))
+    return -jnp.sum(d * jnp.log(d + 1e-8), axis=1)
+
+
+def effective_number_of_neurons(learned_dict: jax.Array) -> jax.Array:
+    """1 / sum(p_i^2) with p the per-row |weight| proportions
+    (reference `effective_number_of_neurons`)."""
+    a = jnp.abs(learned_dict)
+    p = a / jnp.sum(a, axis=1, keepdims=True)
+    return 1.0 / jnp.sum(p**2, axis=1)
+
+
+def run_investigate(
+    smaller_dict: Any,
+    larger_dict: Any,
+    out_dir,
+    threshold: float = 0.9,
+) -> Dict[str, float]:
+    """MCS(smaller → larger) vs entropy / ENN of the smaller dict's rows.
+
+    Writes entropy_vs_mmcs.png, enn_vs_mmcs.png + investigate.json; returns
+    the summary statistics dict.
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    mcs = np.asarray(mcs_to_fixed(smaller_dict, larger_dict.get_learned_dict()))
+    rows = smaller_dict.get_learned_dict()
+    ent = np.asarray(feature_entropy(rows))
+    enn = np.asarray(effective_number_of_neurons(rows))
+
+    ent_corr = float(np.corrcoef(ent, mcs)[0, 1])
+    enn_corr = float(np.corrcoef(enn, mcs)[0, 1])
+    above, below = enn[mcs > threshold], enn[mcs < threshold]
+    summary = {
+        "entropy_mmcs_correlation": ent_corr,
+        "enn_mmcs_correlation": enn_corr,
+        "mean_enn_above_threshold": float(above.mean()) if len(above) else float("nan"),
+        "mean_enn_below_threshold": float(below.mean()) if len(below) else float("nan"),
+        "n_above_threshold": int((mcs > threshold).sum()),
+        "threshold": threshold,
+    }
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for x, name, label in [(ent, "entropy_vs_mmcs", "entropy"), (enn, "enn_vs_mmcs", "Effective number of neurons")]:
+        fig, ax = plt.subplots()
+        ax.scatter(x, mcs, s=8)
+        ax.set_xlabel(label)
+        ax.set_ylabel("MCS to larger dict")
+        fig.savefig(out_dir / f"{name}.png", dpi=150, bbox_inches="tight")
+        plt.close(fig)
+
+    with open(out_dir / "investigate.json", "w") as f:
+        json.dump(summary, f, indent=2)
+    print("correlation between entropy and mmcs:", ent_corr)
+    print("mean enn above threshold:", summary["mean_enn_above_threshold"])
+    print("mean enn below threshold:", summary["mean_enn_below_threshold"])
+    return summary
+
+
+def random_feature_diversity(out_dir, n: int = 10000, d: int = 128, seed: int = 0) -> float:
+    """ENN histogram of random unit directions — the null distribution
+    (reference `test_diversity_of_random_features`). Returns the mean ENN."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    dirs = jax.random.normal(jax.random.PRNGKey(seed), (n, d))
+    dirs = dirs / jnp.linalg.norm(dirs, axis=1, keepdims=True)
+    enn = np.asarray(effective_number_of_neurons(dirs))
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots()
+    ax.hist(enn, bins=50)
+    ax.set_xlabel("Effective number of neurons")
+    ax.set_ylabel("count")
+    fig.savefig(out_dir / "enn_randn.png", dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    print("mean:", enn.mean())
+    return float(enn.mean())
+
+
+def main(argv=None):
+    import argparse
+
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smaller", required=True, help="pkl:index of the smaller dict")
+    ap.add_argument("--larger", required=True, help="pkl:index of the larger dict")
+    ap.add_argument("--threshold", type=float, default=0.9)
+    ap.add_argument("--out", default="outputs/investigate")
+    args = ap.parse_args(argv)
+
+    def load(spec):
+        path, idx = spec.rsplit(":", 1)
+        return load_learned_dicts(path)[int(idx)][0]
+
+    random_feature_diversity(args.out)
+    run_investigate(load(args.smaller), load(args.larger), args.out, args.threshold)
+
+
+if __name__ == "__main__":
+    main()

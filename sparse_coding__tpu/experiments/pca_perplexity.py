@@ -1,0 +1,164 @@
+"""FVU ↔ perplexity scatter with PCA / added-noise baselines.
+
+Counterpart of reference `experiments/pca_perplexity.py:33-169`: for every
+learned dict (plus AddedNoise, dynamic-PCA and static-PCA baselines), measure
+the FVU on an activation sample and the LM loss when the hook point is
+replaced by the dict's reconstruction, then scatter loss vs FVU.
+
+TPU notes: the baselines are built from one streaming `BatchedPCA` pass; all
+perplexity forwards of a given dict shape share one jitted edited-forward
+(`metrics.intervention.calculate_perplexity` semantics).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.lm import model as lm_model
+from sparse_coding__tpu.metrics.intervention import (
+    Location,
+    mean_reconstruction_loss,
+)
+from sparse_coding__tpu.metrics.standard import fraction_variance_unexplained
+from sparse_coding__tpu.models.learned_dict import AddedNoise
+from sparse_coding__tpu.models.pca import BatchedPCA
+
+
+def train_pca(activations: jax.Array, batch_size: int = 5000) -> BatchedPCA:
+    """Streaming PCA over the activation chunk (reference `train_pca`)."""
+    pca = BatchedPCA(activations.shape[1])
+    for i in range(0, activations.shape[0], batch_size):
+        pca.train_batch(activations[i : i + batch_size])
+    return pca
+
+
+def run_pca_perplexity(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    location: Location,
+    tokens: jax.Array,
+    activations: jax.Array,
+    dict_sets: Dict[str, List[Tuple[Any, Dict[str, Any]]]],
+    out_dir,
+    n_sample: int = 10000,
+    noise_mags: Optional[Sequence[float]] = None,
+    pca_step: int = 8,
+    token_batch: int = 16,
+    seed: int = 0,
+) -> Dict[str, List[Tuple[float, float]]]:
+    """Score every dict set + baselines; write scatter PNG + CSV.
+
+    Returns {label: [(fvu, lm_loss), ...]} (the reference's `scores`).
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    d_act = activations.shape[1]
+
+    pca = train_pca(activations)
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(activations.shape[0], min(n_sample, activations.shape[0]), replace=False)
+    sample = jnp.asarray(np.asarray(activations)[idx])
+
+    sets: Dict[str, List[Tuple[Any, Dict[str, Any]]]] = dict(dict_sets)
+    mags = np.linspace(0.0, 0.5, 32) if noise_mags is None else np.asarray(noise_mags)
+    sets["Added Noise"] = [
+        (AddedNoise(float(m), d_act), {"dict_size": d_act, "mag": float(m)}) for m in mags
+    ]
+    sets["PCA (dynamic)"] = [
+        (pca.to_learned_dict(k), {"dict_size": d_act, "k": k})
+        for k in range(1, d_act // 2, pca_step)
+    ]
+    sets["PCA (static)"] = [
+        (pca.to_rotation_dict(n), {"dict_size": d_act, "n": n})
+        for n in range(1, d_act // 2, pca_step)
+    ]
+
+    n = (tokens.shape[0] // token_batch) * token_batch
+    batches = np.asarray(tokens[:n]).reshape(-1, token_batch, tokens.shape[1])
+
+    scores: Dict[str, List[Tuple[float, float]]] = {}
+    for label, ld_set in sets.items():
+        scores[label] = []
+        for ld, _hp in ld_set:
+            fvu = float(fraction_variance_unexplained(ld, sample))
+            loss = mean_reconstruction_loss(params, lm_cfg, ld, location, batches)
+            scores[label].append((fvu, loss))
+
+    with open(out_dir / "pca_perplexity.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["label", "fvu", "lm_loss"])
+        for label, pts in scores.items():
+            for fvu, loss in pts:
+                w.writerow([label, fvu, loss])
+    with open(out_dir / "pca_perplexity.json", "w") as f:
+        json.dump({k: v for k, v in scores.items()}, f)
+
+    _plot(scores, out_dir / "pca_perplexity.png")
+    return scores
+
+
+def _plot(scores, path):
+    import itertools
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    colors = ["red", "blue", "green", "orange", "purple", "black"]
+    markers = ["o", "x", "s", "v", "D", "P"]
+    fig, ax = plt.subplots()
+    for (marker, color), (label, pts) in zip(
+        itertools.product(markers, colors), scores.items()
+    ):
+        if not pts:
+            continue
+        x, y = zip(*pts)
+        ax.scatter(x, y, label=label, color=color, marker=marker)
+    ax.legend(fontsize=7)
+    ax.set_xlabel("Fraction Variance Unexplained")
+    ax.set_ylabel("Loss")
+    fig.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def main(argv=None):
+    import argparse
+
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dicts", nargs="+", required=True, help="learned_dicts.pkl paths")
+    ap.add_argument("--labels", nargs="+", required=True)
+    ap.add_argument("--chunk", required=True, help=".npy activation chunk")
+    ap.add_argument("--tokens", required=True, help=".npy token matrix [N, L]")
+    ap.add_argument("--lm-params", required=True, help="LM params pickle (lm.convert output)")
+    ap.add_argument("--layer", type=int, required=True)
+    ap.add_argument("--layer-loc", default="residual")
+    ap.add_argument("--out", default="outputs/pca_perplexity")
+    args = ap.parse_args(argv)
+
+    import pickle
+
+    with open(args.lm_params, "rb") as f:
+        params, lm_cfg = pickle.load(f)
+    dict_sets: Dict[str, List] = {}
+    for label, path in zip(args.labels, args.dicts):
+        dict_sets.setdefault(label, []).extend(load_learned_dicts(path))
+    activations = jnp.asarray(np.load(args.chunk))
+    tokens = jnp.asarray(np.load(args.tokens))
+    run_pca_perplexity(
+        params, lm_cfg, (args.layer, args.layer_loc), tokens, activations,
+        dict_sets, args.out,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -231,6 +231,22 @@ def read_results(save_folder) -> pd.DataFrame:
     return pd.DataFrame(records)
 
 
+def read_transform_scores(save_folder, score_mode: str = "all"):
+    """Per-feature autointerp scores from a results folder.
+
+    Reference `read_transform_scores` (`interpret.py` consumer used by
+    `experiments/interp_moment_corrs.py:47`): returns (feature_indices,
+    scores) with `score_mode` selecting the aggregate ("all"), top-fragment
+    ("top") or random-fragment ("random") score.
+    """
+    col = {"all": "score", "top": "top_only_score", "random": "random_only_score"}[score_mode]
+    df = read_results(save_folder)
+    if df.empty:
+        return [], []
+    df = df.dropna(subset=[col])
+    return df["feature"].astype(int).tolist(), df[col].astype(float).tolist()
+
+
 def run(feature_dict, cfg, params, lm_cfg, fragments, decode_tokens,
         client: Optional[InterpClient] = None):
     """End-to-end autointerp for one dict (reference `run`, `interpret.py:388-399`)."""

@@ -248,7 +248,10 @@ class AddedNoise(LearnedDict):
     """
 
     def __init__(self, noise_mag: float, activation_size: int, key: Optional[jax.Array] = None):
-        self.noise_mag = noise_mag
+        # noise_mag is an ARRAY leaf (not static aux): jitted consumers that
+        # take the dict as a traced argument then share one compiled program
+        # across magnitudes (e.g. experiments.pca_perplexity's 32-point sweep)
+        self.noise_mag = jnp.asarray(noise_mag, jnp.float32)
         self.activation_size = activation_size
         self.n_feats = activation_size
         self._key = key if key is not None else jax.random.PRNGKey(0)
@@ -279,7 +282,7 @@ class Rotation(LearnedDict):
 
 register_learned_dict(Identity, ())
 register_learned_dict(IdentityReLU, ("bias",))
-register_learned_dict(AddedNoise, ("_key",), ("noise_mag",))
+register_learned_dict(AddedNoise, ("noise_mag", "_key"))
 register_learned_dict(RandomDict, ("encoder", "encoder_bias"))
 register_learned_dict(UntiedSAE, ("encoder", "decoder", "encoder_bias"))
 register_learned_dict(

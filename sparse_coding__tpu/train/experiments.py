@@ -108,7 +108,11 @@ def topk_experiment(cfg: EnsembleArgs, mesh=None):
 
 def synthetic_linear_range(cfg: EnsembleArgs, mesh=None):
     """32-point l1 logspace × dict ratios {0.5,1,2,4} on tied SAEs
-    (reference `:266-293`)."""
+    (reference `:266-293`). The reference splits the 32 l1 values into two
+    half-grids of 16 to fit one ensemble per GPU (its `settings = product(
+    [l1_vals[:16], l1_vals[16:]], dict_ratios)` double grid); here each ratio
+    holds the FULL 32-point grid in one vmapped stack — same coverage, one
+    program."""
     l1_vals = list(np.logspace(-4, -2, 32))
     dict_ratios = [0.5, 1, 2, 4]
     ensembles, dict_sizes = [], []
@@ -138,6 +142,23 @@ def dense_l1_range_experiment(cfg: EnsembleArgs, mesh=None):
         for k, l1 in zip(keys, l1_values)
     ]
     ensembles = [_ensemble(sig, models, cfg, dict_size, "l1_range", mesh=mesh)]
+    return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
+
+
+def simple_setoff(cfg: EnsembleArgs, mesh=None):
+    """9-point l1 grid INCLUDING l1=0 ([0] + logspace(-4,-2,8)) at
+    cfg.learned_dict_ratio, tied per cfg.tied_ae (reference `simple_setoff`,
+    `big_sweep_experiments.py:1099-1145` — the builder `run_across_layers`
+    sweeps)."""
+    l1_values = [0.0] + list(np.logspace(-4, -2, 8))
+    dict_size = int(cfg.activation_width * cfg.learned_dict_ratio)
+    sig = FunctionalTiedSAE if cfg.tied_ae else FunctionalSAE
+    keys = jax.random.split(_key(cfg), len(l1_values))
+    models = [
+        sig.init(k, cfg.activation_width, dict_size, l1, bias_decay=0.0)
+        for k, l1 in zip(keys, l1_values)
+    ]
+    ensembles = [_ensemble(sig, models, cfg, dict_size, "simple", mesh=mesh)]
     return ensembles, ["dict_size"], ["l1_alpha"], {"dict_size": [dict_size], "l1_alpha": l1_values}
 
 
@@ -264,8 +285,11 @@ def run_sweep_synthetic(experiment=synthetic_linear_range, **overrides):
 
 
 def run_single_layer(layer: int = 2, layer_loc: str = "residual", tied: bool = True,
-                     ratio: float = 4.0, **overrides):
-    """One-layer pythia-70m sweep (reference `run_single_layer`, `:1211-1238`)."""
+                     ratio: float = 4.0, experiment=None, **overrides):
+    """One-layer pythia-70m sweep (reference `run_single_layer`, `:1211-1238`).
+
+    `experiment` overrides the swept builder (default the paper's
+    `dense_l1_range_experiment`)."""
     from sparse_coding__tpu.lm.model import get_activation_size
 
     model_name = overrides.pop("model_name", "EleutherAI/pythia-70m-deduped")
@@ -288,7 +312,7 @@ def run_single_layer(layer: int = 2, layer_loc: str = "residual", tied: bool = T
     )
     for k, v in overrides.items():
         setattr(cfg, k, v)
-    return sweep(dense_l1_range_experiment, cfg)
+    return sweep(experiment or dense_l1_range_experiment, cfg)
 
 
 def run_single_layer_gpt2(layer: int = 9, **overrides):
@@ -299,13 +323,60 @@ def run_single_layer_gpt2(layer: int = 9, **overrides):
     )
 
 
-def run_across_layers(layers=range(6), layer_locs=("residual",), **kwargs):
-    """Layer-loop runner (reference `run_across_layers*`, `:646-772`)."""
+def run_across_layers(layers=range(6), layer_locs=("residual",),
+                      experiment=None, ratios=(4,), **kwargs):
+    """Layer-loop runner (reference `run_across_layers`, `:646-680`: tied
+    residual sweeps of `simple_setoff` at ratio 4, batch 1024, 20 chunks)."""
+    experiment = experiment or simple_setoff
     results = {}
     for layer_loc in layer_locs:
         for layer in layers:
-            results[(layer, layer_loc)] = run_single_layer(layer=layer, layer_loc=layer_loc, **kwargs)
+            for ratio in ratios:
+                results[(layer, layer_loc, ratio)] = run_single_layer(
+                    layer=layer, layer_loc=layer_loc, ratio=ratio,
+                    experiment=experiment, **kwargs,
+                )
     return results
+
+
+def run_across_layers_attn(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
+    """Attention-location specialization (reference `run_across_layers_attn`,
+    `:682-711`): tied, batch 2048, lr 3e-4, 10 chunks, save_every 2, dict
+    ratios {1,2,4,8}, sweeping `dense_l1_range_experiment`."""
+    kwargs.setdefault("batch_size", 2048)
+    kwargs.setdefault("lr", 3e-4)
+    kwargs.setdefault("n_chunks", 10)
+    kwargs.setdefault("save_every", 2)
+    return run_across_layers(
+        layers=layers, layer_locs=("attn",), ratios=ratios,
+        experiment=dense_l1_range_experiment, tied=True, **kwargs,
+    )
+
+
+def run_across_layers_mlp_out(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
+    """MLP-out specialization (reference `run_across_layers_mlp_out`,
+    `:713-742`): same shape as the attn run at layer_loc mlpout."""
+    kwargs.setdefault("batch_size", 2048)
+    kwargs.setdefault("lr", 3e-4)
+    kwargs.setdefault("n_chunks", 10)
+    kwargs.setdefault("save_every", 2)
+    return run_across_layers(
+        layers=layers, layer_locs=("mlpout",), ratios=ratios,
+        experiment=dense_l1_range_experiment, tied=True, **kwargs,
+    )
+
+
+def run_across_layers_mlp_untied(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
+    """Untied MLP-hidden specialization (reference
+    `run_across_layers_mlp_untied`, `:745-772`)."""
+    kwargs.setdefault("batch_size", 2048)
+    kwargs.setdefault("lr", 3e-4)
+    kwargs.setdefault("n_chunks", 10)
+    kwargs.setdefault("save_every", 2)
+    return run_across_layers(
+        layers=layers, layer_locs=("mlp",), ratios=ratios,
+        experiment=dense_l1_range_experiment, tied=False, **kwargs,
+    )
 
 
 def run_pythia_1_4_b_sweep(**overrides):

@@ -1,0 +1,117 @@
+"""Smoke tests for the one-off analysis experiments (reference experiments/),
+on tiny synthetic fixtures: each produces its figure/CSV and sane numbers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.lm import LMConfig, init_params
+from sparse_coding__tpu.models.learned_dict import TiedSAE
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=16, n_heads=2, d_mlp=32,
+        vocab_size=64, n_ctx=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, 64)
+    return cfg, params, tokens
+
+
+def _random_tied(n, d, key):
+    return TiedSAE(jax.random.normal(key, (n, d)), jnp.zeros((n,)), norm_encoder=True)
+
+
+def test_pca_perplexity(tiny_lm, tmp_path):
+    from sparse_coding__tpu.experiments import run_pca_perplexity
+
+    cfg, params, tokens = tiny_lm
+    acts = jax.random.normal(jax.random.PRNGKey(2), (512, cfg.d_model))
+    dict_sets = {"Linear": [(_random_tied(24, cfg.d_model, jax.random.PRNGKey(3)), {"dict_size": 24})]}
+    scores = run_pca_perplexity(
+        params, cfg, (1, "residual"), tokens, acts, dict_sets, tmp_path,
+        n_sample=256, noise_mags=[0.0, 0.3], pca_step=4, token_batch=4,
+    )
+    assert set(scores) == {"Linear", "Added Noise", "PCA (dynamic)", "PCA (static)"}
+    for pts in scores.values():
+        assert all(np.isfinite(v) for fvu, loss in pts for v in (fvu, loss))
+    # zero added noise == identity: FVU ~ 0
+    assert scores["Added Noise"][0][0] < 1e-5
+    # more PCA components => lower FVU (monotone non-increasing-ish)
+    fvus = [f for f, _ in scores["PCA (static)"]]
+    assert fvus[0] > fvus[-1]
+    assert (tmp_path / "pca_perplexity.png").exists()
+    assert (tmp_path / "pca_perplexity.csv").exists()
+
+
+def test_embedding_cosine_check(tiny_lm, tmp_path):
+    from sparse_coding__tpu.experiments import run_embedding_cosine_check
+
+    cfg, params, _ = tiny_lm
+    # a dict made OF embedding rows must score ~1 on the embed panel
+    embed_dict = TiedSAE(params["embed"][:10], jnp.zeros((10,)), norm_encoder=True)
+    rand_dict = _random_tied(10, cfg.d_model, jax.random.PRNGKey(4))
+    data = run_embedding_cosine_check(
+        params, {0: [("1", embed_dict)], 1: [("1", rand_dict)]}, tmp_path
+    )
+    assert data[0][0][1] > 0.999  # embed panel, embedding-copy dict
+    assert data[1][0][1] < 0.9
+    assert (tmp_path / "embed_unembed.png").exists()
+
+
+def test_moment_corrs(tmp_path):
+    from sparse_coding__tpu.experiments import run_moment_corrs
+
+    d, n = 16, 12
+    ld = _random_tied(n, d, jax.random.PRNGKey(5))
+    chunk = jax.random.normal(jax.random.PRNGKey(6), (512, d))
+    # fake an autointerp results folder in the on-disk format
+    results = tmp_path / "results"
+    for f in range(6):
+        folder = results / f"feature_{f:04d}"
+        folder.mkdir(parents=True)
+        (folder / "explanation.txt").write_text(
+            f"something\nScore: {0.1 * f:.2f}\nTop only score: {0.2 * f:.2f}\n"
+            f"Random only score: {0.05 * f:.2f}\n"
+        )
+    out = run_moment_corrs([(ld, chunk, results)], tmp_path / "out", batch_size=128)
+    assert set(out["pooled"]) == {"n_active", "mean", "var", "skew", "kurtosis", "l4_norm"}
+    assert (tmp_path / "out" / "moment_corrs.csv").exists()
+    assert len(out["per_entry"]) == 1
+
+
+def test_read_transform_scores_modes(tmp_path):
+    from sparse_coding__tpu.interp.pipeline import read_transform_scores
+
+    folder = tmp_path / "feature_0003"
+    folder.mkdir()
+    (folder / "explanation.txt").write_text(
+        "expl\nScore: 0.50\nTop only score: 0.80\nRandom only score: 0.20\n"
+    )
+    ndxs, scores = read_transform_scores(tmp_path, score_mode="top")
+    assert ndxs == [3] and scores == [0.8]
+    _, scores = read_transform_scores(tmp_path, score_mode="random")
+    assert scores == [0.2]
+
+
+def test_investigate(tmp_path):
+    from sparse_coding__tpu.experiments import random_feature_diversity, run_investigate
+
+    d = 32
+    larger = _random_tied(64, d, jax.random.PRNGKey(7))
+    # smaller dict: half copied from larger (converged), half random
+    rows = jnp.concatenate(
+        [larger.get_learned_dict()[:8], jax.random.normal(jax.random.PRNGKey(8), (8, d))]
+    )
+    smaller = TiedSAE(rows, jnp.zeros((16,)), norm_encoder=True)
+    summary = run_investigate(smaller, larger, tmp_path, threshold=0.9)
+    assert summary["n_above_threshold"] >= 8
+    assert np.isfinite(summary["enn_mmcs_correlation"])
+    assert (tmp_path / "enn_vs_mmcs.png").exists()
+
+    mean_enn = random_feature_diversity(tmp_path, n=500, d=d)
+    # random unit vectors in R^d have ENN well below d but far above 1
+    assert 2 < mean_enn < d

@@ -116,6 +116,7 @@ def test_basic_l1_sweep(tmp_path, data):
 
 BUILDERS = [
     E.tied_vs_not_experiment,
+    E.simple_setoff,
     E.topk_experiment,
     E.synthetic_linear_range,
     E.dense_l1_range_experiment,
@@ -145,3 +146,28 @@ def test_experiment_builders_contract(builder):
 
     lds = unstacked_to_learned_dicts(ens, args, ens_hp, buf_hp)
     assert len(lds) == ens.n_models
+
+
+def test_simple_setoff_includes_zero_l1():
+    cfg = EnsembleArgs(activation_width=16, batch_size=32, lr=1e-3)
+    _, _, _, ranges = E.simple_setoff(cfg)
+    assert ranges["l1_alpha"][0] == 0.0 and len(ranges["l1_alpha"]) == 9
+
+
+def test_across_layers_specializations_smoke(tmp_path, monkeypatch):
+    """The attn/mlpout/mlp-untied drivers wire the reference's shapes through
+    run_single_layer without touching a real model (sweep stubbed)."""
+    calls = []
+
+    def fake_sweep(experiment, cfg):
+        calls.append((experiment.__name__, cfg.layer, cfg.layer_loc, cfg.tied_ae,
+                      cfg.learned_dict_ratio, cfg.batch_size, cfg.lr, cfg.n_chunks))
+        return None
+
+    monkeypatch.setattr(E, "sweep", fake_sweep)
+    E.run_across_layers_attn(layers=[1], ratios=(2,))
+    E.run_across_layers_mlp_out(layers=[3], ratios=(4,))
+    E.run_across_layers_mlp_untied(layers=[0], ratios=(1,))
+    assert calls[0] == ("dense_l1_range_experiment", 1, "attn", True, 2, 2048, 3e-4, 10)
+    assert calls[1] == ("dense_l1_range_experiment", 3, "mlpout", True, 4, 2048, 3e-4, 10)
+    assert calls[2][3] is False and calls[2][2] == "mlp"
