@@ -58,15 +58,24 @@ def run_embedding_cosine_check(
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
+    # one shared categorical x-axis over the union of ratio labels, so layers
+    # with different ratio lists still land on (and are labelled at) the
+    # right positions
+    all_ratios: List[str] = []
+    for rows in data.values():
+        for r, _, _ in rows:
+            if r not in all_ratios:
+                all_ratios.append(r)
+    pos = {r: i for i, r in enumerate(all_ratios)}
+
     fig, ax = plt.subplots(1, 2, figsize=(10, 5))
     for layer, rows in data.items():
-        ratios = [r for r, _, _ in rows]
-        ax[0].plot([e for _, e, _ in rows], label=layer)
-        ax[1].plot([u for _, _, u in rows], label=layer)
-        ax[0].set_xticks(range(len(ratios)))
-        ax[0].set_xticklabels(ratios)
-        ax[1].set_xticks(range(len(ratios)))
-        ax[1].set_xticklabels(ratios)
+        x = [pos[r] for r, _, _ in rows]
+        ax[0].plot(x, [e for _, e, _ in rows], label=layer)
+        ax[1].plot(x, [u for _, _, u in rows], label=layer)
+    for a in ax:
+        a.set_xticks(range(len(all_ratios)))
+        a.set_xticklabels(all_ratios)
     ax[0].set_title("Embedding")
     ax[1].set_title("Unembedding")
     for a in ax:

@@ -2,9 +2,14 @@
 
 Counterpart of reference `experiments/interp_moment_corrs.py:1-123`: for each
 (dict, activation chunk, autointerp results folder) entry, compute the
-streaming per-feature moments (n_active, mean, var, skew, kurtosis, L4 norm)
+streaming per-feature moments (n_active, mean, var, skew, kurtosis, "l4_norm")
 and their Pearson correlation with the per-feature interpretability scores —
 per entry and pooled, plus log-transformed variants.
+
+Note on "l4_norm": the reference's label for the RAW 4th moment E[c^4] — its
+`calc_moments_streaming` returns `m4` as the last element
+(`standard_metrics.py:509`) and `interp_moment_corrs.py:49,64` correlates it
+under that name. We keep the label and the quantity for parity.
 """
 
 from __future__ import annotations

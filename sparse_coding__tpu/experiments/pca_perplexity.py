@@ -33,10 +33,9 @@ from sparse_coding__tpu.models.pca import BatchedPCA
 
 def train_pca(activations: jax.Array, batch_size: int = 5000) -> BatchedPCA:
     """Streaming PCA over the activation chunk (reference `train_pca`)."""
-    pca = BatchedPCA(activations.shape[1])
-    for i in range(0, activations.shape[0], batch_size):
-        pca.train_batch(activations[i : i + batch_size])
-    return pca
+    from sparse_coding__tpu.models.pca import calc_pca
+
+    return calc_pca(activations, batch_size=batch_size)
 
 
 def run_pca_perplexity(
@@ -80,7 +79,10 @@ def run_pca_perplexity(
         for n in range(1, d_act // 2, pca_step)
     ]
 
+    token_batch = min(token_batch, tokens.shape[0])
     n = (tokens.shape[0] // token_batch) * token_batch
+    if n == 0:
+        raise ValueError(f"no token rows to evaluate (tokens.shape={tokens.shape})")
     batches = np.asarray(tokens[:n]).reshape(-1, token_batch, tokens.shape[1])
 
     scores: Dict[str, List[Tuple[float, float]]] = {}
@@ -144,6 +146,11 @@ def main(argv=None):
     ap.add_argument("--layer-loc", default="residual")
     ap.add_argument("--out", default="outputs/pca_perplexity")
     args = ap.parse_args(argv)
+    if len(args.labels) != len(args.dicts):
+        ap.error(
+            f"--labels ({len(args.labels)}) and --dicts ({len(args.dicts)}) "
+            "must have the same length"
+        )
 
     import pickle
 

@@ -241,7 +241,7 @@ def read_transform_scores(save_folder, score_mode: str = "all"):
     """
     col = {"all": "score", "top": "top_only_score", "random": "random_only_score"}[score_mode]
     df = read_results(save_folder)
-    if df.empty:
+    if df.empty or col not in df.columns:
         return [], []
     df = df.dropna(subset=[col])
     return df["feature"].astype(int).tolist(), df[col].astype(float).tolist()

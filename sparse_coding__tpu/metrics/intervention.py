@@ -125,6 +125,57 @@ def cache_all_activations(
     return _encode_cache(models, cache)
 
 
+def _read_feature_positional(acts, t):
+    """acts [S, B, L, n], targets [T, 2] -> [S, B, T]."""
+    return acts[:, :, t[:, 0], t[:, 1]]
+
+
+def _read_feature_non_positional(acts, t):
+    """acts [S, B, L, n], targets [T] -> [S, B, T] (L2 over positions)."""
+    return jnp.linalg.norm(acts[:, :, :, t], axis=2)
+
+
+@lru_cache(maxsize=64)
+def _jitted_ablation_sweep(
+    lm_cfg: lm_model.LMConfig,
+    names: Tuple[str, ...],
+    location: Location,
+    locs: Tuple[Location, ...],
+    target_locs: Tuple[Location, ...],
+    make_hook,
+    read_feature,
+):
+    """One compiled `lax.map` ablation sweep for one ablation site.
+
+    params / tokens / dicts / baseline codes / target indices are all traced
+    ARGUMENTS (not closed-over constants), so graphs built for many dicts in a
+    loop reuse one executable per shape instead of re-tracing per call and
+    baking the LM params into every compile."""
+    name = get_model_tensor_name(location)
+
+    @jax.jit
+    def sweep(params, tokens, models, base_acts, target_arrs, feats_arr):
+        def run_one(feature):
+            hook = make_hook(models[location], feature)
+            _, cache = lm_model.forward(
+                params, tokens, lm_cfg, hooks={name: hook}, cache_names=list(names)
+            )
+            acts = _encode_cache(models, cache)
+            weights = []
+            for loc_ in locs:
+                if loc_ not in target_locs:
+                    continue
+                un = read_feature(base_acts[loc_][None], target_arrs[loc_])
+                ab = read_feature(acts[loc_][None], target_arrs[loc_])
+                diff = jnp.abs(un - ab)[0]  # [..., T]
+                weights.append(diff.mean(axis=tuple(range(diff.ndim - 1))))
+            return jnp.concatenate(weights)
+
+        return jax.lax.map(run_one, feats_arr)
+
+    return sweep
+
+
 def _graph_from_ablations(
     base_acts, models, params, lm_cfg, tokens, features_to_ablate, all_features,
     make_hook, read_feature,
@@ -135,38 +186,32 @@ def _graph_from_ablations(
     weights, so only [F, n_targets] leaves the map — never the stacked
     activation caches (which would be O(F·B·L·n_feats))."""
     names = tuple(get_model_tensor_name(loc) for loc in models)
-    locs = list(models.keys())
+    locs = tuple(models.keys())
+    unknown = {l for (l, _) in all_features} - set(locs)
+    if unknown:
+        raise ValueError(
+            f"feature locations {sorted(unknown)} have no dict in `models` "
+            f"(locations: {sorted(locs)})"
+        )
     targets_by_loc = {
         loc: [f for (l, f) in all_features if l == loc] for loc in locs
     }
     target_arrs = {
         loc: jnp.asarray(t) for loc, t in targets_by_loc.items() if t
     }
+    target_locs = tuple(loc for loc in locs if loc in target_arrs)
     graph = {}
-    for location, model in models.items():
+    for location in models:
         feats = list(features_to_ablate.get(location, []))
         if not feats:
             continue
-        name = get_model_tensor_name(location)
         feats_arr = jnp.asarray(feats)
-
-        def run_one(feature, _model=model, _name=name):
-            hook = make_hook(_model, feature)
-            _, cache = lm_model.forward(
-                params, tokens, lm_cfg, hooks={_name: hook}, cache_names=list(names)
-            )
-            acts = _encode_cache(models, cache)
-            weights = []
-            for loc_ in locs:
-                if loc_ not in target_arrs:
-                    continue
-                un = read_feature(base_acts[loc_][None], target_arrs[loc_])
-                ab = read_feature(acts[loc_][None], target_arrs[loc_])
-                diff = jnp.abs(un - ab)[0]  # [..., T]
-                weights.append(diff.mean(axis=tuple(range(diff.ndim - 1))))
-            return jnp.concatenate(weights)
-
-        w = np.asarray(jax.jit(lambda fa: jax.lax.map(run_one, fa))(feats_arr))
+        sweep = _jitted_ablation_sweep(
+            lm_cfg, names, location, locs, target_locs, make_hook, read_feature
+        )
+        w = np.asarray(
+            sweep(params, tokens, dict(models), base_acts, target_arrs, feats_arr)
+        )
 
         col = 0
         for loc_ in locs:
@@ -203,9 +248,7 @@ def build_ablation_graph(
     base = cache_all_activations(params, lm_cfg, models, tokens)
     return _graph_from_ablations(
         base, models, params, lm_cfg, tokens, features_to_ablate, all_features,
-        ablate_feature_intervention,
-        # acts [S, B, L, n], targets [T, 2] -> [S, B, T]
-        read_feature=lambda acts, t: acts[:, :, t[:, 0], t[:, 1]],
+        ablate_feature_intervention, _read_feature_positional,
     )
 
 
@@ -228,9 +271,7 @@ def build_ablation_graph_non_positional(
     base = cache_all_activations(params, lm_cfg, models, tokens)
     return _graph_from_ablations(
         base, models, params, lm_cfg, tokens, features_to_ablate, all_features,
-        ablate_feature_intervention_non_positional,
-        # acts [S, B, L, n], targets [T] -> [S, B, T] (L2 over positions)
-        read_feature=lambda acts, t: jnp.linalg.norm(acts[:, :, :, t], axis=2),
+        ablate_feature_intervention_non_positional, _read_feature_non_positional,
     )
 
 

@@ -64,6 +64,12 @@ class LearnedDict:
         return jax.tree.unflatten(treedef, [jax.device_put(l, device) for l in leaves])
 
 
+# {cls: (array_fields, static_fields)} — lets serialization reconstruct
+# instances by FIELD NAME instead of pickling treedefs (which silently
+# corrupt when a registration's field order/partition changes across versions)
+LEARNED_DICT_REGISTRY: dict = {}
+
+
 def register_learned_dict(cls, array_fields: Tuple[str, ...], static_fields: Tuple[str, ...] = ()):
     """Register a LearnedDict subclass as a pytree with given array leaves.
 
@@ -72,6 +78,7 @@ def register_learned_dict(cls, array_fields: Tuple[str, ...], static_fields: Tup
     of the first child's type.
     """
     static_fields = static_fields + ("n_feats", "activation_size")
+    LEARNED_DICT_REGISTRY[cls] = (array_fields, static_fields)
 
     def flatten(obj):
         children = tuple(getattr(obj, f) for f in array_fields)

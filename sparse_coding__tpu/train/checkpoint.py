@@ -31,16 +31,32 @@ import numpy as np
 def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
     """Save a `[(LearnedDict, hyperparams), ...]` list.
 
-    LearnedDicts are registered pytrees: we store (class, static aux, numpy
-    leaves) so loading needs only this package, not jax array types.
+    Records store fields BY NAME (`{class, arrays, statics}`) via the
+    LearnedDict registry — never pickled treedefs, whose leaf order silently
+    shifts (corrupting loads) if a class's pytree registration changes between
+    save and load. Non-registered values (e.g. nested pytrees inside a field)
+    are handled by `jax.tree.map` over the field value.
     """
+    from sparse_coding__tpu.models.learned_dict import LEARNED_DICT_REGISTRY
+
     records = []
     for ld, hyperparams in learned_dicts:
-        leaves, treedef = jax.tree.flatten(ld)
+        if type(ld) not in LEARNED_DICT_REGISTRY:
+            raise TypeError(
+                f"{type(ld).__name__} is not a registered LearnedDict; register "
+                "it with register_learned_dict before saving"
+            )
+        array_fields, static_fields = LEARNED_DICT_REGISTRY[type(ld)]
         records.append(
             {
-                "treedef": pickle.dumps(treedef),
-                "leaves": [np.asarray(jax.device_get(l)) for l in leaves],
+                "class": f"{type(ld).__module__}.{type(ld).__qualname__}",
+                "arrays": {
+                    f: jax.tree.map(
+                        lambda l: np.asarray(jax.device_get(l)), getattr(ld, f)
+                    )
+                    for f in array_fields
+                },
+                "statics": {f: getattr(ld, f, None) for f in static_fields},
                 "hyperparams": hyperparams,
             }
         )
@@ -51,12 +67,29 @@ def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
 
 
 def load_learned_dicts(path) -> List[Tuple[Any, Dict[str, Any]]]:
+    import importlib
+
     with open(path, "rb") as f:
         records = pickle.load(f)
     out = []
     for rec in records:
-        treedef = pickle.loads(rec["treedef"])
-        ld = jax.tree.unflatten(treedef, [jax.numpy.asarray(l) for l in rec["leaves"]])
+        if "treedef" in rec:
+            # the round-1 treedef-pickle format: unflattening an old treedef
+            # with a class whose registration has since changed SILENTLY
+            # mis-assigns fields (e.g. AddedNoise's noise_mag static→leaf
+            # move), so refuse loudly rather than corrupt
+            raise ValueError(
+                f"{path} uses the removed treedef-pickle learned-dict format; "
+                "re-export it with save_learned_dicts (field-name records)"
+            )
+        else:
+            mod_name, _, cls_name = rec["class"].rpartition(".")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            ld = cls.__new__(cls)
+            for f, v in rec["arrays"].items():
+                setattr(ld, f, jax.tree.map(jax.numpy.asarray, v))
+            for f, v in rec["statics"].items():
+                setattr(ld, f, v)
         out.append((ld, rec["hyperparams"]))
     return out
 

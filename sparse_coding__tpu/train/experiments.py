@@ -328,55 +328,53 @@ def run_across_layers(layers=range(6), layer_locs=("residual",),
     """Layer-loop runner (reference `run_across_layers`, `:646-680`: tied
     residual sweeps of `simple_setoff` at ratio 4, batch 1024, 20 chunks)."""
     experiment = experiment or simple_setoff
+    kwargs.setdefault("batch_size", 1024)  # the reference residual-run shape
+    kwargs.setdefault("n_chunks", 20)
+    legacy_keys = "ratio" in kwargs  # pre-round-2 callers: single ratio= kwarg,
+    if legacy_keys:                  # results keyed (layer, layer_loc)
+        ratios = (kwargs.pop("ratio"),)
     results = {}
     for layer_loc in layer_locs:
         for layer in layers:
             for ratio in ratios:
-                results[(layer, layer_loc, ratio)] = run_single_layer(
+                key = (layer, layer_loc) if legacy_keys else (layer, layer_loc, ratio)
+                results[key] = run_single_layer(
                     layer=layer, layer_loc=layer_loc, ratio=ratio,
                     experiment=experiment, **kwargs,
                 )
     return results
 
 
-def run_across_layers_attn(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
-    """Attention-location specialization (reference `run_across_layers_attn`,
-    `:682-711`): tied, batch 2048, lr 3e-4, 10 chunks, save_every 2, dict
-    ratios {1,2,4,8}, sweeping `dense_l1_range_experiment`."""
+def _run_across_layers_location(layer_loc, tied, layers, ratios, kwargs):
+    """Shared shape of the reference's attn/mlpout/mlp layer-loop runners
+    (`:682-772`): batch 2048, lr 3e-4, 10 chunks, save_every 2, sweeping
+    `dense_l1_range_experiment` over dict ratios {1,2,4,8}."""
     kwargs.setdefault("batch_size", 2048)
     kwargs.setdefault("lr", 3e-4)
     kwargs.setdefault("n_chunks", 10)
     kwargs.setdefault("save_every", 2)
     return run_across_layers(
-        layers=layers, layer_locs=("attn",), ratios=ratios,
-        experiment=dense_l1_range_experiment, tied=True, **kwargs,
+        layers=layers, layer_locs=(layer_loc,), ratios=ratios,
+        experiment=dense_l1_range_experiment, tied=tied, **kwargs,
     )
+
+
+def run_across_layers_attn(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
+    """Attention-location specialization (reference `run_across_layers_attn`,
+    `:682-711`)."""
+    return _run_across_layers_location("attn", True, layers, ratios, kwargs)
 
 
 def run_across_layers_mlp_out(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
     """MLP-out specialization (reference `run_across_layers_mlp_out`,
-    `:713-742`): same shape as the attn run at layer_loc mlpout."""
-    kwargs.setdefault("batch_size", 2048)
-    kwargs.setdefault("lr", 3e-4)
-    kwargs.setdefault("n_chunks", 10)
-    kwargs.setdefault("save_every", 2)
-    return run_across_layers(
-        layers=layers, layer_locs=("mlpout",), ratios=ratios,
-        experiment=dense_l1_range_experiment, tied=True, **kwargs,
-    )
+    `:713-742`)."""
+    return _run_across_layers_location("mlpout", True, layers, ratios, kwargs)
 
 
 def run_across_layers_mlp_untied(layers=range(6), ratios=(1, 2, 4, 8), **kwargs):
     """Untied MLP-hidden specialization (reference
     `run_across_layers_mlp_untied`, `:745-772`)."""
-    kwargs.setdefault("batch_size", 2048)
-    kwargs.setdefault("lr", 3e-4)
-    kwargs.setdefault("n_chunks", 10)
-    kwargs.setdefault("save_every", 2)
-    return run_across_layers(
-        layers=layers, layer_locs=("mlp",), ratios=ratios,
-        experiment=dense_l1_range_experiment, tied=False, **kwargs,
-    )
+    return _run_across_layers_location("mlp", False, layers, ratios, kwargs)
 
 
 def run_pythia_1_4_b_sweep(**overrides):
