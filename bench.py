@@ -26,6 +26,8 @@ v4-32 pod; this bench reports the single-chip number.)
 """
 
 import json
+import shutil
+import tempfile
 import time
 
 import jax
@@ -35,6 +37,80 @@ N_MODELS, D_ACT, N_DICT, BATCH = 8, 512, 4096, 2048
 A100_BASELINE_ACTS_PER_SEC = 0.78e6
 SCAN_STEPS = 128
 TPU_PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
+
+
+def bench_harvest() -> float:
+    """Tokens/sec through `make_activation_dataset` on a Pythia-70M-shaped
+    random-init LM (the reference's real bottleneck: a 4-sentence eager
+    forward per batch, `activation_dataset.py:37`; here one jitted
+    64-sentence capture forward, cached per config)."""
+    import numpy as np
+
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+    from sparse_coding__tpu.lm import LMConfig, init_params
+
+    cfg = LMConfig(
+        arch="neox", n_layers=6, d_model=D_ACT, n_heads=8, d_mlp=4 * D_ACT,
+        vocab_size=50304, n_ctx=256, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    batch_size, seq_len, n_chunks = 64, 256, 3
+    # ~0.04 GB chunks => 2 capture batches per chunk at 512 wide
+    chunk_gb = 0.04
+    batches_per_chunk = max(1, int(chunk_gb * 1024**3 / (D_ACT * 2)) // (batch_size * seq_len))
+    rows = (n_chunks + 1) * batches_per_chunk * batch_size
+    tokens = rng.integers(0, cfg.vocab_size, (rows, seq_len), dtype=np.int32)
+
+    tmp = tempfile.mkdtemp(prefix="bench_harvest_")
+    try:
+        from sparse_coding__tpu.data.chunks import ChunkStore
+
+        # warmup: compiles the capture forward (reused via the per-config cache)
+        make_activation_dataset(
+            params, cfg, tokens, f"{tmp}/warm", [2], ["residual"],
+            batch_size=batch_size, chunk_size_gb=chunk_gb, n_chunks=1,
+        )
+        t0 = time.perf_counter()
+        folders = make_activation_dataset(
+            params, cfg, tokens, f"{tmp}/run", [2], ["residual"],
+            batch_size=batch_size, chunk_size_gb=chunk_gb, n_chunks=n_chunks,
+        )
+        dt = time.perf_counter() - t0
+        # tokens actually harvested = rows written (one activation per token)
+        n_tokens = ChunkStore(folders[(2, "residual")]).n_datapoints()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return n_tokens / dt
+
+
+def bench_stream() -> float:
+    """Rows/sec through `ChunkStore.iter_chunks` (disk → host → HBM with
+    double-buffered prefetch), fenced by an on-device reduction per chunk."""
+    import numpy as np
+
+    from sparse_coding__tpu.data.chunks import ChunkStore, save_chunk
+
+    n_chunks, rows = 4, 40960
+    reduce_fn = jax.jit(lambda x: x.sum())
+    tmp = tempfile.mkdtemp(prefix="bench_stream_")
+    try:
+        rng = np.random.default_rng(0)
+        for i in range(n_chunks):
+            save_chunk(tmp, i, rng.standard_normal((rows, D_ACT), dtype=np.float32))
+        store = ChunkStore(tmp)
+        # warmup pass compiles the reduce and touches the page cache
+        for chunk in store.iter_chunks([0]):
+            jax.device_get(reduce_fn(chunk))
+        t0 = time.perf_counter()
+        total = 0
+        for chunk in store.iter_chunks(list(range(n_chunks))):
+            jax.device_get(reduce_fn(chunk))
+            total += chunk.shape[0]
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return total / dt
 
 
 def main():
@@ -83,6 +159,11 @@ def main():
     flops_per_act = N_MODELS * 5 * 2 * D_ACT * N_DICT
     peak = TPU_PEAK_TFLOPS.get(jax.devices()[0].device_kind, 197.0)
     mfu = acts_per_sec * flops_per_act / (peak * 1e12)
+
+    # secondary benches: the harvest pipeline (SURVEY §7 hard part #1) and
+    # chunk-store streaming — reported as extra fields on the one JSON line
+    harvest_tps = bench_harvest()
+    stream_rps = bench_stream()
     print(
         json.dumps(
             {
@@ -92,6 +173,8 @@ def main():
                 "vs_baseline": round(acts_per_sec / A100_BASELINE_ACTS_PER_SEC, 3),
                 "mfu": round(mfu, 3),
                 "device": jax.devices()[0].device_kind,
+                "harvest_tokens_per_sec": round(harvest_tps, 1),
+                "stream_rows_per_sec": round(stream_rps, 1),
             }
         )
     )

@@ -69,13 +69,19 @@ class ChunkStore:
         return total
 
     def load(self, i: int, dtype=jnp.float32, device=None, sharding=None) -> jax.Array:
-        """Load chunk `i` to device (defaults to JAX's default device)."""
+        """Load chunk `i` to device (defaults to JAX's default device).
+
+        The on-disk fp16 bytes are transferred as-is and upcast ON DEVICE:
+        host-side upcasting would double the host→device bytes, the dominant
+        cost of chunk streaming."""
         arr = np.load(chunk_path(self.folder, i))
-        x = jnp.asarray(arr, dtype=dtype)
+        x = jnp.asarray(arr)
         if sharding is not None:
             x = jax.device_put(x, sharding)
         elif device is not None:
             x = jax.device_put(x, device)
+        if x.dtype != jnp.dtype(dtype):
+            x = x.astype(dtype)
         return x
 
     def iter_chunks(

@@ -61,11 +61,10 @@ def run_embedding_cosine_check(
     # one shared categorical x-axis over the union of ratio labels, so layers
     # with different ratio lists still land on (and are labelled at) the
     # right positions
-    all_ratios: List[str] = []
-    for rows in data.values():
-        for r, _, _ in rows:
-            if r not in all_ratios:
-                all_ratios.append(r)
+    all_ratios = sorted(
+        {r for rows in data.values() for r, _, _ in rows},
+        key=lambda r: (0, float(r)) if r.replace(".", "", 1).isdigit() else (1, r),
+    )
     pos = {r: i for i, r in enumerate(all_ratios)}
 
     fig, ax = plt.subplots(1, 2, figsize=(10, 5))

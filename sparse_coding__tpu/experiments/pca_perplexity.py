@@ -79,10 +79,10 @@ def run_pca_perplexity(
         for n in range(1, d_act // 2, pca_step)
     ]
 
+    if tokens.shape[0] == 0:
+        raise ValueError(f"no token rows to evaluate (tokens.shape={tokens.shape})")
     token_batch = min(token_batch, tokens.shape[0])
     n = (tokens.shape[0] // token_batch) * token_batch
-    if n == 0:
-        raise ValueError(f"no token rows to evaluate (tokens.shape={tokens.shape})")
     batches = np.asarray(tokens[:n]).reshape(-1, token_batch, tokens.shape[1])
 
     scores: Dict[str, List[Tuple[float, float]]] = {}
