@@ -249,6 +249,15 @@ class InterpArgs(BaseArgs):
     df_n_feats: int = 200
     top_k: int = 50
     save_loc: str = ""
+    # context inputs (no network needed when all three are set):
+    # lm_params: pickle of (params, LMConfig) from lm.convert; fragments:
+    # .npy [n, fragment_len] int tokens; token_strs: json list mapping token
+    # id -> string. Empty ⇒ resolved from model_name/dataset via HF cache.
+    lm_params: str = ""
+    fragments: str = ""
+    token_strs: str = ""
+    dataset_name: str = "openwebtext"
+    results_base: str = "auto_interp_results"  # reference BASE_FOLDER
 
     def validate(self):
         if self.sort_mode not in ("max", "mean"):
@@ -264,6 +273,7 @@ class InterpGraphArgs(BaseArgs):
     layer_loc: str = "mlp"
     score_mode: str = "all"
     run_all: bool = False
+    results_base: str = "auto_interp_results"
 
     def validate(self):
         if self.score_mode not in ("top", "random", "top_random", "all"):

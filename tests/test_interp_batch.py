@@ -1,0 +1,222 @@
+"""Batch autointerp: shared-forward multi-dict dataframes, the
+folder/group/sweep/baseline batch runners, CLI dispatch, and the calibrated
+logprob simulator math."""
+
+import json
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from sparse_coding__tpu import interp
+from sparse_coding__tpu.lm import LMConfig, init_params
+from sparse_coding__tpu.models.learned_dict import TiedSAE
+from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+from sparse_coding__tpu.utils.config import InterpArgs
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=16, n_heads=2, d_mlp=32,
+        vocab_size=64, n_ctx=16, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    saes = [
+        TiedSAE(
+            jax.random.normal(jax.random.PRNGKey(10 + i), (12, cfg.d_model)),
+            jnp.zeros((12,)),
+            norm_encoder=True,
+        )
+        for i in range(3)
+    ]
+    fragments = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(2), (48, 8), 0, 64), dtype=np.int32
+    )
+    decode = lambda row: [f"tok{int(t)}" for t in row]
+    return cfg, params, saes, fragments, decode
+
+
+def _interp_cfg(save_loc, **kw):
+    return InterpArgs(
+        layer=1, layer_loc="residual", n_feats_explain=2, df_n_feats=12,
+        save_loc=str(save_loc), **kw,
+    )
+
+
+def _ctx(setup):
+    cfg, params, saes, fragments, decode = setup
+    return interp.InterpContext(
+        params, cfg, fragments, decode, client=interp.TokenLexiconClient()
+    )
+
+
+def test_multi_dict_df_matches_single(setup):
+    cfg, params, saes, fragments, decode = setup
+    dfs = interp.make_feature_activation_datasets(
+        params, cfg, saes[:2], 1, "residual", fragments, decode, batch_size=16
+    )
+    single = interp.make_feature_activation_dataset(
+        params, cfg, saes[1], 1, "residual", fragments, decode, batch_size=16
+    )
+    pd.testing.assert_frame_equal(dfs[1], single)
+
+
+def test_run_many_and_read_scores(tmp_path, setup):
+    cfg, params, saes, fragments, decode = setup
+    icfg = _interp_cfg(tmp_path / "l1_residual")
+    out = interp.run_many(
+        [("sparse_coding", saes[0]), ("random", saes[1])], icfg, _ctx(setup)
+    )
+    assert len(out) == 2
+    for folder in out:
+        assert (folder / "activation_df.parquet").exists()
+        assert any(folder.glob("feature_*"))
+    scores = interp.read_scores(tmp_path / "l1_residual", "top_random")
+    # sparse_coding is pinned first, reference read_scores behavior
+    assert list(scores)[0] == "sparse_coding"
+    for _t, (ndxs, s) in scores.items():
+        assert len(ndxs) == len(s) > 0
+
+    # resume: dataframe cache hit, no recompute crash, same folders
+    out2 = interp.run_many(
+        [("sparse_coding", saes[0]), ("random", saes[1])], icfg, _ctx(setup)
+    )
+    assert out == out2
+
+
+def test_run_from_grouped_and_folder(tmp_path, setup):
+    cfg, params, saes, fragments, decode = setup
+    grouped = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(
+        grouped,
+        [(saes[0], {"l1_alpha": 1e-3, "dict_size": 12}),
+         (saes[1], {"l1_alpha": 3e-3, "dict_size": 12})],
+    )
+    icfg = _interp_cfg(tmp_path / "results", results_base=str(tmp_path / "base"))
+    out = interp.run_from_grouped(icfg, _ctx(setup), grouped, out_dir=tmp_path / "split")
+    assert len(out) == 2
+    # per-dict files are tagged by hyperparams (reference make_tag_name)
+    names = sorted(p.name for p in (tmp_path / "split").glob("*.pkl"))
+    assert names == ["dict_size_12l1_alpha_0.001.pkl", "dict_size_12l1_alpha_0.003.pkl"]
+    for folder in out:
+        assert any(folder.glob("feature_*"))
+
+
+def test_interpret_across_big_sweep_and_chunks(tmp_path, setup):
+    cfg, params, saes, fragments, decode = setup
+    # fake two sweep output folders in the reference naming scheme
+    for layer, sae in [(1, saes[0])]:
+        for n_chunks in (1, 10):
+            d = tmp_path / "sweeps" / f"tied_residual_l{layer}_r2" / f"_{n_chunks - 1}"
+            d.mkdir(parents=True, exist_ok=True)
+            save_learned_dicts(
+                d / "learned_dicts.pkl",
+                [(sae, {"l1_alpha": 8.577e-4}), (saes[2], {"l1_alpha": 1e-2})],
+            )
+    icfg = _interp_cfg(tmp_path / "unused", results_base=str(tmp_path / "res"))
+    out = interp.interpret_across_big_sweep(
+        8.577e-4, icfg, _ctx(setup), tmp_path / "sweeps", save_dir=tmp_path / "res"
+    )
+    assert len(out) == 1 and "l1_residual" in str(out[0])
+    assert any(out[0].glob("feature_*"))
+
+    out = interp.interpret_across_chunks(
+        8.577e-4, icfg, _ctx(setup), tmp_path / "sweeps",
+        save_dir=tmp_path / "chunks", chunk_counts=(1, 10),
+    )
+    assert len(out) == 2 and all("_nc" in str(p) for p in out)
+
+
+def test_interpret_across_baselines(tmp_path, setup):
+    cfg, params, saes, fragments, decode = setup
+    bdir = tmp_path / "baselines" / "l1_residual"
+    bdir.mkdir(parents=True)
+    with open(bdir / "pca.pkl", "wb") as f:
+        pickle.dump(saes[0], f)  # plain pickle, the baselines-runner format
+    with open(bdir / "nmf.pkl", "wb") as f:
+        pickle.dump(saes[1], f)
+    icfg = _interp_cfg(tmp_path / "unused")
+    out = interp.interpret_across_baselines(
+        icfg, _ctx(setup), tmp_path / "baselines", save_dir=tmp_path / "res"
+    )
+    assert [p.name for p in out] == ["pca"]  # nmf skipped, reference parity
+
+
+def test_cli_single_file_and_read_results(tmp_path, setup, monkeypatch):
+    cfg, params, saes, fragments, decode = setup
+    from sparse_coding__tpu.interp.__main__ import main
+
+    lm_pkl = tmp_path / "lm.pkl"
+    with open(lm_pkl, "wb") as f:
+        pickle.dump((params, cfg), f)
+    frag_npy = tmp_path / "fragments.npy"
+    np.save(frag_npy, fragments)
+    vocab_json = tmp_path / "vocab.json"
+    with open(vocab_json, "w") as f:
+        json.dump([f"tok{i}" for i in range(64)], f)
+    dict_pkl = tmp_path / "sparse_coding.pkl"
+    save_learned_dicts(dict_pkl, [(saes[0], {"l1_alpha": 1e-3})])
+
+    monkeypatch.chdir(tmp_path)
+    main([
+        "--load_interpret_autoencoder", str(dict_pkl),
+        "--lm_params", str(lm_pkl),
+        "--fragments", str(frag_npy),
+        "--token_strs", str(vocab_json),
+        "--layer", "1", "--layer_loc", "residual",
+        "--n_feats_explain", "2", "--df_n_feats", "12",
+        "--results_base", str(tmp_path / "auto_interp_results"),
+    ])
+    result_dir = tmp_path / "auto_interp_results" / "l1_residual" / "sparse_coding"
+    assert any(result_dir.glob("feature_*"))
+
+    main([
+        "read_results",
+        "--layer", "1", "--layer_loc", "residual", "--score_mode", "top_random",
+        "--model_name", "x/layer",  # activation name derives from model_name
+        "--results_base", str(tmp_path / "auto_interp_results"),
+        "--run_all", "true",
+    ])
+    assert (
+        tmp_path / "auto_interp_results" / "l1_residual"
+        / "top_random_means_and_violin.png"
+    ).exists()
+
+
+def test_calibrated_simulator_math():
+    import math
+
+    # single digit token with certainty → that digit
+    assert interp.expected_activation_from_digit_logprobs({"7": 0.0}) == 7.0
+    # uniform over 0 and 10 → 5; non-digit tokens ignored
+    v = interp.expected_activation_from_digit_logprobs(
+        {" 0": math.log(0.5), "10": math.log(0.5), "the": 0.0}
+    )
+    assert abs(v - 5.0) < 1e-9
+    # no digits → 0
+    assert interp.expected_activation_from_digit_logprobs({"a": 0.0}) == 0.0
+    # duplicate variants keep the likelier one
+    v = interp.expected_activation_from_digit_logprobs(
+        {"3": math.log(0.9), " 3": math.log(0.1)}
+    )
+    assert v == 3.0
+
+
+def test_scores_from_completion_logprobs():
+    # prompt ends with "tok0\t", so the first response token is a digit cell
+    tokens = ["4", "\n", "cat", "\t", "9"]
+    tops = [{"4": 0.0}, {}, {}, {}, {"9": 0.0}]
+    out = interp.scores_from_completion_logprobs(tokens, tops, 2)
+    assert out == [4.0, 9.0]
+    # short response pads with zeros
+    out = interp.scores_from_completion_logprobs(tokens[:1], tops[:1], 3)
+    assert out == [4.0, 0.0, 0.0]
+    # an echoed NUMERIC corpus token ("2020" in the token column) is not an
+    # activation cell and must not shift later scores
+    tokens = ["7", "\n", "2020", "\t", "3"]
+    tops = [{"7": 0.0}, {}, {"2020": 0.0}, {}, {"3": 0.0}]
+    assert interp.scores_from_completion_logprobs(tokens, tops, 2) == [7.0, 3.0]
