@@ -91,6 +91,9 @@ def main(argv=None):
         return
 
     cfg = InterpArgs.from_cli(argv)
+    if not cfg.save_loc:
+        # every dict-running mode writes where read_results will look
+        cfg.save_loc = str(Path(cfg.results_base) / f"l{cfg.layer}_{cfg.layer_loc}")
     ctx = build_context(cfg)
 
     if mode == "run_group":
@@ -106,8 +109,6 @@ def main(argv=None):
             DEFAULT_L1, cfg, ctx, cfg.load_interpret_autoencoder
         )
     elif mode == "":
-        if not cfg.save_loc:
-            cfg.save_loc = str(Path(cfg.results_base) / f"l{cfg.layer}_{cfg.layer_loc}")
         target = Path(cfg.load_interpret_autoencoder)
         if target.is_dir():
             batch_mod.run_folder(cfg, ctx)

@@ -286,8 +286,11 @@ def interpret_across_baselines(
         for file in sorted(os.listdir(Path(baselines_dir) / folder)):
             if not file.endswith(".pkl") or any(s in file for s in skip):
                 continue
-            for ld, _hp in _load_dict_file(Path(baselines_dir) / folder / file):
-                named.append((f"{folder}/{Path(file).stem}", ld))
+            for i, (ld, hp) in enumerate(_load_dict_file(Path(baselines_dir) / folder / file)):
+                # multi-dict files: disambiguate like run_folder, else later
+                # dicts would silently reuse the first's cached dataframe
+                suffix = f"_{make_tag_name(hp) or i}" if i else ""
+                named.append((f"{folder}/{Path(file).stem}{suffix}", ld))
         sub_cfg = dataclasses.replace(cfg, layer=layer, save_loc=str(save_dir))
         out.extend(run_many(named, sub_cfg, ctx))
     return out

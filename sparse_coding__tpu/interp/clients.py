@@ -149,7 +149,7 @@ class OpenAIClient:
                 prompt=prompt,
                 max_tokens=4 * len(tokens) + 16,
                 temperature=0.0,
-                logprobs=15,
+                logprobs=5,  # the completions API's maximum
             )
             lp = resp.choices[0].logprobs
             return scores_from_completion_logprobs(

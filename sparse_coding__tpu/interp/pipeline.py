@@ -16,7 +16,7 @@ pytables, absent here; reference `interpret.py:215-262` used HDF).
 from __future__ import annotations
 
 import pickle
-from functools import partial
+from functools import lru_cache, partial
 from pathlib import Path
 from typing import Callable, List, Optional, Sequence
 
@@ -93,6 +93,35 @@ def _codes_to_dataframe(codes: np.ndarray, token_strs: list, frag_len: int) -> p
     return df
 
 
+@lru_cache(maxsize=16)
+def _jitted_fragment_capture(lm_cfg: lm_model.LMConfig, layer: int, layer_loc: str):
+    """One compiled fragment-capture forward per (config, hook point) —
+    repeated `make_feature_activation_datasets` calls (e.g. one per
+    `run_many` flush group over a sweep's dicts) share the executable
+    instead of re-tracing the subject LM each time."""
+    name = lm_model.make_tensor_name(layer, layer_loc)
+
+    @jax.jit
+    def capture(params, tokens):
+        _, cache = lm_model.forward(
+            params, tokens, lm_cfg, cache_names=[name], stop_at_layer=layer + 1
+        )
+        return cache[name]
+
+    return capture
+
+
+# n is static per dict: the device slices off the unwanted features, so only
+# [B, L, n_feats_kept] ever crosses to host (a 16k-feature dict with
+# df_n_feats=200 would otherwise ship 80x the bytes and OOM the host on real
+# fragment counts). The dict is a traced pytree argument — same-shaped dicts
+# share one compile.
+@partial(jax.jit, static_argnums=2)
+def _encode_sliced(ld, acts, n):
+    B, L, C = acts.shape
+    return ld.encode(acts.reshape(B * L, C)).reshape(B, L, -1)[:, :, :n]
+
+
 def make_feature_activation_datasets(
     params,
     lm_cfg: lm_model.LMConfig,
@@ -112,23 +141,8 @@ def make_feature_activation_datasets(
     subject-LM forward. Single-controller TPU version: capture the hook
     tensor once, then encode it with every dict (each dict is a traced pytree
     argument, so same-shaped dicts share one compiled encode)."""
-    name = lm_model.make_tensor_name(layer, layer_loc)
-
-    @jax.jit
-    def capture(tokens):
-        _, cache = lm_model.forward(
-            params, tokens, lm_cfg, cache_names=[name], stop_at_layer=layer + 1
-        )
-        return cache[name]
-
-    # n is static per dict: the device slices off the unwanted features, so
-    # only [B, L, n_feats_kept] ever crosses to host (a 16k-feature dict with
-    # df_n_feats=200 would otherwise ship 80x the bytes and OOM the host on
-    # real fragment counts)
-    @partial(jax.jit, static_argnums=2)
-    def encode(ld, acts, n):
-        B, L, C = acts.shape
-        return ld.encode(acts.reshape(B * L, C)).reshape(B, L, -1)[:, :, :n]
+    capture = _jitted_fragment_capture(lm_cfg, layer, layer_loc)
+    encode = _encode_sliced
 
     n_kept = [
         ld.n_feats if not max_features else min(max_features, ld.n_feats)
@@ -141,7 +155,7 @@ def make_feature_activation_datasets(
         fragments = np.concatenate([fragments, np.zeros((pad, frag_len), fragments.dtype)])
     blocks: List[List[np.ndarray]] = [[] for _ in learned_dicts]
     for start in range(0, fragments.shape[0], batch_size):
-        acts = capture(jnp.asarray(fragments[start : start + batch_size]))
+        acts = capture(params, jnp.asarray(fragments[start : start + batch_size]))
         for d, ld in enumerate(learned_dicts):
             blocks[d].append(np.asarray(jax.device_get(encode(ld, acts, n_kept[d]))))
     token_strs = [decode_tokens(fragments[b]) for b in range(n_frags)]

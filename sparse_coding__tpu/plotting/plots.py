@@ -206,6 +206,187 @@ def histogram(values, x_label: str, y_label: str = "Frequency", bins: int = 20):
     return fig
 
 
+# -- autointerp comparison figures --------------------------------------------
+#
+# The reference ships four near-identical scripts (grouped mean±95%-CI bars
+# over layers, differing only in which transforms are selected):
+#   plot_autointerp_across_chunks.py   — nc{1,4,16,32} save points
+#   plot_autointerp_across_size.py     — dict ratios 0.5…32
+#   plot_autointerp_vs_baselines.py    — SAE vs identity_relu/random/ica/pca
+#   plot_autointerp_vs_topk_baselines.py — SAE vs ica_topk/pca_topk etc.
+# Here: one core figure + four selector wrappers reading
+# `interp.batch.read_scores` folders (results_base/l{layer}_{loc}/<transform>).
+
+def grouped_score_bars(
+    all_scores: List[Dict[str, Tuple[List[int], List[float]]]],
+    transforms: Sequence[str],
+    group_labels: Sequence[str],
+    title: str = "",
+    ylabel: str = "autointerp score",
+):
+    """Grouped bars of mean score ±95% CI: one group per layer, one bar per
+    transform (the shared core of the reference's four comparison scripts,
+    e.g. `plot_autointerp_vs_baselines.py:48-140`)."""
+    fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(group_labels)), 4))
+    width = 0.8 / max(1, len(transforms))
+    for j, transform in enumerate(transforms):
+        xs, means, cis = [], [], []
+        for i, scores in enumerate(all_scores):
+            if transform not in scores:
+                continue
+            s = np.asarray(scores[transform][1], dtype=float)
+            if len(s) == 0:
+                continue
+            xs.append(i + j * width)
+            means.append(s.mean())
+            cis.append(
+                1.96 * s.std(ddof=1) / np.sqrt(len(s)) if len(s) > 1 else 0.0
+            )
+        if xs:
+            ax.bar(xs, means, width=width, yerr=cis, capsize=2, label=transform)
+    ax.set_xticks([i + 0.4 - width / 2 for i in range(len(group_labels))])
+    ax.set_xticklabels(group_labels)
+    ax.grid(axis="y", color="grey", linestyle="-", linewidth=0.5, alpha=0.3)
+    ax.set_xlabel("layer")
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    return fig
+
+
+def read_layer_scores(
+    results_base, layers: Sequence[int], layer_loc: str, score_mode: str
+):
+    """(scores per layer, layer labels) from `l{layer}_{loc}` result folders."""
+    from pathlib import Path
+
+    from sparse_coding__tpu.interp.batch import read_scores
+
+    all_scores, labels = [], []
+    for layer in layers:
+        folder = Path(results_base) / f"l{layer}_{layer_loc}"
+        if not folder.is_dir():
+            continue
+        all_scores.append(read_scores(folder, score_mode))
+        labels.append(str(layer))
+    return all_scores, labels
+
+
+def _common_transforms(all_scores) -> List[str]:
+    common = set(all_scores[0]) if all_scores else set()
+    for scores in all_scores[1:]:
+        common &= set(scores)
+    return sorted(common)
+
+
+def autointerp_across_chunks(
+    results_base,
+    layers: Sequence[int] = range(6),
+    layer_loc: str = "residual",
+    score_mode: str = "top_random",
+    title: str = "Autointerp over training chunks",
+):
+    """Score vs number of training chunks (`plot_autointerp_across_chunks.py`):
+    transforms carrying the `_nc{n}` save-point tag, ordered by n."""
+    all_scores, labels = read_layer_scores(results_base, layers, layer_loc, score_mode)
+    transforms = [t for t in _common_transforms(all_scores) if "_nc" in t]
+    transforms.sort(key=lambda t: int(t.split("_nc")[1].split("_")[0]))
+    return grouped_score_bars(all_scores, transforms, labels, title=title)
+
+
+def autointerp_across_size(
+    results_base,
+    layers: Sequence[int] = range(6),
+    layer_loc: str = "residual",
+    score_mode: str = "top_random",
+    title: str = "Autointerp across dict sizes",
+):
+    """Score vs dictionary ratio (`plot_autointerp_across_size.py`):
+    transforms carrying an `_r{ratio}` tag, ordered by ratio."""
+    all_scores, labels = read_layer_scores(results_base, layers, layer_loc, score_mode)
+
+    def ratio_of(t):
+        try:
+            return float(t.split("_r")[1].split("_")[0])
+        except (IndexError, ValueError):
+            return None
+
+    transforms = [t for t in _common_transforms(all_scores) if ratio_of(t) is not None]
+    transforms.sort(key=ratio_of)
+    return grouped_score_bars(all_scores, transforms, labels, title=title)
+
+
+def autointerp_vs_baselines(
+    results_base,
+    layers: Sequence[int] = range(6),
+    layer_loc: str = "residual",
+    score_mode: str = "top_random",
+    baselines: Sequence[str] = ("identity_relu", "random", "ica", "pca"),
+    title: str = "Autointerp vs baselines",
+):
+    """Trained SAE(s) against the baseline dicts
+    (`plot_autointerp_vs_baselines.py:33-46`; SAE transforms sort first like
+    the reference's tied-first sort)."""
+    all_scores, labels = read_layer_scores(results_base, layers, layer_loc, score_mode)
+    common = _common_transforms(all_scores)
+    sae = [t for t in common if t not in baselines]
+    chosen = sae + [t for t in baselines if t in common]
+    return grouped_score_bars(all_scores, chosen, labels, title=title)
+
+
+def autointerp_vs_topk_baselines(
+    results_base,
+    layers: Sequence[int] = range(6),
+    layer_loc: str = "residual",
+    score_mode: str = "top_random",
+    baselines: Sequence[str] = ("identity_relu", "ica", "ica_topk", "pca", "pca_topk"),
+    title: str = "Autointerp vs top-k baselines",
+):
+    """(`plot_autointerp_vs_topk_baselines.py:33-42`)"""
+    return autointerp_vs_baselines(
+        results_base, layers, layer_loc, score_mode, baselines=baselines, title=title
+    )
+
+
+def n_active_over_time(
+    save_points: Dict[int, LearnedDictList],
+    batch,
+    threshold: int = 10,
+    x_hyperparam: str = "l1_alpha",
+    title: str = "Active features over training",
+):
+    """Fraction of ever-active features vs l1, one line per training save
+    point (reference `plot_n_active_over_time.py:31-80`: encode a held-out
+    chunk with every saved dict, count features with > `threshold`
+    activations).
+
+    `save_points`: {chunk_count: [(LearnedDict, hyperparams), ...]} — e.g.
+    `{n: load_learned_dicts(out / f"_{n-1}" / "learned_dicts.pkl") for n in
+    (1, 4, 16, 32)}`."""
+    from sparse_coding__tpu.metrics.standard import batched_calc_feature_n_ever_active
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for chunk_count in sorted(save_points):
+        pts = []
+        for ld, hp in save_points[chunk_count]:
+            l1 = hp.get(x_hyperparam, 0) or 8e-5  # reference maps l1=0 → 8e-5
+            n_active = batched_calc_feature_n_ever_active(
+                ld, batch, threshold=threshold
+            )
+            pts.append((float(l1), float(n_active) / ld.n_feats))
+        pts.sort()
+        if pts:
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, "o-", label=f"{chunk_count} chunks")
+    ax.set_xscale("log")
+    ax.set_xlabel(x_hyperparam)
+    ax.set_ylabel(f"fraction of features active (> {threshold} activations)")
+    ax.set_title(title)
+    ax.legend(fontsize=8)
+    return fig
+
+
 def save_figure(fig, path):
     from pathlib import Path
 

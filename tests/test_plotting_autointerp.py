@@ -1,0 +1,100 @@
+"""Autointerp comparison figures + n_active_over_time (the round-1 plotting
+long tail: reference plot_autointerp_across_chunks/_across_size/
+_vs_baselines/_vs_topk_baselines and plot_n_active_over_time)."""
+
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu import plotting
+from sparse_coding__tpu.data import RandomDatasetGenerator
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+
+
+def _write_scores(folder: Path, scores):
+    for i, s in enumerate(scores):
+        d = folder / f"feature_{i}"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "explanation.txt").write_text(
+            f"some explanation\nScore: {s:.2f}\nTop only score: {s:.2f}\n"
+            f"Random only score: {s:.2f}\n"
+        )
+
+
+@pytest.fixture(scope="module")
+def results_tree(tmp_path_factory):
+    """Two layers of results with nc-tagged, ratio-tagged and baseline
+    transforms, in the layout interp.batch's writers produce."""
+    base = tmp_path_factory.mktemp("auto_interp_results")
+    rng = np.random.default_rng(0)
+    for layer in (0, 1):
+        for transform in (
+            "tied_r2_nc1_l1a0.00086",
+            "tied_r2_nc4_l1a0.00086",
+            "tied_r1_l1a0.00086",
+            "tied_r4_l1a0.00086",
+            "sparse_coding",
+            "identity_relu",
+            "pca",
+            "pca_topk",
+        ):
+            _write_scores(
+                base / f"l{layer}_residual" / transform, rng.uniform(0, 0.5, 5)
+            )
+    return base
+
+
+def test_autointerp_comparison_figures(results_tree, tmp_path):
+    figs = {
+        "across_chunks": plotting.autointerp_across_chunks(
+            results_tree, layers=(0, 1)
+        ),
+        "across_size": plotting.autointerp_across_size(results_tree, layers=(0, 1)),
+        "vs_baselines": plotting.autointerp_vs_baselines(results_tree, layers=(0, 1)),
+        "vs_topk": plotting.autointerp_vs_topk_baselines(results_tree, layers=(0, 1)),
+    }
+    for name, fig in figs.items():
+        path = plotting.save_figure(fig, tmp_path / f"{name}.png")
+        assert Path(path).stat().st_size > 1000
+
+    # across_chunks selected exactly the nc-tagged transforms, in nc order
+    all_scores, labels = plotting.read_layer_scores(
+        results_tree, (0, 1), "residual", "top_random"
+    )
+    assert labels == ["0", "1"]
+    assert all("tied_r2_nc1_l1a0.00086" in s for s in all_scores)
+
+
+def test_read_layer_scores_skips_missing_layers(results_tree):
+    all_scores, labels = plotting.read_layer_scores(
+        results_tree, (0, 1, 5), "residual", "top_random"
+    )
+    assert labels == ["0", "1"]  # layer 5 folder absent → skipped, not crashed
+
+
+def test_n_active_over_time(tmp_path):
+    gen = RandomDatasetGenerator(
+        activation_dim=16, n_ground_truth_components=32, batch_size=512,
+        feature_num_nonzero=4, feature_prob_decay=0.99, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    ens = build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(1),
+        [{"l1_alpha": a} for a in (1e-4, 1e-2)],
+        optimizer_kwargs={"learning_rate": 3e-3},
+        activation_size=16, n_dict_components=32,
+    )
+    save_points = {}
+    for chunk_count, steps in ((1, 5), (4, 20)):
+        for _ in range(steps):
+            ens.step_batch(next(gen))
+        save_points[chunk_count] = [
+            (ld, {"l1_alpha": a})
+            for ld, a in zip(ens.to_learned_dicts(), (1e-4, 1e-2))
+        ]
+    fig = plotting.n_active_over_time(save_points, next(gen), threshold=1)
+    path = plotting.save_figure(fig, tmp_path / "n_active_over_time.png")
+    assert Path(path).stat().st_size > 1000
