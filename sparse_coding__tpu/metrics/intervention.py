@@ -306,6 +306,20 @@ def mean_reconstruction_loss(params, lm_cfg, ld, location, batches) -> float:
     return float(np.mean([float(fn(params, ld, jnp.asarray(b))) for b in batches]))
 
 
+@lru_cache(maxsize=64)
+def _jitted_reconstruction_loss_vmapped(lm_cfg: lm_model.LMConfig, location: Location):
+    """Edited forward vmapped over a STACK of dicts: one compiled program
+    scores every same-shaped dict of a sweep at once — the P4 eval fan-out
+    (the reference pools per-dict eval over 6 GPUs,
+    `standard_metrics.py:751-806`)."""
+    return jax.jit(
+        jax.vmap(
+            lambda p, ld, t: perplexity_under_reconstruction(p, lm_cfg, ld, location, t),
+            in_axes=(None, 0, None),
+        )
+    )
+
+
 def calculate_perplexity(
     params,
     lm_cfg: lm_model.LMConfig,
@@ -313,18 +327,51 @@ def calculate_perplexity(
     location: Location,
     tokens: jax.Array,
     batch_size: int = 16,
+    vmapped: bool = True,
 ) -> Tuple[float, List[Tuple[Dict[str, Any], float]]]:
     """Baseline LM loss + loss under each dict's reconstruction
     (reference `calculate_perplexity`, `standard_metrics.py:619-707`).
-    Batches the token set; one jitted edited-forward per dict."""
+
+    With `vmapped` (default), same-shaped dicts are stacked and scored by ONE
+    vmapped edited-forward per token batch; oddly-shaped dicts fall back to
+    the per-dict jitted path. `vmapped=False` forces per-dict evaluation
+    (lower peak memory: the vmapped forward holds n_dicts edited streams)."""
+    from sparse_coding__tpu.metrics.standard import group_stackable_dicts
+
+    if tokens.shape[0] == 0:
+        raise ValueError(f"no token rows to evaluate (tokens.shape={tokens.shape})")
+    batch_size = min(batch_size, tokens.shape[0])
     n = (tokens.shape[0] // batch_size) * batch_size
     batches = np.asarray(tokens[:n]).reshape(-1, batch_size, tokens.shape[1])
 
     loss_fn = jax.jit(partial(lm_model.lm_loss, cfg=lm_cfg))
     base = float(np.mean([float(loss_fn(params, jnp.asarray(b))) for b in batches]))
 
-    results = []
-    for ld, hyperparams in learned_dicts:
-        loss = mean_reconstruction_loss(params, lm_cfg, ld, location, batches)
-        results.append((hyperparams, loss))
+    losses: List[float] = [0.0] * len(learned_dicts)
+    dicts_only = [ld for ld, _hp in learned_dicts]
+    groups = (
+        group_stackable_dicts(dicts_only)
+        if vmapped
+        else [[i] for i in range(len(learned_dicts))]
+    )
+    for idxs in groups:
+        if len(idxs) == 1 or not jax.tree.leaves(dicts_only[idxs[0]]):
+            # singletons, and leafless dicts (Identity & co — no axis to
+            # vmap over), go through the per-dict jitted path
+            for i in idxs:
+                losses[i] = mean_reconstruction_loss(
+                    params, lm_cfg, dicts_only[i], location, batches
+                )
+            continue
+        stacked = jax.tree.map(
+            lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+            *[dicts_only[i] for i in idxs],
+        )
+        fn = _jitted_reconstruction_loss_vmapped(lm_cfg, location)
+        per_batch = np.stack(
+            [np.asarray(jax.device_get(fn(params, stacked, jnp.asarray(b)))) for b in batches]
+        )  # [n_batches, n_dicts]
+        for j, i in enumerate(idxs):
+            losses[i] = float(per_batch[:, j].mean())
+    results = [(hp, losses[i]) for i, (_ld, hp) in enumerate(learned_dicts)]
     return base, results

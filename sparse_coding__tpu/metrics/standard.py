@@ -12,6 +12,7 @@ All dictionary arguments accept either a `LearnedDict` or a raw
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -244,3 +245,77 @@ def ridge_regression_auroc(activations, labels, **kwargs) -> float:
     clf = RidgeClassifier(**kwargs)
     clf.fit(x, y)
     return float(roc_auc_score(y, clf.predict(x)))
+
+
+# -- P4: vmapped multi-dict evaluation ----------------------------------------
+#
+# The reference fans per-dict metric evaluation out over a 6-GPU mp.Pool
+# (`standard_metrics.py:751-806`). Single-controller TPU replacement: stack
+# same-shaped LearnedDict pytrees and `vmap` the metric over the stack — one
+# compiled program evaluates the whole sweep's dicts at once.
+
+def group_stackable_dicts(learned_dicts: List[Any]) -> List[List[int]]:
+    """Indices grouped by (pytree structure, leaf shapes/dtypes) — each group
+    can be stacked into one vmap operand."""
+    groups: Dict[Any, List[int]] = {}
+    for i, ld in enumerate(learned_dicts):
+        leaves, treedef = jax.tree.flatten(ld)
+        key = (
+            str(treedef),
+            tuple((tuple(jnp.shape(l)), str(jnp.result_type(l))) for l in leaves),
+        )
+        groups.setdefault(key, []).append(i)
+    return list(groups.values())
+
+
+def _stack_dicts(lds: List[Any]):
+    return jax.tree.map(lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *lds)
+
+
+# bounded: non-module-level metric fns (lambdas rebuilt per call) would
+# otherwise pin their jitted wrappers + executables forever
+@lru_cache(maxsize=64)
+def _vmapped_metric(fn):
+    return jax.jit(jax.vmap(fn, in_axes=(0, None)))
+
+
+# r2 is derived on host as 1 - fvu (one fewer vmapped program per stack)
+DEFAULT_EVAL_METRICS: Dict[str, Any] = {
+    "fvu": fraction_variance_unexplained,
+    "l0": sparsity_l0,
+}
+
+
+def evaluate_dicts(
+    learned_dicts: List[Any],
+    batch: jax.Array,
+    metric_fns: Dict[str, Any] = None,
+) -> List[Dict[str, float]]:
+    """Per-dict metrics, vmapped over stacks of same-shaped dicts.
+
+    Returns one `{metric: value}` dict per input, in input order. Dicts that
+    can't stack with anything (unique shape/class) still run through the same
+    jitted metric (vmap over a stack of one). `metric_fns` values must be
+    `fn(learned_dict, batch) -> scalar` with the dict usable as a traced
+    pytree — true for every registered LearnedDict. Pass module-level
+    functions (not per-call lambdas) so the jitted wrapper cache hits."""
+    defaults = metric_fns is None
+    metric_fns = DEFAULT_EVAL_METRICS if defaults else metric_fns
+    out: List[Dict[str, float]] = [dict() for _ in learned_dicts]
+    for idxs in group_stackable_dicts(learned_dicts):
+        if not jax.tree.leaves(learned_dicts[idxs[0]]):
+            # leafless dicts (Identity & co) have no axis to vmap over;
+            # evaluate directly — they are O(1) baselines anyway
+            for i in idxs:
+                for name, fn in metric_fns.items():
+                    out[i][name] = float(fn(learned_dicts[i], batch))
+            continue
+        stacked = _stack_dicts([learned_dicts[i] for i in idxs])
+        for name, fn in metric_fns.items():
+            vals = np.asarray(jax.device_get(_vmapped_metric(fn)(stacked, batch)))
+            for j, i in enumerate(idxs):
+                out[i][name] = float(vals[j])
+    if defaults:
+        for row in out:
+            row["r2"] = 1.0 - row["fvu"]
+    return out

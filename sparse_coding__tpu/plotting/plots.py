@@ -280,6 +280,15 @@ def _common_transforms(all_scores) -> List[str]:
     return sorted(common)
 
 
+def _nc_of(transform: str):
+    """Chunk count from an `_nc{n}` save-point tag, None if absent/unparsable
+    (transform names are arbitrary file stems — don't crash the figure)."""
+    if "_nc" not in transform:
+        return None
+    head = transform.split("_nc")[1].split("_")[0]
+    return int(head) if head.isdigit() else None
+
+
 def autointerp_across_chunks(
     results_base,
     layers: Sequence[int] = range(6),
@@ -290,8 +299,10 @@ def autointerp_across_chunks(
     """Score vs number of training chunks (`plot_autointerp_across_chunks.py`):
     transforms carrying the `_nc{n}` save-point tag, ordered by n."""
     all_scores, labels = read_layer_scores(results_base, layers, layer_loc, score_mode)
-    transforms = [t for t in _common_transforms(all_scores) if "_nc" in t]
-    transforms.sort(key=lambda t: int(t.split("_nc")[1].split("_")[0]))
+    transforms = [
+        t for t in _common_transforms(all_scores) if _nc_of(t) is not None
+    ]
+    transforms.sort(key=_nc_of)
     return grouped_score_bars(all_scores, transforms, labels, title=title)
 
 
@@ -312,7 +323,13 @@ def autointerp_across_size(
         except (IndexError, ValueError):
             return None
 
-    transforms = [t for t in _common_transforms(all_scores) if ratio_of(t) is not None]
+    # nc-tagged names are training save points (the across_chunks figure's
+    # subject); mixing them in would duplicate ratios with undertrained bars
+    transforms = [
+        t
+        for t in _common_transforms(all_scores)
+        if ratio_of(t) is not None and _nc_of(t) is None
+    ]
     transforms.sort(key=ratio_of)
     return grouped_score_bars(all_scores, transforms, labels, title=title)
 

@@ -236,3 +236,46 @@ def test_clustering():
     # members of the same planted group share a cluster id
     for g in range(3):
         assert len(np.unique(clusters[g * 20 : (g + 1) * 20])) == 1
+
+
+def test_calculate_perplexity_vmapped_matches_serial(setup):
+    """P4 fan-out: the vmapped multi-dict edited-forward must agree with the
+    per-dict path."""
+    cfg, params, tokens = setup
+    mk = lambda k: TiedSAE(
+        jax.random.normal(jax.random.PRNGKey(k), (24, cfg.d_model)),
+        jnp.zeros((24,)),
+        norm_encoder=True,
+    )
+    dicts = [(mk(20), {"id": 0}), (mk(21), {"id": 1}), (Identity(cfg.d_model), {"id": 2})]
+    base_v, res_v = sm.calculate_perplexity(
+        params, cfg, dicts, (0, "residual"), tokens, batch_size=4, vmapped=True
+    )
+    base_s, res_s = sm.calculate_perplexity(
+        params, cfg, dicts, (0, "residual"), tokens, batch_size=4, vmapped=False
+    )
+    assert abs(base_v - base_s) < 1e-6
+    for (hp_v, loss_v), (hp_s, loss_s) in zip(res_v, res_s):
+        assert hp_v == hp_s
+        assert abs(loss_v - loss_s) < 1e-4, (hp_v, loss_v, loss_s)
+    # the identity dict must leave the loss at baseline either way
+    assert abs(res_v[2][1] - base_v) < 1e-4
+
+
+def test_evaluate_dicts_vmapped_matches_direct(setup):
+    cfg, params, tokens = setup
+    batch = jax.random.normal(jax.random.PRNGKey(30), (128, cfg.d_model))
+    mk = lambda k, n: TiedSAE(
+        jax.random.normal(jax.random.PRNGKey(k), (n, cfg.d_model)),
+        jnp.zeros((n,)),
+        norm_encoder=True,
+    )
+    # two stackable (24) + one odd-shaped (12) + one different class
+    dicts = [mk(40, 24), mk(41, 24), mk(42, 12), Identity(cfg.d_model)]
+    groups = sm.group_stackable_dicts(dicts)
+    assert sorted(len(g) for g in groups) == [1, 1, 2]
+    rows = sm.evaluate_dicts(dicts, batch)
+    for ld, row in zip(dicts, rows):
+        assert abs(row["fvu"] - float(sm.fraction_variance_unexplained(ld, batch))) < 1e-5
+        assert abs(row["l0"] - float(sm.sparsity_l0(ld, batch))) < 1e-5
+        assert abs(row["r2"] - (1.0 - row["fvu"])) < 1e-5
