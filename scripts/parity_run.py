@@ -1,0 +1,252 @@
+"""Behavioral-parity artifact: harvest → train → eval, end to end, on TPU.
+
+Produces the parity deliverable BASELINE.md defines (the reference publishes
+no numbers, so parity = the full measurement suite on the paper's workload
+shape): FVU-vs-L0 pareto across an l1 sweep, cross-seed MMCS, active/dead
+feature counts (>10-activation threshold, `standard_metrics.py:444-452`), and
+perplexity under reconstruction (`standard_metrics.py:619-707`).
+
+Subject model: a pythia-70m-GEOMETRY GPTNeoX (d=512, 6 layers, 8 heads,
+vocab 50304) built with transformers at random init (zero-egress image: no
+weights downloadable) and converted through `lm.convert` — the converter's
+logit-exactness against torch is separately proven by `tests/test_lm.py`.
+Workload shape follows `big_sweep_experiments.py:295-341`: layer 2 residual,
+tied SAEs, dict ratio 4x, l1 in logspace(-4,-2), batch 2048, fp16 chunks.
+
+Run: `python scripts/parity_run.py` (real chip, ~5-10 min; writes
+PARITY_r02.json + parity_pareto_r02.png at the repo root).
+`--quick` runs a minutes-long CPU-sized version for CI (same code path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def build_subject_model(quick: bool):
+    import torch
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    from sparse_coding__tpu.lm import config_from_hf, params_from_hf
+
+    torch.manual_seed(0)
+    if quick:
+        hf_cfg = GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=3,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, rotary_pct=0.25,
+            use_parallel_residual=True, tie_word_embeddings=False,
+        )
+    else:
+        # pythia-70m-deduped geometry (EleutherAI config)
+        hf_cfg = GPTNeoXConfig(
+            vocab_size=50304, hidden_size=512, num_hidden_layers=6,
+            num_attention_heads=8, intermediate_size=2048,
+            max_position_embeddings=2048, rotary_pct=0.25,
+            use_parallel_residual=True, tie_word_embeddings=False,
+        )
+    model = GPTNeoXForCausalLM(hf_cfg).eval()
+    return config_from_hf(model.config), params_from_hf(model)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
+    ap.add_argument("--out", default=None, help="output prefix (default repo root)")
+    ap.add_argument("--seq-len", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import build_ensemble, metrics as sm
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+    from sparse_coding__tpu.data.chunks import ChunkStore
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.models.learned_dict import Identity
+    from sparse_coding__tpu.train.loop import ensemble_train_loop
+
+    t_start = time.time()
+    quick = args.quick
+    layer, layer_loc = (1, "residual") if quick else (2, "residual")
+    seq_len = 32 if quick else args.seq_len
+    batch_rows = 16 if quick else 64
+    chunk_gb = 0.002 if quick else 0.0625
+    n_chunks = 3 if quick else 5  # last chunk held out for eval
+    l1_grid = [1e-4, 1e-3] if quick else list(np.logspace(-4, -2, 8))
+    ratio = 2 if quick else 4
+    sae_batch = 256 if quick else 2048
+    n_epochs = 1 if quick else 3
+    seeds = (0, 1)
+
+    print("Building subject model (pythia-70m geometry, random init)...")
+    lm_cfg, params = build_subject_model(quick)
+    d_act = lm_cfg.d_model
+    n_dict = int(ratio * d_act)
+
+    rng = np.random.default_rng(0)
+    bytes_per_row = d_act * 2
+    batches_per_chunk = max(1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len))
+    n_rows = (n_chunks + 1) * batches_per_chunk * batch_rows
+    tokens = rng.integers(0, lm_cfg.vocab_size, (n_rows, seq_len), dtype=np.int32)
+
+    report: dict = {
+        "config": {
+            "subject": f"GPTNeoX d={d_act} L={lm_cfg.n_layers} (pythia-70m geometry, random init)",
+            "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
+            "dict_ratio": ratio, "n_dict": n_dict, "l1_grid": [float(a) for a in l1_grid],
+            "sae_batch": sae_batch, "n_epochs": n_epochs, "seeds": list(seeds),
+            "device": jax.devices()[0].device_kind,
+        }
+    }
+
+    with tempfile.TemporaryDirectory(prefix="parity_") as tmp:
+        print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens)...")
+        t0 = time.time()
+        folders = make_activation_dataset(
+            params, lm_cfg, tokens, f"{tmp}/acts", [layer], [layer_loc],
+            batch_size=batch_rows, chunk_size_gb=chunk_gb, n_chunks=n_chunks + 1,
+        )
+        store = ChunkStore(folders[(layer, layer_loc)])
+        harvest_s = time.time() - t0
+        n_train_rows = sum(
+            np.load(store.folder / f"{i}.npy", mmap_mode="r").shape[0]
+            for i in range(n_chunks)
+        )
+        report["harvest"] = {
+            "seconds": round(harvest_s, 1),
+            "tokens_per_sec": round(n_rows * seq_len / harvest_s, 1),
+            "train_rows": int(n_train_rows),
+        }
+        print(f"  {harvest_s:.0f}s ({report['harvest']['tokens_per_sec']:.0f} tok/s)")
+
+        # chunks stay resident in HBM across epochs: one H2D per chunk total
+        train_chunks = [store.load(i) for i in range(n_chunks)]
+        eval_chunk = store.load(n_chunks)
+
+        ensembles = {}
+        t0 = time.time()
+        for seed in seeds:
+            ens = build_ensemble(
+                FunctionalTiedSAE, jax.random.PRNGKey(seed),
+                [{"l1_alpha": float(a)} for a in l1_grid],
+                optimizer_kwargs={"learning_rate": 1e-3},
+                activation_size=d_act, n_dict_components=n_dict,
+                compute_dtype=None if quick else jnp.bfloat16,
+            )
+            losses_first = losses_last = None
+            key = jax.random.PRNGKey(100 + seed)
+            for epoch in range(n_epochs):
+                for chunk in train_chunks:
+                    key, k = jax.random.split(key)
+                    losses = ensemble_train_loop(ens, chunk, batch_size=sae_batch, key=k)
+                    if losses_first is None:
+                        losses_first = np.asarray(jax.device_get(losses["loss"]))
+                    losses_last = np.asarray(jax.device_get(losses["loss"]))
+            ensembles[seed] = ens
+            report[f"train_seed{seed}"] = {
+                "loss_first_chunk": [float(x) for x in losses_first],
+                "loss_last_chunk": [float(x) for x in losses_last],
+            }
+        report["train_seconds"] = round(time.time() - t0, 1)
+        print(f"Trained {len(seeds)} ensembles in {report['train_seconds']}s")
+
+        # -- evaluation on the held-out chunk ---------------------------------
+        t0 = time.time()
+        pareto = {}
+        for seed, ens in ensembles.items():
+            dicts = ens.to_learned_dicts()
+            rows = sm.evaluate_dicts(dicts, eval_chunk)  # vmapped P4 fan-out
+            dead = [
+                int(ld.n_feats) - sm.batched_calc_feature_n_ever_active(
+                    ld, eval_chunk, threshold=10
+                )
+                for ld in dicts
+            ]
+            pareto[seed] = [
+                {
+                    "l1_alpha": float(a), "fvu": row["fvu"], "l0": row["l0"],
+                    "r2": row["r2"], "n_dead": int(d), "n_feats": int(ld.n_feats),
+                }
+                for a, row, d, ld in zip(l1_grid, rows, dead, dicts)
+            ]
+        report["pareto"] = {str(s): p for s, p in pareto.items()}
+
+        # cross-seed MMCS at each l1: the paper's feature-consistency check
+        dicts0 = ensembles[seeds[0]].to_learned_dicts()
+        dicts1 = ensembles[seeds[1]].to_learned_dicts()
+        report["mmcs_cross_seed"] = {
+            f"{a:.2e}": float(sm.mmcs(d0, d1))
+            for a, d0, d1 in zip(l1_grid, dicts0, dicts1)
+        }
+
+        # perplexity under reconstruction: low/mid/high l1 + identity control
+        eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
+        picks = sorted({0, len(l1_grid) // 2, len(l1_grid) - 1})
+        ppl_dicts = [(dicts0[i], {"l1_alpha": float(l1_grid[i])}) for i in picks]
+        ppl_dicts.append((Identity(d_act), {"baseline": "identity"}))
+        base_loss, ppl = sm.calculate_perplexity(
+            params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
+            batch_size=4 if quick else 8,
+        )
+        report["perplexity"] = {
+            "base_lm_loss": float(base_loss),
+            "under_reconstruction": [
+                {**hp, "lm_loss": float(loss)} for hp, loss in ppl
+            ],
+        }
+        report["eval_seconds"] = round(time.time() - t0, 1)
+        report["total_seconds"] = round(time.time() - t_start, 1)
+
+        # sanity: the pareto must slope the right way, identity must be ~base
+        fvus = [p["fvu"] for p in pareto[seeds[0]]]
+        l0s = [p["l0"] for p in pareto[seeds[0]]]
+        assert fvus[-1] > fvus[0] and l0s[-1] < l0s[0], "pareto slope wrong"
+        ident_loss = report["perplexity"]["under_reconstruction"][-1]["lm_loss"]
+        assert abs(ident_loss - base_loss) < 1e-3, "identity hook changed the LM"
+
+        out_prefix = Path(args.out) if args.out else REPO
+        out_prefix.mkdir(parents=True, exist_ok=True)
+        suffix = "_quick" if quick else ""
+        json_path = out_prefix / f"PARITY_r02{suffix}.json"
+        with open(json_path, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"Wrote {json_path}")
+
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(7, 5))
+        for seed, pts in pareto.items():
+            xs = [p["l0"] for p in pts]
+            ys = [p["fvu"] for p in pts]
+            ax.plot(xs, ys, "o-", label=f"tied SAE r{ratio} seed {seed}")
+        ax.set_xlabel("mean L0 (active features/example)")
+        ax.set_ylabel("FVU")
+        ax.set_title(
+            f"FVU vs L0, l1 sweep — layer {layer} {layer_loc}, "
+            f"{report['config']['subject']}"
+        )
+        ax.legend()
+        fig_path = out_prefix / f"parity_pareto_r02{suffix}.png"
+        fig.savefig(fig_path, dpi=150, bbox_inches="tight")
+        print(f"Wrote {fig_path}")
+
+    return report
+
+
+if __name__ == "__main__":
+    main()

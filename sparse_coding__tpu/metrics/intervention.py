@@ -336,7 +336,7 @@ def calculate_perplexity(
     vmapped edited-forward per token batch; oddly-shaped dicts fall back to
     the per-dict jitted path. `vmapped=False` forces per-dict evaluation
     (lower peak memory: the vmapped forward holds n_dicts edited streams)."""
-    from sparse_coding__tpu.metrics.standard import group_stackable_dicts
+    from sparse_coding__tpu.metrics.standard import _stack_dicts, group_stackable_dicts
 
     if tokens.shape[0] == 0:
         raise ValueError(f"no token rows to evaluate (tokens.shape={tokens.shape})")
@@ -363,10 +363,7 @@ def calculate_perplexity(
                     params, lm_cfg, dicts_only[i], location, batches
                 )
             continue
-        stacked = jax.tree.map(
-            lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
-            *[dicts_only[i] for i in idxs],
-        )
+        stacked = _stack_dicts([dicts_only[i] for i in idxs])
         fn = _jitted_reconstruction_loss_vmapped(lm_cfg, location)
         per_batch = np.stack(
             [np.asarray(jax.device_get(fn(params, stacked, jnp.asarray(b)))) for b in batches]

@@ -1,0 +1,35 @@
+"""The parity artifact script stays runnable end to end (quick CPU mode —
+same code path as the committed PARITY_r02.json TPU run)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_parity_quick(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / "PARITY_r02_quick.json").read_text())
+    assert (tmp_path / "parity_pareto_r02_quick.png").exists()
+
+    for seed in ("0", "1"):
+        pts = report["pareto"][seed]
+        assert pts[-1]["fvu"] > pts[0]["fvu"]  # higher l1 → worse FVU
+        assert pts[-1]["l0"] < pts[0]["l0"]  # higher l1 → sparser
+    # identity hook must not move the LM loss
+    base = report["perplexity"]["base_lm_loss"]
+    ident = report["perplexity"]["under_reconstruction"][-1]
+    assert ident["baseline"] == "identity" and abs(ident["lm_loss"] - base) < 1e-3
+    assert set(report["mmcs_cross_seed"]) == {
+        f"{a:.2e}" for a in report["config"]["l1_grid"]
+    }
