@@ -16,6 +16,14 @@ from sparse_coding__tpu.experiments.investigate import (
     run_investigate,
     random_feature_diversity,
 )
+from sparse_coding__tpu.experiments.case_studies import (
+    dict_across_time,
+    dict_compare,
+    feature_case_study,
+    inter_dict_connections,
+    inter_layer_mcs,
+    render_case_study,
+)
 
 __all__ = [
     "run_pca_perplexity",
@@ -23,4 +31,10 @@ __all__ = [
     "run_moment_corrs",
     "run_investigate",
     "random_feature_diversity",
+    "dict_compare",
+    "dict_across_time",
+    "inter_layer_mcs",
+    "inter_dict_connections",
+    "feature_case_study",
+    "render_case_study",
 ]

@@ -1,0 +1,242 @@
+"""Scripted equivalents of the reference's analysis notebooks.
+
+The reference ships five notebook analyses (SURVEY.md §2.5 "Notebooks") with
+hard-coded cluster paths; here each is a function over `(LearnedDict,
+hyperparams)` exports + the JAX subject LM, so they run headless and are
+testable:
+
+  dict_compare            — Hungarian-matched MCS between two dictionaries
+                            (`interp_notebooks/dict_compare.ipynb`,
+                            `minimal_feature_interp.ipynb`: matched-feature
+                            histogram + count above threshold)
+  dict_across_time        — matched MCS of each training save point against
+                            the final dictionary
+                            (`interp_notebooks/dict_across_time.ipynb`)
+  inter_layer_mcs         — mean matched MCS between every pair of layers'
+                            dictionaries
+                            (`experiments/inter_layer_comparison.ipynb`)
+  inter_dict_connections  — activation-correlation matrix between two dicts'
+                            codes on shared inputs, top connections
+                            (`inter_dict_connections.ipynb`)
+  feature_case_study      — top-activating fragments with per-token
+                            activations + top output-logit tokens for one
+                            feature (`case_studies_loop.ipynb`,
+                            `interp_notebooks/feature_interp.ipynb`,
+                            `minimal_feature_interp.ipynb`)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.metrics.standard import mmcs
+
+
+def _as_matrix(d) -> jax.Array:
+    return d.get_learned_dict() if hasattr(d, "get_learned_dict") else jnp.asarray(d)
+
+
+def _matched_sims(small: jax.Array, large: jax.Array) -> Tuple[np.ndarray, np.ndarray]:
+    """Hungarian 1:1 matching of the smaller dict's atoms into the larger.
+
+    Returns (sims, assignment), BOTH in small-atom order: `sims[k]` is atom
+    k's matched cosine and `assignment[k]` the large-dict atom it matched."""
+    from scipy.optimize import linear_sum_assignment
+
+    cos = np.asarray(jnp.einsum("sd,ld->sl", small, large))
+    rows, cols = linear_sum_assignment(-cos)  # rows == arange(n_small), sorted
+    return cos[rows, cols], cols
+
+
+def dict_compare(dict_a, dict_b, threshold: float = 0.9) -> Dict[str, Any]:
+    """Hungarian-matched comparison of two dictionaries.
+
+    `matched_sims[k]` is the k-th SMALLER-dict atom's matched cosine and
+    `assignment[k]` the larger-dict atom it matched (1:1). Also reports the
+    fraction above `threshold` ("shared features") and plain MMCS both ways.
+    """
+    a, b = _as_matrix(dict_a), _as_matrix(dict_b)
+    small, large = (a, b) if a.shape[0] <= b.shape[0] else (b, a)
+    sims, assignment = _matched_sims(small, large)
+    return {
+        "matched_sims": sims,
+        "assignment": assignment,
+        "frac_shared": float((sims > threshold).mean()),
+        "n_shared": int((sims > threshold).sum()),
+        "mmcs_a_to_b": float(mmcs(a, b)),
+        "mmcs_b_to_a": float(mmcs(b, a)),
+    }
+
+
+def dict_across_time(
+    save_points: Dict[int, Any], threshold: float = 0.9
+) -> List[Dict[str, Any]]:
+    """Feature stability over training: each save point's dictionary matched
+    against the FINAL one. Returns one row per save point with the matched-MCS
+    summary (`dict_across_time.ipynb`'s across-checkpoint comparison)."""
+    if not save_points:
+        return []
+    final = _as_matrix(save_points[max(save_points)])
+    rows = []
+    for k in sorted(save_points):
+        m = _as_matrix(save_points[k])
+        small, large = (m, final) if m.shape[0] <= final.shape[0] else (final, m)
+        sims, _ = _matched_sims(small, large)
+        rows.append(
+            {
+                "save_point": k,
+                "mean_matched_mcs": float(sims.mean()),
+                "frac_shared": float((sims > threshold).mean()),
+            }
+        )
+    return rows
+
+
+def inter_layer_mcs(dicts_by_layer: Dict[int, Any]) -> Tuple[np.ndarray, List[int]]:
+    """Mean matched MCS between every pair of layers' dictionaries
+    (`inter_layer_comparison.ipynb`: do features persist across the residual
+    stream?). Returns (symmetric [L, L] matrix, layer order)."""
+    layers = sorted(dicts_by_layer)
+    mats = [_as_matrix(dicts_by_layer[l]) for l in layers]
+    n = len(layers)
+    out = np.eye(n, dtype=np.float64)
+    for i in range(n):
+        for j in range(i + 1, n):
+            a, b = mats[i], mats[j]
+            small, large = (a, b) if a.shape[0] <= b.shape[0] else (b, a)
+            sims, _ = _matched_sims(small, large)
+            out[i, j] = out[j, i] = float(sims.mean())
+    return out, layers
+
+
+def inter_dict_connections(
+    dict_up,
+    dict_down,
+    acts_up: jax.Array,
+    acts_down: jax.Array,
+    top_k: int = 10,
+    eps: float = 1e-8,
+) -> Dict[str, Any]:
+    """Correlation of two dictionaries' feature activations on shared inputs
+    (`inter_dict_connections.ipynb`): which upstream features co-fire with
+    which downstream ones. `acts_up`/`acts_down` are the SAME datapoints'
+    activations at the two hook points, row-aligned.
+
+    Returns the [n_up, n_down] Pearson matrix and the top-k strongest
+    (upstream, downstream, r) connections.
+    """
+    assert acts_up.shape[0] == acts_down.shape[0], "row-aligned inputs required"
+    cu = np.asarray(dict_up.encode(dict_up.center(acts_up)), dtype=np.float64)
+    cd = np.asarray(dict_down.encode(dict_down.center(acts_down)), dtype=np.float64)
+    cu = (cu - cu.mean(0)) / (cu.std(0) + eps)
+    cd = (cd - cd.mean(0)) / (cd.std(0) + eps)
+    corr = cu.T @ cd / cu.shape[0]
+    flat = np.argsort(-np.abs(corr), axis=None)[:top_k]
+    ups, downs = np.unravel_index(flat, corr.shape)
+    top = [(int(u), int(d), float(corr[u, d])) for u, d in zip(ups, downs)]
+    return {"correlation": corr, "top_connections": top}
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=2)
+def _encode_one_feature(ld, acts, feature):
+    """One feature's per-token activations, in the dict's centered basis
+    (encode∘center, the canonical path used by `LearnedDict.predict` and the
+    metric library)."""
+    B, L, C = acts.shape
+    c = ld.encode(ld.center(acts.reshape(B * L, C)))
+    return c.reshape(B, L, -1)[:, :, feature]
+
+
+def feature_case_study(
+    params,
+    lm_cfg,
+    learned_dict,
+    layer: int,
+    layer_loc: str,
+    fragments: np.ndarray,
+    decode_tokens: Callable[[Sequence[int]], List[str]],
+    feature: int,
+    n_top_fragments: int = 5,
+    n_top_logits: int = 10,
+    batch_size: int = 32,
+) -> Dict[str, Any]:
+    """One feature's story (`case_studies_loop.ipynb` /
+    `feature_interp.ipynb`): top-activating fragments with per-token
+    activations, plus the feature direction's top output-logit tokens
+    (direction @ unembed — only for residual-stream dicts, where the
+    direction lives in the unembed's input space).
+
+    Returns {"fragments": [(tokens, activations)...], "top_logit_tokens":
+    [(token_id, logit)...] or None}.
+    """
+    from sparse_coding__tpu.interp.pipeline import _jitted_fragment_capture
+
+    if not 0 <= feature < learned_dict.n_feats:
+        raise ValueError(
+            f"feature {feature} out of range for a {learned_dict.n_feats}-feature "
+            "dict (JAX would silently clamp the index)"
+        )
+    capture = _jitted_fragment_capture(lm_cfg, layer, layer_loc)
+    n_frags, frag_len = fragments.shape
+    pad = (-n_frags) % batch_size
+    padded = (
+        np.concatenate([fragments, np.zeros((pad, frag_len), fragments.dtype)])
+        if pad
+        else fragments
+    )
+    acts_per_frag = []
+    for start in range(0, padded.shape[0], batch_size):
+        acts = capture(params, jnp.asarray(padded[start : start + batch_size]))
+        codes = _encode_one_feature(learned_dict, acts, feature)
+        acts_per_frag.append(np.asarray(jax.device_get(codes)))
+    per_tok = np.concatenate(acts_per_frag)[:n_frags]  # [n_frags, frag_len]
+
+    order = np.argsort(-per_tok.max(axis=1))[:n_top_fragments]
+    frags = [
+        (decode_tokens(fragments[i]), [float(a) for a in per_tok[i]]) for i in order
+    ]
+
+    top_logits: Optional[List[Tuple[int, float]]] = None
+    if layer_loc == "residual":
+        # logit lens: residual directions live in the unembed's input space;
+        # tied-embedding models unembed with params["embed"] (lm.model's
+        # forward does exactly this)
+        unembed = (
+            params.get("embed")
+            if getattr(lm_cfg, "tie_word_embeddings", False)
+            else params.get("unembed")
+        )
+        if unembed is not None:
+            direction = learned_dict.get_learned_dict()[feature]
+            logits = np.asarray(jnp.asarray(unembed) @ direction)
+            top_ids = np.argsort(-logits)[:n_top_logits]
+            top_logits = [(int(t), float(logits[t])) for t in top_ids]
+    return {"fragments": frags, "top_logit_tokens": top_logits}
+
+
+def render_case_study(study: Dict[str, Any], decode_token: Optional[Callable[[int], str]] = None) -> str:
+    """Plain-text rendering of a `feature_case_study` (the notebook's
+    circuitsvis HTML, minus the HTML): tokens annotated with activations."""
+    lines = []
+    for toks, acts in study["fragments"]:
+        peak = max(acts) or 1.0
+        lines.append(
+            " ".join(
+                f"[{t}|{a:.1f}]" if a > 0.1 * peak else t
+                for t, a in zip(toks, acts)
+            )
+        )
+    if study["top_logit_tokens"]:
+        shown = [
+            decode_token(t) if decode_token else str(t)
+            for t, _ in study["top_logit_tokens"]
+        ]
+        lines.append("top output tokens: " + ", ".join(shown))
+    return "\n".join(lines)

@@ -1,0 +1,139 @@
+"""Notebook-equivalent analyses: dictionary comparison, stability over time,
+inter-layer MCS, inter-dict connections, feature case studies."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu import experiments as ex
+from sparse_coding__tpu.lm import LMConfig, init_params
+from sparse_coding__tpu.models.learned_dict import Rotation, TiedSAE
+
+
+def _unit_rows(key, n, d):
+    m = jax.random.normal(key, (n, d))
+    return m / jnp.linalg.norm(m, axis=1, keepdims=True)
+
+
+def test_dict_compare_identical_and_rotated():
+    feats = _unit_rows(jax.random.PRNGKey(0), 16, 8)
+    a = Rotation(feats)
+    same = ex.dict_compare(a, Rotation(feats))
+    assert same["frac_shared"] == 1.0
+    assert np.allclose(same["matched_sims"], 1.0, atol=1e-5)
+
+    other = Rotation(_unit_rows(jax.random.PRNGKey(1), 32, 8))
+    cross = ex.dict_compare(a, other)
+    # smaller dict's atoms each get a unique match
+    assert len(cross["matched_sims"]) == 16
+    assert cross["frac_shared"] < 1.0
+    # subset case: every atom of `a` exists inside `big` → all matched at 1
+    big = Rotation(
+        jnp.concatenate([feats, _unit_rows(jax.random.PRNGKey(2), 16, 8)])
+    )
+    sub = ex.dict_compare(a, big)
+    assert sub["frac_shared"] == 1.0
+
+
+def test_dict_across_time_monotone_identity():
+    feats = _unit_rows(jax.random.PRNGKey(0), 12, 6)
+    noisy = lambda s, k: Rotation(
+        (feats + s * jax.random.normal(jax.random.PRNGKey(k), feats.shape))
+        / jnp.linalg.norm(
+            feats + s * jax.random.normal(jax.random.PRNGKey(k), feats.shape),
+            axis=1, keepdims=True,
+        )
+    )
+    rows = ex.dict_across_time({1: noisy(1.0, 1), 4: noisy(0.3, 2), 16: Rotation(feats)})
+    assert [r["save_point"] for r in rows] == [1, 4, 16]
+    assert rows[-1]["mean_matched_mcs"] == pytest.approx(1.0, abs=1e-5)
+    assert rows[0]["mean_matched_mcs"] < rows[1]["mean_matched_mcs"]
+
+
+def test_inter_layer_mcs_matrix():
+    mk = lambda k: Rotation(_unit_rows(jax.random.PRNGKey(k), 10, 6))
+    mat, layers = ex.inter_layer_mcs({0: mk(0), 1: mk(1), 2: mk(0)})
+    assert layers == [0, 1, 2]
+    assert np.allclose(np.diag(mat), 1.0)
+    assert mat[0, 2] == pytest.approx(1.0, abs=1e-5)  # identical dicts
+    assert mat[0, 1] < 0.99
+    assert np.allclose(mat, mat.T)
+
+
+def test_inter_dict_connections_finds_shared_feature():
+    # two dicts sharing feature 0's direction; inputs fire it strongly
+    d = 8
+    feats_a = _unit_rows(jax.random.PRNGKey(0), 6, d)
+    feats_b = jnp.concatenate([feats_a[:1], _unit_rows(jax.random.PRNGKey(1), 5, d)])
+    a, b = Rotation(feats_a), Rotation(feats_b)
+    strengths = jax.random.uniform(jax.random.PRNGKey(2), (256, 1))
+    x = strengths * feats_a[0][None, :] + 0.01 * jax.random.normal(
+        jax.random.PRNGKey(3), (256, d)
+    )
+    out = ex.inter_dict_connections(a, b, x, x, top_k=3)
+    assert out["correlation"].shape == (6, 6)
+    u, v, r = out["top_connections"][0]
+    assert (u, v) == (0, 0) and r > 0.95
+
+
+def test_feature_case_study_and_render():
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=16, n_heads=2, d_mlp=32,
+        vocab_size=64, n_ctx=16, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    sae = TiedSAE(
+        jax.random.normal(jax.random.PRNGKey(1), (12, cfg.d_model)),
+        jnp.zeros((12,)),
+        norm_encoder=True,
+    )
+    fragments = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(2), (24, 8), 0, 64), dtype=np.int32
+    )
+    study = ex.feature_case_study(
+        params, cfg, sae, 1, "residual", fragments,
+        lambda row: [f"tok{int(t)}" for t in row], feature=3,
+        n_top_fragments=4, batch_size=16,
+    )
+    assert len(study["fragments"]) == 4
+    toks, acts = study["fragments"][0]
+    assert len(toks) == len(acts) == 8
+    # fragments are sorted by peak activation
+    peaks = [max(a) for _, a in study["fragments"]]
+    assert peaks == sorted(peaks, reverse=True)
+    assert study["top_logit_tokens"] is not None and len(study["top_logit_tokens"]) == 10
+
+    text = ex.render_case_study(study, decode_token=lambda t: f"tok{t}")
+    assert "top output tokens:" in text and "[" in text
+
+    # non-residual location: no logit lens (mlp hidden is d_mlp=32 wide)
+    sae_mlp = TiedSAE(
+        jax.random.normal(jax.random.PRNGKey(4), (12, cfg.d_mlp)),
+        jnp.zeros((12,)),
+        norm_encoder=True,
+    )
+    study2 = ex.feature_case_study(
+        params, cfg, sae_mlp, 1, "mlp", fragments,
+        lambda row: [f"tok{int(t)}" for t in row], feature=0, batch_size=16,
+    )
+    assert study2["top_logit_tokens"] is None
+
+    # out-of-range feature must raise, not silently clamp
+    with pytest.raises(ValueError, match="out of range"):
+        ex.feature_case_study(
+            params, cfg, sae, 1, "residual", fragments,
+            lambda row: [f"tok{int(t)}" for t in row], feature=50, batch_size=16,
+        )
+
+
+def test_dict_compare_attribution_order():
+    """matched_sims/assignment are in SMALL-atom order: atom k's entry is
+    atom k's match."""
+    d = 6
+    large = _unit_rows(jax.random.PRNGKey(0), 5, d)
+    # small atom 0 == large atom 3; small atom 1 == large atom 1
+    small = jnp.stack([large[3], large[1]])
+    cmp = ex.dict_compare(Rotation(small), Rotation(large))
+    assert list(cmp["assignment"]) == [3, 1]
+    assert np.allclose(cmp["matched_sims"], 1.0, atol=1e-5)
