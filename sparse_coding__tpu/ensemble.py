@@ -316,8 +316,13 @@ class Ensemble:
             and hasattr(self.sig, "fused_adam_step")
             and isinstance(self.optimizer_kwargs.get("learning_rate", 1e-3), (int, float))
             # the in-kernel update is vanilla Adam: refuse kwargs that change
-            # optax.adam's semantics (nesterov, eps_root, mu_dtype, ...)
-            and set(self.optimizer_kwargs) <= {"learning_rate", "b1", "b2", "eps"}
+            # optax.adam's semantics (nesterov, eps_root, ...). mu_dtype is
+            # supported — the kernel reads/writes mu in the state's dtype and
+            # accumulates in f32, exactly like optax
+            and set(self.optimizer_kwargs) <= {"learning_rate", "b1", "b2", "eps", "mu_dtype"}
+            # the kernel is only validated for f32/bf16 moment storage
+            and jnp.dtype(self.optimizer_kwargs.get("mu_dtype") or jnp.float32)
+            in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
         ):
             fused_adam = dict(
                 lr=float(self.optimizer_kwargs.get("learning_rate", 1e-3)),

@@ -299,13 +299,18 @@ class FunctionalTiedSAE:
         bias_l2 = jnp.sqrt(jnp.maximum(jnp.sum(b * b, axis=-1), 1e-24))
         l_bias_decay = buffers["bias_decay"] * bias_l2
         g_bias = g_bias + (buffers["bias_decay"] / bias_l2)[:, None] * b
-        mu_b = b1 * adam_st.mu["encoder_bias"] + (1.0 - b1) * g_bias
+        # optax semantics (incl. mu_dtype=bfloat16): `b1 * mu` in the storage
+        # dtype, sum in f32, the bias-corrected update uses the UNcast mu,
+        # storage is cast back — expression shape mirrors optax's
+        # update_moment lambda for bit parity
+        mu_b_prev = adam_st.mu["encoder_bias"]
+        mu_b = (1.0 - b1) * g_bias + b1 * mu_b_prev
         nu_b = b2 * adam_st.nu["encoder_bias"] + (1.0 - b2) * g_bias * g_bias
         bias_new = b - lr * (mu_b / bc1[:, None]) / (jnp.sqrt(nu_b / bc2[:, None]) + eps)
         new_params = {"encoder": d_new, "encoder_bias": bias_new}
         new_adam = adam_st._replace(
             count=t,
-            mu={"encoder": mu_d, "encoder_bias": mu_b},
+            mu={"encoder": mu_d, "encoder_bias": mu_b.astype(mu_b_prev.dtype)},
             nu={"encoder": nu_d, "encoder_bias": nu_b},
         )
         new_opt_state = (new_adam,) + tuple(opt_state[1:])

@@ -113,45 +113,61 @@ def _bwd_kernel(l1b_ref, x_ref, dxh_ref, d_ref, nrm_ref, c_ref, gd_ref, gb_ref):
 
 
 def _bwd_adam_kernel(
-    l1b_ref, hp_ref, bc_ref, x_ref, dxh_ref, dhat_ref, nrm_ref, c_ref,
+    l1b_ref, hp_ref, bc_ref, x_ref, dxh_ref, nrm_ref, c_ref,
     draw_ref, mu_ref, nu_ref,
     dnew_ref, munew_ref, nunew_ref, gb_ref,
 ):
     """`_bwd_kernel` + the Adam update for the encoder, all in VMEM: the
     encoder gradient is consumed by the moment/param updates without ever
-    being written to HBM.
+    being written to HBM. The normalized dictionary tile is DERIVED from the
+    raw-encoder tile already resident for Adam (draw/nrm) instead of being a
+    separate HBM stream — one fewer [M, N, D] read per step.
 
-    Extra prefetch: hp_ref [4] f32 = (lr, b1, b2, eps); bc_ref [M, 2] f32 =
-    per-member bias corrections (1-b1^t, 1-b2^t). Extra blocks: draw/mu/nu
-    [1, Nt, D] f32 (raw encoder + Adam moments), outputs dnew/munew/nunew.
+    Extra prefetch: hp_ref [6] f32 = (lr, b1, b2, eps, 1-b1, 1-b2), the
+    complements computed in python-float precision by the caller (see the
+    moment-update comment below); bc_ref [M, 2] f32 =
+    per-member bias corrections (1-b1^t, 1-b2^t). Blocks: draw [1, Nt, D]
+    f32 raw encoder; mu/nu [1, Nt, D] Adam moments (mu may be bf16 when the
+    optimizer uses `mu_dtype=bfloat16`); outputs dnew/munew/nunew.
     """
     m = pl.program_id(0)
     x = x_ref[:]
     dxh = dxh_ref[0]
-    dj = dhat_ref[0]
     cj = c_ref[0]
+    nrm_col = nrm_ref[0, 0, :][:, None]
+    # normalized rows derived in VMEM (fp32 divide + bf16 round, bit-identical
+    # to the old separate d_hat-bf16 HBM stream and to `_bwd_kernel`'s tile)
+    dj = (draw_ref[0] / nrm_col).astype(bf16)
+    djf = dj.astype(f32)
     dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
     dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
     dcb = dc.astype(bf16)
     g_dhat = jax.lax.dot_general(
         cj, dxh, (((0,), (0,)), ((), ())), preferred_element_type=f32
     ) + jax.lax.dot_general(dcb, x, (((0,), (0,)), ((), ())), preferred_element_type=f32)
-    djf = dj.astype(f32)
     radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
-    g = (g_dhat - djf * radial) / nrm_ref[0, 0, :][:, None]
+    g = (g_dhat - djf * radial) / nrm_col
     gb_ref[0, 0, :] = jnp.sum(dc, axis=0)
 
     lr, b1, b2, eps = hp_ref[0], hp_ref[1], hp_ref[2], hp_ref[3]
-    mu = b1 * mu_ref[0] + (1.0 - b1) * g
-    nu = b2 * nu_ref[0] + (1.0 - b2) * g * g
+    # hp[4]/hp[5] are (1-b1)/(1-b2) computed in PYTHON floats by the caller:
+    # optax's update_moment uses python-float complements, and f32 `1.0 - b1`
+    # differs from them by one ulp. `b1 * mu` runs in the STORAGE dtype (for
+    # mu_dtype=bfloat16 that means a bf16-rounded b1 and product), only the
+    # sum in f32 — mirroring optax bit-for-bit.
+    mu = (b1.astype(mu_ref.dtype) * mu_ref[0]).astype(f32) + hp_ref[4] * g
+    nu = b2 * nu_ref[0] + hp_ref[5] * g * g
     mhat = mu / bc_ref[m, 0]
     vhat = nu / bc_ref[m, 1]
-    munew_ref[0, :, :] = mu
+    munew_ref[0, :, :] = mu.astype(munew_ref.dtype)
     nunew_ref[0, :, :] = nu
     dnew_ref[0, :, :] = draw_ref[0] - lr * mhat / (jnp.sqrt(vhat) + eps)
 
 
-@partial(jax.jit, static_argnames=("batch_tile", "dict_tile", "interpret"))
+@partial(
+    jax.jit,
+    static_argnames=("lr", "b1", "b2", "eps", "batch_tile", "dict_tile", "interpret"),
+)
 def tied_sae_adam_step_stacked(
     d_raw: jax.Array,
     bias: jax.Array,
@@ -213,7 +229,10 @@ def tied_sae_adam_step_stacked(
     )(xb, db, b3)
 
     l1_over_b = (jnp.asarray(l1_alpha, f32) / B).reshape(M)
-    hp = jnp.asarray([lr, b1, b2, eps], f32)
+    # lr/b1/b2/eps are STATIC (python floats at trace time), so `1 - b1` here
+    # is python-double subtraction rounded once to f32 — the same value
+    # optax's update_moment uses; a traced f32 `1.0 - b1` would be ~3 ulp off
+    hp = jnp.asarray([lr, b1, b2, eps, 1 - b1, 1 - b2], f32)
     tile3 = lambda m, j, *_: (m, j, 0)
     d_new, mu_new, nu_new, g_bias = pl.pallas_call(
         _bwd_adam_kernel,
@@ -223,7 +242,6 @@ def tied_sae_adam_step_stacked(
             in_specs=[
                 pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
                 pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
-                pl.BlockSpec((1, dict_tile, D), tile3),
                 pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
                 pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
                 pl.BlockSpec((1, dict_tile, D), tile3),
@@ -239,7 +257,7 @@ def tied_sae_adam_step_stacked(
         ),
         out_shape=[
             jax.ShapeDtypeStruct((M, N, D), f32),
-            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, N, D), mu_d.dtype),
             jax.ShapeDtypeStruct((M, N, D), f32),
             jax.ShapeDtypeStruct((M, 1, N), f32),
         ],
@@ -247,9 +265,9 @@ def tied_sae_adam_step_stacked(
         # a scanned train step the carry must live in fixed buffers, and
         # without aliasing XLA inserts a 67 MB copy per array per step
         # (indices count the scalar-prefetch operands)
-        input_output_aliases={8: 0, 9: 1, 10: 2},
+        input_output_aliases={7: 0, 8: 1, 9: 2},
         interpret=interpret,
-    )(l1_over_b, hp, bc.astype(f32), xb, dxh, db, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d)
+    )(l1_over_b, hp, bc.astype(f32), xb, dxh, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d)
 
     l_rec = lrec[:, 0] / (B * D)
     l_l1_raw = ll1[:, 0] / B

@@ -130,3 +130,68 @@ def test_step_scan_matches_sequential_steps():
         np.asarray(jax.device_get(b.state.params["encoder"])),
         rtol=1e-6,
     )
+
+
+def test_bf16_mu_update_formula_matches_optax_exactly():
+    """The moment arithmetic the kernel implements for mu_dtype=bfloat16 —
+    `b1` and the `b1*mu` product rounded through bf16, sum in f32 — is
+    BIT-identical to optax's update_moment lambda."""
+    g = jax.random.normal(jax.random.PRNGKey(0), (4096,), jnp.float32)
+    mu = jax.random.normal(jax.random.PRNGKey(1), (4096,)).astype(jnp.bfloat16)
+    b1 = 0.9
+    optax_mu = (1 - b1) * g + b1 * mu  # the update_moment expression
+    assert optax_mu.dtype == jnp.float32
+    # the kernel receives b1 and the PYTHON-computed complement as f32 scalars
+    b1_f32 = jnp.float32(b1)
+    omb1_f32 = jnp.float32(1 - b1)
+    kernel_mu = (b1_f32.astype(mu.dtype) * mu).astype(jnp.float32) + omb1_f32 * g
+    np.testing.assert_array_equal(np.asarray(optax_mu), np.asarray(kernel_mu))
+    # and the stored value is the bf16 cast of that same sum
+    np.testing.assert_array_equal(
+        np.asarray(optax_mu.astype(jnp.bfloat16), np.float32),
+        np.asarray(kernel_mu.astype(jnp.bfloat16), np.float32),
+    )
+
+
+def test_fused_adam_step_matches_optax_bf16_mu(stacked):
+    """mu_dtype=bfloat16: step 1 must match optax exactly like the fp32 test
+    (the uncast mu drives the update, so bf16 storage cannot move params);
+    step 2 from state-synced inputs exercises the bf16 mu read-back."""
+    params, buffers, batch = stacked
+    tx = optax.adam(1e-3, mu_dtype=jnp.bfloat16)
+    os0 = jax.vmap(tx.init)(params)
+    assert os0[0].mu["encoder"].dtype == jnp.bfloat16
+
+    grads, _ld = FunctionalTiedSAE.fused_grads_stacked(
+        params, buffers, batch, interpret=True
+    )
+    upd, os_ref = jax.vmap(tx.update)(grads, os0, params)
+    p_ref = optax.apply_updates(params, upd)
+    p_f, os_f, _ld = FunctionalTiedSAE.fused_adam_step(
+        params, buffers, batch, os0, 1e-3, 0.9, 0.999, 1e-8, interpret=True
+    )
+    assert os_f[0].mu["encoder"].dtype == jnp.bfloat16
+    for k in ["encoder", "encoder_bias"]:
+        a, b = np.asarray(p_ref[k]), np.asarray(p_f[k])
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 1e-5, k
+        ma = np.asarray(os_ref[0].mu[k]).astype(np.float32)
+        mb = np.asarray(os_f[0].mu[k]).astype(np.float32)
+        # stored bf16 moments: identical up to one ulp where the two paths'
+        # f32 gradients (different dict tilings) straddle a rounding boundary
+        assert np.abs(ma - mb).max() / (np.abs(ma).max() + 1e-12) < 1e-2, k
+
+    # step 2 from the SAME state on both sides: the kernel reads the bf16 mu
+    # it wrote; residual diff is only gradient tile-order noise through
+    # Adam's normalization
+    grads2, _ = FunctionalTiedSAE.fused_grads_stacked(
+        p_ref, buffers, batch, interpret=True
+    )
+    upd2, os_ref2 = jax.vmap(tx.update)(grads2, os_ref, p_ref)
+    p_ref2 = optax.apply_updates(p_ref, upd2)
+    p_f2, os_f2, _ = FunctionalTiedSAE.fused_adam_step(
+        p_ref, buffers, batch, os_ref, 1e-3, 0.9, 0.999, 1e-8, interpret=True
+    )
+    assert os_f2[0].mu["encoder"].dtype == jnp.bfloat16
+    for k in ["encoder", "encoder_bias"]:
+        a, b = np.asarray(p_ref2[k]), np.asarray(p_f2[k])
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 1e-3, k
