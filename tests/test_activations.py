@@ -129,3 +129,28 @@ def test_ioi_dataset():
     assert clean.shape[0] == 10
     # clean and corrupted differ only in the name ordering
     assert (clean != corrupted).any(axis=1).all()
+
+
+def test_harvest_with_mesh_matches_unsharded(tmp_path, tiny_lm, tokens, devices):
+    """The sequence-parallel (ring attention) harvest path must write the
+    same chunks as the single-device path — the wiring check on top of
+    test_lm's exact ring-vs-dense attention match."""
+    from sparse_coding__tpu.parallel import make_mesh
+
+    cfg, params = tiny_lm
+    kw = dict(
+        layers=[1], layer_locs=["residual"], batch_size=16,
+        chunk_size_gb=_tiny_chunk_gb(16 * 16, cfg.d_model), n_chunks=2,
+    )
+    plain = make_activation_dataset(params, cfg, tokens, tmp_path / "plain", **kw)
+    mesh = make_mesh(1, 8, 1)
+    sharded = make_activation_dataset(
+        params, cfg, tokens, tmp_path / "mesh", mesh=mesh, **kw
+    )
+    plain_store = ChunkStore(plain[(1, "residual")])
+    sharded_store = ChunkStore(sharded[(1, "residual")])
+    for i in range(2):
+        a = np.asarray(plain_store.load(i))
+        b = np.asarray(sharded_store.load(i))
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, atol=2e-3)
