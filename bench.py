@@ -84,6 +84,27 @@ def bench_harvest() -> float:
     return n_tokens / dt
 
 
+def bench_fista() -> float:
+    """Codes/sec through the auto-selected FISTA solver (the fork's hot inner
+    loop: 500 iterations of two matmuls + shrinkage per solve,
+    `fista.py:99-128`) at the bench dictionary shape — `fista_solve` picks
+    the VMEM kernel or the XLA loop per shape."""
+    from sparse_coding__tpu.ops.fista_pallas import fista_solve
+
+    d = jax.random.normal(jax.random.PRNGKey(0), (N_DICT, D_ACT))
+    d = d / jnp.linalg.norm(d, axis=1, keepdims=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, D_ACT))
+    solve = jax.jit(lambda xx, dd: fista_solve(xx, dd, 1e-3, None, num_iter=500)[0])
+    jax.device_get(solve(x, d)).sum()  # warmup/compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ahat = solve(x, d)
+    jax.device_get(ahat).sum()
+    dt = time.perf_counter() - t0
+    return reps * BATCH / dt
+
+
 def bench_stream() -> float:
     """Rows/sec through `ChunkStore.iter_chunks` (disk → host → HBM with
     double-buffered prefetch), fenced by an on-device reduction per chunk."""
@@ -164,6 +185,7 @@ def main():
     # chunk-store streaming — reported as extra fields on the one JSON line
     harvest_tps = bench_harvest()
     stream_rps = bench_stream()
+    fista_cps = bench_fista()
     print(
         json.dumps(
             {
@@ -175,6 +197,7 @@ def main():
                 "device": jax.devices()[0].device_kind,
                 "harvest_tokens_per_sec": round(harvest_tps, 1),
                 "stream_rows_per_sec": round(stream_rps, 1),
+                "fista500_codes_per_sec": round(fista_cps, 1),
             }
         )
     )

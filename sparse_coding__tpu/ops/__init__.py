@@ -1,1 +1,6 @@
-from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu
+from sparse_coding__tpu.ops.fista_pallas import (
+    fista_pallas,
+    fista_solve,
+    on_tpu,
+    pallas_fits,
+)

@@ -45,24 +45,29 @@ def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
     tensor from the gradient step (warm start for FISTA, exactly as the
     reference reuses `aux_buffer["c"]`, `big_sweep.py:177`).
 
-    `use_pallas`: None → auto (the VMEM-resident `ops.fista_pallas` kernel on
-    TPU, plain jnp elsewhere). The kernel composes with the ensemble vmap —
+    `use_pallas`: None → auto: on TPU the VMEM-resident `ops.fista_pallas`
+    kernel where the shape fits its VMEM budget, the XLA loop otherwise
+    (`ops.fista_pallas.pallas_fits` — at large dictionaries the kernel's
+    shrunken tiles starve the MXU and plain XLA is measured 3.2x faster);
+    True/False force one path. The kernel composes with the ensemble vmap —
     the model axis becomes an extra grid dimension.
 
     Cached by `(num_iter, use_pallas)` so repeated `ensemble_train_loop` calls
     across a sweep's chunks reuse one jit object (and XLA's compile cache)
     instead of re-tracing the 500-iteration solve every chunk.
     """
-    if use_pallas is None:
-        from sparse_coding__tpu.ops.fista_pallas import on_tpu
-
-        use_pallas = on_tpu()
-    return _cached_fista_decoder_update(num_iter, use_pallas)
+    return _cached_fista_decoder_update(num_iter, "auto" if use_pallas is None else use_pallas)
 
 
 @lru_cache(maxsize=None)
-def _cached_fista_decoder_update(num_iter: int, use_pallas: bool) -> Callable:
+def _cached_fista_decoder_update(num_iter: int, use_pallas) -> Callable:
     def solve(batch, learned_dict, l1_alpha, c_m):
+        if use_pallas == "auto":
+            # one shared selector (trace-time shapes); on CPU it always takes
+            # the XLA path, so no interpret flag is needed here
+            from sparse_coding__tpu.ops.fista_pallas import fista_solve
+
+            return fista_solve(batch, learned_dict, l1_alpha, c_m, num_iter)
         if use_pallas:
             from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu
 

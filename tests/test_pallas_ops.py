@@ -73,3 +73,34 @@ def test_fista_decoder_update_pallas_path(planted):
     np.testing.assert_allclose(
         np.asarray(s1.params["decoder"]), np.asarray(s2.params["decoder"]), atol=1e-4
     )
+
+
+def test_pallas_fits_heuristic():
+    from sparse_coding__tpu.ops.fista_pallas import pallas_fits
+
+    # small dictionaries fit the VMEM-resident kernel
+    assert pallas_fits(256, 512, 128)
+    # the bench shape measured-OOMs at the default tile — must not fit
+    assert not pallas_fits(2048, 4096, 512)
+
+
+def test_fista_solve_matches_fista():
+    """The auto selector's XLA branch (and the None-coefficients default)
+    must match the plain solver exactly. Uses a shape pallas_fits REJECTS so
+    the XLA fallback is the branch under test on every backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_coding__tpu.models.fista import fista
+    from sparse_coding__tpu.ops.fista_pallas import fista_solve, pallas_fits
+
+    B, N, D = 256, 2048, 512
+    assert not pallas_fits(B, N, D)  # guarantees the XLA branch below
+    d = jax.random.normal(jax.random.PRNGKey(0), (N, D))
+    d = d / jnp.linalg.norm(d, axis=1, keepdims=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    a1, r1 = fista_solve(x, d, 1e-3, None, num_iter=20)
+    a2, r2 = fista(x, d, 1e-3, jnp.zeros((B, N)), 20)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-6)
