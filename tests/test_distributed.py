@@ -2,7 +2,6 @@
 needs a real pod; these pin the single-host contract it degrades to)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
