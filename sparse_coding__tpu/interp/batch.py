@@ -113,6 +113,7 @@ def run_many(
             pipeline.interpret(
                 df, loc, cfg.n_feats_explain, client=ctx.client,
                 fragment_len=ctx.fragments.shape[1],
+                max_concurrent=cfg.max_concurrent,
             )
         todo.clear()
 
@@ -132,6 +133,7 @@ def run_many(
                 pipeline.interpret(
                     df, loc, cfg.n_feats_explain,
                     client=ctx.client, fragment_len=ctx.fragments.shape[1],
+                    max_concurrent=cfg.max_concurrent,
                 )
                 continue
             print(f"{name}: cached dataframe lacks requested features, remaking")

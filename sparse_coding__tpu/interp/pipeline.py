@@ -234,25 +234,33 @@ def interpret(
     n_feats_to_explain: int,
     client: Optional[InterpClient] = None,
     fragment_len: int = OPENAI_FRAGMENT_LEN,
+    max_concurrent: int = 1,
 ):
     """Explain + simulate + score each feature; save per-feature folders
     (reference `interpret`, `interpret.py:265-386`). Skips features whose
-    folder already exists (resume, `:267-269`)."""
+    folder already exists (resume, `:267-269`).
+
+    `max_concurrent` > 1 runs features on a thread pool — the reference's
+    async `MAX_CONCURRENT` fan-out (`interpret.py:337,354`) for API-bound
+    clients (explain/simulate block on HTTP; per-feature folders make the
+    writes independent). The default stays serial: the offline client is
+    CPU-bound and deterministic ordering keeps logs readable."""
     client = client or default_client()
     save_folder = Path(save_folder)
-    for feat_n in range(n_feats_to_explain):
+
+    def one(feat_n: int):
         folder = save_folder / f"feature_{feat_n}"
         # complete = explanation written, or an explicit no-data placeholder;
         # a bare folder from a crashed run is retried
         if (folder / "explanation.txt").exists() or (folder / "no_data").exists():
             print(f"Feature {feat_n} already exists, skipping")
-            continue
+            return
         record = select_records(base_df, feat_n, fragment_len)
         if record is None:
             folder.mkdir(parents=True, exist_ok=True)
             (folder / "no_data").touch()  # placeholder = don't recompute
             print(f"Skipping feature {feat_n} due to lack of activating examples")
-            continue
+            return
 
         train = record.train_records()
         valid = record.valid_records()
@@ -282,6 +290,16 @@ def interpret(
                 f"{explanation}\nScore: {score:.2f}\n"
                 f"Top only score: {top_only:.2f}\nRandom only score: {random_only:.2f}\n"
             )
+
+    if max_concurrent <= 1:
+        for feat_n in range(n_feats_to_explain):
+            one(feat_n)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=max_concurrent) as pool:
+        # list() surfaces worker exceptions instead of dropping them
+        list(pool.map(one, range(n_feats_to_explain)))
 
 
 def read_results(save_folder) -> pd.DataFrame:
@@ -331,5 +349,6 @@ def run(feature_dict, cfg, params, lm_cfg, fragments, decode_tokens,
         fragments, decode_tokens, n_feats=cfg.df_n_feats, save_loc=cfg.save_loc,
     )
     interpret(df, cfg.save_loc, cfg.n_feats_explain, client=client,
-              fragment_len=fragments.shape[1])
+              fragment_len=fragments.shape[1],
+              max_concurrent=cfg.max_concurrent)
     return read_results(cfg.save_loc)

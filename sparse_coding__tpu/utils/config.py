@@ -258,6 +258,9 @@ class InterpArgs(BaseArgs):
     token_strs: str = ""
     dataset_name: str = "openwebtext"
     results_base: str = "auto_interp_results"  # reference BASE_FOLDER
+    # >1: thread-pool fan-out of per-feature explain/simulate API calls
+    # (the reference's async MAX_CONCURRENT, `interpret.py:59,337,354`)
+    max_concurrent: int = 1
 
     def validate(self):
         if self.sort_mode not in ("max", "mean"):

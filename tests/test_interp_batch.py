@@ -220,3 +220,20 @@ def test_scores_from_completion_logprobs():
     tokens = ["7", "\n", "2020", "\t", "3"]
     tops = [{"7": 0.0}, {}, {"2020": 0.0}, {}, {"3": 0.0}]
     assert interp.scores_from_completion_logprobs(tokens, tops, 2) == [7.0, 3.0]
+
+
+def test_interpret_concurrent_matches_serial(tmp_path, setup):
+    """max_concurrent > 1 (the reference's async fan-out) must produce the
+    same per-feature results as the serial path."""
+    cfg, params, saes, fragments, decode = setup
+    df = interp.make_feature_activation_dataset(
+        params, cfg, saes[0], 1, "residual", fragments, decode, batch_size=16
+    )
+    interp.interpret(df, tmp_path / "serial", n_feats_to_explain=4,
+                     client=interp.TokenLexiconClient(), fragment_len=8)
+    interp.interpret(df, tmp_path / "pool", n_feats_to_explain=4,
+                     client=interp.TokenLexiconClient(), fragment_len=8,
+                     max_concurrent=4)
+    a = interp.read_results(tmp_path / "serial")
+    b = interp.read_results(tmp_path / "pool")
+    pd.testing.assert_frame_equal(a, b)
