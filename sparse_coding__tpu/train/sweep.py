@@ -87,6 +87,16 @@ def unstacked_to_learned_dicts(
     return learned_dicts
 
 
+def _n_ever_active_gt1(ld, batch):
+    """Features active more than once on the sample — the single-pass form of
+    `batched_calc_feature_n_ever_active(threshold=1)` (which encodes RAW
+    activations, no centering — reference `standard_metrics.py:444-452`),
+    written as a `fn(ld, batch) -> scalar` so `evaluate_dicts` can vmap it
+    over a stack."""
+    c = ld.encode(batch)
+    return ((c != 0).sum(axis=0) > 1).sum()
+
+
 def log_sweep_metrics(
     learned_dicts: List[Tuple[Any, Dict[str, Any]]],
     chunk: jax.Array,
@@ -105,9 +115,21 @@ def log_sweep_metrics(
     sample = chunk[idx]
 
     results: Dict[str, Any] = {"n_active": {}, "mmcs_grids": {}}
-    for ld, setting in learned_dicts:
+    # P4 fan-out: vmapped over stacks of same-shaped dicts instead of a
+    # per-dict Python loop. Groups of ≤8 bound the transient
+    # [group, n_samples, n_feats] code tensor (this runs mid-training with
+    # the ensembles resident in HBM)
+    rows: List[Dict[str, float]] = []
+    for g in range(0, len(learned_dicts), 8):
+        rows.extend(
+            sm.evaluate_dicts(
+                [ld for ld, _ in learned_dicts[g : g + 8]], sample,
+                {"n_active": _n_ever_active_gt1},
+            )
+        )
+    for (ld, setting), row in zip(learned_dicts, rows):
         name = make_hyperparam_name(setting)
-        n_ever = sm.batched_calc_feature_n_ever_active(ld, sample, threshold=1)
+        n_ever = int(row["n_active"])
         results["n_active"][name] = {
             "n_active": n_ever,
             "prop_active": n_ever / ld.n_feats,
