@@ -124,6 +124,7 @@ def make_activation_dataset(
     skip_chunks: int = 0,
     center_dataset: bool = False,
     mesh=None,
+    seq_attn: str = "ring",
     single_folder: bool = False,
 ) -> Dict[Tuple[int, str], Path]:
     """Run the subject LM over `tokens` `[N, S]`, capturing every requested
@@ -132,7 +133,8 @@ def make_activation_dataset(
     Returns {(layer, loc): folder}. `skip_chunks` resumes after a partial run
     (reference `:351-358`); `center_dataset` subtracts the first chunk's mean
     from all chunks (reference `:308-311, 379-381`); `mesh` switches the
-    forward to ring-attention sequence parallelism.
+    forward to sequence parallelism (`seq_attn`: "ring" | "ulysses",
+    `lm.ring_attention`).
     """
     names = {
         (layer, loc): lm_model.make_tensor_name(layer, loc)
@@ -164,7 +166,8 @@ def make_activation_dataset(
         # fp16 cast is jitted AROUND seq_fn so XLA fuses it like the
         # single-device path (halved fetch bytes, no transient fp32 copy)
         seq_fn = make_sequence_parallel_fn(
-            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at
+            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at,
+            attn=seq_attn,
         )
 
         @jax.jit

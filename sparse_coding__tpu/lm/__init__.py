@@ -15,4 +15,5 @@ from sparse_coding__tpu.lm.ring_attention import (
     make_sequence_parallel_fn,
     ring_attention,
     sequence_parallel_forward,
+    ulysses_attention,
 )

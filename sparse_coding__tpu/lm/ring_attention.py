@@ -1,17 +1,27 @@
-"""Ring attention: sequence-parallel exact attention over a mesh axis.
+"""Sequence-parallel exact attention over a mesh axis: ring and all-to-all.
 
 Long-context support for the subject LM (SURVEY.md §5 notes the reference has
 none by construction — sequences are capped at 256 tokens,
-`activation_dataset.py:39` — but long-context is first-class here). The
-sequence is sharded across a mesh axis; each device holds a `[B, S/p, H, Dh]`
-block of Q/K/V. K/V blocks rotate around the ring via `lax.ppermute` (ICI
-neighbor exchange) while each device accumulates its queries' attention with a
-numerically-stable online softmax — communication overlaps compute, memory is
-O(S/p), and the result is EXACTLY dense causal attention (verified by
-`tests/test_lm.py::test_ring_attention_matches_dense`).
+`activation_dataset.py:39` — but long-context is first-class here). Two
+strategies, both EXACTLY dense causal attention (verified against the dense
+forward in `tests/test_lm.py`):
 
-Use through `sequence_parallel_forward`, which shard_maps the full LM forward
-with `attn_impl=ring_attention(axis)` and global position offsets per shard.
+  `ring_attention` — each device holds a `[B, S/p, H, Dh]` block of Q/K/V;
+  K/V blocks rotate around the ring via `lax.ppermute` (ICI neighbor
+  exchange) while each device accumulates its queries' attention with a
+  numerically-stable online softmax. Communication overlaps compute, memory
+  stays O(S/p) — the choice for very long sequences.
+
+  `ulysses_attention` — DeepSpeed-Ulysses-style: two `lax.all_to_all`s swap
+  the sequence shard for a HEAD-group shard, so each device runs plain dense
+  attention over the FULL sequence for H/p of the heads, then swaps back.
+  O(S²/p) score memory per device but only 2 collectives per layer (one
+  stacked QKV scatter + one gather, vs ring's p-1 permutes) — the choice
+  when heads are plentiful and S is moderate. Requires n_heads % p == 0.
+
+Use through `sequence_parallel_forward` / `make_sequence_parallel_fn`
+(`attn="ring" | "ulysses"`), which shard_map the full LM forward with the
+chosen `attn_impl` and global position offsets per shard.
 """
 
 from __future__ import annotations
@@ -74,6 +84,45 @@ def ring_attention(axis_name: str) -> Callable:
     return attn
 
 
+def ulysses_attention(axis_name: str) -> Callable:
+    """Build an `attn_impl(q, k, v, causal=True)` running all-to-all
+    (Ulysses-style) sequence parallelism over `axis_name`. Must be called
+    inside `shard_map` over that axis; requires `H % axis_size == 0`.
+
+    Q/K/V arrive sequence-sharded `[B, S/p, H, Dh]` with rotary already
+    applied at GLOBAL positions (the caller passes per-shard offsets), so
+    after the head-scatter all-to-all the full-sequence blocks are exactly
+    the dense layout restricted to H/p heads."""
+
+    def attn(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+        p = jax.lax.psum(1, axis_name)  # static under shard_map
+        B, S_local, H, Dh = q.shape
+        if H % p != 0:
+            raise ValueError(
+                f"ulysses attention needs n_heads ({H}) divisible by the "
+                f"sequence axis size ({p}); use ring attention instead"
+            )
+        # sequence-shard → head-shard in ONE collective: Q/K/V stacked on a
+        # leading axis, head axis split p ways, full sequence gathered
+        # (received blocks concatenate in axis order = global token order)
+        qkv = jnp.stack([q, k, v])  # [3, B, S_local, H, Dh]
+        qg, kg, vg = jax.lax.all_to_all(
+            qkv, axis_name, split_axis=3, concat_axis=2, tiled=True
+        )  # each [B, S, H/p, Dh]
+        # the gathered blocks are exactly the dense layout restricted to H/p
+        # heads — reuse the dense kernel so the two paths cannot diverge
+        out = lm_model.dense_attention(qg, kg, vg, causal=causal)
+        # head-shard → sequence-shard
+        return jax.lax.all_to_all(
+            out.astype(q.dtype), axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    return attn
+
+
+ATTN_IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
 def make_sequence_parallel_fn(
     cfg: lm_model.LMConfig,
     mesh: Mesh,
@@ -81,15 +130,20 @@ def make_sequence_parallel_fn(
     cache_names: Optional[Sequence[str]] = None,
     hooks: Optional[Dict[str, Callable]] = None,
     stop_at_layer: Optional[int] = None,
+    attn: str = "ring",
 ) -> Callable:
     """Build ONCE a reusable `fn(params, tokens) -> (out, cache)` that runs
     the sequence-sharded forward. Calling the returned fn repeatedly hits
     JAX's compilation cache (building a fresh `shard_map` closure per batch
-    would retrace + recompile the whole LM every call)."""
+    would retrace + recompile the whole LM every call). `attn` selects the
+    parallel-attention strategy ("ring" | "ulysses", see module docstring)."""
     from jax.experimental.shard_map import shard_map
 
     cache_names = tuple(cache_names or ())
     n_shards = mesh.shape[axis_name]
+    if attn not in ATTN_IMPLS:
+        raise ValueError(f"unknown attn {attn!r}, expected one of {sorted(ATTN_IMPLS)}")
+    attn_impl = ATTN_IMPLS[attn](axis_name)
 
     def local_fn(params, tok_shard):
         idx = jax.lax.axis_index(axis_name)
@@ -102,7 +156,7 @@ def make_sequence_parallel_fn(
             hooks=hooks,
             cache_names=cache_names,
             stop_at_layer=stop_at_layer,
-            attn_impl=ring_attention(axis_name),
+            attn_impl=attn_impl,
             positions=positions,
         )
 
@@ -141,6 +195,7 @@ def sequence_parallel_forward(
     cache_names: Optional[Sequence[str]] = None,
     hooks: Optional[Dict[str, Callable]] = None,
     stop_at_layer: Optional[int] = None,
+    attn: str = "ring",
 ) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
     """One-shot convenience over `make_sequence_parallel_fn`.
 
@@ -152,6 +207,6 @@ def sequence_parallel_forward(
     `make_sequence_parallel_fn`.
     """
     fn = make_sequence_parallel_fn(
-        cfg, mesh, axis_name, cache_names, hooks, stop_at_layer
+        cfg, mesh, axis_name, cache_names, hooks, stop_at_layer, attn
     )
     return fn(params, tokens)

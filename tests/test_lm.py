@@ -178,3 +178,59 @@ def test_ring_attention_gpt2_and_hooks(devices):
     np.testing.assert_allclose(
         np.asarray(ring_hooked), np.asarray(dense_hooked), atol=2e-4
     )
+
+
+def test_ulysses_attention_matches_dense(devices):
+    """All-to-all (Ulysses) sequence parallelism over 8 shards == dense; the
+    head axis (8) divides the shard count."""
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=64, n_heads=8, d_mlp=128,
+        vocab_size=64, n_ctx=128, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 64)
+    mesh = make_mesh(1, 8, 1, devices=devices)
+
+    name = "blocks.1.hook_resid_post"
+    dense_logits, dense_cache = forward(params, tokens, cfg, cache_names=[name])
+    uly_logits, uly_cache = sequence_parallel_forward(
+        params, tokens, cfg, mesh, axis_name="data", cache_names=[name],
+        attn="ulysses",
+    )
+    np.testing.assert_allclose(
+        np.asarray(uly_logits), np.asarray(dense_logits), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(uly_cache[name]), np.asarray(dense_cache[name]), atol=2e-4
+    )
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    cfg = LMConfig(
+        arch="neox", n_layers=1, d_model=32, n_heads=4, d_mlp=64,
+        vocab_size=64, n_ctx=128, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0, 64)
+    mesh = make_mesh(1, 8, 1, devices=devices)
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_parallel_forward(params, tokens, cfg, mesh, attn="ulysses")
+
+
+def test_ulysses_gpt2_and_hooks(devices):
+    """Ulysses also handles gpt2 (learned pos-embed) and shard-local hooks."""
+    cfg = LMConfig(
+        arch="gpt2", n_layers=1, d_model=32, n_heads=8, d_mlp=64,
+        vocab_size=32, n_ctx=64, tie_word_embeddings=True,
+    )
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 32), 0, 32)
+    mesh = make_mesh(1, 8, 1, devices=devices)
+    name = "blocks.0.hook_resid_post"
+    dense_hooked = forward(params, tokens, cfg, hooks={name: lambda t: t * 0.5})[0]
+    uly_hooked, _ = sequence_parallel_forward(
+        params, tokens, cfg, mesh, hooks={name: lambda t: t * 0.5}, attn="ulysses"
+    )
+    np.testing.assert_allclose(
+        np.asarray(uly_hooked), np.asarray(dense_hooked), atol=2e-4
+    )
