@@ -134,10 +134,22 @@ def bench_stream() -> float:
     return total / dt
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--profile", nargs="?", const="/tmp/jax-trace-bench", default=None,
+        metavar="DIR",
+        help="write a jax.profiler trace of the timed training scan to DIR "
+        "(view with TensorBoard / ui.perfetto.dev)",
+    )
+    args = ap.parse_args(argv)
+
     from sparse_coding__tpu import build_ensemble
     from sparse_coding__tpu.data import RandomDatasetGenerator
     from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.utils.trace import trace
 
     ens = build_ensemble(
         FunctionalTiedSAE,
@@ -167,11 +179,17 @@ def main():
     jax.device_get(losses["loss"])
 
     reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        losses = ens.step_scan(batches)
-    jax.device_get(losses["loss"])
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            losses = ens.step_scan(batches)
+        jax.device_get(losses["loss"])
+        dt = time.perf_counter() - t0
+    if args.profile:
+        print(f"# trace written to {args.profile}")
 
     n_steps = reps * SCAN_STEPS
     acts_per_sec = n_steps * BATCH / dt
@@ -198,6 +216,9 @@ def main():
                 "harvest_tokens_per_sec": round(harvest_tps, 1),
                 "stream_rows_per_sec": round(stream_rps, 1),
                 "fista500_codes_per_sec": round(fista_cps, 1),
+                # profiled numbers include jax.profiler overhead — marked so
+                # they can't be mistaken for clean measurements
+                **({"profiled": True} if args.profile else {}),
             }
         )
     )
