@@ -110,6 +110,12 @@ def resurrect_dead_features(
     with count ≤ threshold are rewritten via a masked `jnp.where` — fixed
     shapes, jit-safe. `replacement_vectors` is `[n_feats, d]` (rows for live
     features are ignored; callers tile the worst examples to n_feats rows).
+
+    Deliberate fix vs the reference's `worst.T * ratio / av_norm`
+    (`huge_batch_size.py:240`, which never normalizes the example, so the new
+    row's norm scales with the ACTIVATION's magnitude): here the replacement
+    is normalized to `ratio x` the average encoder-row norm — the stated
+    intent of worst-example resurrection.
     """
     dead = state.c_totals <= threshold
     n_dead = int(jax.device_get(dead.sum()))

@@ -4,6 +4,7 @@ logprob simulator math."""
 
 import json
 import pickle
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -237,3 +238,69 @@ def test_interpret_concurrent_matches_serial(tmp_path, setup):
     a = interp.read_results(tmp_path / "serial")
     b = interp.read_results(tmp_path / "pool")
     pd.testing.assert_frame_equal(a, b)
+
+
+def _stub_openai_client(simulator_model):
+    """OpenAIClient with a stubbed SDK object (no network, no openai pkg)."""
+    from sparse_coding__tpu.interp.clients import OpenAIClient
+
+    client = OpenAIClient.__new__(OpenAIClient)
+    client.explainer_model = "gpt-4"
+    client.simulator_model = simulator_model
+    return client
+
+
+_Obj = SimpleNamespace
+
+
+def test_openai_completions_simulate_path():
+    """davinci-style simulators go through the completions endpoint and the
+    calibrated logprob parser; prompt ends with the first row's tab seed."""
+    client = _stub_openai_client("text-davinci-003")
+    captured = {}
+
+    def create(**kw):
+        captured.update(kw)
+        lp = _Obj(tokens=["4", "\n", "cat", "\t", "9"],
+                  top_logprobs=[{"4": 0.0}, {}, {}, {}, {"9": 0.0}])
+        return _Obj(choices=[_Obj(logprobs=lp)])
+
+    client._client = _Obj(completions=_Obj(create=create))
+    out = client.simulate("fires on cats", ["the", "cat"])
+    assert out == [4.0, 9.0]
+    assert captured["model"] == "text-davinci-003"
+    assert captured["logprobs"] == 5  # the completions API maximum
+    assert captured["prompt"].endswith("the\t")
+    assert "Tokens: the cat" in captured["prompt"]
+
+
+def test_openai_chat_simulate_fallback():
+    """Chat-only simulators fall back to parsing printed digits."""
+    client = _stub_openai_client("gpt-4o-mini")
+    captured = {}
+
+    def create(**kw):
+        captured.update(kw)
+        return _Obj(choices=[_Obj(message=_Obj(content="3, 0, bad, 7"))])
+
+    client._client = _Obj(chat=_Obj(completions=_Obj(create=create)))
+    out = client.simulate("something", ["a", "b", "c", "d", "e"])
+    assert out == [3.0, 0.0, 0.0, 7.0, 0.0]  # unparsable -> 0, padded
+    assert captured["model"] == "gpt-4o-mini"
+
+
+def test_openai_explain_prompt_shape():
+    client = _stub_openai_client("text-davinci-003")
+    captured = {}
+
+    def create(**kw):
+        captured.update(kw)
+        return _Obj(choices=[_Obj(message=_Obj(content="  cat detector  "))])
+
+    client._client = _Obj(chat=_Obj(completions=_Obj(create=create)))
+    records = [interp.ActivationRecord(tokens=["the", "cat"], activations=[0.0, 5.0])]
+    out = client.explain(records, 5.0)
+    assert out == "cat detector"
+    assert captured["model"] == "gpt-4"
+    # activating tokens are annotated with their activation
+    assert "cat (5.0)" in captured["messages"][1]["content"]
