@@ -34,29 +34,41 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 
-def build_subject_model(quick: bool):
+def build_subject_model(quick: bool, arch: str = "neox"):
     import torch
-    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
 
     from sparse_coding__tpu.lm import config_from_hf, params_from_hf
 
     torch.manual_seed(0)
-    if quick:
-        hf_cfg = GPTNeoXConfig(
-            vocab_size=128, hidden_size=32, num_hidden_layers=3,
-            num_attention_heads=4, intermediate_size=64,
-            max_position_embeddings=64, rotary_pct=0.25,
-            use_parallel_residual=True, tie_word_embeddings=False,
-        )
+    if arch == "gpt2":
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        if quick:
+            hf_cfg = GPT2Config(
+                vocab_size=128, n_embd=32, n_layer=3, n_head=4, n_positions=64,
+            )
+        else:
+            hf_cfg = GPT2Config()  # gpt2-small geometry: d=768, 12 layers
+        model = GPT2LMHeadModel(hf_cfg).eval()
     else:
-        # pythia-70m-deduped geometry (EleutherAI config)
-        hf_cfg = GPTNeoXConfig(
-            vocab_size=50304, hidden_size=512, num_hidden_layers=6,
-            num_attention_heads=8, intermediate_size=2048,
-            max_position_embeddings=2048, rotary_pct=0.25,
-            use_parallel_residual=True, tie_word_embeddings=False,
-        )
-    model = GPTNeoXForCausalLM(hf_cfg).eval()
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        if quick:
+            hf_cfg = GPTNeoXConfig(
+                vocab_size=128, hidden_size=32, num_hidden_layers=3,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64, rotary_pct=0.25,
+                use_parallel_residual=True, tie_word_embeddings=False,
+            )
+        else:
+            # pythia-70m-deduped geometry (EleutherAI config)
+            hf_cfg = GPTNeoXConfig(
+                vocab_size=50304, hidden_size=512, num_hidden_layers=6,
+                num_attention_heads=8, intermediate_size=2048,
+                max_position_embeddings=2048, rotary_pct=0.25,
+                use_parallel_residual=True, tie_word_embeddings=False,
+            )
+        model = GPTNeoXForCausalLM(hf_cfg).eval()
     return config_from_hf(model.config), params_from_hf(model)
 
 
@@ -65,6 +77,11 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
     ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument(
+        "--config", choices=("l1", "topk"), default="l1",
+        help="l1: pythia-70m-geometry tied-SAE l1 sweep (BASELINE config 2); "
+        "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4)",
+    )
     args = ap.parse_args(argv)
 
     import jax
@@ -73,25 +90,43 @@ def main(argv=None):
     from sparse_coding__tpu import build_ensemble, metrics as sm
     from sparse_coding__tpu.data.activations import make_activation_dataset
     from sparse_coding__tpu.data.chunks import ChunkStore
-    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.models import FunctionalTiedSAE, TopKEncoder
     from sparse_coding__tpu.models.learned_dict import Identity
     from sparse_coding__tpu.train.loop import ensemble_train_loop
 
     t_start = time.time()
     quick = args.quick
-    layer, layer_loc = (1, "residual") if quick else (2, "residual")
+    topk = args.config == "topk"
     seq_len = 32 if quick else args.seq_len
     batch_rows = 16 if quick else 64
     chunk_gb = 0.002 if quick else 0.0625
-    n_chunks = 3 if quick else 5  # last chunk held out for eval
-    l1_grid = [1e-4, 1e-3] if quick else list(np.logspace(-4, -2, 8))
-    ratio = 2 if quick else 4
     sae_batch = 256 if quick else 2048
-    n_epochs = 1 if quick else 3
     seeds = (0, 1)
+    if topk:
+        # GPT-2-small residual, 16x dict, k-sweep (one mid layer stands in
+        # for the reference's layers 0-11 loop)
+        layer, layer_loc = (1, "residual") if quick else (5, "residual")
+        n_chunks = 2 if quick else 3  # last chunk held out for eval
+        # the reference's sparsity_levels span 1..151 (`:234`); a denser k
+        # than ~150 needs far more training than a parity run's budget
+        grid = [2, 8] if quick else [1, 11, 31, 61, 91, 121, 151]
+        ratio, n_epochs = (2, 1) if quick else (16, 3)
+        hp_name, arch = "sparsity", "gpt2"
+        mk_hp = lambda v: {"sparsity": int(v)}
+        hp_key = lambda v: str(int(v))  # report keys/values stay integers
+        subject = "gpt2-small geometry, random init"
+    else:
+        layer, layer_loc = (1, "residual") if quick else (2, "residual")
+        n_chunks = 3 if quick else 5
+        grid = [1e-4, 1e-3] if quick else list(np.logspace(-4, -2, 8))
+        ratio, n_epochs = (2, 1) if quick else (4, 3)
+        hp_name, arch = "l1_alpha", "neox"
+        mk_hp = lambda v: {"l1_alpha": float(v)}
+        hp_key = lambda v: f"{v:.2e}"
+        subject = "pythia-70m geometry, random init"
 
-    print("Building subject model (pythia-70m geometry, random init)...")
-    lm_cfg, params = build_subject_model(quick)
+    print(f"Building subject model ({subject})...")
+    lm_cfg, params = build_subject_model(quick, arch)
     d_act = lm_cfg.d_model
     n_dict = int(ratio * d_act)
 
@@ -103,9 +138,11 @@ def main(argv=None):
 
     report: dict = {
         "config": {
-            "subject": f"GPTNeoX d={d_act} L={lm_cfg.n_layers} (pythia-70m geometry, random init)",
+            "subject": f"{lm_cfg.arch} d={d_act} L={lm_cfg.n_layers} ({subject})",
+            "model": "TopKEncoder" if topk else "FunctionalTiedSAE",
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
-            "dict_ratio": ratio, "n_dict": n_dict, "l1_grid": [float(a) for a in l1_grid],
+            "dict_ratio": ratio, "n_dict": n_dict,
+            f"{hp_name}_grid": [mk_hp(a)[hp_name] for a in grid],
             "sae_batch": sae_batch, "n_epochs": n_epochs, "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         }
@@ -135,15 +172,20 @@ def main(argv=None):
         train_chunks = [store.load(i) for i in range(n_chunks)]
         eval_chunk = store.load(n_chunks)
 
+        if topk:
+            sig, size_kw = TopKEncoder, {"d_activation": d_act, "n_features": n_dict}
+        else:
+            sig = FunctionalTiedSAE
+            size_kw = {"activation_size": d_act, "n_dict_components": n_dict}
         ensembles = {}
         t0 = time.time()
         for seed in seeds:
             ens = build_ensemble(
-                FunctionalTiedSAE, jax.random.PRNGKey(seed),
-                [{"l1_alpha": float(a)} for a in l1_grid],
+                sig, jax.random.PRNGKey(seed),
+                [mk_hp(v) for v in grid],
                 optimizer_kwargs={"learning_rate": 1e-3},
-                activation_size=d_act, n_dict_components=n_dict,
                 compute_dtype=None if quick else jnp.bfloat16,
+                **size_kw,
             )
             losses_first = losses_last = None
             key = jax.random.PRNGKey(100 + seed)
@@ -176,25 +218,25 @@ def main(argv=None):
             ]
             pareto[seed] = [
                 {
-                    "l1_alpha": float(a), "fvu": row["fvu"], "l0": row["l0"],
+                    hp_name: mk_hp(a)[hp_name], "fvu": row["fvu"], "l0": row["l0"],
                     "r2": row["r2"], "n_dead": int(d), "n_feats": int(ld.n_feats),
                 }
-                for a, row, d, ld in zip(l1_grid, rows, dead, dicts)
+                for a, row, d, ld in zip(grid, rows, dead, dicts)
             ]
         report["pareto"] = {str(s): p for s, p in pareto.items()}
 
-        # cross-seed MMCS at each l1: the paper's feature-consistency check
+        # cross-seed MMCS at each grid point: the paper's consistency check
         dicts0 = ensembles[seeds[0]].to_learned_dicts()
         dicts1 = ensembles[seeds[1]].to_learned_dicts()
         report["mmcs_cross_seed"] = {
-            f"{a:.2e}": float(sm.mmcs(d0, d1))
-            for a, d0, d1 in zip(l1_grid, dicts0, dicts1)
+            hp_key(a): float(sm.mmcs(d0, d1))
+            for a, d0, d1 in zip(grid, dicts0, dicts1)
         }
 
-        # perplexity under reconstruction: low/mid/high l1 + identity control
+        # perplexity under reconstruction: low/mid/high grid point + identity
         eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
-        picks = sorted({0, len(l1_grid) // 2, len(l1_grid) - 1})
-        ppl_dicts = [(dicts0[i], {"l1_alpha": float(l1_grid[i])}) for i in picks]
+        picks = sorted({0, len(grid) // 2, len(grid) - 1})
+        ppl_dicts = [(dicts0[i], mk_hp(grid[i])) for i in picks]
         ppl_dicts.append((Identity(d_act), {"baseline": "identity"}))
         base_loss, ppl = sm.calculate_perplexity(
             params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
@@ -212,13 +254,18 @@ def main(argv=None):
         # sanity: the pareto must slope the right way, identity must be ~base
         fvus = [p["fvu"] for p in pareto[seeds[0]]]
         l0s = [p["l0"] for p in pareto[seeds[0]]]
-        assert fvus[-1] > fvus[0] and l0s[-1] < l0s[0], "pareto slope wrong"
+        if topk:
+            # ascending k ⇒ denser codes, better reconstruction
+            assert fvus[-1] < fvus[0] and l0s[-1] > l0s[0], "pareto slope wrong"
+        else:
+            # ascending l1 ⇒ sparser codes, worse reconstruction
+            assert fvus[-1] > fvus[0] and l0s[-1] < l0s[0], "pareto slope wrong"
         ident_loss = report["perplexity"]["under_reconstruction"][-1]["lm_loss"]
         assert abs(ident_loss - base_loss) < 1e-3, "identity hook changed the LM"
 
         out_prefix = Path(args.out) if args.out else REPO
         out_prefix.mkdir(parents=True, exist_ok=True)
-        suffix = "_quick" if quick else ""
+        suffix = ("_topk" if topk else "") + ("_quick" if quick else "")
         json_path = out_prefix / f"PARITY_r02{suffix}.json"
         with open(json_path, "w") as f:
             json.dump(report, f, indent=1)
@@ -229,15 +276,16 @@ def main(argv=None):
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
 
+        model_label = "TopK" if topk else "tied SAE"
         fig, ax = plt.subplots(figsize=(7, 5))
         for seed, pts in pareto.items():
             xs = [p["l0"] for p in pts]
             ys = [p["fvu"] for p in pts]
-            ax.plot(xs, ys, "o-", label=f"tied SAE r{ratio} seed {seed}")
+            ax.plot(xs, ys, "o-", label=f"{model_label} r{ratio} seed {seed}")
         ax.set_xlabel("mean L0 (active features/example)")
         ax.set_ylabel("FVU")
         ax.set_title(
-            f"FVU vs L0, l1 sweep — layer {layer} {layer_loc}, "
+            f"FVU vs L0, {hp_name} sweep — layer {layer} {layer_loc}, "
             f"{report['config']['subject']}"
         )
         ax.legend()

@@ -12,24 +12,34 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.mark.slow
-def test_parity_quick(tmp_path):
+@pytest.mark.parametrize("config,hp", [("l1", "l1_alpha"), ("topk", "sparsity")])
+def test_parity_quick(tmp_path, config, hp):
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
-         "--out", str(tmp_path)],
+         "--config", config, "--out", str(tmp_path)],
         capture_output=True, text=True, timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    report = json.loads((tmp_path / "PARITY_r02_quick.json").read_text())
-    assert (tmp_path / "parity_pareto_r02_quick.png").exists()
+    suffix = "_topk" if config == "topk" else ""
+    report = json.loads((tmp_path / f"PARITY_r02{suffix}_quick.json").read_text())
+    assert (tmp_path / f"parity_pareto_r02{suffix}_quick.png").exists()
 
     for seed in ("0", "1"):
         pts = report["pareto"][seed]
-        assert pts[-1]["fvu"] > pts[0]["fvu"]  # higher l1 → worse FVU
-        assert pts[-1]["l0"] < pts[0]["l0"]  # higher l1 → sparser
+        if config == "topk":  # higher k → denser, better FVU
+            assert pts[-1]["fvu"] < pts[0]["fvu"]
+            assert pts[-1]["l0"] > pts[0]["l0"]
+        else:  # higher l1 → sparser, worse FVU
+            assert pts[-1]["fvu"] > pts[0]["fvu"]
+            assert pts[-1]["l0"] < pts[0]["l0"]
     # identity hook must not move the LM loss
     base = report["perplexity"]["base_lm_loss"]
     ident = report["perplexity"]["under_reconstruction"][-1]
     assert ident["baseline"] == "identity" and abs(ident["lm_loss"] - base) < 1e-3
-    assert set(report["mmcs_cross_seed"]) == {
-        f"{a:.2e}" for a in report["config"]["l1_grid"]
-    }
+    grid = report["config"][f"{hp}_grid"]
+    if config == "topk":
+        assert all(isinstance(v, int) for v in grid)  # k stays integer
+        expect_keys = {str(int(a)) for a in grid}
+    else:
+        expect_keys = {f"{a:.2e}" for a in grid}
+    assert set(report["mmcs_cross_seed"]) == expect_keys
