@@ -78,9 +78,10 @@ def main(argv=None):
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument(
-        "--config", choices=("l1", "topk"), default="l1",
+        "--config", choices=("l1", "topk", "fista"), default="l1",
         help="l1: pythia-70m-geometry tied-SAE l1 sweep (BASELINE config 2); "
-        "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4)",
+        "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4); "
+        "fista: FISTA-dictionary vs tied-SAE at matched L0 (BASELINE config 3)",
     )
     args = ap.parse_args(argv)
 
@@ -90,13 +91,14 @@ def main(argv=None):
     from sparse_coding__tpu import build_ensemble, metrics as sm
     from sparse_coding__tpu.data.activations import make_activation_dataset
     from sparse_coding__tpu.data.chunks import ChunkStore
-    from sparse_coding__tpu.models import FunctionalTiedSAE, TopKEncoder
+    from sparse_coding__tpu.models import FunctionalFista, FunctionalTiedSAE, TopKEncoder
     from sparse_coding__tpu.models.learned_dict import Identity
     from sparse_coding__tpu.train.loop import ensemble_train_loop
 
     t_start = time.time()
     quick = args.quick
     topk = args.config == "topk"
+    fista = args.config == "fista"
     seq_len = 32 if quick else args.seq_len
     batch_rows = 16 if quick else 64
     chunk_gb = 0.002 if quick else 0.0625
@@ -124,6 +126,12 @@ def main(argv=None):
         mk_hp = lambda v: {"l1_alpha": float(v)}
         hp_key = lambda v: f"{v:.2e}"
         subject = "pythia-70m geometry, random init"
+        if fista:
+            # the per-step 500-iteration decoder update bounds the budget:
+            # fewer grid points, one epoch, fewer chunks
+            n_chunks = 2 if quick else 3
+            grid = [1e-4, 1e-3] if quick else [1e-4, 3e-4, 1e-3, 3e-3]
+            n_epochs = 1
 
     print(f"Building subject model ({subject})...")
     lm_cfg, params = build_subject_model(quick, arch)
@@ -139,7 +147,13 @@ def main(argv=None):
     report: dict = {
         "config": {
             "subject": f"{lm_cfg.arch} d={d_act} L={lm_cfg.n_layers} ({subject})",
-            "model": "TopKEncoder" if topk else "FunctionalTiedSAE",
+            "model": (
+                "TopKEncoder"
+                if topk
+                else "FunctionalFista + FunctionalTiedSAE"
+                if fista
+                else "FunctionalTiedSAE"
+            ),
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
             "dict_ratio": ratio, "n_dict": n_dict,
             f"{hp_name}_grid": [mk_hp(a)[hp_name] for a in grid],
@@ -173,41 +187,51 @@ def main(argv=None):
         eval_chunk = store.load(n_chunks)
 
         if topk:
-            sig, size_kw = TopKEncoder, {"d_activation": d_act, "n_features": n_dict}
+            families = {"": (TopKEncoder, {"d_activation": d_act, "n_features": n_dict})}
         else:
-            sig = FunctionalTiedSAE
             size_kw = {"activation_size": d_act, "n_dict_components": n_dict}
+            families = (
+                {"fista": (FunctionalFista, size_kw), "tied": (FunctionalTiedSAE, size_kw)}
+                if fista
+                else {"": (FunctionalTiedSAE, size_kw)}
+            )
+        tag = lambda fam, seed: f"{fam}_{seed}" if fam else str(seed)
+        fista_iters = 20 if quick else 500
         ensembles = {}
         t0 = time.time()
-        for seed in seeds:
-            ens = build_ensemble(
-                sig, jax.random.PRNGKey(seed),
-                [mk_hp(v) for v in grid],
-                optimizer_kwargs={"learning_rate": 1e-3},
-                compute_dtype=None if quick else jnp.bfloat16,
-                **size_kw,
-            )
-            losses_first = losses_last = None
-            key = jax.random.PRNGKey(100 + seed)
-            for epoch in range(n_epochs):
-                for chunk in train_chunks:
-                    key, k = jax.random.split(key)
-                    losses = ensemble_train_loop(ens, chunk, batch_size=sae_batch, key=k)
-                    if losses_first is None:
-                        losses_first = np.asarray(jax.device_get(losses["loss"]))
-                    losses_last = np.asarray(jax.device_get(losses["loss"]))
-            ensembles[seed] = ens
-            report[f"train_seed{seed}"] = {
-                "loss_first_chunk": [float(x) for x in losses_first],
-                "loss_last_chunk": [float(x) for x in losses_last],
-            }
+        for fam, (sig, size_kw) in families.items():
+            for seed in seeds:
+                ens = build_ensemble(
+                    sig, jax.random.PRNGKey(seed),
+                    [mk_hp(v) for v in grid],
+                    optimizer_kwargs={"learning_rate": 1e-3},
+                    compute_dtype=None if quick else jnp.bfloat16,
+                    **size_kw,
+                )
+                losses_first = losses_last = None
+                key = jax.random.PRNGKey(100 + seed)
+                for epoch in range(n_epochs):
+                    for chunk in train_chunks:
+                        key, k = jax.random.split(key)
+                        losses = ensemble_train_loop(
+                            ens, chunk, batch_size=sae_batch, key=k,
+                            fista_iters=fista_iters,
+                        )
+                        if losses_first is None:
+                            losses_first = np.asarray(jax.device_get(losses["loss"]))
+                        losses_last = np.asarray(jax.device_get(losses["loss"]))
+                ensembles[(fam, seed)] = ens
+                report[f"train_{tag(fam, seed)}"] = {
+                    "loss_first_chunk": [float(x) for x in losses_first],
+                    "loss_last_chunk": [float(x) for x in losses_last],
+                }
         report["train_seconds"] = round(time.time() - t0, 1)
-        print(f"Trained {len(seeds)} ensembles in {report['train_seconds']}s")
+        print(f"Trained {len(ensembles)} ensembles in {report['train_seconds']}s")
 
         # -- evaluation on the held-out chunk ---------------------------------
         t0 = time.time()
         pareto = {}
-        for seed, ens in ensembles.items():
+        for (fam, seed), ens in ensembles.items():
             dicts = ens.to_learned_dicts()
             rows = sm.evaluate_dicts(dicts, eval_chunk)  # vmapped P4 fan-out
             dead = [
@@ -216,27 +240,60 @@ def main(argv=None):
                 )
                 for ld in dicts
             ]
-            pareto[seed] = [
+            pareto[tag(fam, seed)] = [
                 {
                     hp_name: mk_hp(a)[hp_name], "fvu": row["fvu"], "l0": row["l0"],
                     "r2": row["r2"], "n_dead": int(d), "n_feats": int(ld.n_feats),
                 }
                 for a, row, d, ld in zip(grid, rows, dead, dicts)
             ]
-        report["pareto"] = {str(s): p for s, p in pareto.items()}
+        report["pareto"] = pareto
 
         # cross-seed MMCS at each grid point: the paper's consistency check
-        dicts0 = ensembles[seeds[0]].to_learned_dicts()
-        dicts1 = ensembles[seeds[1]].to_learned_dicts()
+        # (computed on the first family — labeled so the artifact is explicit)
+        fam0 = next(iter(families))
+        dicts0 = ensembles[(fam0, seeds[0])].to_learned_dicts()
+        dicts1 = ensembles[(fam0, seeds[1])].to_learned_dicts()
         report["mmcs_cross_seed"] = {
             hp_key(a): float(sm.mmcs(d0, d1))
             for a, d0, d1 in zip(grid, dicts0, dicts1)
         }
+        report["mmcs_cross_seed_family"] = fam0 or report["config"]["model"]
 
-        # perplexity under reconstruction: low/mid/high grid point + identity
+        if fista:
+            # BASELINE config 3: FVU at MATCHED L0 — the tied pareto is
+            # piecewise-linearly interpolated at each FISTA dict's L0 (nearest
+            # grid points can sit at very different sparsities, which would
+            # make the delta an artifact of the mismatch)
+            f_pts = pareto[tag("fista", seeds[0])]
+            t_pts = sorted(pareto[tag("tied", seeds[0])], key=lambda t: t["l0"])
+            t_l0s = [t["l0"] for t in t_pts]
+            t_fvus = [t["fvu"] for t in t_pts]
+            report["matched_l0"] = []
+            for fp in f_pts:
+                tied_fvu = float(np.interp(fp["l0"], t_l0s, t_fvus))
+                report["matched_l0"].append(
+                    {
+                        "fista_l0": fp["l0"], "fista_fvu": fp["fvu"],
+                        "tied_fvu_interp_at_l0": tied_fvu,
+                        "extrapolated": bool(
+                            fp["l0"] < t_l0s[0] or fp["l0"] > t_l0s[-1]
+                        ),
+                        "fvu_delta_fista_minus_tied": fp["fvu"] - tied_fvu,
+                    }
+                )
+
+        # perplexity under reconstruction: low/mid/high grid point PER FAMILY
+        # (family-labeled rows) + one identity control
         eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
         picks = sorted({0, len(grid) // 2, len(grid) - 1})
-        ppl_dicts = [(dicts0[i], mk_hp(grid[i])) for i in picks]
+        ppl_dicts = []
+        for fam in families:
+            fam_dicts = ensembles[(fam, seeds[0])].to_learned_dicts()
+            ppl_dicts.extend(
+                (fam_dicts[i], {**mk_hp(grid[i]), **({"family": fam} if fam else {})})
+                for i in picks
+            )
         ppl_dicts.append((Identity(d_act), {"baseline": "identity"}))
         base_loss, ppl = sm.calculate_perplexity(
             params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
@@ -252,8 +309,8 @@ def main(argv=None):
         report["total_seconds"] = round(time.time() - t_start, 1)
 
         # sanity: the pareto must slope the right way, identity must be ~base
-        fvus = [p["fvu"] for p in pareto[seeds[0]]]
-        l0s = [p["l0"] for p in pareto[seeds[0]]]
+        fvus = [p["fvu"] for p in pareto[tag(fam0, seeds[0])]]
+        l0s = [p["l0"] for p in pareto[tag(fam0, seeds[0])]]
         if topk:
             # ascending k ⇒ denser codes, better reconstruction
             assert fvus[-1] < fvus[0] and l0s[-1] > l0s[0], "pareto slope wrong"
@@ -265,7 +322,10 @@ def main(argv=None):
 
         out_prefix = Path(args.out) if args.out else REPO
         out_prefix.mkdir(parents=True, exist_ok=True)
-        suffix = ("_topk" if topk else "") + ("_quick" if quick else "")
+        suffix = (
+            ("_topk" if topk else "") + ("_fista" if fista else "")
+            + ("_quick" if quick else "")
+        )
         json_path = out_prefix / f"PARITY_r02{suffix}.json"
         with open(json_path, "w") as f:
             json.dump(report, f, indent=1)
@@ -278,10 +338,11 @@ def main(argv=None):
 
         model_label = "TopK" if topk else "tied SAE"
         fig, ax = plt.subplots(figsize=(7, 5))
-        for seed, pts in pareto.items():
+        for key, pts in pareto.items():
             xs = [p["l0"] for p in pts]
             ys = [p["fvu"] for p in pts]
-            ax.plot(xs, ys, "o-", label=f"{model_label} r{ratio} seed {seed}")
+            label = key if fista else f"{model_label} r{ratio} seed {key}"
+            ax.plot(xs, ys, "o-", label=label)
         ax.set_xlabel("mean L0 (active features/example)")
         ax.set_ylabel("FVU")
         ax.set_title(

@@ -12,7 +12,9 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("config,hp", [("l1", "l1_alpha"), ("topk", "sparsity")])
+@pytest.mark.parametrize(
+    "config,hp", [("l1", "l1_alpha"), ("topk", "sparsity"), ("fista", "l1_alpha")]
+)
 def test_parity_quick(tmp_path, config, hp):
     proc = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
@@ -20,11 +22,19 @@ def test_parity_quick(tmp_path, config, hp):
         capture_output=True, text=True, timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    suffix = "_topk" if config == "topk" else ""
+    suffix = {"topk": "_topk", "fista": "_fista"}.get(config, "")
     report = json.loads((tmp_path / f"PARITY_r02{suffix}_quick.json").read_text())
     assert (tmp_path / f"parity_pareto_r02{suffix}_quick.png").exists()
 
-    for seed in ("0", "1"):
+    if config == "fista":
+        assert set(report["pareto"]) == {"fista_0", "fista_1", "tied_0", "tied_1"}
+        assert len(report["matched_l0"]) == len(report["config"]["l1_alpha_grid"])
+        for m in report["matched_l0"]:
+            assert m["fvu_delta_fista_minus_tied"] == pytest.approx(
+                m["fista_fvu"] - m["tied_fvu_interp_at_l0"], abs=1e-6
+            )
+    seed_keys = ("fista_0", "fista_1") if config == "fista" else ("0", "1")
+    for seed in seed_keys:
         pts = report["pareto"][seed]
         if config == "topk":  # higher k → denser, better FVU
             assert pts[-1]["fvu"] < pts[0]["fvu"]
