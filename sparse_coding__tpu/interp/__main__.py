@@ -90,6 +90,13 @@ def main(argv=None):
                 batch_mod.read_results(name, score_mode, results_base=base)
         return
 
+    if mode not in ("", "run_group", "big_sweep", "all_baselines", "chunks"):
+        # validate BEFORE building the context (which may hit the HF cache)
+        raise SystemExit(
+            f"unknown mode {mode!r}; expected one of: read_results, run_group, "
+            "big_sweep, all_baselines, chunks (or no mode for a single file/folder)"
+        )
+
     cfg = InterpArgs.from_cli(argv)
     if not cfg.save_loc:
         # every dict-running mode writes where read_results will look
@@ -118,11 +125,8 @@ def main(argv=None):
                 for i, (ld, _hp) in enumerate(batch_mod._load_dict_file(target))
             ]
             batch_mod.run_many(named, cfg, ctx)
-    else:
-        raise SystemExit(
-            f"unknown mode {mode!r}; expected one of: read_results, run_group, "
-            "big_sweep, all_baselines, chunks (or no mode for a single file/folder)"
-        )
+    else:  # unreachable unless the guard tuple above drifts from this chain
+        raise AssertionError(f"mode {mode!r} passed validation but has no handler")
 
 
 if __name__ == "__main__":
