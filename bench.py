@@ -39,14 +39,9 @@ SCAN_STEPS = 128
 TPU_PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
 
 
-def bench_harvest() -> float:
-    """Tokens/sec through `make_activation_dataset` on a Pythia-70M-shaped
-    random-init LM (the reference's real bottleneck: a 4-sentence eager
-    forward per batch, `activation_dataset.py:37`; here one jitted
-    64-sentence capture forward, cached per config)."""
+def _harvest_setup():
     import numpy as np
 
-    from sparse_coding__tpu.data.activations import make_activation_dataset
     from sparse_coding__tpu.lm import LMConfig, init_params
 
     cfg = LMConfig(
@@ -61,7 +56,20 @@ def bench_harvest() -> float:
     batches_per_chunk = max(1, int(chunk_gb * 1024**3 / (D_ACT * 2)) // (batch_size * seq_len))
     rows = (n_chunks + 1) * batches_per_chunk * batch_size
     tokens = rng.integers(0, cfg.vocab_size, (rows, seq_len), dtype=np.int32)
+    return cfg, params, tokens, batch_size, chunk_gb, n_chunks
 
+
+def bench_harvest() -> float:
+    """Tokens/sec through `make_activation_dataset` on a Pythia-70M-shaped
+    random-init LM (the reference's real bottleneck: a 4-sentence eager
+    forward per batch, `activation_dataset.py:37`; here one jitted
+    64-sentence capture forward, cached per config). On this tunneled
+    backend the number is device→host transfer-bound (~20 MiB/s tunnel,
+    THROUGHPUT.md) — see `bench_harvest_fused` for the path that avoids the
+    transfer entirely."""
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+
+    cfg, params, tokens, batch_size, chunk_gb, n_chunks = _harvest_setup()
     tmp = tempfile.mkdtemp(prefix="bench_harvest_")
     try:
         from sparse_coding__tpu.data.chunks import ChunkStore
@@ -82,6 +90,32 @@ def bench_harvest() -> float:
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return n_tokens / dt
+
+
+def bench_harvest_fused() -> float:
+    """Tokens/sec through `harvest_to_device` — the fused harvest→train
+    streaming path (SURVEY §7 hard part #1): activation chunks stay
+    HBM-resident for the consuming train step; the host never touches them.
+    Fenced per chunk by an on-device reduction, like a consuming train step
+    would fence."""
+    from sparse_coding__tpu.data.activations import harvest_to_device
+
+    cfg, params, tokens, batch_size, chunk_gb, n_chunks = _harvest_setup()
+    reduce_fn = jax.jit(lambda x: x.astype(jnp.float32).sum())
+    kw = dict(
+        layers=[2], layer_locs=["residual"], batch_size=batch_size,
+        chunk_size_gb=chunk_gb,
+    )
+    # warmup (compile via the shared capture cache)
+    for chunk in harvest_to_device(params, cfg, tokens, n_chunks=1, **kw):
+        jax.device_get(reduce_fn(chunk[(2, "residual")]))
+    t0 = time.perf_counter()
+    n_tokens = 0
+    for chunk in harvest_to_device(params, cfg, tokens, n_chunks=n_chunks, **kw):
+        arr = chunk[(2, "residual")]
+        jax.device_get(reduce_fn(arr))
+        n_tokens += arr.shape[0]
+    return n_tokens / (time.perf_counter() - t0)
 
 
 def bench_fista() -> float:
@@ -204,6 +238,7 @@ def main(argv=None):
     # secondary benches: the harvest pipeline (SURVEY §7 hard part #1) and
     # chunk-store streaming — reported as extra fields on the one JSON line
     harvest_tps = bench_harvest()
+    harvest_fused_tps = bench_harvest_fused()
     stream_rps = bench_stream()
     fista_cps = bench_fista()
     print(
@@ -216,6 +251,7 @@ def main(argv=None):
                 "mfu": round(mfu, 3),
                 "device": jax.devices()[0].device_kind,
                 "harvest_tokens_per_sec": round(harvest_tps, 1),
+                "harvest_fused_tokens_per_sec": round(harvest_fused_tps, 1),
                 "stream_rows_per_sec": round(stream_rps, 1),
                 "fista500_codes_per_sec": round(fista_cps, 1),
                 # profiled numbers include jax.profiler overhead — marked so

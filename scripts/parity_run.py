@@ -72,18 +72,170 @@ def build_subject_model(quick: bool, arch: str = "neox"):
     return config_from_hf(model.config), params_from_hf(model)
 
 
+def run_basic(args):
+    """BASELINE config 1: Pythia-70M-geometry residual layer-2, SINGLE dict /
+    single l1, trained through the `train.basic_l1_sweep` driver itself (the
+    reference's single-host FISTA driver, `basic_l1_sweep.py:48-123`) on
+    disk-resident chunks, then evaluated on a held-out chunk. Two driver runs
+    (seeds 0/1) give the cross-seed MMCS consistency number."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import metrics as sm
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+    from sparse_coding__tpu.data.chunks import ChunkStore
+    from sparse_coding__tpu.models.learned_dict import Identity
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    t_start = time.time()
+    quick = args.quick
+    seq_len = 32 if quick else args.seq_len
+    batch_rows = 16 if quick else 64
+    chunk_gb = 0.002 if quick else 0.0625
+    n_chunks = 2  # train chunks; one more harvested and held out for eval
+    layer, layer_loc = (1, "residual") if quick else (2, "residual")
+    l1_alpha = 1e-3
+    ratio = 2 if quick else 4
+    sae_batch = 64 if quick else 128  # reference default batch_size=128
+    fista_iters = 20 if quick else 500
+    seeds = (0, 1)
+
+    print("Building subject model (pythia-70m geometry, random init)...")
+    lm_cfg, params = build_subject_model(quick, "neox")
+    d_act = lm_cfg.d_model
+
+    rng = np.random.default_rng(0)
+    bytes_per_row = d_act * 2
+    batches_per_chunk = max(1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len))
+    n_rows = (n_chunks + 1) * batches_per_chunk * batch_rows
+    tokens = rng.integers(0, lm_cfg.vocab_size, (n_rows, seq_len), dtype=np.int32)
+
+    report: dict = {
+        "config": {
+            "baseline_config": 1,
+            "subject": f"{lm_cfg.arch} d={d_act} L={lm_cfg.n_layers} "
+            "(pythia-70m geometry, random init)",
+            "model": "FunctionalFista via train.basic_l1_sweep driver",
+            "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
+            "dict_ratio": ratio, "n_dict": int(ratio * d_act),
+            "l1_alpha": l1_alpha, "sae_batch": sae_batch,
+            "fista_iters": fista_iters, "seeds": list(seeds),
+            "device": jax.devices()[0].device_kind,
+        }
+    }
+
+    with tempfile.TemporaryDirectory(prefix="parity_basic_") as tmp:
+        print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens)...")
+        t0 = time.time()
+        folders = make_activation_dataset(
+            params, lm_cfg, tokens, f"{tmp}/acts", [layer], [layer_loc],
+            batch_size=batch_rows, chunk_size_gb=chunk_gb, n_chunks=n_chunks + 1,
+        )
+        train_folder = Path(folders[(layer, layer_loc)])
+        harvest_s = time.time() - t0
+        # hold the last chunk out of the driver's dataset folder for eval
+        eval_folder = Path(tmp) / "eval"
+        eval_folder.mkdir()
+        (train_folder / f"{n_chunks}.npy").rename(eval_folder / "0.npy")
+        report["harvest"] = {
+            "seconds": round(harvest_s, 1),
+            "tokens_per_sec": round(n_rows * seq_len / harvest_s, 1),
+        }
+        eval_chunk = ChunkStore(str(eval_folder)).load(0)
+
+        dicts_by_seed = {}
+        t0 = time.time()
+        for seed in seeds:
+            out_dir = Path(tmp) / f"sweep_seed{seed}"
+            learned = basic_l1_sweep(
+                str(train_folder), str(out_dir), activation_width=d_act,
+                l1_values=[l1_alpha], dict_ratio=ratio, batch_size=sae_batch,
+                n_epochs=1, fista_iters=fista_iters, seed=seed,
+            )
+            # the driver's on-disk export must round-trip to the same dict
+            (ld_disk, hp_disk), = load_learned_dicts(
+                out_dir / "epoch_0" / "learned_dicts.pkl"
+            )
+            (ld_mem, hp_mem), = learned
+            assert hp_disk == hp_mem, (hp_disk, hp_mem)
+            np.testing.assert_allclose(
+                np.asarray(ld_disk.get_learned_dict()),
+                np.asarray(ld_mem.get_learned_dict()),
+                rtol=0, atol=0,
+            )
+            dicts_by_seed[seed] = ld_mem
+        report["train_seconds"] = round(time.time() - t0, 1)
+        print(f"Trained {len(seeds)} driver runs in {report['train_seconds']}s")
+
+        t0 = time.time()
+        for seed, ld in dicts_by_seed.items():
+            (row,) = sm.evaluate_dicts([ld], eval_chunk)
+            dead = int(ld.n_feats) - sm.batched_calc_feature_n_ever_active(
+                ld, eval_chunk, threshold=10
+            )
+            report[f"eval_seed{seed}"] = {
+                "fvu": row["fvu"], "l0": row["l0"], "r2": row["r2"],
+                "n_dead": int(dead), "n_feats": int(ld.n_feats),
+            }
+        report["mmcs_cross_seed"] = float(
+            sm.mmcs(dicts_by_seed[seeds[0]], dicts_by_seed[seeds[1]])
+        )
+
+        eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
+        ppl_dicts = [
+            (dicts_by_seed[seeds[0]], {"l1_alpha": l1_alpha}),
+            (Identity(d_act), {"baseline": "identity"}),
+        ]
+        base_loss, ppl = sm.calculate_perplexity(
+            params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
+            batch_size=4 if quick else 8,
+        )
+        report["perplexity"] = {
+            "base_lm_loss": float(base_loss),
+            "under_reconstruction": [
+                {**hp, "lm_loss": float(loss)} for hp, loss in ppl
+            ],
+        }
+        report["eval_seconds"] = round(time.time() - t0, 1)
+        report["total_seconds"] = round(time.time() - t_start, 1)
+
+        # sanity: the single dict must reconstruct far better than nothing
+        # (FVU substantially below 1) with a sparse code, and the identity
+        # hook must leave the LM loss unchanged
+        for seed in seeds:
+            ev = report[f"eval_seed{seed}"]
+            assert ev["fvu"] < 0.5, ev
+            assert 0 < ev["l0"] < 0.5 * dicts_by_seed[seed].n_feats, ev
+        ident_loss = report["perplexity"]["under_reconstruction"][-1]["lm_loss"]
+        assert abs(ident_loss - base_loss) < 1e-3, "identity hook changed the LM"
+
+    out_prefix = Path(args.out) if args.out else REPO
+    out_prefix.mkdir(parents=True, exist_ok=True)
+    json_path = out_prefix / f"PARITY_r02_basic{'_quick' if quick else ''}.json"
+    with open(json_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {json_path}")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument(
-        "--config", choices=("l1", "topk", "fista"), default="l1",
+        "--config", choices=("l1", "topk", "fista", "basic"), default="l1",
         help="l1: pythia-70m-geometry tied-SAE l1 sweep (BASELINE config 2); "
         "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4); "
-        "fista: FISTA-dictionary vs tied-SAE at matched L0 (BASELINE config 3)",
+        "fista: FISTA-dictionary vs tied-SAE at matched L0 (BASELINE config 3); "
+        "basic: single-dict single-l1 run through the basic_l1_sweep driver "
+        "(BASELINE config 1)",
     )
     args = ap.parse_args(argv)
+
+    if args.config == "basic":
+        return run_basic(args)
 
     import jax
     import jax.numpy as jnp

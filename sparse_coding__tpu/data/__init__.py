@@ -14,6 +14,7 @@ from sparse_coding__tpu.data.activations import (
     chunk_and_tokenize_texts,
     chunk_tokens,
     harvest_folder_name,
+    harvest_to_device,
     make_activation_dataset,
     setup_data,
     setup_token_data,

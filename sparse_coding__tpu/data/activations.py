@@ -230,6 +230,97 @@ def make_activation_dataset(
     return folders
 
 
+def harvest_to_device(
+    params,
+    lm_cfg: lm_model.LMConfig,
+    tokens: np.ndarray,
+    layers: Sequence[int],
+    layer_locs: Sequence[str],
+    batch_size: int = MODEL_BATCH_SIZE,
+    chunk_size_gb: float = 2.0,
+    n_chunks: Optional[int] = None,
+    mesh=None,
+    seq_attn: str = "ring",
+    save_folder: Optional[Union[str, Path]] = None,
+):
+    """Fused harvest→train streaming: yield HBM-resident activation chunks,
+    never round-tripping through the host.
+
+    `make_activation_dataset` exists for the reference's on-disk data contract
+    (`activation_dataset.py:393-397`) — but when the chunks are consumed by
+    training on the same chip(s), fetching them to host only to re-upload
+    costs two PCIe/tunnel crossings per chunk for nothing. This generator is
+    the design SURVEY.md §7 ("hard parts" #1) calls for: the capture forward
+    and the consuming train step share HBM; the only host work is feeding
+    token ids (tiny). Yields ``{(layer, loc): [rows, d_loc] fp16 device
+    array}`` per chunk — the same values `make_activation_dataset` would have
+    written (asserted in tests).
+
+    ``save_folder``: optionally ALSO persist each chunk through the normal
+    fp16 `.npy` store (pays the device→host fetch; keeps the data contract
+    when the run should be resumable/reusable).
+    """
+    names = {
+        (layer, loc): lm_model.make_tensor_name(layer, loc)
+        for layer in layers
+        for loc in layer_locs
+    }
+    stop_at = max(layers) + 1
+    d_sizes = {
+        (layer, loc): lm_model.get_activation_size(lm_cfg, loc) for layer, loc in names
+    }
+    if mesh is None:
+        capture = _jitted_capture(lm_cfg, tuple(names.values()), stop_at)
+    else:
+        from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
+
+        seq_fn = make_sequence_parallel_fn(
+            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at,
+            attn=seq_attn,
+        )
+
+        @jax.jit
+        def capture(p, t):
+            return {k: v.astype(jnp.float16) for k, v in seq_fn(p, t)[1].items()}
+
+    folders = None
+    if save_folder is not None:
+        folders = {
+            (layer, loc): harvest_folder_name(save_folder, layer, loc)
+            for (layer, loc) in names
+        }
+        for f in folders.values():
+            f.mkdir(parents=True, exist_ok=True)
+
+    seq_len = tokens.shape[1]
+    chunk_rows = min(
+        int(chunk_size_gb * 1024**3 // (d * 2)) for d in d_sizes.values()
+    )
+    batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
+    n_batches_total = tokens.shape[0] // batch_size
+    max_chunks = n_chunks if n_chunks is not None else math.inf
+
+    chunk_idx = 0
+    batch_cursor = 0
+    while chunk_idx < max_chunks and batch_cursor + batches_per_chunk <= n_batches_total:
+        buffers: Dict[Tuple[int, str], List[jax.Array]] = {k: [] for k in names}
+        for b in range(batches_per_chunk):
+            rows = tokens[(batch_cursor + b) * batch_size : (batch_cursor + b + 1) * batch_size]
+            cache = capture(params, jnp.asarray(rows))
+            for key, name in names.items():
+                act = cache[name]
+                buffers[key].append(act.reshape(-1, act.shape[-1]))
+        chunk = {
+            key: jnp.concatenate(parts, axis=0) for key, parts in buffers.items()
+        }
+        if folders is not None:
+            for key, arr in chunk.items():
+                save_chunk(folders[key], chunk_idx, np.asarray(jax.device_get(arr)))
+        yield chunk
+        batch_cursor += batches_per_chunk
+        chunk_idx += 1
+
+
 def setup_data(
     model_name: str,
     dataset_name: str,

@@ -157,7 +157,19 @@ def make_ensemble_step(
             # Fused Pallas path: one kernel launch for the whole stack (the
             # model axis is a grid dim — vmapping the kernel would serialize
             # it). Static trace-time condition; shared-batch only.
-            if fused and not per_model_batch and not unstacked and batch.shape[0] % 256 == 0:
+            fused_ok = (
+                fused
+                and not per_model_batch
+                and not unstacked
+                and batch.shape[0] % 256 == 0
+                # batch-dependent VMEM fit (e.g. the bwd kernel's resident
+                # x/dxh grow with B·D); static shapes → trace-time decision
+                and (
+                    not hasattr(sig, "fused_batch_supported")
+                    or sig.fused_batch_supported(state.params, batch.shape[0])
+                )
+            )
+            if fused_ok:
                 if fused_adam is not None and hasattr(sig, "fused_adam_step"):
                     params, opt_state, loss_dict = sig.fused_adam_step(
                         state.params, state.buffers, batch, state.opt_state, **fused_adam

@@ -137,7 +137,7 @@ def make_sequence_parallel_fn(
     JAX's compilation cache (building a fresh `shard_map` closure per batch
     would retrace + recompile the whole LM every call). `attn` selects the
     parallel-attention strategy ("ring" | "ulysses", see module docstring)."""
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     cache_names = tuple(cache_names or ())
     n_shards = mesh.shape[axis_name]
@@ -171,7 +171,7 @@ def make_sequence_parallel_fn(
             mesh=mesh,
             in_specs=(P(), seq_spec),
             out_specs=(out_spec, cache_specs),
-            check_rep=False,
+            check_vma=False,
         )
     )
 

@@ -220,8 +220,14 @@ class FunctionalTiedSAE:
     @staticmethod
     def fused_supported(params, buffers) -> bool:
         """True when the Pallas fused gradient kernel covers this config:
-        no whitening centering, tile-divisible shapes (batch divisibility is
-        checked per-trace in the ensemble step)."""
+        no whitening centering, tile-divisible shapes, and a dictionary small
+        enough for the kernel's VMEM-resident layout (`ops.tied_sae_kernel.
+        fused_fits` — e.g. a 32x overcomplete 32768x1024 dictionary is 64 MB
+        and must take the XLA path). Batch divisibility and the bwd kernel's
+        batch-dependent working set are checked per-trace in the ensemble
+        step (`fused_batch_supported`)."""
+        from sparse_coding__tpu.ops.tied_sae_kernel import fused_fits
+
         n_dict_components, activation_size = params["encoder"].shape
         return (
             buffers.get("center_rot") is None
@@ -229,7 +235,17 @@ class FunctionalTiedSAE:
             and buffers.get("center_scale") is None
             and n_dict_components % 512 == 0
             and activation_size % 128 == 0
+            and fused_fits(n_dict_components, activation_size)
         )
+
+    @staticmethod
+    def fused_batch_supported(stacked_params, batch_size: int) -> bool:
+        """Trace-time check that the bwd+Adam kernel's batch-dependent VMEM
+        working set fits (`stacked_params` carry the leading model axis)."""
+        from sparse_coding__tpu.ops.tied_sae_kernel import fused_fits
+
+        n_dict_components, activation_size = stacked_params["encoder"].shape[-2:]
+        return fused_fits(n_dict_components, activation_size, batch_size)
 
     @staticmethod
     def fused_grads_stacked(params, buffers, batch, interpret: bool = False):

@@ -362,3 +362,46 @@ def on_tpu() -> bool:
         return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
+
+
+# Calibrated VMEM working-set estimates for the two kernels. The fwd kernel
+# keeps a WHOLE member dictionary resident ([N, D] bf16, double-buffered
+# across the member grid dim); the bwd kernel keeps the full batch's x/dxh
+# resident plus f32 Adam tiles. The formulas are deliberately coarse — they
+# exist to refuse shapes that cannot fit a ~16 MB VMEM core (e.g. the 32x
+# overcomplete BASELINE config 5, 32768x1024 = 64 MB of dictionary alone)
+# while keeping the bench-proven shape (4096x512, batch 2048) comfortably
+# inside. Callers fall back to the plain XLA (vmap+jnp) path when this says
+# no — XLA tiles those shapes itself.
+VMEM_BUDGET_BYTES = 16 * 2**20
+
+
+def fused_fits(
+    n_dict: int,
+    d_act: int,
+    batch: int | None = None,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+) -> bool:
+    """Whether the fused tied-SAE kernels' VMEM working sets fit.
+
+    ``batch=None`` checks only the batch-independent fwd kernel (all the
+    ensemble knows at construction time); pass the real batch size at trace
+    time to also check the bwd+Adam kernel.
+    """
+    fwd = (
+        2 * n_dict * d_act * 2  # member dictionary, double-buffered
+        + 2 * batch_tile * (n_dict + 2 * d_act) * 2  # c out tile + x + dxh
+        + batch_tile * d_act * 4  # f32 x_hat accumulator
+    )
+    if fwd > VMEM_BUDGET_BYTES:
+        return False
+    if batch is not None:
+        bwd = (
+            batch * d_act * 2 * 2  # resident x + dxh (bf16)
+            + 2 * batch * dict_tile * (2 + 2)  # c tile (bf16) + dc (spread f32)
+            + 3 * dict_tile * d_act * 4 * 2  # draw/mu/nu f32 tiles, buffered
+        )
+        if bwd > VMEM_BUDGET_BYTES:
+            return False
+    return True

@@ -35,10 +35,14 @@ def basic_l1_sweep(
     lr: float = 1e-3,
     fista_iters: int = 500,
     seed: int = 0,
+    shuffle_chunks: bool = True,
+    save_after_every: bool = False,
 ) -> List[Tuple[object, dict]]:
     """Train a FISTA ensemble over `l1_values` on every chunk in
     `dataset_folder`; save learned dicts per epoch (reference
-    `basic_l1_sweep.py:48-123`). Returns the final dict list."""
+    `basic_l1_sweep.py:48-123`). Chunk order is re-shuffled each epoch and
+    `save_after_every` saves per chunk instead of per epoch, as in the
+    reference (`basic_l1_sweep.py:90,110-118`). Returns the final dict list."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
@@ -58,19 +62,37 @@ def basic_l1_sweep(
     logger = MetricLogger(out_dir=output_folder, run_name="basic_l1_sweep")
 
     key = jax.random.PRNGKey(seed + 1)
+    order_rng = np.random.default_rng(seed)
     learned_dicts: List[Tuple[object, dict]] = []
+
+    def export():
+        return [
+            (ld, {"l1_alpha": float(a), "dict_size": dict_size})
+            for ld, a in zip(ens.to_learned_dicts(), l1_values)
+        ]
+
     for epoch in range(n_epochs):
-        for chunk_idx in range(len(store)):
-            chunk = store.load(chunk_idx)
+        chunk_order = (
+            order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
+        )
+        for chunk_idx in chunk_order:
+            chunk = store.load(int(chunk_idx))
             key, k = jax.random.split(key)
             ensemble_train_loop(
                 ens, chunk, batch_size=batch_size, key=k,
                 logger=logger, fista_iters=fista_iters,
             )
-        learned_dicts = [
-            (ld, {"l1_alpha": float(a), "dict_size": dict_size})
-            for ld, a in zip(ens.to_learned_dicts(), l1_values)
-        ]
-        save_learned_dicts(out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts)
+            if save_after_every:
+                learned_dicts = export()
+                save_learned_dicts(
+                    out / f"epoch_{epoch}" / f"chunk_{int(chunk_idx)}"
+                    / "learned_dicts.pkl",
+                    learned_dicts,
+                )
+        if not save_after_every:
+            learned_dicts = export()
+            save_learned_dicts(
+                out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
+            )
     logger.close()
     return learned_dicts

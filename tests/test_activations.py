@@ -15,6 +15,7 @@ from sparse_coding__tpu.data import (
     chunk_and_tokenize_texts,
     generate_ioi_dataset,
     harvest_folder_name,
+    harvest_to_device,
     make_activation_dataset,
 )
 from sparse_coding__tpu.lm import LMConfig, init_params, make_tensor_name, run_with_cache
@@ -154,3 +155,27 @@ def test_harvest_with_mesh_matches_unsharded(tmp_path, tiny_lm, tokens, devices)
         b = np.asarray(sharded_store.load(i))
         assert a.shape == b.shape
         np.testing.assert_allclose(a, b, atol=2e-3)
+
+
+def test_harvest_to_device_matches_disk_path(tmp_path, tiny_lm, tokens):
+    """The fused harvest→train generator must produce exactly the values the
+    on-disk pipeline writes (same capture forward, no host round trip), and
+    its save_folder option must write an identical chunk store."""
+    cfg, params = tiny_lm
+    kw = dict(
+        layers=[1, 2], layer_locs=["residual", "mlp"], batch_size=8,
+        chunk_size_gb=_tiny_chunk_gb(8 * 16 * 2, 16), n_chunks=2,
+    )
+    folders = make_activation_dataset(params, cfg, tokens, tmp_path / "disk", **kw)
+    device_chunks = list(
+        harvest_to_device(params, cfg, tokens, save_folder=tmp_path / "dev", **kw)
+    )
+    assert len(device_chunks) == 2
+    for key, folder in folders.items():
+        disk = ChunkStore(folder)
+        saved = ChunkStore(harvest_folder_name(tmp_path / "dev", *key))
+        for i, chunk in enumerate(device_chunks):
+            dev_arr = np.asarray(jax.device_get(chunk[key]))
+            assert dev_arr.dtype == np.float16
+            np.testing.assert_array_equal(dev_arr, np.load(disk.folder / f"{i}.npy"))
+            np.testing.assert_array_equal(dev_arr, np.load(saved.folder / f"{i}.npy"))

@@ -195,3 +195,46 @@ def test_fused_adam_step_matches_optax_bf16_mu(stacked):
     for k in ["encoder", "encoder_bias"]:
         a, b = np.asarray(p_ref2[k]), np.asarray(p_f2[k])
         assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 1e-3, k
+
+
+def test_fused_fits_vmem_gate():
+    """The VMEM estimator must keep the bench-proven shape and refuse shapes
+    whose working sets cannot fit a 16 MB core (BASELINE config 5's 32x
+    overcomplete dictionary being the motivating case)."""
+    from sparse_coding__tpu.ops.tied_sae_kernel import fused_fits
+
+    assert fused_fits(4096, 512)  # bench shape, fwd
+    assert fused_fits(4096, 512, 2048)  # bench shape incl. bwd at batch 2048
+    assert not fused_fits(32768, 1024)  # config 5: 64 MB dictionary
+    assert not fused_fits(8192, 512)  # 16 MB dict buffer alone fills VMEM
+    # fwd fits but the bwd working set grows with batch: same shape flips
+    assert fused_fits(2048, 1024, 256)
+    assert not fused_fits(2048, 1024, 2048)
+
+
+def test_fused_auto_selection_respects_vmem(monkeypatch):
+    """`build_ensemble(compute_dtype=bf16)` on TPU must auto-select the fused
+    path only when the dictionary fits VMEM (simulated TPU via on_tpu)."""
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.ops import tied_sae_kernel
+
+    monkeypatch.setattr(tied_sae_kernel, "on_tpu", lambda: True)
+
+    def build(n_dict):
+        return build_ensemble(
+            FunctionalTiedSAE,
+            jax.random.PRNGKey(0),
+            [{"l1_alpha": 1e-3}],
+            optimizer_kwargs={"learning_rate": 1e-3},
+            activation_size=512,
+            n_dict_components=n_dict,
+            compute_dtype=jnp.bfloat16,
+        )
+
+    assert build(4096).fused
+    assert not build(32768).fused
+
+    # batch-dependent trace-time gate on the stacked params
+    params = {"encoder": jnp.zeros((1, 2048, 1024))}
+    assert FunctionalTiedSAE.fused_batch_supported(params, 256)
+    assert not FunctionalTiedSAE.fused_batch_supported(params, 2048)

@@ -53,3 +53,47 @@ def test_parity_quick(tmp_path, config, hp):
     else:
         expect_keys = {f"{a:.2e}" for a in grid}
     assert set(report["mmcs_cross_seed"]) == expect_keys
+
+
+@pytest.mark.slow
+def test_parity_basic_quick(tmp_path):
+    """BASELINE config 1: the basic_l1_sweep-driver artifact stays runnable
+    (includes the driver's on-disk export round-trip check internally)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
+         "--config", "basic", "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / "PARITY_r02_basic_quick.json").read_text())
+    assert report["config"]["baseline_config"] == 1
+    for seed in (0, 1):
+        ev = report[f"eval_seed{seed}"]
+        assert 0 < ev["l0"] < ev["n_feats"]
+        assert 0 <= ev["fvu"] < 0.5
+    assert 0.0 < report["mmcs_cross_seed"] <= 1.0
+    base = report["perplexity"]["base_lm_loss"]
+    ident = report["perplexity"]["under_reconstruction"][-1]
+    assert ident["baseline"] == "identity" and abs(ident["lm_loss"] - base) < 1e-3
+
+
+@pytest.mark.slow
+def test_dictpar_quick(tmp_path):
+    """BASELINE config 5: the 32x dict-parallel artifact stays runnable,
+    including the virtual-mesh sharding validation subprocess."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "dictpar_run.py"), "--quick",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / "PARITY_r02_dictpar_quick.json").read_text())
+    assert report["config"]["baseline_config"] == 5
+    assert report["config"]["dict_ratio"] == 32
+    mv = report["mesh_validation"]
+    assert "dict" in mv["encoder_spec"] and mv["adam_mu_spec"] == mv["encoder_spec"]
+    assert mv["encoder_bytes_per_device"] * 4 == mv["encoder_bytes_total"]
+    assert mv["loss_rel_diff_vs_unsharded"] < 1e-4
+    for seed in ("0", "1"):
+        pts = report["pareto"][seed]
+        assert pts[-1]["l0"] < pts[0]["l0"]  # higher l1 → sparser
