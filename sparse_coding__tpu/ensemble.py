@@ -166,7 +166,10 @@ def make_ensemble_step(
                 # x/dxh grow with B·D); static shapes → trace-time decision
                 and (
                     not hasattr(sig, "fused_batch_supported")
-                    or sig.fused_batch_supported(state.params, batch.shape[0])
+                    or sig.fused_batch_supported(
+                        state.params, batch.shape[0],
+                        adam_fused=fused_adam is not None,
+                    )
                 )
             )
             if fused_ok:

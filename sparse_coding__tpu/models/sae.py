@@ -239,13 +239,19 @@ class FunctionalTiedSAE:
         )
 
     @staticmethod
-    def fused_batch_supported(stacked_params, batch_size: int) -> bool:
-        """Trace-time check that the bwd+Adam kernel's batch-dependent VMEM
-        working set fits (`stacked_params` carry the leading model axis)."""
+    def fused_batch_supported(
+        stacked_params, batch_size: int, adam_fused: bool = True
+    ) -> bool:
+        """Trace-time check that the bwd kernel's batch-dependent VMEM working
+        set fits (`stacked_params` carry the leading model axis).
+        ``adam_fused`` selects which bwd kernel (and tile size) will run —
+        the ensemble step passes whether the in-kernel Adam path is active."""
         from sparse_coding__tpu.ops.tied_sae_kernel import fused_fits
 
         n_dict_components, activation_size = stacked_params["encoder"].shape[-2:]
-        return fused_fits(n_dict_components, activation_size, batch_size)
+        return fused_fits(
+            n_dict_components, activation_size, batch_size, adam_tiles=adam_fused
+        )
 
     @staticmethod
     def fused_grads_stacked(params, buffers, batch, interpret: bool = False):

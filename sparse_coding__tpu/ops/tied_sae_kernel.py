@@ -381,14 +381,23 @@ def fused_fits(
     d_act: int,
     batch: int | None = None,
     batch_tile: int = 256,
-    dict_tile: int = 256,
+    dict_tile: int | None = None,
+    adam_tiles: bool = True,
 ) -> bool:
     """Whether the fused tied-SAE kernels' VMEM working sets fit.
 
     ``batch=None`` checks only the batch-independent fwd kernel (all the
     ensemble knows at construction time); pass the real batch size at trace
-    time to also check the bwd+Adam kernel.
+    time to also check the bwd kernel. ``adam_tiles`` selects which bwd
+    kernel will run: the Adam-fused one keeps three f32 moment/param tiles
+    resident at ``dict_tile`` 256 (`_bwd_adam_kernel`), the plain-grads one
+    streams the dictionary and gradient tiles at ``dict_tile`` 512
+    (`_bwd_kernel`) — the defaults of `tied_sae_adam_step_stacked` and
+    `tied_sae_grads_stacked` respectively; pass ``dict_tile`` explicitly if
+    calling those with non-default tiles.
     """
+    if dict_tile is None:
+        dict_tile = 256 if adam_tiles else 512
     fwd = (
         2 * n_dict * d_act * 2  # member dictionary, double-buffered
         + 2 * batch_tile * (n_dict + 2 * d_act) * 2  # c out tile + x + dxh
@@ -399,9 +408,16 @@ def fused_fits(
     if batch is not None:
         bwd = (
             batch * d_act * 2 * 2  # resident x + dxh (bf16)
-            + 2 * batch * dict_tile * (2 + 2)  # c tile (bf16) + dc (spread f32)
-            + 3 * dict_tile * d_act * 4 * 2  # draw/mu/nu f32 tiles, buffered
+            + 2 * batch * dict_tile * 2  # c tile (bf16), buffered
+            + batch * dict_tile * 4  # dc f32 intermediate
         )
+        if adam_tiles:
+            bwd += 3 * dict_tile * d_act * 4 * 2  # draw/mu/nu f32, buffered
+        else:
+            bwd += (
+                2 * dict_tile * d_act * 2  # normalized dict tile bf16, buffered
+                + 2 * dict_tile * d_act * 4  # g_enc out tile f32, buffered
+            )
         if bwd > VMEM_BUDGET_BYTES:
             return False
     return True

@@ -210,6 +210,10 @@ def test_fused_fits_vmem_gate():
     # fwd fits but the bwd working set grows with batch: same shape flips
     assert fused_fits(2048, 1024, 256)
     assert not fused_fits(2048, 1024, 2048)
+    # the plain-grads bwd kernel (no Adam tiles) runs at dict_tile 512: the
+    # bench shape still fits, the d=1024 shape still doesn't
+    assert fused_fits(4096, 512, 2048, adam_tiles=False)
+    assert not fused_fits(2048, 1024, 2048, adam_tiles=False)
 
 
 def test_fused_auto_selection_respects_vmem(monkeypatch):
