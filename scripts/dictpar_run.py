@@ -57,22 +57,19 @@ def subject_geometry(quick: bool):
 
 
 def build_subject_model(quick: bool):
-    import torch
+    """Thin wrapper over `parity_run.build_subject_model` with the
+    pythia-410m geometry (the scripts share one subject builder)."""
+    from parity_run import build_subject_model as build
 
-    from sparse_coding__tpu.lm import config_from_hf, params_from_hf
-    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
-
-    torch.manual_seed(0)
     d, L, h, mlp, _ = subject_geometry(quick)
-    hf_cfg = GPTNeoXConfig(
-        vocab_size=50304, hidden_size=d, num_hidden_layers=L,
-        num_attention_heads=h, intermediate_size=mlp,
-        max_position_embeddings=2048, rotary_pct=0.25,
-        use_parallel_residual=True, tie_word_embeddings=False,
+    return build(
+        quick, "neox",
+        hf_kwargs=dict(
+            vocab_size=50304, hidden_size=d, num_hidden_layers=L,
+            num_attention_heads=h, intermediate_size=mlp,
+            max_position_embeddings=2048,
+        ),
     )
-    model = GPTNeoXForCausalLM(hf_cfg).eval()
-    cfg, params = config_from_hf(model.config), params_from_hf(model)
-    return cfg, params
 
 
 def mesh_validate(quick: bool) -> dict:
@@ -195,13 +192,12 @@ def main(argv=None):
     print(f"Building subject model (pythia-410m geometry, random init, d={d_act})...")
     lm_cfg, params = build_subject_model(quick)
 
-    rng = np.random.default_rng(0)
-    bytes_per_row = d_act * 2
-    batches_per_chunk = max(
-        1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len)
+    from parity_run import synth_tokens
+
+    tokens = synth_tokens(
+        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
     )
-    n_rows = (n_chunks + 1) * batches_per_chunk * batch_rows
-    tokens = rng.integers(0, lm_cfg.vocab_size, (n_rows, seq_len), dtype=np.int32)
+    n_rows = tokens.shape[0]
 
     report: dict = {
         "config": {

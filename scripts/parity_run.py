@@ -34,7 +34,11 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 
-def build_subject_model(quick: bool, arch: str = "neox"):
+def build_subject_model(quick: bool, arch: str = "neox", hf_kwargs: dict = None):
+    """Random-init subject model (zero-egress image: no weights downloadable),
+    converted through `lm.convert` (logit-exactness vs torch is proven by
+    `tests/test_lm.py`). ``hf_kwargs`` overrides the NeoX geometry entirely
+    (used by `dictpar_run.py` for the pythia-410m shape)."""
     import torch
 
     from sparse_coding__tpu.lm import config_from_hf, params_from_hf
@@ -53,23 +57,39 @@ def build_subject_model(quick: bool, arch: str = "neox"):
     else:
         from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
 
-        if quick:
-            hf_cfg = GPTNeoXConfig(
-                vocab_size=128, hidden_size=32, num_hidden_layers=3,
-                num_attention_heads=4, intermediate_size=64,
-                max_position_embeddings=64, rotary_pct=0.25,
-                use_parallel_residual=True, tie_word_embeddings=False,
-            )
-        else:
-            # pythia-70m-deduped geometry (EleutherAI config)
-            hf_cfg = GPTNeoXConfig(
-                vocab_size=50304, hidden_size=512, num_hidden_layers=6,
-                num_attention_heads=8, intermediate_size=2048,
-                max_position_embeddings=2048, rotary_pct=0.25,
-                use_parallel_residual=True, tie_word_embeddings=False,
-            )
+        if hf_kwargs is None:
+            if quick:
+                hf_kwargs = dict(
+                    vocab_size=128, hidden_size=32, num_hidden_layers=3,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64,
+                )
+            else:
+                # pythia-70m-deduped geometry (EleutherAI config)
+                hf_kwargs = dict(
+                    vocab_size=50304, hidden_size=512, num_hidden_layers=6,
+                    num_attention_heads=8, intermediate_size=2048,
+                    max_position_embeddings=2048,
+                )
+        hf_cfg = GPTNeoXConfig(
+            rotary_pct=0.25, use_parallel_residual=True,
+            tie_word_embeddings=False, **hf_kwargs,
+        )
         model = GPTNeoXForCausalLM(hf_cfg).eval()
     return config_from_hf(model.config), params_from_hf(model)
+
+
+def synth_tokens(vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks, seed=0):
+    """Random token rows sized so the harvest fills exactly `n_chunks` chunks
+    (the chunk-geometry formula of `data.activations._harvest_plan`, fp16
+    store). One definition shared by every artifact runner."""
+    bytes_per_row = d_act * 2
+    batches_per_chunk = max(
+        1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len)
+    )
+    n_rows = n_chunks * batches_per_chunk * batch_rows
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab_size, (n_rows, seq_len), dtype=np.int32)
 
 
 def run_basic(args):
@@ -105,11 +125,10 @@ def run_basic(args):
     lm_cfg, params = build_subject_model(quick, "neox")
     d_act = lm_cfg.d_model
 
-    rng = np.random.default_rng(0)
-    bytes_per_row = d_act * 2
-    batches_per_chunk = max(1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len))
-    n_rows = (n_chunks + 1) * batches_per_chunk * batch_rows
-    tokens = rng.integers(0, lm_cfg.vocab_size, (n_rows, seq_len), dtype=np.int32)
+    tokens = synth_tokens(
+        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+    )
+    n_rows = tokens.shape[0]
 
     report: dict = {
         "config": {
@@ -290,11 +309,10 @@ def main(argv=None):
     d_act = lm_cfg.d_model
     n_dict = int(ratio * d_act)
 
-    rng = np.random.default_rng(0)
-    bytes_per_row = d_act * 2
-    batches_per_chunk = max(1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len))
-    n_rows = (n_chunks + 1) * batches_per_chunk * batch_rows
-    tokens = rng.integers(0, lm_cfg.vocab_size, (n_rows, seq_len), dtype=np.int32)
+    tokens = synth_tokens(
+        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+    )
+    n_rows = tokens.shape[0]
 
     report: dict = {
         "config": {

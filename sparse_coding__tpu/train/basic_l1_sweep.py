@@ -75,7 +75,7 @@ def basic_l1_sweep(
         chunk_order = (
             order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
         )
-        for chunk_idx in chunk_order:
+        for pos, chunk_idx in enumerate(chunk_order):
             chunk = store.load(int(chunk_idx))
             key, k = jax.random.split(key)
             ensemble_train_loop(
@@ -84,9 +84,11 @@ def basic_l1_sweep(
             )
             if save_after_every:
                 learned_dicts = export()
+                # named by training-sequence position (like the reference's
+                # enumerate counter, `basic_l1_sweep.py:92,114`), NOT by the
+                # shuffled store index — chunk_{k} is always the k-th state
                 save_learned_dicts(
-                    out / f"epoch_{epoch}" / f"chunk_{int(chunk_idx)}"
-                    / "learned_dicts.pkl",
+                    out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
                     learned_dicts,
                 )
         if not save_after_every:
