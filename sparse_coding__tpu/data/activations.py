@@ -105,6 +105,53 @@ def _jitted_capture(lm_cfg: lm_model.LMConfig, names: Tuple[str, ...], stop_at: 
 
     return jax.jit(f)
 
+def _harvest_plan(
+    lm_cfg: lm_model.LMConfig,
+    layers: Sequence[int],
+    layer_locs: Sequence[str],
+    chunk_size_gb: float,
+    batch_size: int,
+    seq_len: int,
+):
+    """Shared geometry for the disk and fused harvest paths: capture-point
+    name map, early-exit layer, and how many capture batches fill one chunk
+    (all points fill at the same row rate; the budget is the min)."""
+    names = {
+        (layer, loc): lm_model.make_tensor_name(layer, loc)
+        for layer in layers
+        for loc in layer_locs
+    }
+    stop_at = max(layers) + 1
+    chunk_rows = min(
+        int(chunk_size_gb * 1024**3 // (lm_model.get_activation_size(lm_cfg, loc) * 2))
+        for _, loc in names
+    )
+    batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
+    return names, stop_at, batches_per_chunk
+
+
+def _build_capture(lm_cfg, names: Dict, stop_at: int, mesh, seq_attn: str):
+    """The compiled capture forward, single-device or sequence-parallel; both
+    cast to fp16 ON DEVICE inside the jitted program (halved fetch bytes)."""
+    if mesh is None:
+        return _jitted_capture(lm_cfg, tuple(names.values()), stop_at)
+    from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
+
+    # built ONCE: repeated calls reuse the compiled sharded program; the
+    # fp16 cast is jitted AROUND seq_fn so XLA fuses it like the
+    # single-device path
+    seq_fn = make_sequence_parallel_fn(
+        lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at,
+        attn=seq_attn,
+    )
+
+    @jax.jit
+    def capture(p, t):
+        return {k: v.astype(jnp.float16) for k, v in seq_fn(p, t)[1].items()}
+
+    return capture
+
+
 def harvest_folder_name(base_folder, layer: int, layer_loc: str) -> Path:
     """One folder per (layer, location), reference layout `{base}_l{layer}_{loc}`
     (cf. `make_activation_dataset_hf` folder-per-layer, `:326-391`)."""
@@ -136,15 +183,9 @@ def make_activation_dataset(
     forward to sequence parallelism (`seq_attn`: "ring" | "ulysses",
     `lm.ring_attention`).
     """
-    names = {
-        (layer, loc): lm_model.make_tensor_name(layer, loc)
-        for layer in layers
-        for loc in layer_locs
-    }
-    stop_at = max(layers) + 1
-    d_sizes = {
-        (layer, loc): lm_model.get_activation_size(lm_cfg, loc) for layer, loc in names
-    }
+    names, stop_at, batches_per_chunk = _harvest_plan(
+        lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
+    )
 
     if single_folder:
         assert len(names) == 1, "single_folder requires exactly one capture point"
@@ -157,30 +198,7 @@ def make_activation_dataset(
     for f in folders.values():
         f.mkdir(parents=True, exist_ok=True)
 
-    if mesh is None:
-        capture = _jitted_capture(lm_cfg, tuple(names.values()), stop_at)
-    else:
-        from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
-
-        # built ONCE: repeated calls reuse the compiled sharded program; the
-        # fp16 cast is jitted AROUND seq_fn so XLA fuses it like the
-        # single-device path (halved fetch bytes, no transient fp32 copy)
-        seq_fn = make_sequence_parallel_fn(
-            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at,
-            attn=seq_attn,
-        )
-
-        @jax.jit
-        def capture(p, t):
-            return {k: v.astype(jnp.float16) for k, v in seq_fn(p, t)[1].items()}
-
-    seq_len = tokens.shape[1]
-    rows_per_chunk = {
-        key: int(chunk_size_gb * 1024**3 // (d * 2)) for key, d in d_sizes.items()
-    }
-    # all capture points fill at the same row rate; chunk row budget is the min
-    chunk_rows = min(rows_per_chunk.values())
-    batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
+    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn)
 
     n_batches_total = tokens.shape[0] // batch_size
     max_chunks = n_chunks if n_chunks is not None else math.inf
@@ -260,28 +278,10 @@ def harvest_to_device(
     fp16 `.npy` store (pays the device→host fetch; keeps the data contract
     when the run should be resumable/reusable).
     """
-    names = {
-        (layer, loc): lm_model.make_tensor_name(layer, loc)
-        for layer in layers
-        for loc in layer_locs
-    }
-    stop_at = max(layers) + 1
-    d_sizes = {
-        (layer, loc): lm_model.get_activation_size(lm_cfg, loc) for layer, loc in names
-    }
-    if mesh is None:
-        capture = _jitted_capture(lm_cfg, tuple(names.values()), stop_at)
-    else:
-        from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
-
-        seq_fn = make_sequence_parallel_fn(
-            lm_cfg, mesh, cache_names=list(names.values()), stop_at_layer=stop_at,
-            attn=seq_attn,
-        )
-
-        @jax.jit
-        def capture(p, t):
-            return {k: v.astype(jnp.float16) for k, v in seq_fn(p, t)[1].items()}
+    names, stop_at, batches_per_chunk = _harvest_plan(
+        lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
+    )
+    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn)
 
     folders = None
     if save_folder is not None:
@@ -292,11 +292,6 @@ def harvest_to_device(
         for f in folders.values():
             f.mkdir(parents=True, exist_ok=True)
 
-    seq_len = tokens.shape[1]
-    chunk_rows = min(
-        int(chunk_size_gb * 1024**3 // (d * 2)) for d in d_sizes.values()
-    )
-    batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
     n_batches_total = tokens.shape[0] // batch_size
     max_chunks = n_chunks if n_chunks is not None else math.inf
 
@@ -313,6 +308,10 @@ def harvest_to_device(
         chunk = {
             key: jnp.concatenate(parts, axis=0) for key, parts in buffers.items()
         }
+        # free the per-batch parts BEFORE yielding: the paused generator would
+        # otherwise keep a second full copy of the chunk alive in HBM for the
+        # whole consuming train step
+        del buffers
         if folders is not None:
             for key, arr in chunk.items():
                 save_chunk(folders[key], chunk_idx, np.asarray(jax.device_get(arr)))
