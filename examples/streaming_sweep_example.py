@@ -1,0 +1,80 @@
+"""Fused harvest→train streaming example.
+
+Trains an l1-sweep SAE ensemble directly on LM activations as they are
+captured — the chunks never leave HBM (`data.harvest_to_device`,
+THROUGHPUT.md round-2f). Use this shape when the activations are consumed
+once by training on the same chip(s); use `make_activation_dataset` +
+`train.sweep` when you need the on-disk store (resume, multiple epochs over
+more data than fits in HBM, offline eval).
+
+Runs on CPU or one TPU chip in ~a minute with a small random-init subject
+model: `python examples/streaming_sweep_example.py`
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+
+from sparse_coding__tpu import build_ensemble, metrics as sm
+from sparse_coding__tpu.data import harvest_to_device
+from sparse_coding__tpu.lm import LMConfig, init_params
+from sparse_coding__tpu.models import FunctionalTiedSAE
+
+
+def main():
+    # subject model: pythia-70m-like geometry at random init (swap in
+    # lm.convert.load_model("EleutherAI/pythia-70m-deduped") with weights)
+    layer, loc = 2, "residual"
+    cfg = LMConfig(
+        arch="neox", n_layers=4, d_model=128, n_heads=4, d_mlp=512,
+        vocab_size=1024, n_ctx=64, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (512, 64), dtype=np.int32)
+
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(1),
+        [{"l1_alpha": a} for a in (1e-4, 3e-4, 1e-3, 3e-3)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=cfg.d_model,
+        n_dict_components=4 * cfg.d_model,
+    )
+
+    batch_size, n_epochs_per_chunk = 1024, 4
+    last_chunk = None
+    for i, chunk in enumerate(
+        harvest_to_device(
+            params, cfg, tokens, [layer], [loc],
+            batch_size=64, chunk_size_gb=64 * 64 * cfg.d_model * 2 * 2 / 1024**3,
+        )
+    ):
+        acts = chunk[(layer, loc)].astype(jnp.float32)  # HBM-resident already
+        key = jax.random.PRNGKey(10 + i)
+        for _ in range(n_epochs_per_chunk):
+            key, k = jax.random.split(key)
+            perm = jax.random.permutation(k, acts.shape[0])
+            n_steps = acts.shape[0] // batch_size
+            batches = acts[perm[: n_steps * batch_size]].reshape(
+                n_steps, batch_size, cfg.d_model
+            )
+            losses = ens.step_scan(batches)  # one dispatch per epoch pass
+        loss = np.asarray(jax.device_get(losses["loss"]))[-1]
+        print(f"chunk {i}: rows={acts.shape[0]} final losses {np.round(loss, 5)}")
+        last_chunk = acts
+
+    rows = sm.evaluate_dicts(ens.to_learned_dicts(), last_chunk)
+    for hp, row in zip((1e-4, 3e-4, 1e-3, 3e-3), rows):
+        print(f"l1={hp:.0e}  fvu={row['fvu']:.3f}  l0={row['l0']:.1f}")
+
+
+if __name__ == "__main__":
+    main()

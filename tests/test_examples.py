@@ -1,0 +1,28 @@
+"""The committed examples stay runnable (subprocess, same entry a user runs)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "script", ["ensemble_training_example.py", "streaming_sweep_example.py"]
+)
+def test_example_runs(script):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / script)],
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    if script == "streaming_sweep_example.py":
+        # the printed pareto must slope the right way: the last (highest-l1)
+        # line is sparser than the first
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("l1=")]
+        assert len(lines) == 4, proc.stdout
+        l0s = [float(l.split("l0=")[1]) for l in lines]
+        assert l0s[-1] < l0s[0], proc.stdout
