@@ -256,6 +256,24 @@ def make_ensemble_multi_step(
     return multi_step
 
 
+def _preshard(batch, sharding):
+    """Place `batch` under `sharding` unless it already is.
+
+    Multi-host: a caller-presharded global array must pass through —
+    `jax.device_put` from host values cannot target non-addressable devices,
+    and re-putting an already-equivalent array is pointless (pod callers
+    build batches with `jax.make_array_from_callback` /
+    `parallel.distributed.host_local_to_global`). Equivalence, not equality:
+    `P('data')` and `P('data', None)` are the same placement but compare
+    unequal.
+    """
+    if isinstance(batch, jax.Array) and sharding.is_equivalent_to(
+        batch.sharding, batch.ndim
+    ):
+        return batch
+    return jax.device_put(batch, sharding)
+
+
 class Ensemble:
     """N models of one signature, trained in lockstep inside one compiled step.
 
@@ -400,7 +418,7 @@ class Ensemble:
         """
         if getattr(self, "_mesh", None) is not None:
             sharding = self._pm_batch_sharding if per_model else self._batch_sharding
-            batch = jax.device_put(batch, sharding)
+            batch = _preshard(batch, sharding)
         fn = self._step_pm if per_model else self._step
         self.state, (loss_dict, aux) = fn(self.state, batch)
         return loss_dict, aux
@@ -421,7 +439,7 @@ class Ensemble:
                 if per_model
                 else mesh_lib.batch_sharding(self._mesh, leading=1)
             )
-            batches = jax.device_put(batches, sharding)
+            batches = _preshard(batches, sharding)
         fn = self._multi_pm if per_model else self._multi
         self.state, loss_dicts = fn(self.state, batches)
         return loss_dicts

