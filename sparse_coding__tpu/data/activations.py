@@ -382,10 +382,16 @@ def setup_data(
     batch_size: int = MODEL_BATCH_SIZE,
     max_lines: int = 100_000,
     skip_chunks: int = 0,
+    compute_dtype=None,
 ) -> int:
     """Full pipeline: HF model + dataset → tokenize → harvest → chunk store
     (reference `setup_data`, `activation_dataset.py:400-460`). Needs the HF
-    model/dataset locally cached or network access. Returns n_datapoints."""
+    model/dataset locally cached or network access. Returns n_datapoints.
+    ``compute_dtype="bfloat16"`` runs the capture forward in bf16 (see
+    `_jitted_capture`)."""
+    # resolve the dtype BEFORE the expensive model load/tokenize: a typo'd
+    # string should fail in milliseconds, not minutes into the run
+    compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
     import transformers
 
     from sparse_coding__tpu.lm.convert import _canonical_hf_name, load_model
@@ -403,6 +409,7 @@ def setup_data(
         batch_size=batch_size, chunk_size_gb=chunk_size_gb, n_chunks=n_chunks,
         skip_chunks=skip_chunks, center_dataset=center_dataset,
         single_folder=single,
+        compute_dtype=compute_dtype,
     )
     return sum(ChunkStore(f).n_datapoints() for f in folders.values())
 
@@ -422,12 +429,14 @@ def main(argv=None):
     p.add_argument("--chunk_size_gb", type=float, default=2.0)
     p.add_argument("--center_dataset", action="store_true")
     p.add_argument("--skip_chunks", type=int, default=0)
+    p.add_argument("--compute_dtype", default=None,
+                   help="e.g. bfloat16: run the capture forward MXU-native")
     args = p.parse_args(argv)
     n = setup_data(
         args.model_name, args.dataset_name, args.dataset_folder,
         layer=args.layers, layer_loc=args.layer_locs, n_chunks=args.n_chunks,
         chunk_size_gb=args.chunk_size_gb, center_dataset=args.center_dataset,
-        skip_chunks=args.skip_chunks,
+        skip_chunks=args.skip_chunks, compute_dtype=args.compute_dtype,
     )
     print(f"wrote {n} datapoints")
 

@@ -240,6 +240,7 @@ def init_model_dataset(cfg) -> ChunkStore:
         n_chunks=cfg.n_chunks,
         chunk_size_gb=cfg.chunk_size_gb,
         center_dataset=cfg.center_dataset,
+        compute_dtype=cfg.harvest_compute_dtype,
     )
     return store
 

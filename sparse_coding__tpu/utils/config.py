@@ -155,10 +155,17 @@ class TrainArgs(BaseArgs):
     # fields sweep() reads that the reference forgot to declare (§2.7):
     n_repetitions: Optional[int] = None  # None → use n_epochs
     center_activations: bool = False
+    # bf16 subject forward for the harvest (data.activations._jitted_capture)
+    harvest_compute_dtype: Optional[str] = None
 
     def validate(self):
         if self.dtype not in DTYPES:
             raise ValueError(f"dtype must be one of {sorted(DTYPES)}, got {self.dtype}")
+        if self.harvest_compute_dtype is not None and self.harvest_compute_dtype not in DTYPES:
+            raise ValueError(
+                f"harvest_compute_dtype must be one of {sorted(DTYPES)} or None, "
+                f"got {self.harvest_compute_dtype}"
+            )
         # exactly the set lm.model.make_tensor_name/get_activation_size accept
         if self.layer_loc not in ("residual", "mlp", "mlpout", "attn"):
             raise ValueError(f"unknown layer_loc {self.layer_loc}")

@@ -59,3 +59,14 @@ def test_cli_optional_and_typed_fields():
     cfg = TrainArgs.from_cli(["--n_repetitions", "3", "--chunk_size_gb", "0.5"])
     assert cfg.n_repetitions == 3 and isinstance(cfg.n_repetitions, int)
     assert cfg.chunk_size_gb == 0.5
+
+
+def test_harvest_compute_dtype_field():
+    """The bf16-capture option reaches the sweep config and its auto-CLI."""
+    assert TrainArgs().harvest_compute_dtype is None
+    cfg = TrainArgs.from_cli(["--harvest_compute_dtype", "bfloat16"])
+    assert cfg.harvest_compute_dtype == "bfloat16"
+    import pytest
+
+    with pytest.raises(ValueError):
+        TrainArgs(harvest_compute_dtype="bf16x")
