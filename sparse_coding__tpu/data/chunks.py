@@ -73,14 +73,16 @@ class ChunkStore:
 
         The on-disk fp16 bytes are transferred as-is and upcast ON DEVICE:
         host-side upcasting would double the host→device bytes, the dominant
-        cost of chunk streaming."""
+        cost of chunk streaming. ``dtype=None`` keeps the on-disk dtype
+        (callers that cache chunks in HBM keep the fp16 footprint and upcast
+        per use — exact, fp16→fp32 is lossless)."""
         arr = np.load(chunk_path(self.folder, i))
         x = jnp.asarray(arr)
         if sharding is not None:
             x = jax.device_put(x, sharding)
         elif device is not None:
             x = jax.device_put(x, device)
-        if x.dtype != jnp.dtype(dtype):
+        if dtype is not None and x.dtype != jnp.dtype(dtype):
             x = x.astype(dtype)
         return x
 

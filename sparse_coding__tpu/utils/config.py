@@ -157,6 +157,9 @@ class TrainArgs(BaseArgs):
     center_activations: bool = False
     # bf16 subject forward for the harvest (data.activations._jitted_capture)
     harvest_compute_dtype: Optional[str] = None
+    # multi-epoch sweeps with HBM-sized datasets: upload chunks once, not
+    # once per epoch (train/sweep.py)
+    hbm_cache_chunks: bool = False
 
     def validate(self):
         if self.dtype not in DTYPES:

@@ -129,3 +129,20 @@ def test_chunk_store_prefetch(tmp_path):
         np.testing.assert_allclose(
             np.asarray(c), np.asarray(store.load(i)), rtol=1e-6
         )
+
+
+def test_hbm_cache_chunks_matches_streaming(tmp_path):
+    """`hbm_cache_chunks=True` (upload each chunk once, reuse every epoch)
+    must train to exactly the same dictionaries as the streaming path."""
+    cfg_a = make_cfg(tmp_path, output_folder=str(tmp_path / "out_stream"))
+    dicts_a = sweep(l1_ensemble_init, cfg_a)
+    cfg_b = make_cfg(
+        tmp_path, output_folder=str(tmp_path / "out_cached"),
+        hbm_cache_chunks=True,
+    )
+    dicts_b = sweep(l1_ensemble_init, cfg_b)
+    for (ld_a, hp_a), (ld_b, hp_b) in zip(dicts_a, dicts_b):
+        assert hp_a == hp_b
+        np.testing.assert_array_equal(
+            np.asarray(ld_a.get_learned_dict()), np.asarray(ld_b.get_learned_dict())
+        )
