@@ -14,6 +14,7 @@ from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding__tpu.data.chunks import ChunkStore
@@ -37,12 +38,15 @@ def basic_l1_sweep(
     seed: int = 0,
     shuffle_chunks: bool = True,
     save_after_every: bool = False,
+    hbm_cache: bool = False,
 ) -> List[Tuple[object, dict]]:
     """Train a FISTA ensemble over `l1_values` on every chunk in
     `dataset_folder`; save learned dicts per epoch (reference
     `basic_l1_sweep.py:48-123`). Chunk order is re-shuffled each epoch and
     `save_after_every` saves per chunk instead of per epoch, as in the
-    reference (`basic_l1_sweep.py:90,110-118`). Returns the final dict list."""
+    reference (`basic_l1_sweep.py:90,110-118`). `hbm_cache` uploads each
+    chunk once (native dtype) and reuses it across epochs — see
+    `train.sweep`'s `hbm_cache_chunks`. Returns the final dict list."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
@@ -64,6 +68,7 @@ def basic_l1_sweep(
     key = jax.random.PRNGKey(seed + 1)
     order_rng = np.random.default_rng(seed)
     learned_dicts: List[Tuple[object, dict]] = []
+    cache: dict = {}
 
     def export():
         return [
@@ -76,7 +81,12 @@ def basic_l1_sweep(
             order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
         )
         for pos, chunk_idx in enumerate(chunk_order):
-            chunk = store.load(int(chunk_idx))
+            if hbm_cache:
+                if int(chunk_idx) not in cache:
+                    cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
+                chunk = cache[int(chunk_idx)].astype(jnp.float32)
+            else:
+                chunk = store.load(int(chunk_idx))
             key, k = jax.random.split(key)
             ensemble_train_loop(
                 ens, chunk, batch_size=batch_size, key=k,

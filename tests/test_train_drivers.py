@@ -105,13 +105,23 @@ def test_resurrect_dead_features_pure():
 
 def test_basic_l1_sweep(tmp_path, data):
     save_chunk(tmp_path / "chunks", 0, np.asarray(data))
-    dicts = basic_l1_sweep(
-        str(tmp_path / "chunks"), str(tmp_path / "out"),
+    kw = dict(
         activation_width=24, l1_values=[1e-4, 1e-3], dict_ratio=2,
-        batch_size=256, fista_iters=30,
+        batch_size=256, fista_iters=30, n_epochs=2,
     )
+    dicts = basic_l1_sweep(str(tmp_path / "chunks"), str(tmp_path / "out"), **kw)
     assert len(dicts) == 2
     assert (tmp_path / "out" / "epoch_0" / "learned_dicts.pkl").exists()
+
+    # hbm_cache (chunk uploaded once, reused across epochs) trains identically
+    cached = basic_l1_sweep(
+        str(tmp_path / "chunks"), str(tmp_path / "out_cached"), hbm_cache=True, **kw
+    )
+    for (ld_a, hp_a), (ld_b, hp_b) in zip(dicts, cached):
+        assert hp_a == hp_b
+        np.testing.assert_array_equal(
+            np.asarray(ld_a.get_learned_dict()), np.asarray(ld_b.get_learned_dict())
+        )
 
 
 BUILDERS = [
