@@ -123,6 +123,12 @@ def _jitted_capture(
     return jax.jit(f)
 
 
+def _canon_dtype(compute_dtype):
+    """Canonicalise a dtype spec ('bfloat16' / np.dtype / jnp.bfloat16 / None)
+    so jit static args and lru_cache keys are identical for equal specs."""
+    return jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+
 def _cast_params(params, compute_dtype):
     """Cast the floating leaves of a param tree to `compute_dtype`."""
     return jax.tree.map(
@@ -172,6 +178,7 @@ def _build_capture(
     cast to fp16 ON DEVICE inside the jitted program (halved fetch bytes).
     `compute_dtype` (single-device path): bf16 subject forward, see
     `_jitted_capture`."""
+    compute_dtype = _canon_dtype(compute_dtype)
     if compute_dtype is not None and mesh is not None:
         raise ValueError("compute_dtype is a single-device capture option")
     if mesh is None:
@@ -242,6 +249,7 @@ def make_activation_dataset(
     for f in folders.values():
         f.mkdir(parents=True, exist_ok=True)
 
+    compute_dtype = _canon_dtype(compute_dtype)
     capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype)
     if compute_dtype is not None:
         params = _cast_params_jit(params, compute_dtype)  # pay the cast once
@@ -328,6 +336,7 @@ def harvest_to_device(
     names, stop_at, batches_per_chunk = _harvest_plan(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
     )
+    compute_dtype = _canon_dtype(compute_dtype)
     capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype)
     if compute_dtype is not None:
         params = _cast_params_jit(params, compute_dtype)  # pay the cast once
@@ -391,7 +400,7 @@ def setup_data(
     `_jitted_capture`)."""
     # resolve the dtype BEFORE the expensive model load/tokenize: a typo'd
     # string should fail in milliseconds, not minutes into the run
-    compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+    compute_dtype = _canon_dtype(compute_dtype)
     import transformers
 
     from sparse_coding__tpu.lm.convert import _canonical_hf_name, load_model

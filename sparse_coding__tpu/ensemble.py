@@ -168,7 +168,12 @@ def make_ensemble_step(
                     not hasattr(sig, "fused_batch_supported")
                     or sig.fused_batch_supported(
                         state.params, batch.shape[0],
-                        adam_fused=fused_adam is not None,
+                        # mirror the dispatch below: the Adam kernel only runs
+                        # when the signature actually implements it, so the
+                        # VMEM fit must be checked against the kernel that
+                        # will execute
+                        adam_fused=fused_adam is not None
+                        and hasattr(sig, "fused_adam_step"),
                     )
                 )
             )
