@@ -130,13 +130,15 @@ def bench_fista() -> float:
     x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, D_ACT))
     solve = jax.jit(lambda xx, dd: fista_solve(xx, dd, 1e-3, None, num_iter=500)[0])
     jax.device_get(solve(x, d)).sum()  # warmup/compile
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    # single 1-4 s dispatches vary 3-5x run-to-run on the shared chip
+    # (THROUGHPUT.md r3) — report the best of 5, not a polluted mean
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
         ahat = solve(x, d)
-    jax.device_get(ahat).sum()
-    dt = time.perf_counter() - t0
-    return reps * BATCH / dt
+        jax.device_get(ahat).sum()
+        best = min(best, time.perf_counter() - t0)
+    return BATCH / best
 
 
 def bench_stream() -> float:

@@ -29,7 +29,7 @@ that story this environment can measure:
    to the unsharded step, with the dictionary + Adam moments confirmed
    dict-axis-sharded (per-device parameter bytes halve).
 
-Writes PARITY_r02_dictpar.json (+ pareto figure) at the repo root.
+Writes PARITY_<round>_dictpar.json (+ pareto figure) at the repo root.
 Run: `python scripts/dictpar_run.py` (real chip, ~5 min). `--quick` is a
 CPU-sized smoke mode used by the test suite.
 """
@@ -47,6 +47,9 @@ from pathlib import Path
 import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r03")  # artifact round tag
+
+
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
@@ -152,6 +155,9 @@ def mesh_validate(quick: bool) -> dict:
 
 
 def main(argv=None):
+    from sparse_coding__tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
@@ -399,7 +405,7 @@ def main(argv=None):
     out_prefix = Path(args.out) if args.out else REPO
     out_prefix.mkdir(parents=True, exist_ok=True)
     suffix = "_quick" if quick else ""
-    json_path = out_prefix / f"PARITY_r02_dictpar{suffix}.json"
+    json_path = out_prefix / f"PARITY_{ROUND_TAG}_dictpar{suffix}.json"
     with open(json_path, "w") as f:
         json.dump(report, f, indent=1)
     print(f"Wrote {json_path}")
@@ -420,7 +426,7 @@ def main(argv=None):
         f"{report['config']['subject']}"
     )
     ax.legend()
-    fig_path = out_prefix / f"parity_pareto_r02_dictpar{suffix}.png"
+    fig_path = out_prefix / f"parity_pareto_{ROUND_TAG}_dictpar{suffix}.png"
     fig.savefig(fig_path, dpi=150, bbox_inches="tight")
     print(f"Wrote {fig_path}")
     return report

@@ -146,6 +146,21 @@ def _cast_params_jit(params, compute_dtype):
     the bf16 win it exists to buy."""
     return _cast_params(params, compute_dtype)
 
+def _probe_activation_size(lm_cfg, name: str, stop_at: int, seq_len: int) -> int:
+    """Width of an arbitrary qualified hook point, WITHOUT running the model:
+    `jax.eval_shape` traces the capture forward on abstract values. This is
+    what lets harvest accept any name `forward` emits (the baukit
+    any-module analogue, reference `activation_dataset.py:292-298`) instead
+    of only the four registered shorthands."""
+    tok = jax.ShapeDtypeStruct((1, seq_len), jnp.int32)
+    params = jax.eval_shape(lambda k: lm_model.init_params(k, lm_cfg), jax.random.PRNGKey(0))
+    _, cache = jax.eval_shape(
+        lambda p, t: lm_model.run_with_cache(p, t, lm_cfg, [name], stop_at_layer=stop_at),
+        params, tok,
+    )
+    return int(cache[name].shape[-1])
+
+
 def _harvest_plan(
     lm_cfg: lm_model.LMConfig,
     layers: Sequence[int],
@@ -163,9 +178,18 @@ def _harvest_plan(
         for loc in layer_locs
     }
     stop_at = max(layers) + 1
+
+    def width(loc, name):
+        try:
+            return lm_model.get_activation_size(lm_cfg, loc)
+        except ValueError:
+            # unregistered qualified name: size it by shape-probing the
+            # forward (no compute, no compile)
+            return _probe_activation_size(lm_cfg, name, stop_at, seq_len)
+
     chunk_rows = min(
-        int(chunk_size_gb * 1024**3 // (lm_model.get_activation_size(lm_cfg, loc) * 2))
-        for _, loc in names
+        int(chunk_size_gb * 1024**3 // (width(loc, name) * 2))
+        for (_, loc), name in names.items()
     )
     batches_per_chunk = max(1, chunk_rows // (batch_size * seq_len))
     return names, stop_at, batches_per_chunk
@@ -224,6 +248,7 @@ def make_activation_dataset(
     seq_attn: str = "ring",
     single_folder: bool = False,
     compute_dtype=None,
+    store_dtype=np.float16,
 ) -> Dict[Tuple[int, str], Path]:
     """Run the subject LM over `tokens` `[N, S]`, capturing every requested
     (layer, layer_loc) in one pass; write fp16 chunks per capture point.
@@ -232,7 +257,8 @@ def make_activation_dataset(
     (reference `:351-358`); `center_dataset` subtracts the first chunk's mean
     from all chunks (reference `:308-311, 379-381`); `mesh` switches the
     forward to sequence parallelism (`seq_attn`: "ring" | "ulysses",
-    `lm.ring_attention`).
+    `lm.ring_attention`); `store_dtype=np.int8` writes quantized chunks
+    (half the disk/transfer bytes, on-device dequant — `data.chunks`).
     """
     names, stop_at, batches_per_chunk = _harvest_plan(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
@@ -295,7 +321,7 @@ def make_activation_dataset(
                 elif key not in means:
                     means[key] = np.load(folders[key] / "mean.npy")
                 chunk = chunk - means[key]
-            save_chunk(folders[key], chunk_idx, chunk)
+            save_chunk(folders[key], chunk_idx, chunk, dtype=store_dtype)
         batch_cursor += batches_per_chunk
         chunk_idx += 1
 

@@ -346,6 +346,15 @@ class Ensemble:
 
         self._build_steps(donate=donate)
 
+    # Shared jitted step functions: two Ensembles with the same (signature,
+    # optimizer config, execution flags) — e.g. the per-seed replicas of a
+    # parity/sweep run — reuse ONE jit wrapper, so XLA compiles each program
+    # once per shape instead of once per instance. Keyed only for string
+    # optimizers (a custom optax tx has no canonical identity). FIFO-bounded:
+    # a driver sweeping many configs must not pin executables forever.
+    _SHARED_STEPS: Dict[tuple, tuple] = {}
+    _SHARED_STEPS_MAX = 32
+
     def _build_steps(self, donate: bool = True):
         fused_adam = None
         if (
@@ -375,6 +384,25 @@ class Ensemble:
             fused_adam=fused_adam,
         )
         donate_argnums = (0,) if donate else ()
+
+        cache_key = None
+        if self.optimizer_name != "custom":
+            cache_key = (
+                self.sig,
+                self.optimizer_name,
+                tuple(sorted((k, str(v)) for k, v in self.optimizer_kwargs.items())),
+                self.unstacked,
+                self.compute_dtype,
+                kw["fused"],
+                None if fused_adam is None else tuple(sorted(fused_adam.items())),
+                donate,
+            )
+            if cache_key in Ensemble._SHARED_STEPS:
+                (self._step, self._step_pm, self._multi, self._multi_pm) = (
+                    Ensemble._SHARED_STEPS[cache_key]
+                )
+                return
+
         self._step = jax.jit(
             make_ensemble_step(self.sig, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
@@ -391,6 +419,12 @@ class Ensemble:
             make_ensemble_multi_step(self.sig, self.tx, per_model_batch=True, **kw),
             donate_argnums=donate_argnums,
         )
+        if cache_key is not None:
+            if len(Ensemble._SHARED_STEPS) >= Ensemble._SHARED_STEPS_MAX:
+                Ensemble._SHARED_STEPS.pop(next(iter(Ensemble._SHARED_STEPS)))
+            Ensemble._SHARED_STEPS[cache_key] = (
+                self._step, self._step_pm, self._multi, self._multi_pm
+            )
 
     # -- scale-out -----------------------------------------------------------
 

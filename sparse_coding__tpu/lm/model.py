@@ -23,6 +23,14 @@ Hook names are transformer_lens-compatible:
   blocks.{i}.mlp.hook_post         — MLP hidden post-activation      ("mlp")
   blocks.{i}.hook_mlp_out          — MLP output in residual basis    ("mlpout")
   blocks.{i}.attn.hook_z           — per-head attn out, flattened    ("attn")
+plus the generic-capture surface (any named intermediate, the baukit
+`Trace`-on-any-module analogue, reference `activation_dataset.py:292-298`):
+  hook_embed                       — token embeddings
+  blocks.{i}.attn.hook_{q,k,v}     — post-rotary heads, flattened    ("attn_q"…)
+  blocks.{i}.attn.hook_pattern     — attention probs (dense only)    ("pattern")
+  blocks.{i}.hook_attn_out         — attn out in residual basis      ("attn_out")
+  blocks.{i}.hook_resid_mid        — residual after attn (serial)    ("resid_mid")
+  blocks.{i}.mlp.hook_pre          — MLP hidden pre-activation       ("mlp_pre")
 (The reference's `make_tensor_name` maps "attn" to `hook_resid_post` while
 `get_activation_size` sizes it as n_heads*d_head — `activation_dataset.py:51-76`
 vs `:99-103`, an inconsistency we do not replicate.)
@@ -112,26 +120,55 @@ def get_activation_size(model_name_or_cfg, layer_loc: str) -> int:
         if isinstance(model_name_or_cfg, LMConfig)
         else config_for(model_name_or_cfg)
     )
-    if layer_loc in ("residual", "mlpout"):
+    if layer_loc in ("residual", "mlpout", "attn_out", "resid_mid"):
         return cfg.d_model
-    if layer_loc == "mlp":
+    if layer_loc in ("mlp", "mlp_pre"):
         return cfg.d_mlp
-    if layer_loc == "attn":
+    if layer_loc in ("attn", "attn_q", "attn_k", "attn_v"):
         return cfg.n_heads * cfg.d_head
-    raise ValueError(f"Layer location {layer_loc} not supported")
+    if layer_loc == "pattern":
+        return cfg.n_ctx  # upper bound; the true last dim is the seq length
+    raise ValueError(
+        f"Layer location {layer_loc} has no registered size; harvest sizes "
+        "unregistered qualified names via a jax.eval_shape probe"
+    )
+
+
+# every per-block hook point `forward` emits, by shorthand. The first four
+# are the reference's vocabulary (`activation_dataset.py:78-109`); the rest
+# are the generic-capture surface (the baukit `Trace`-on-any-module analogue,
+# reference `activation_dataset.py:292-298`) — in a functional model "any
+# module" means "any named intermediate", and these name every one the
+# forward materializes. See docs/adding_an_architecture.md.
+HOOK_TEMPLATES = {
+    "residual": "blocks.{layer}.hook_resid_post",
+    "mlp": "blocks.{layer}.mlp.hook_post",
+    "mlpout": "blocks.{layer}.hook_mlp_out",
+    "attn": "blocks.{layer}.attn.hook_z",
+    "mlp_pre": "blocks.{layer}.mlp.hook_pre",
+    "attn_out": "blocks.{layer}.hook_attn_out",
+    "attn_q": "blocks.{layer}.attn.hook_q",
+    "attn_k": "blocks.{layer}.attn.hook_k",
+    "attn_v": "blocks.{layer}.attn.hook_v",
+    "pattern": "blocks.{layer}.attn.hook_pattern",
+    "resid_mid": "blocks.{layer}.hook_resid_mid",
+}
 
 
 def make_tensor_name(layer: int, layer_loc: str) -> str:
-    """(reference `make_tensor_name`, `activation_dataset.py:78-109`)"""
-    names = {
-        "residual": f"blocks.{layer}.hook_resid_post",
-        "mlp": f"blocks.{layer}.mlp.hook_post",
-        "mlpout": f"blocks.{layer}.hook_mlp_out",
-        "attn": f"blocks.{layer}.attn.hook_z",
-    }
-    if layer_loc not in names:
-        raise ValueError(f"Layer location {layer_loc} not supported")
-    return names[layer_loc]
+    """(reference `make_tensor_name`, `activation_dataset.py:78-109`)
+
+    `layer_loc` is a shorthand from `HOOK_TEMPLATES`, a template containing
+    ``{layer}`` (e.g. ``"blocks.{layer}.attn.hook_q"``), or an already
+    fully-qualified hook name (used as-is) — the capture-by-qualified-name
+    surface."""
+    if layer_loc in HOOK_TEMPLATES:
+        return HOOK_TEMPLATES[layer_loc].format(layer=layer)
+    if "{layer}" in layer_loc:
+        return layer_loc.format(layer=layer)
+    if layer_loc.startswith(("blocks.", "hook_")):
+        return layer_loc
+    raise ValueError(f"Layer location {layer_loc} not supported")
 
 
 # -- init ---------------------------------------------------------------------
@@ -202,8 +239,12 @@ def _rope(x: jax.Array, positions: jax.Array, rotary_dims: int, base: float) -> 
     return jnp.concatenate([rotated, rest], axis=-1)
 
 
-def dense_attention(q, k, v, causal: bool = True):
-    """[B, S, H, Dh] attention, fp32 softmax accumulation."""
+def dense_attention(q, k, v, causal: bool = True, pattern_cb: Optional[Callable] = None):
+    """[B, S, H, Dh] attention, fp32 softmax accumulation.
+
+    `pattern_cb` intercepts (and may replace) the [B, H, Q, K] attention
+    probabilities — the `hook_pattern` capture point. Only the dense impl can
+    offer it: the ring/blockwise impls never materialize the full pattern."""
     scale = 1.0 / jnp.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -211,6 +252,8 @@ def dense_attention(q, k, v, causal: bool = True):
         mask = jnp.tril(jnp.ones((S, K), bool))
         scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if pattern_cb is not None:
+        probs = pattern_cb(probs)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -221,10 +264,15 @@ def _gelu_new(x):
 
 def attention_block(
     p, x_normed, cfg: LMConfig, attn_impl: Callable = dense_attention,
-    positions: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None, hook: Optional[Callable] = None,
+    pattern_needed: bool = False,
 ):
     """Returns (attn_out [B,S,d_model], z [B,S,H*Dh]). `positions` are GLOBAL
-    token positions (needed when the sequence axis is sharded)."""
+    token positions (needed when the sequence axis is sharded). `hook(suffix,
+    tensor)` intercepts the block-local capture points (`attn.hook_{q,k,v}`
+    post-rotary as flattened [B,S,H*Dh]); `pattern_needed` additionally
+    routes `attn.hook_pattern` through it (dense attention only — the
+    [B,H,Q,K] pattern is materialized only when asked for)."""
     qkv = jnp.einsum("thdm,bsm->tbshd", p["w_qkv"], x_normed) + p["b_qkv"][:, None, None]
     q, k, v = qkv[0], qkv[1], qkv[2]
     if cfg.arch == "neox":
@@ -233,17 +281,40 @@ def attention_block(
             positions = jnp.arange(x_normed.shape[1])
         q = _rope(q, positions, rotary_dims, cfg.rotary_base)
         k = _rope(k, positions, rotary_dims, cfg.rotary_base)
-    z = attn_impl(q, k, v)  # [B, S, H, Dh]
+    if hook is not None:
+        flat = lambda t: t.reshape(*t.shape[:2], -1)
+        q = hook("attn.hook_q", flat(q)).reshape(q.shape)
+        k = hook("attn.hook_k", flat(k)).reshape(k.shape)
+        v = hook("attn.hook_v", flat(v)).reshape(v.shape)
+    if pattern_needed:
+        if attn_impl is not dense_attention:
+            raise ValueError(
+                "hook_pattern needs dense attention — sequence-parallel "
+                "impls never materialize the full [B,H,Q,K] pattern"
+            )
+        z = dense_attention(q, k, v, pattern_cb=lambda pr: hook("attn.hook_pattern", pr))
+    else:
+        z = attn_impl(q, k, v)  # [B, S, H, Dh]
     z_flat = z.reshape(*z.shape[:2], -1)
     out = jnp.einsum("mhd,bshd->bsm", p["w_o"], z) + p["b_o"]
     return out, z_flat
 
 
+def mlp_act(cfg: LMConfig) -> Callable:
+    """THE arch→MLP-nonlinearity mapping (single source of truth)."""
+    return _gelu_new if cfg.arch == "gpt2" else jax.nn.gelu
+
+
+def mlp_pre(p, x_normed):
+    """MLP hidden PRE-activation ("mlp_pre" hook point); the nonlinearity and
+    output projection happen in `forward` AFTER the hooks so replacements
+    propagate."""
+    return jnp.einsum("fm,bsm->bsf", p["w_in"], x_normed) + p["b_in"]
+
+
 def mlp_hidden(p, x_normed, cfg: LMConfig):
-    """MLP hidden post-activation ("mlp" hook point); the output projection
-    happens in `forward` AFTER the hook so replacements propagate."""
-    act = _gelu_new if cfg.arch == "gpt2" else jax.nn.gelu
-    return act(jnp.einsum("fm,bsm->bsf", p["w_in"], x_normed) + p["b_in"])
+    """MLP hidden post-activation ("mlp" hook point)."""
+    return mlp_act(cfg)(mlp_pre(p, x_normed))
 
 
 # -- forward with hooks -------------------------------------------------------
@@ -271,6 +342,7 @@ def forward(
     hooks = hooks or {}
     want = set(cache_names or [])
     cache: Dict[str, jax.Array] = {}
+    needed = hooks.keys() | want
 
     def at_hook(name: str, tensor: jax.Array) -> jax.Array:
         if name in hooks:
@@ -279,7 +351,7 @@ def forward(
             cache[name] = tensor
         return tensor
 
-    x = params["embed"][tokens]
+    x = at_hook("hook_embed", params["embed"][tokens])
     if cfg.arch == "gpt2":
         pos = positions if positions is not None else jnp.arange(tokens.shape[1])
         x = x + params["pos_embed"][pos][None]
@@ -287,19 +359,25 @@ def forward(
     n_blocks = cfg.n_layers if stop_at_layer is None else min(stop_at_layer, cfg.n_layers)
     for i in range(n_blocks):
         p = params["blocks"][i]
+        pfx = f"blocks.{i}"
         parallel = cfg.arch == "neox" and cfg.parallel_residual
         attn_out, z = attention_block(
-            p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl, positions
+            p["attn"], layer_norm(x, p["ln1"], cfg.layer_norm_eps), cfg, attn_impl,
+            positions,
+            hook=lambda sfx, t, _pfx=pfx: at_hook(f"{_pfx}.{sfx}", t),
+            pattern_needed=f"{pfx}.attn.hook_pattern" in needed,
         )
-        z = at_hook(f"blocks.{i}.attn.hook_z", z)
+        z = at_hook(f"{pfx}.attn.hook_z", z)
+        attn_out = at_hook(f"{pfx}.hook_attn_out", attn_out)
         if not parallel:  # serial (gpt2, non-parallel neox): attn lands first
-            x = x + attn_out
-        h = mlp_hidden(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps), cfg)
-        h = at_hook(f"blocks.{i}.mlp.hook_post", h)
+            x = at_hook(f"{pfx}.hook_resid_mid", x + attn_out)
+        pre = mlp_pre(p["mlp"], layer_norm(x, p["ln2"], cfg.layer_norm_eps))
+        pre = at_hook(f"{pfx}.mlp.hook_pre", pre)
+        h = at_hook(f"{pfx}.mlp.hook_post", mlp_act(cfg)(pre))
         mlp_out = jnp.einsum("mf,bsf->bsm", p["mlp"]["w_out"], h) + p["mlp"]["b_out"]
-        mlp_out = at_hook(f"blocks.{i}.hook_mlp_out", mlp_out)
+        mlp_out = at_hook(f"{pfx}.hook_mlp_out", mlp_out)
         x = x + attn_out + mlp_out if parallel else x + mlp_out
-        x = at_hook(f"blocks.{i}.hook_resid_post", x)
+        x = at_hook(f"{pfx}.hook_resid_post", x)
 
     if stop_at_layer is not None:
         return x, cache

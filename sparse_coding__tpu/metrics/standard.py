@@ -308,13 +308,16 @@ def evaluate_dicts(
             # evaluate directly — they are O(1) baselines anyway
             for i in idxs:
                 for name, fn in metric_fns.items():
-                    out[i][name] = float(fn(learned_dicts[i], batch))
+                    val = np.asarray(jax.device_get(fn(learned_dicts[i], batch)))
+                    out[i][name] = float(val) if val.ndim == 0 else val
             continue
         stacked = _stack_dicts([learned_dicts[i] for i in idxs])
         for name, fn in metric_fns.items():
             vals = np.asarray(jax.device_get(_vmapped_metric(fn)(stacked, batch)))
             for j, i in enumerate(idxs):
-                out[i][name] = float(vals[j])
+                # metric fns may return a scalar or a vector (e.g. the
+                # per-feature activity counts behind the sweep dashboards)
+                out[i][name] = float(vals[j]) if vals[j].ndim == 0 else vals[j]
     if defaults:
         for row in out:
             row["r2"] = 1.0 - row["fvu"]

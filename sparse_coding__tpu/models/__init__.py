@@ -18,7 +18,7 @@ from sparse_coding__tpu.models.sae import (
     FunctionalTiedCenteredSAE,
     FunctionalTiedSAE,
 )
-from sparse_coding__tpu.models.topk import TopKEncoder, TopKLearnedDict
+from sparse_coding__tpu.models.topk import TopKEncoder, TopKEncoderApprox, TopKLearnedDict
 from sparse_coding__tpu.models.fista import (
     Fista,
     FunctionalFista,

@@ -81,6 +81,12 @@ def fista(
 
     Shapes: batch [b, d], learned_dict [n, d], coefficients [b, n] (warm
     start). Returns (ahat, residual). Reference `fista.py:99-128`.
+
+    Stays full-f32 on purpose: measured on v5e (THROUGHPUT.md r3), bf16
+    matmul operands change the codes (~1% values, ~23% boundary-support
+    flips) while buying ZERO time — the loop is bound by the elementwise
+    shrinkage/momentum passes at the backend's effective HBM bandwidth, not
+    by the MXU.
     """
     if eta is None:
         # power iteration approaches λmax from below (measured ≤3.4% low at 30

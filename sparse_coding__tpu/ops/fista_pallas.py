@@ -121,6 +121,105 @@ def fista_pallas(
     return ahat, res
 
 
+def _fista_kernel_hbm_dict(
+    eta_ref, l1_ref, x_ref, d_hbm_ref, c0_ref, a_out_ref, d_vmem, sem,
+    *, num_iter: int
+):
+    """Batch-tiled FISTA with the dictionary DMA'd HBM→VMEM ONCE.
+
+    The v1 kernel (`_fista_kernel`) lets the pipeline double-buffer every
+    input block; at bench shape (n=4096, d=512) the [n, d] dictionary alone
+    then costs 2x8 MB of VMEM and the kernel stops fitting (the 3.2x-slower
+    XLA fallback at 2048x4096x512, round 2). Here the dictionary arrives as
+    an ANY/HBM ref, is copied into a SINGLE VMEM scratch on the first grid
+    step, and persists across batch tiles (the TPU grid is sequential), so
+    only the small per-tile x/c0/out blocks are double-buffered.
+    """
+    @pl.when(pl.program_id(0) == 0)
+    def _copy_dict():
+        pltpu.make_async_copy(d_hbm_ref, d_vmem, sem).start()
+        pltpu.make_async_copy(d_hbm_ref, d_vmem, sem).wait()
+
+    eta = eta_ref[0]
+    l1 = l1_ref[0]
+    x = x_ref[:]
+    d = d_vmem[:]
+
+    def body(_, carry):
+        ahat, ahat_y, tk = carry
+        tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
+        res = x - jnp.dot(ahat_y, d, preferred_element_type=jnp.float32)
+        ahat_y = ahat_y + eta * jnp.dot(res, d.T, preferred_element_type=jnp.float32)
+        ahat_new = jnp.maximum(ahat_y - eta * l1, 0.0)
+        ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
+        return ahat_new, ahat_y, tk_n
+
+    c0 = c0_ref[:].astype(jnp.float32)
+    ahat, _, _ = jax.lax.fori_loop(0, num_iter, body, (c0, c0, jnp.float32(1.0)))
+    a_out_ref[:] = ahat
+
+
+@partial(jax.jit, static_argnames=("num_iter", "batch_tile", "interpret"))
+def fista_pallas_hbm_dict(
+    batch: jax.Array,
+    learned_dict: jax.Array,
+    l1_coef,
+    num_iter: int = 500,
+    eta: Optional[jax.Array] = None,
+    coefficients: Optional[jax.Array] = None,
+    batch_tile: int = 128,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """`fista_pallas` for dictionaries too big to double-buffer (see
+    `_fista_kernel_hbm_dict`). Same contract and numerics."""
+    from sparse_coding__tpu.models.fista import power_iteration_max_eig
+
+    if eta is None:
+        eta = 1.0 / (1.05 * power_iteration_max_eig(learned_dict, n_iter=50))
+    B, d = batch.shape
+    n = learned_dict.shape[0]
+    tile = min(batch_tile, B)
+    pad = (-B) % tile
+    x = jnp.pad(batch, ((0, pad), (0, 0))) if pad else batch
+    c0 = (
+        jnp.zeros((x.shape[0], n), jnp.float32)
+        if coefficients is None
+        else jnp.pad(coefficients.astype(jnp.float32), ((0, pad), (0, 0)))
+        if pad
+        else coefficients.astype(jnp.float32)
+    )
+
+    grid = (x.shape[0] // tile,)
+    ahat = pl.pallas_call(
+        partial(_fista_kernel_hbm_dict, num_iter=num_iter),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tile, d), lambda i, *_: (i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((tile, n), lambda i, *_: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((tile, n), lambda i, *_: (i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n, d), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], n), jnp.float32),
+        interpret=interpret,
+    )(
+        jnp.asarray(eta, jnp.float32).reshape(1),
+        jnp.asarray(l1_coef, jnp.float32).reshape(1),
+        x.astype(jnp.float32),
+        learned_dict.astype(jnp.float32),
+        c0,
+    )
+    ahat = ahat[:B].astype(batch.dtype)
+    res = batch - ahat @ learned_dict
+    return ahat, res
+
+
 def on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -135,12 +234,24 @@ PALLAS_VMEM_BUDGET = 12 * 1024**2
 
 
 def pallas_fits(batch: int, n_dict: int, d_act: int, batch_tile: int = 256) -> bool:
-    """Whether the VMEM-resident kernel fits at this shape. Beyond the budget
-    the kernel either OOMs or needs tiles so small the MXU starves — the
-    plain XLA loop is faster there (measured 3.2x at 2048x4096x512)."""
+    """Whether the fully-VMEM-resident v1 kernel fits at this shape (every
+    block double-buffered by the pipeline, dictionary included)."""
     bt = min(batch_tile, batch)
     resident = 4 * (n_dict * d_act + 3 * bt * n_dict + 2 * bt * d_act)
     return 2 * resident <= PALLAS_VMEM_BUDGET
+
+
+def pallas_hbm_dict_fits(batch: int, n_dict: int, d_act: int, batch_tile: int = 128) -> bool:
+    """Whether the v2 kernel (dictionary in a SINGLE VMEM scratch, only the
+    small per-tile blocks double-buffered) fits. Covers the bench shape
+    2048x4096x512 that v1 rejects."""
+    bt = min(batch_tile, batch)
+    resident = 4 * (
+        n_dict * d_act          # dictionary scratch, single-buffered
+        + 3 * bt * n_dict       # fori carry (ahat, ahat_y) + update temp
+        + 2 * (2 * bt * n_dict + bt * d_act)  # double-buffered c0/out/x tiles
+    )
+    return resident <= 14 * 1024**2
 
 
 def fista_solve(
@@ -159,6 +270,10 @@ def fista_solve(
     N = learned_dict.shape[0]
     if on_tpu() and pallas_fits(B, N, D):
         return fista_pallas(
+            batch, learned_dict, l1_coef, num_iter=num_iter, coefficients=coefficients
+        )
+    if on_tpu() and pallas_hbm_dict_fits(B, N, D):
+        return fista_pallas_hbm_dict(
             batch, learned_dict, l1_coef, num_iter=num_iter, coefficients=coefficients
         )
     if coefficients is None:

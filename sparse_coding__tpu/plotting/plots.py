@@ -206,6 +206,32 @@ def histogram(values, x_label: str, y_label: str = "Frequency", bins: int = 20):
     return fig
 
 
+def feature_activity_overlay(
+    counts_by_name: Dict[str, np.ndarray],
+    n_samples: int,
+    title: str = "Feature activation counts",
+):
+    """In-training dashboard: per-feature activation-count distribution, one
+    step-line per dictionary (reference `big_sweep.py:87-157` logs a separate
+    sparsity-histogram image per dict every 10 chunks; overlaying keeps one
+    image per save point at sweep scale).
+
+    ``counts_by_name``: {dict name: [n_feats] counts over the sampled rows}.
+    """
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    bins = np.linspace(0, max(1, n_samples), 41)
+    for name, counts in counts_by_name.items():
+        ax.hist(
+            np.asarray(counts), bins=bins, histtype="step", log=True, label=name
+        )
+    ax.set_xlabel(f"activations on {n_samples} sampled rows")
+    ax.set_ylabel("features (log)")
+    ax.set_title(title)
+    if len(counts_by_name) <= 12:
+        ax.legend(fontsize=7)
+    return fig
+
+
 # -- autointerp comparison figures --------------------------------------------
 #
 # The reference ships four near-identical scripts (grouped mean±95%-CI bars

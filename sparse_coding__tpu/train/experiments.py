@@ -91,8 +91,10 @@ def topk_experiment(cfg: EnsembleArgs, mesh=None):
         dict_size = int(cfg.activation_width * r)
         dict_sizes.append(dict_size)
         keys = jax.random.split(_key(cfg, int(r * 2)), len(sparsity_levels))
+        cap = min(max(sparsity_levels), dict_size)
         models = [
-            TopKEncoder.init(k, cfg.activation_width, dict_size, min(s, dict_size))
+            TopKEncoder.init(k, cfg.activation_width, dict_size, min(s, dict_size),
+                             sparsity_cap=cap)
             for k, s in zip(keys, sparsity_levels)
         ]
         ensembles.append(

@@ -87,14 +87,15 @@ def unstacked_to_learned_dicts(
     return learned_dicts
 
 
-def _n_ever_active_gt1(ld, batch):
-    """Features active more than once on the sample — the single-pass form of
-    `batched_calc_feature_n_ever_active(threshold=1)` (which encodes RAW
-    activations, no centering — reference `standard_metrics.py:444-452`),
-    written as a `fn(ld, batch) -> scalar` so `evaluate_dicts` can vmap it
-    over a stack."""
+def _feature_activity_counts(ld, batch):
+    """Per-feature activation counts on the sample — one vmapped encode feeds
+    both the `n_active` scalar ((counts > 1).sum(), the single-pass form of
+    `batched_calc_feature_n_ever_active(threshold=1)`, reference
+    `standard_metrics.py:444-452`) and the sparsity-histogram dashboard
+    image. `fn(ld, batch) -> [n_feats]` so `evaluate_dicts` can vmap it over
+    a stack."""
     c = ld.encode(batch)
-    return ((c != 0).sum(axis=0) > 1).sum()
+    return (c != 0).sum(axis=0)
 
 
 def log_sweep_metrics(
@@ -109,27 +110,32 @@ def log_sweep_metrics(
 ) -> Dict[str, Any]:
     """Per-save-point metric dashboard (reference `log_standard_metrics`,
     `big_sweep.py:87-157`): feature-activity counts per dict, plus the
-    small-vs-larger-dict MMCS grid when the sweep spans dict sizes. Returns
-    the computed values; images are the plotting module's job (offline)."""
+    small-vs-larger-dict MMCS grid when the sweep spans dict sizes. Scalars
+    go through `logger`; MMCS-grid heatmaps and feature-activity histograms
+    are ALSO rendered as images at each call (wandb images when live, PNGs
+    under `<output dir>/images/` otherwise), matching the reference's
+    in-training wandb dashboards. Returns the computed values."""
     idx = np.random.default_rng(seed).choice(chunk.shape[0], size=min(n_samples, chunk.shape[0]), replace=False)
     sample = chunk[idx]
 
-    results: Dict[str, Any] = {"n_active": {}, "mmcs_grids": {}}
+    results: Dict[str, Any] = {"n_active": {}, "feat_counts": {}, "mmcs_grids": {}}
     # P4 fan-out: vmapped over stacks of same-shaped dicts instead of a
     # per-dict Python loop. Groups of ≤8 bound the transient
     # [group, n_samples, n_feats] code tensor (this runs mid-training with
     # the ensembles resident in HBM)
-    rows: List[Dict[str, float]] = []
+    rows: List[Dict[str, Any]] = []
     for g in range(0, len(learned_dicts), 8):
         rows.extend(
             sm.evaluate_dicts(
                 [ld for ld, _ in learned_dicts[g : g + 8]], sample,
-                {"n_active": _n_ever_active_gt1},
+                {"feat_counts": _feature_activity_counts},
             )
         )
     for (ld, setting), row in zip(learned_dicts, rows):
         name = make_hyperparam_name(setting)
-        n_ever = int(row["n_active"])
+        counts = np.asarray(row["feat_counts"])
+        n_ever = int((counts > 1).sum())
+        results["feat_counts"][name] = counts
         results["n_active"][name] = {
             "n_active": n_ever,
             "prop_active": n_ever / ld.n_feats,
@@ -174,6 +180,30 @@ def log_sweep_metrics(
     if output_folder is not None and results["mmcs_grids"]:
         out = Path(output_folder) / f"mmcs_grids_{chunk_num}.npz"
         np.savez(out, **results["mmcs_grids"])
+
+    # in-training image dashboards (reference `big_sweep.py:87-157`)
+    if logger is not None:
+        import matplotlib.pyplot as plt
+
+        from sparse_coding__tpu.plotting import plots as figs
+
+        fig = figs.feature_activity_overlay(
+            results["feat_counts"], n_samples=len(sample)
+        )
+        logger.log_image(chunk_num, "feature_activity", fig)
+        plt.close(fig)
+        for grid_name, scores in results["mmcs_grids"].items():
+            fig = figs.grid_heatmap(
+                scores,
+                x_tick_labels=dict_sizes[1:],
+                y_tick_labels=l1_values,
+                x_label="dict size",
+                y_label="l1_alpha",
+                vmin=0.0,
+                vmax=1.0,
+            )
+            logger.log_image(chunk_num, f"mmcs_grid_{grid_name}", fig)
+            plt.close(fig)
     return results
 
 

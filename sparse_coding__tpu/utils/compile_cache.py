@@ -1,0 +1,35 @@
+"""Persistent XLA compile cache, one switch for scripts and tests.
+
+Compilation is 20-40 s per program on the tunneled TPU backend; cached
+executables make re-runs measure work, not compilation. (On the remote-compile
+axon backend cross-process hits are unreliable — see THROUGHPUT.md r3 — but
+the cache is strictly-no-worse and pays off fully on CPU test runs.)
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def enable_persistent_compile_cache(
+    cache_dir: str | os.PathLike | None = None,
+    min_compile_time_secs: float = 1.0,
+    min_entry_size_bytes: int | None = None,
+) -> None:
+    """Point jax at an on-disk compile cache. Safe no-op on jax versions
+    without the feature. `JAX_COMPILATION_CACHE_DIR` overrides `cache_dir`
+    (default: `<repo>/.jax_cache`)."""
+    import jax
+
+    default_dir = Path(__file__).resolve().parents[2] / ".jax_cache"
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", str(cache_dir or default_dir)),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_time_secs)
+        if min_entry_size_bytes is not None:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes)
+    except Exception:
+        pass  # older jax: run uncached

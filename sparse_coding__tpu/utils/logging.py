@@ -62,10 +62,32 @@ class MetricLogger:
                 self._wandb = wandb.init(project=wandb_project, name=run_name)
             except Exception:
                 self._wandb = None
+        self._out_dir = Path(out_dir) if out_dir is not None else None
         if self._wandb is None and out_dir is not None:
             path = Path(out_dir)
             path.mkdir(parents=True, exist_ok=True)
             self._jsonl = open(path / f"{run_name}_metrics.jsonl", "a")
+
+    def log_image(self, step: int, name: str, fig) -> Optional[Path]:
+        """Log a matplotlib figure: a wandb image when wandb is live, a PNG
+        under ``<out_dir>/images/`` otherwise (the in-training dashboard
+        channel — reference `big_sweep.py:87-157` logs MMCS grids and
+        sparsity histograms as wandb images every 10 chunks).
+
+        Returns the written path (None on the wandb path). The caller owns
+        the figure (close it after logging)."""
+        if self._wandb is not None:
+            import wandb
+
+            self._wandb.log({name: wandb.Image(fig)}, step=int(step))
+            return None
+        if self._out_dir is None:
+            return None
+        img_dir = self._out_dir / "images"
+        img_dir.mkdir(parents=True, exist_ok=True)
+        path = img_dir / f"{name}_{int(step)}.png"
+        fig.savefig(path, dpi=110, bbox_inches="tight")
+        return path
 
     def log(self, step: int, tree: Dict[str, jax.Array]):
         """Queue a pytree of [n_models]-shaped device scalars. No host sync."""

@@ -199,3 +199,53 @@ def test_harvest_bf16_compute_close_to_fp32(tmp_path, tiny_lm, tokens):
     assert b.dtype == np.float32 and b.shape == a.shape
     denom = np.abs(a).max() + 1e-6
     assert np.abs(a - b).max() / denom < 0.05, np.abs(a - b).max() / denom
+
+
+def test_generic_qualified_capture(tmp_path, tiny_lm, tokens):
+    """Harvest NON-standard points through make_activation_dataset: the MLP
+    pre-activation shorthand and a fully-templated qualified q-head name —
+    the capture-by-any-name surface (baukit `Trace` analogue, reference
+    `activation_dataset.py:292-298`)."""
+    cfg, params = tiny_lm
+    folders = make_activation_dataset(
+        params, cfg, tokens, tmp_path / "acts", layers=[1],
+        layer_locs=["mlp_pre", "blocks.{layer}.attn.hook_q"],
+        batch_size=16, chunk_size_gb=_tiny_chunk_gb(512, 32), n_chunks=1,
+    )
+    # direct recomputation through run_with_cache
+    names = [
+        make_tensor_name(1, "mlp_pre"),
+        make_tensor_name(1, "blocks.{layer}.attn.hook_q"),
+    ]
+    assert names == ["blocks.1.mlp.hook_pre", "blocks.1.attn.hook_q"]
+    _, cache = run_with_cache(params, jnp.asarray(tokens[:32]), cfg, names, stop_at_layer=2)
+    for loc, name in zip(["mlp_pre", "blocks.{layer}.attn.hook_q"], names):
+        got = np.load(folders[(1, loc)] / "0.npy")
+        want = np.asarray(cache[name]).reshape(-1, cache[name].shape[-1])
+        assert got.shape[1] == want.shape[1]
+        np.testing.assert_allclose(
+            got[: want.shape[0]], want.astype(np.float16), atol=1e-3
+        )
+
+
+def test_pattern_capture_and_hook(tiny_lm, tokens):
+    """The attention pattern materializes only when asked for, rows sum to 1,
+    and a pattern hook can replace it (dense attention only)."""
+    cfg, params = tiny_lm
+    name = make_tensor_name(0, "pattern")
+    t = jnp.asarray(tokens[:4])
+    _, cache = run_with_cache(params, t, cfg, [name], stop_at_layer=1)
+    pat = np.asarray(cache[name])
+    assert pat.shape == (4, cfg.n_heads, 16, 16)
+    np.testing.assert_allclose(pat.sum(-1), 1.0, atol=1e-5)
+
+    from sparse_coding__tpu.lm.model import forward
+
+    # ablate the pattern to uniform-causal: logits must change
+    def uniform(p):
+        mask = np.tril(np.ones((16, 16), np.float32))
+        return jnp.asarray(mask / mask.sum(-1, keepdims=True))[None, None]
+
+    base, _ = forward(params, t, cfg)
+    hooked, _ = forward(params, t, cfg, hooks={name: uniform})
+    assert np.abs(np.asarray(base) - np.asarray(hooked)).max() > 1e-6

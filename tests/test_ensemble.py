@@ -143,6 +143,7 @@ def test_topk_heterogeneous_sparsity_in_one_stack():
         TopKEncoder,
         jax.random.PRNGKey(0),
         [{"sparsity": 2}, {"sparsity": 8}, {"sparsity": 16}],
+        sparsity_cap=16,
         optimizer_kwargs={"learning_rate": 1e-3},
         d_activation=D_ACT,
         n_features=N_DICT,

@@ -36,7 +36,7 @@ ZOO = [
       {"l1_alpha": 1e-3, "n_dict_components": 48}], 30),
     (M.FunctionalReverseSAE, dict(activation_size=D_ACT, n_dict_components=N_DICT),
      [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}], 30),
-    (M.TopKEncoder, dict(d_activation=D_ACT, n_features=N_DICT),
+    (M.TopKEncoder, dict(d_activation=D_ACT, n_features=N_DICT, sparsity_cap=12),
      [{"sparsity": 4}, {"sparsity": 12}], 30),
     (M.FunctionalFista, dict(activation_size=D_ACT, n_dict_components=N_DICT),
      [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}], 30),

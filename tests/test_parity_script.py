@@ -1,7 +1,8 @@
 """The parity artifact script stays runnable end to end (quick CPU mode —
-same code path as the committed PARITY_r02.json TPU run)."""
+same code path as the committed PARITY_<round>.json TPU runs)."""
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -9,6 +10,8 @@ from pathlib import Path
 import pytest
 
 REPO = Path(__file__).resolve().parent.parent
+# the scripts tag artifacts by round; tests pin the tag via env
+ROUND = "rtest"
 
 
 @pytest.mark.slow
@@ -20,11 +23,12 @@ def test_parity_quick(tmp_path, config, hp):
         [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
          "--config", config, "--out", str(tmp_path)],
         capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     suffix = {"topk": "_topk", "fista": "_fista"}.get(config, "")
-    report = json.loads((tmp_path / f"PARITY_r02{suffix}_quick.json").read_text())
-    assert (tmp_path / f"parity_pareto_r02{suffix}_quick.png").exists()
+    report = json.loads((tmp_path / f"PARITY_{ROUND}{suffix}_quick.json").read_text())
+    assert (tmp_path / f"parity_pareto_{ROUND}{suffix}_quick.png").exists()
 
     if config == "fista":
         assert set(report["pareto"]) == {"fista_0", "fista_1", "tied_0", "tied_1"}
@@ -63,9 +67,10 @@ def test_parity_basic_quick(tmp_path):
         [sys.executable, str(REPO / "scripts" / "parity_run.py"), "--quick",
          "--config", "basic", "--out", str(tmp_path)],
         capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    report = json.loads((tmp_path / "PARITY_r02_basic_quick.json").read_text())
+    report = json.loads((tmp_path / f"PARITY_{ROUND}_basic_quick.json").read_text())
     assert report["config"]["baseline_config"] == 1
     for seed in (0, 1):
         ev = report[f"eval_seed{seed}"]
@@ -85,9 +90,10 @@ def test_dictpar_quick(tmp_path):
         [sys.executable, str(REPO / "scripts" / "dictpar_run.py"), "--quick",
          "--out", str(tmp_path)],
         capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    report = json.loads((tmp_path / "PARITY_r02_dictpar_quick.json").read_text())
+    report = json.loads((tmp_path / f"PARITY_{ROUND}_dictpar_quick.json").read_text())
     assert report["config"]["baseline_config"] == 5
     assert report["config"]["dict_ratio"] == 32
     mv = report["mesh_validation"]

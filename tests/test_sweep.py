@@ -62,7 +62,7 @@ def l1_ensemble_init(cfg):
 
 
 def test_sweep_end_to_end(tmp_path):
-    cfg = make_cfg(tmp_path)
+    cfg = make_cfg(tmp_path, wandb_images=True)
     learned_dicts = sweep(l1_ensemble_init, cfg)
     assert len(learned_dicts) == 2
     # hyperparams recorded per dict (float32 round-trip → approximate)
@@ -91,6 +91,9 @@ def test_sweep_end_to_end(tmp_path):
     assert (out_dirs[-1] / "config.yaml").exists()
     # ground truth persisted for MMCS eval
     assert (tmp_path / "outputs" / "ground_truth_dict.npy").exists()
+    # in-training image dashboards rendered at the metric save points
+    images = list((tmp_path / "outputs" / "images").glob("feature_activity_*.png"))
+    assert images, "no dashboard images written"
 
 
 def test_sweep_resume(tmp_path):
