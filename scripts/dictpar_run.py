@@ -15,8 +15,10 @@ that story this environment can measure:
    d=1024) per layer, recording the FVU/L0 pareto, dead features, cross-seed
    MMCS, and perplexity-under-reconstruction. Activations are standardized
    by a per-layer scalar std and trained at lr 3e-4 — measured on the chip:
-   lr 1e-3 collapses every member of the 32768-dim bf16 ensemble to zero
-   codes, 3e-4 learns at both depths (layer 2 keeps more token-embedding
+   lr 1e-3 collapses the 32768-dim ensemble's high-l1 members to zero codes
+   (NOT a bf16 effect: the round-3 LR_COLLAPSE study's fp32 control collapses
+   identically — it is the l1-pressure x Adam-lr dynamic), 3e-4 learns at
+   both depths (layer 2 keeps more token-embedding
    structure than the mid layer, so its pareto sits lower). At this shape the
    fused-kernel VMEM gate (`ops.tied_sae_kernel.fused_fits`) correctly routes
    training to the XLA path — exercised and asserted here.
@@ -215,7 +217,12 @@ def main(argv=None):
     # token-embedding structure of the random-init subject; the spec's mid
     # layer dilutes it with depth and is the harder target.
     cap_layers = [layer] if quick else [2, layer]
-    lr = 3e-4  # 1e-3 collapses the 32768-dim bf16 ensemble (all-zero codes)
+    # 1e-3 collapses the 32768-dim ensemble's high-l1 members (all-zero
+    # codes). LR_COLLAPSE_r03.json: fp32 control collapses identically, so
+    # this is the l1-pressure x Adam-lr dynamic, not precision; the train
+    # loop's dead-ensemble watchdog (train.loop.warn_if_ensemble_dead) now
+    # catches it loudly.
+    lr = 3e-4
     report: dict = {
         "config": {
             "baseline_config": 5,
@@ -229,8 +236,9 @@ def main(argv=None):
         },
         "notes": (
             "random-init subject; activations standardized by a per-layer "
-            "scalar std before training (recorded below). lr 3e-4: measured "
-            "lr 1e-3 drives every 32768-dim bf16 member to all-zero codes. "
+            "scalar std before training (recorded below). lr 3e-4: lr 1e-3 "
+            "kills the high-l1 members (LR_COLLAPSE_r03: fp32 collapses "
+            "identically - l1 x Adam-lr dynamics, not bf16). "
             "Layer 2 keeps more token-embedding structure than the mid "
             "layer, so its pareto sits lower"
         ),

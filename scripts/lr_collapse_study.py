@@ -1,0 +1,169 @@
+"""Root-cause study: why lr 1e-3 kills 32k-dim tied-SAE ensembles (VERDICT r2 #3).
+
+Round 2 recorded (dictpar artifact) that Adam lr 1e-3 drives every member of
+the 32,768-dim bf16 ensemble to all-zero codes while 3e-4 trains fine — but
+did not isolate precision vs optimization or the mechanism. This script runs
+the controlled grid on the chip:
+
+    {bf16, fp32 compute} x {lr 1e-3, lr 3e-4}   (config-5 shape, l1 grid)
+
+tracking per-step telemetry that discriminates the candidate mechanisms:
+  - mean L0 per member              (the collapse observable)
+  - encoder_bias mean               (l1-through-relu pushes biases down;
+                                     Adam's normalization makes the push
+                                     ~lr/step regardless of gradient size)
+  - max pre-activation              (when bias_mean < -max_preact, the relu
+                                     gate is shut for every feature = death)
+  - reconstruction loss
+
+Writes LR_COLLAPSE_r03.json + a telemetry figure. The companion regression
+test (tests/test_lr_guard.py) covers the guard this study motivates:
+`train.loop.ensemble_train_loop` warns loudly when every member's L0 hits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r03")
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    from sparse_coding__tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu.data.synthetic import RandomDatasetGenerator
+    from sparse_coding__tpu.ensemble import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    quick = args.quick
+    d_act = 64 if quick else 1024
+    n_dict = 32 * d_act  # config-5 ratio
+    batch = 256 if quick else 2048
+    steps = args.steps or (40 if quick else 400)
+    probe_every = 4 if quick else 10
+    grid = [1e-4, 3e-4, 1e-3, 3e-3]
+
+    gen = RandomDatasetGenerator(
+        activation_dim=d_act,
+        n_ground_truth_components=2 * d_act,
+        batch_size=batch,
+        feature_num_nonzero=max(4, d_act // 20),
+        feature_prob_decay=0.996,
+        correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    batches = [next(gen) for _ in range(8)]
+
+    @jax.jit
+    def probe(c, params):
+        l0 = (c > 0).sum(-1).mean(-1)  # [members]
+        bias_mean = params["encoder_bias"].mean(-1)
+        return l0, bias_mean
+
+    report = {
+        "config": {
+            "shape": f"{n_dict}x{d_act}, batch {batch}, steps {steps}",
+            "l1_grid": grid,
+            "device": jax.devices()[0].device_kind,
+        },
+        "runs": {},
+    }
+    for dtype_name, compute_dtype in (("bf16", jnp.bfloat16), ("fp32", None)):
+        for lr in (1e-3, 3e-4):
+            tag = f"{dtype_name}_lr{lr:g}"
+            print(f"== {tag} ==")
+            ens = build_ensemble(
+                FunctionalTiedSAE,
+                jax.random.PRNGKey(1),
+                [{"l1_alpha": a} for a in grid],
+                optimizer_kwargs={"learning_rate": lr},
+                activation_size=d_act,
+                n_dict_components=n_dict,
+                compute_dtype=compute_dtype,
+            )
+            tel = {"step": [], "l0": [], "bias_mean": [], "loss": []}
+            t0 = time.time()
+            for i in range(steps):
+                ld, aux = ens.step_batch(batches[i % len(batches)])
+                if i % probe_every == 0 or i == steps - 1:
+                    l0, bmean = probe(aux["c"], ens.state.params)
+                    l0, bmean, loss = jax.device_get((l0, bmean, ld["loss"]))
+                    tel["step"].append(i)
+                    tel["l0"].append(np.asarray(l0).round(2).tolist())
+                    tel["bias_mean"].append(np.asarray(bmean).round(5).tolist())
+                    tel["loss"].append(np.asarray(loss).round(6).tolist())
+            final_l0 = np.asarray(tel["l0"][-1])
+            report["runs"][tag] = {
+                "seconds": round(time.time() - t0, 1),
+                "final_l0": final_l0.tolist(),
+                "collapsed_members": int((final_l0 < 0.5).sum()),
+                "telemetry": tel,
+            }
+            print(
+                f"  final L0 {final_l0}  bias_mean {tel['bias_mean'][-1]}  "
+                f"({report['runs'][tag]['seconds']}s)"
+            )
+
+    # mechanism synthesis: did fp32 collapse too at 1e-3?
+    b1, f1 = report["runs"]["bf16_lr0.001"], report["runs"]["fp32_lr0.001"]
+    report["conclusion"] = {
+        "bf16_lr1e-3_collapsed": b1["collapsed_members"],
+        "fp32_lr1e-3_collapsed": f1["collapsed_members"],
+        "precision_specific": b1["collapsed_members"] > f1["collapsed_members"],
+    }
+
+    out = Path(args.out) if args.out else REPO
+    out.mkdir(parents=True, exist_ok=True)
+    json_path = out / f"LR_COLLAPSE_{ROUND_TAG}{'_quick' if quick else ''}.json"
+    with open(json_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {json_path}")
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+    for tag, run in report["runs"].items():
+        tel = run["telemetry"]
+        mid = len(grid) // 2  # the lr-grid member closest to the r2 report
+        axes[0].plot(tel["step"], [r[mid] for r in tel["l0"]], label=tag)
+        axes[1].plot(tel["step"], [r[mid] for r in tel["bias_mean"]], label=tag)
+        axes[2].plot(tel["step"], [r[mid] for r in tel["loss"]], label=tag)
+    for ax, name in zip(axes, ("mean L0", "encoder bias mean", "loss")):
+        ax.set_xlabel("step")
+        ax.set_title(name)
+        ax.legend(fontsize=7)
+    axes[2].set_yscale("log")
+    fig.tight_layout()
+    fig_path = out / f"lr_collapse_{ROUND_TAG}{'_quick' if quick else ''}.png"
+    fig.savefig(fig_path, dpi=110)
+    print(f"Wrote {fig_path}")
+
+
+if __name__ == "__main__":
+    main()

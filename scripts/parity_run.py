@@ -83,17 +83,63 @@ def build_subject_model(quick: bool, arch: str = "neox", hf_kwargs: dict = None)
     return config_from_hf(model.config), params_from_hf(model)
 
 
-def synth_tokens(vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks, seed=0):
-    """Random token rows sized so the harvest fills exactly `n_chunks` chunks
-    (the chunk-geometry formula of `data.activations._harvest_plan`, fp16
-    store). One definition shared by every artifact runner."""
+def harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks) -> int:
+    """Token-row count that fills exactly `n_chunks` chunks (the chunk-geometry
+    formula of `data.activations._harvest_plan`, fp16 store). THE one
+    definition every artifact runner and token generator shares."""
     bytes_per_row = d_act * 2
     batches_per_chunk = max(
         1, int(chunk_gb * 1024**3 / bytes_per_row) // (batch_rows * seq_len)
     )
-    n_rows = n_chunks * batches_per_chunk * batch_rows
+    return n_chunks * batches_per_chunk * batch_rows
+
+
+def synth_tokens(vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks, seed=0):
+    """Uniform-random token rows sized by `harvest_rows`."""
+    n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
     rng = np.random.default_rng(seed)
     return rng.integers(0, vocab_size, (n_rows, seq_len), dtype=np.int32)
+
+
+def maybe_pretrain(params, lm_cfg, quick: bool, pretrain_steps: int):
+    """Pretrain the random-init subject on the synthetic trigram language
+    (VERDICT r2 #4: random-init activations are near-toy; a pretrained
+    subject makes perplexity-under-reconstruction discriminate). Returns
+    (params, language-or-None, stats-or-None); the language also generates
+    the harvest/eval tokens so all measurements live on one distribution."""
+    if pretrain_steps <= 0:
+        return params, None, None
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu.data.synthetic_text import TrigramLanguage
+    from sparse_coding__tpu.lm.pretrain import pretrain_lm
+
+    lang = TrigramLanguage(lm_cfg.vocab_size, seed=7)
+    corpus = lang.sample(n_rows=4096, seq_len=min(128, lm_cfg.n_ctx), seed=11)
+    print(f"Pretraining subject {pretrain_steps} steps on the trigram corpus...")
+    t0 = time.time()
+    params, stats = pretrain_lm(
+        params, lm_cfg, corpus, n_steps=pretrain_steps,
+        batch_size=16 if quick else 32,
+        compute_dtype=None if quick else jnp.bfloat16,
+        log_every=max(100, pretrain_steps // 10),
+    )
+    stats = {
+        **stats, "steps": pretrain_steps, "seconds": round(time.time() - t0, 1),
+        "entropy_bound": lang.per_token_entropy_bound,
+    }
+    print(f"  loss {stats['loss_first']:.2f} -> {stats['loss_last']:.2f} "
+          f"(bound {stats['entropy_bound']:.2f}) in {stats['seconds']}s")
+    return params, lang, stats
+
+
+def corpus_tokens(lang, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks, seed=13):
+    """Harvest tokens: from the pretraining language when there is one
+    (held-out sample, same distribution), else uniform random."""
+    if lang is None:
+        return synth_tokens(vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks, seed)
+    n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    return lang.sample(n_rows, seq_len, seed=seed)
 
 
 def run_basic(args):
@@ -125,12 +171,14 @@ def run_basic(args):
     fista_iters = 20 if quick else 500
     seeds = (0, 1)
 
+    pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
     print("Building subject model (pythia-70m geometry, random init)...")
     lm_cfg, params = build_subject_model(quick, "neox")
     d_act = lm_cfg.d_model
+    params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
 
-    tokens = synth_tokens(
-        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+    tokens = corpus_tokens(
+        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
     )
     n_rows = tokens.shape[0]
 
@@ -138,7 +186,8 @@ def run_basic(args):
         "config": {
             "baseline_config": 1,
             "subject": f"{lm_cfg.arch} d={d_act} L={lm_cfg.n_layers} "
-            "(pythia-70m geometry, random init)",
+            f"(pythia-70m geometry, "
+            f"{'trigram-pretrained' if lang is not None else 'random init'})",
             "model": "FunctionalFista via train.basic_l1_sweep driver",
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
             "dict_ratio": ratio, "n_dict": int(ratio * d_act),
@@ -147,6 +196,8 @@ def run_basic(args):
             "device": jax.devices()[0].device_kind,
         }
     }
+    if pretrain_stats is not None:
+        report["pretrain"] = pretrain_stats
 
     with tempfile.TemporaryDirectory(prefix="parity_basic_") as tmp:
         print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens)...")
@@ -251,6 +302,11 @@ def main(argv=None):
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument(
+        "--pretrain", type=int, default=-1,
+        help="subject pretraining steps on the synthetic trigram corpus "
+        "(-1 = auto: 2000 for full l1/basic runs, 0 otherwise)",
+    )
+    ap.add_argument(
         "--config", choices=("l1", "topk", "fista", "basic"), default="l1",
         help="l1: pythia-70m-geometry tied-SAE l1 sweep (BASELINE config 2); "
         "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4); "
@@ -316,13 +372,19 @@ def main(argv=None):
             grid = [1e-4, 1e-3] if quick else [1e-4, 3e-4, 1e-3, 3e-3]
             n_epochs = 1
 
+    pretrain_steps = args.pretrain if args.pretrain >= 0 else (
+        0 if (quick or topk or fista) else 2000
+    )
     print(f"Building subject model ({subject})...")
     lm_cfg, params = build_subject_model(quick, arch)
     d_act = lm_cfg.d_model
     n_dict = int(ratio * d_act)
+    params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
+    if lang is not None:
+        subject = subject.replace("random init", "trigram-pretrained")
 
-    tokens = synth_tokens(
-        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+    tokens = corpus_tokens(
+        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
     )
     n_rows = tokens.shape[0]
 
@@ -343,6 +405,8 @@ def main(argv=None):
             "device": jax.devices()[0].device_kind,
         }
     }
+    if pretrain_stats is not None:
+        report["pretrain"] = pretrain_stats
 
     with tempfile.TemporaryDirectory(prefix="parity_") as tmp:
         print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens)...")
@@ -416,13 +480,17 @@ def main(argv=None):
         # XLA compilation on this backend (remote compile, no stable persistent
         # cache); re-running an epoch on compiled programs measures training.
         # A FRESH probe ensemble (same config -> shared jitted steps, no new
-        # compile) keeps the evaluated seeds' training budgets untouched.
+        # compile) keeps the evaluated seeds' training budgets untouched. The
+        # probe uses the run's PRIMARY family — for --config fista that is
+        # FunctionalFista (whose per-step FISTA decoder update dominates), not
+        # whatever family the loop iterated last.
+        probe_family, (probe_sig, probe_kw) = next(iter(families.items()))
         probe = build_ensemble(
-            sig, jax.random.PRNGKey(9999),
+            probe_sig, jax.random.PRNGKey(9999),
             [mk_hp(v) for v in grid],
             optimizer_kwargs={"learning_rate": 1e-3},
             compute_dtype=None if quick else jnp.bfloat16,
-            **size_kw,
+            **probe_kw,
         )
         key, k = jax.random.split(key)
         jax.device_get(ensemble_train_loop(  # warm: any residual compiles
@@ -440,6 +508,7 @@ def main(argv=None):
             "ms_per_step": round(steady_s / max(1, steps) * 1e3, 1),
             "rows_per_sec": round(steps * sae_batch / steady_s, 1),
             "n_members": len(grid),
+            "family": probe_family or "default",
         }
         print(f"  steady-state: {report['steady_state']['ms_per_step']} ms/step")
 

@@ -386,7 +386,16 @@ class Ensemble:
         donate_argnums = (0,) if donate else ()
 
         cache_key = None
-        if self.optimizer_name != "custom":
+        # only scalar-valued optimizer kwargs can key the shared cache: a
+        # callable (e.g. an optax schedule) has no stable identity — str()
+        # embeds its address, and address reuse after GC could alias two
+        # different schedules onto one cached step
+        import numpy as _np
+
+        _scalar = (int, float, str, bool, type(None), _np.dtype, type)
+        if self.optimizer_name != "custom" and all(
+            isinstance(v, _scalar) for v in self.optimizer_kwargs.values()
+        ):
             cache_key = (
                 self.sig,
                 self.optimizer_name,

@@ -1,0 +1,107 @@
+"""In-image subject-LM pretraining (next-token loss on synthetic corpora).
+
+The reference harvests from downloaded Pythia/GPT-2 checkpoints
+(`activation_dataset.py:126-132`); this image has zero egress, so parity
+subjects are pretrained HERE, on the chip, on a `data.synthetic_text`
+corpus — a few thousand steps take a random-init transformer from ~log(vocab)
+nats to near the corpus's ~log(k_succ) entropy bound, giving its activations
+genuine contextual structure (VERDICT r2 next #4).
+
+TPU shape: one jitted `lax.scan` over K batches per dispatch (amortizes the
+tunnel's ~10 ms dispatch latency, cf. `Ensemble.step_scan`), bf16 compute
+with f32 master params/Adam via the same master-weights scheme the SAE
+training uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sparse_coding__tpu.lm import model as lm_model
+
+
+def make_pretrain_scan_step(
+    cfg: lm_model.LMConfig,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
+):
+    """`(params, opt_state, tokens[K,B,S]) -> (params, opt_state, losses[K])`,
+    one compiled program for K optimizer steps."""
+
+    def loss_fn(p, toks):
+        if compute_dtype is not None:
+            p = jax.tree.map(
+                lambda x: x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                p,
+            )
+        return lm_model.lm_loss(p, toks, cfg)
+
+    def one(carry, toks):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+        # grads arrive in compute dtype; the optimizer update runs f32
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def scan_step(params, opt_state, tokens):
+        (params, opt_state), losses = jax.lax.scan(one, (params, opt_state), tokens)
+        return params, opt_state, losses
+
+    return scan_step
+
+
+def pretrain_lm(
+    params,
+    cfg: lm_model.LMConfig,
+    tokens: np.ndarray,
+    n_steps: int,
+    batch_size: int = 32,
+    learning_rate: float = 3e-4,
+    scan_steps: int = 8,
+    compute_dtype=jnp.bfloat16,
+    warmup: int = 100,
+    seed: int = 0,
+    log_every: int = 0,
+) -> Tuple[dict, Dict[str, float]]:
+    """Train `params` for `n_steps` of AdamW on `[N, S]` token rows.
+
+    Returns (trained params, {"loss_first", "loss_last"}). Rows are sampled
+    with replacement per step; cosine-decayed LR after linear warmup (the
+    standard small-LM recipe — nothing exotic, the goal is structured
+    activations, not SOTA).
+    """
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, min(warmup, max(1, n_steps // 10)), max(n_steps, 2)
+    )
+    tx = optax.adamw(sched, weight_decay=0.01)
+    opt_state = tx.init(params)
+    step = make_pretrain_scan_step(cfg, tx, compute_dtype)
+
+    rng = np.random.default_rng(seed)
+    loss_first: Optional[float] = None
+    loss_last = float("nan")
+    done = 0
+    while done < n_steps:
+        k = min(scan_steps, n_steps - done)
+        idx = rng.integers(0, tokens.shape[0], (k, batch_size))
+        batch = jnp.asarray(tokens[idx])
+        params, opt_state, losses = step(params, opt_state, batch)
+        done += k
+        losses = jax.device_get(losses)
+        if loss_first is None:
+            loss_first = float(losses[0])
+        loss_last = float(losses[-1])
+        if log_every and (done % log_every < k):
+            print(f"  pretrain step {done}/{n_steps}: loss {loss_last:.3f}")
+    return params, {"loss_first": float(loss_first), "loss_last": loss_last}

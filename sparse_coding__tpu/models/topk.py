@@ -157,11 +157,13 @@ class TopKEncoder:
         cap = buffers.get("topk_cap")
         return params["dict"].shape[0] if cap is None else cap.shape[0]
 
-    @staticmethod
-    def loss(params, buffers, batch):
+    @classmethod
+    def loss(cls, params, buffers, batch):
+        # classmethod: subclasses redefine ONLY `encode` (selection strategy);
+        # the loss contract lives in one place
         normed_dict = _norm_rows(params["dict"])
-        code = TopKEncoder.encode(
-            batch, buffers["sparsity"], normed_dict, TopKEncoder._cap(params, buffers)
+        code = cls.encode(
+            batch, buffers["sparsity"], normed_dict, cls._cap(params, buffers)
         )
         x_hat = _decode_mm(normed_dict, code)
         loss = _mse_f32(x_hat, batch)
@@ -192,16 +194,6 @@ class TopKEncoderApprox(TopKEncoder):
         scores = _encode_mm(normed_dict, batch)
         code = topk_mask_code_approx(scores, sparsity, cap, TopKEncoderApprox.RECALL)
         return jax.nn.relu(code)
-
-    @staticmethod
-    def loss(params, buffers, batch):
-        normed_dict = _norm_rows(params["dict"])
-        code = TopKEncoderApprox.encode(
-            batch, buffers["sparsity"], normed_dict, TopKEncoder._cap(params, buffers)
-        )
-        x_hat = _decode_mm(normed_dict, code)
-        loss = _mse_f32(x_hat, batch)
-        return loss, ({"loss": loss}, {"c": code})
 
 
 class TopKLearnedDict(LearnedDict):

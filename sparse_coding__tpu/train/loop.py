@@ -38,6 +38,64 @@ from sparse_coding__tpu.models.learned_dict import _norm_rows
 from sparse_coding__tpu.utils.logging import MetricLogger
 
 
+@lru_cache(maxsize=8)
+def _dead_ensemble_probe(sig):
+    """Cached jit: True iff EVERY member's code tensor is all-zero on a probe
+    batch — the observable of the 32k/lr-1e-3 collapse (LR_COLLAPSE study)."""
+
+    @jax.jit
+    def probe(params, buffers, batch):
+        def one(p, b):
+            _, (_, aux) = sig.loss(p, b, batch)
+            c = aux.get("c") if isinstance(aux, dict) else None
+            if c is None:
+                return jnp.asarray(True)  # no code tensor: treat as alive
+            return (c != 0).any()
+
+        alive = jax.vmap(one)(params, buffers)
+        return ~alive.any()
+
+    return probe
+
+
+def warn_if_ensemble_dead(ensemble: Ensemble, batch, context: str = "") -> bool:
+    """Loud warning when every member's codes are identically zero.
+
+    Motivated by the LR_COLLAPSE_r03 study: at 32x-overcomplete shapes
+    (config 5) Adam lr 1e-3 drives tied-SAE members to all-zero codes
+    (high-l1 members first; on the r2 harvested-activation run, all of them)
+    — silently, because the loss still decreases toward the dataset-mean
+    predictor. One probe dispatch per call (~64 rows)."""
+    import warnings
+
+    try:
+        dead = bool(
+            jax.device_get(
+                _dead_ensemble_probe(ensemble.sig)(
+                    ensemble.state.params, ensemble.state.buffers, batch[:64]
+                )
+            )
+        )
+    except Exception:
+        return False  # signatures without a standard aux contract: skip
+    if dead:
+        warnings.warn(
+            f"DEAD ENSEMBLE{' (' + context + ')' if context else ''}: every "
+            f"member of the {ensemble.n_models}-member {ensemble.sig.__name__} "
+            "ensemble produced all-zero codes on a probe batch. At large "
+            "(>=32x-overcomplete) dictionaries this is the known Adam-lr x "
+            "l1 collapse (LR_COLLAPSE_r03: under Adam the persistent l1 "
+            "push moves codes toward zero at ~lr per step however small the "
+            "l1 gradient, while per-feature reconstruction gradients scale "
+            "like 1/n_dict; fp32 collapses identically to bf16 - precision "
+            "is NOT the cause). Lower the lr (3e-4 trains 32768-dim "
+            "ensembles; 1e-3 kills the high-l1 members) or warm up l1.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    return dead
+
+
 def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
     """Build (or fetch the cached) jitted, ensemble-vmapped FISTA decoder update.
 
@@ -117,6 +175,7 @@ def ensemble_train_loop(
     fista_iters: int = 500,
     progress_callback: Optional[Callable[[int, int], None]] = None,
     scan_steps: int = 8,
+    dead_check: bool = True,
 ) -> Dict[str, jax.Array]:
     """Train the ensemble for one pass over `dataset` ([N, d] activations).
 
@@ -165,4 +224,8 @@ def ensemble_train_loop(
             progress_callback(i - 1, n_batches)
     if logger is not None:
         logger.flush()
+    if dead_check and n_batches > 0:
+        warn_if_ensemble_dead(
+            ensemble, dataset[perm[:64]], context="after chunk pass"
+        )
     return loss_dict

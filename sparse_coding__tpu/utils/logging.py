@@ -79,7 +79,11 @@ class MetricLogger:
         if self._wandb is not None:
             import wandb
 
-            self._wandb.log({name: wandb.Image(fig)}, step=int(step))
+            # no explicit step: scalar logging advances the wandb run step
+            # per BATCH, while images arrive per CHUNK — an explicit smaller
+            # step would trip wandb's monotonic-step rule and be dropped.
+            # The chunk index rides alongside as its own metric.
+            self._wandb.log({name: wandb.Image(fig), f"{name}_chunk": int(step)})
             return None
         if self._out_dir is None:
             return None

@@ -1,0 +1,99 @@
+"""Elastic resume: a checkpoint saved under one mesh shape restores and
+continues under ANY other shape (VERDICT r2 next #5).
+
+This is the pod failure-recovery story `train/checkpoint.py` claims: after a
+preemption the job may come back with a different device count/topology.
+State lives in checkpoints as host numpy with a leading model axis, so
+resharding is just `.shard(new_mesh)` — these tests pin that the continued
+training losses match the unsharded control to float tolerance on every
+target shape, including through the full orbax sweep-checkpoint path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.ensemble import Ensemble, build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.parallel.mesh import make_mesh
+from sparse_coding__tpu.train import checkpoint as ckpt_lib
+
+N_MODELS, D_ACT, N_DICT, BATCH = 4, 16, 64, 32
+
+
+def _build():
+    return build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": a} for a in (1e-4, 3e-4, 1e-3, 3e-3)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+
+
+def _batches(n, start=0):
+    return [
+        jax.random.normal(jax.random.PRNGKey(1000 + start + i), (BATCH, D_ACT))
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def trained_snapshot(devices):
+    """5 steps on a (2,2,2) mesh, then the host-side state_dict snapshot and
+    the control continuation losses (3 more steps, unsharded)."""
+    ens = _build().shard(make_mesh(2, 2, 2, devices=devices))
+    for b in _batches(5):
+        ens.step_batch(b)
+    sd = ens.state_dict()
+    control = Ensemble.from_state(sd)  # unsharded continuation
+    ref_losses = [
+        np.asarray(jax.device_get(control.step_batch(b)[0]["loss"]))
+        for b in _batches(3, start=5)
+    ]
+    return sd, ref_losses
+
+
+@pytest.mark.parametrize("shape", [(1, 4, 2), (4, 2, 1), (2, 2, 2), None])
+def test_resume_on_other_mesh_matches(devices, trained_snapshot, shape):
+    sd, ref_losses = trained_snapshot
+    ens = Ensemble.from_state(sd)
+    if shape is not None:
+        ens = ens.shard(make_mesh(*shape, devices=devices))
+    else:
+        # single-device resume: no mesh at all
+        pass
+    for ref, b in zip(ref_losses, _batches(3, start=5)):
+        got = np.asarray(jax.device_get(ens.step_batch(b)[0]["loss"]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_orbax_checkpoint_reshards(tmp_path, devices):
+    """The full sweep-checkpoint path: save while sharded on (2,2,2), restore
+    via orbax, continue on (4,2,1) — the preemption-with-new-topology drill."""
+    ens = _build().shard(make_mesh(2, 2, 2, devices=devices))
+    for b in _batches(4):
+        ens.step_batch(b)
+    ckpt_lib.save_ensemble_checkpoint(
+        tmp_path / "ckpt_3", [(ens, {"dict_size": N_DICT}, "sweep")], chunk_cursor=3
+    )
+    control_losses = [
+        np.asarray(jax.device_get(ens.step_batch(b)[0]["loss"]))
+        for b in _batches(2, start=4)
+    ]
+
+    template = {
+        "cursor": {"chunk": 0},
+        "ensembles": {"sweep": _build().state_dict()},
+        "args": {"sweep": {"dict_size": N_DICT}},
+    }
+    tree = ckpt_lib.restore_ensemble_checkpoint(tmp_path / "ckpt_3", template=template)
+    assert int(tree["cursor"]["chunk"]) == 3
+    resumed = Ensemble.from_state(tree["ensembles"]["sweep"]).shard(
+        make_mesh(4, 2, 1, devices=devices)
+    )
+    for ref, b in zip(control_losses, _batches(2, start=4)):
+        got = np.asarray(jax.device_get(resumed.step_batch(b)[0]["loss"]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,60 @@
+"""The dead-ensemble watchdog (LR_COLLAPSE study follow-up, VERDICT r2 #3):
+`ensemble_train_loop` warns loudly when every member's codes are all-zero,
+and stays silent on live ensembles."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.train.loop import ensemble_train_loop, warn_if_ensemble_dead
+
+
+def _ens(bias=0.0):
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=16,
+        n_dict_components=64,
+    )
+    if bias:
+        ens.state.params["encoder_bias"] = (
+            jnp.full_like(ens.state.params["encoder_bias"], bias)
+        )
+    return ens
+
+
+def test_live_ensemble_no_warning():
+    ens = _ens()
+    data = jax.random.normal(jax.random.PRNGKey(1), (128, 16))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        ensemble_train_loop(ens, data, batch_size=32, key=jax.random.PRNGKey(2))
+
+
+def test_dead_ensemble_warns():
+    # a hugely negative encoder bias shuts every relu gate: all-zero codes,
+    # exactly the collapse end-state
+    ens = _ens(bias=-1e6)
+    data = jax.random.normal(jax.random.PRNGKey(1), (128, 16))
+    assert warn_if_ensemble_dead(ens, data)
+    with pytest.warns(RuntimeWarning, match="DEAD ENSEMBLE"):
+        ensemble_train_loop(
+            ens, data, batch_size=32, key=jax.random.PRNGKey(2),
+        )
+
+
+def test_dead_check_can_be_disabled():
+    ens = _ens(bias=-1e6)
+    data = jax.random.normal(jax.random.PRNGKey(1), (128, 16))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        ensemble_train_loop(
+            ens, data, batch_size=32, key=jax.random.PRNGKey(2), dead_check=False
+        )

@@ -1,0 +1,60 @@
+"""Subject-LM pretraining on the synthetic trigram language: the loss must
+fall from ~log(vocab) toward the corpus's ~log(k_succ) entropy bound, which
+is what makes pretrained-subject parity runs meaningful (VERDICT r2 #4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data.synthetic_text import TrigramLanguage
+from sparse_coding__tpu.lm import LMConfig, init_params
+from sparse_coding__tpu.lm.pretrain import pretrain_lm
+
+
+@pytest.fixture(scope="module")
+def lang():
+    return TrigramLanguage(vocab_size=64, n_ctx_slots=256, k_succ=4, seed=0)
+
+
+def test_corpus_statistics(lang):
+    rows = lang.sample(n_rows=512, seq_len=32, seed=1)
+    assert rows.shape == (512, 32) and rows.dtype == np.int32
+    assert rows.min() >= 0 and rows.max() < 64
+    # deterministic per seed, fresh per seed
+    np.testing.assert_array_equal(rows, lang.sample(512, 32, seed=1))
+    assert (rows != lang.sample(512, 32, seed=2)).any()
+    # Zipfian marginal: the most frequent token dominates the median one
+    counts = np.bincount(rows.ravel(), minlength=64)
+    assert counts.max() > 8 * np.median(counts[counts > 0])
+    # trigram determinism: a context's successors come from a small set
+    a, b = rows[:, 10], rows[:, 11]
+    succ = rows[:, 12]
+    pairs = {}
+    for ai, bi, si in zip(a, b, succ):
+        pairs.setdefault((int(ai), int(bi)), set()).add(int(si))
+    multi = [len(v) for k, v in pairs.items()]
+    assert max(multi) <= lang.k_succ + 1  # hash slot has k_succ successors
+
+
+def test_pretrain_learns_the_language(lang):
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=32, n_heads=4, d_mlp=64,
+        vocab_size=64, n_ctx=32, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = lang.sample(n_rows=2048, seq_len=32, seed=3)
+    params, stats = pretrain_lm(
+        params, cfg, tokens, n_steps=120, batch_size=64,
+        learning_rate=3e-3, compute_dtype=None, seed=0,
+    )
+    # from ~log(64)=4.16 toward log(4)=1.39: must at least clearly move
+    assert stats["loss_first"] > 3.5
+    assert stats["loss_last"] < stats["loss_first"] - 1.0, stats
+    # trained params still run the capture forward
+    from sparse_coding__tpu.lm.model import run_with_cache
+
+    _, cache = run_with_cache(
+        params, jax.numpy.asarray(tokens[:4]), cfg,
+        ["blocks.1.hook_resid_post"], stop_at_layer=2,
+    )
+    assert np.isfinite(np.asarray(cache["blocks.1.hook_resid_post"])).all()
