@@ -141,9 +141,48 @@ def bench_fista() -> float:
     return BATCH / best
 
 
-def bench_stream() -> float:
+def bench_topk() -> float:
+    """Steps/sec of the BASELINE config-4 top-k train step (7-member k-sweep,
+    gpt2-small geometry, `TopKEncoderApprox` + bf16 + scan-8 — the r3
+    PartialReduce threshold path, THROUGHPUT.md r3a; r2's argsort path ran
+    ~2 steps/sec here)."""
+    import numpy as np
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import TopKEncoderApprox
+
+    ks = [1, 11, 31, 61, 91, 121, 151]
+    S = 8
+    ens = build_ensemble(
+        TopKEncoderApprox,
+        jax.random.PRNGKey(0),
+        [{"sparsity": k} for k in ks],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        d_activation=768,
+        n_features=12288,
+        sparsity_cap=151,
+        compute_dtype=jnp.bfloat16,
+    )
+    batches = jax.device_put(
+        np.random.default_rng(0).standard_normal((S, 2048, 768), dtype=np.float32)
+    )
+    jax.device_get(ens.step_scan(batches)["loss"])  # compile
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        losses = ens.step_scan(batches)
+        jax.device_get(losses["loss"])
+        best = min(best, (time.perf_counter() - t0) / S)
+    return 1.0 / best
+
+
+def bench_stream(store_dtype="float16") -> float:
     """Rows/sec through `ChunkStore.iter_chunks` (disk → host → HBM with
-    double-buffered prefetch), fenced by an on-device reduction per chunk."""
+    double-buffered prefetch), fenced by an on-device reduction per chunk.
+
+    ``store_dtype="int8"`` measures the quantized transport (half the disk
+    and host→device bytes, on-device dequant — `data.chunks`); on the
+    ~20 MiB/s tunneled link this path ≈2x the fp16 stream."""
     import numpy as np
 
     from sparse_coding__tpu.data.chunks import ChunkStore, save_chunk
@@ -154,7 +193,10 @@ def bench_stream() -> float:
     try:
         rng = np.random.default_rng(0)
         for i in range(n_chunks):
-            save_chunk(tmp, i, rng.standard_normal((rows, D_ACT), dtype=np.float32))
+            save_chunk(
+                tmp, i, rng.standard_normal((rows, D_ACT), dtype=np.float32),
+                dtype=np.dtype(store_dtype),
+            )
         store = ChunkStore(tmp)
         # warmup pass compiles the reduce and touches the page cache
         for chunk in store.iter_chunks([0]):
@@ -242,7 +284,9 @@ def main(argv=None):
     harvest_tps = bench_harvest()
     harvest_fused_tps = bench_harvest_fused()
     stream_rps = bench_stream()
+    stream_q8_rps = bench_stream("int8")
     fista_cps = bench_fista()
+    topk_sps = bench_topk()
     print(
         json.dumps(
             {
@@ -255,7 +299,9 @@ def main(argv=None):
                 "harvest_tokens_per_sec": round(harvest_tps, 1),
                 "harvest_fused_tokens_per_sec": round(harvest_fused_tps, 1),
                 "stream_rows_per_sec": round(stream_rps, 1),
+                "stream_int8_rows_per_sec": round(stream_q8_rps, 1),
                 "fista500_codes_per_sec": round(fista_cps, 1),
+                "topk_steps_per_sec": round(topk_sps, 1),
                 # profiled numbers include jax.profiler overhead — marked so
                 # they can't be mistaken for clean measurements
                 **({"profiled": True} if args.profile else {}),

@@ -53,6 +53,9 @@ class TrigramLanguage:
         ].astype(np.int32)
         w = rng.gamma(0.5, size=(n_ctx_slots, k_succ))
         self.succ_cum = np.cumsum(w / w.sum(axis=1, keepdims=True), axis=1)
+        # float cumsum can end below 1.0; a uniform draw in that gap would
+        # index past k_succ (same guard as _sample_categorical)
+        self.succ_cum[:, -1] = 1.0
 
     def _slot(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         return (a.astype(np.int64) * _P1 + b.astype(np.int64) * _P2) % self.n_ctx_slots

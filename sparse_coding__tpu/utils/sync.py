@@ -1,0 +1,232 @@
+"""Remote/cloud sync for datasets, sweep outputs, and autointerp results.
+
+Counterpart of the reference's `utils.py:30-222` + `cmdutil.py` — a pile of
+rsync/scp/S3 one-liners with hardcoded personal hosts, ports and AWS key IDs
+baked into the module. Redesigned for pod workflows:
+
+  - one engine, URL-scheme dispatch: `host:path` / `ssh://` → rsync over
+    ssh, `gs://` → `gsutil -m rsync` (the natural store next to TPU pods),
+    `s3://` → `aws s3 sync`, plain paths → local rsync;
+  - destinations come from arguments or the `SC_TPU_REMOTE` env var — no
+    identities in source code (the reference ships real usernames, IPs and
+    access-key IDs);
+  - retries with backoff (pod-scale syncs hit transient network errors);
+  - the reference's task-level helpers survive as thin wrappers:
+    `push_outputs`, `pull_outputs`, `push_dataset`, `pull_latest_outputs`
+    (its `sync`/`datasets_sync`/`autointerp_sync`/`copy_recent`).
+
+Pure orchestration — testable by injecting `runner` (tests stub the
+subprocess; no network needed).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
+
+
+def _default_runner(cmd: List[str]) -> "subprocess.CompletedProcess":
+    return subprocess.run(cmd, capture_output=True, text=True)
+
+
+def _is_remote(path: str) -> bool:
+    return (
+        path.startswith(("gs://", "s3://", "ssh://"))
+        or (":" in path and not Path(path.split(":", 1)[0]).exists() and "/" not in path.split(":", 1)[0])
+    )
+
+
+def _build_command(
+    src: str,
+    dst: str,
+    includes: Optional[Sequence[str]],
+    excludes: Optional[Sequence[str]],
+    delete: bool,
+    ssh_port: int,
+) -> List[str]:
+    """One transfer command for the scheme pair. Cloud schemes must appear on
+    at most one side (gsutil/aws sync between two clouds is out of scope)."""
+    cloud = [p for p in (src, dst) if p.startswith(("gs://", "s3://"))]
+    if len(cloud) > 1:
+        raise ValueError("cloud-to-cloud sync not supported; stage locally")
+    if cloud:
+        scheme = cloud[0].split("://", 1)[0]
+        if scheme == "gs":
+            cmd = ["gsutil", "-m", "rsync", "-r"]
+            if delete:
+                cmd.append("-d")
+            if excludes:
+                # gsutil takes ONE Python-regex -x (globs are invalid regex,
+                # repeated flags override each other): translate and join
+                import fnmatch
+
+                cmd += ["-x", "|".join(fnmatch.translate(p) for p in excludes)]
+            if includes:
+                raise ValueError("gsutil rsync has no include filter; use excludes")
+            return cmd + [src, dst]
+        cmd = ["aws", "s3", "sync", src, dst]
+        if delete:
+            cmd.append("--delete")
+        if includes:
+            # aws filter semantics: later filters win, so the canonical
+            # include-list form is exclude-everything THEN re-include
+            cmd += ["--exclude", "*"]
+            for pat in includes:
+                cmd += ["--include", pat]
+        else:
+            for pat in excludes or ():
+                cmd += ["--exclude", pat]
+        return cmd
+    # rsync (local or over ssh). `ssh://host/path` → host:path
+    def rs(p: str) -> str:
+        return p.split("://", 1)[1].replace("/", ":", 1) if p.startswith("ssh://") else p
+
+    cmd = ["rsync", "-az", "--partial"]
+    if delete:
+        cmd.append("--delete")
+    if includes:
+        # include-list semantics (reference datasets_sync): directories must
+        # stay included or rsync never descends to nested matches
+        cmd += ["--include", "*/"]
+        for pat in includes:
+            cmd += ["--include", pat]
+        cmd += ["--exclude", "*", "--prune-empty-dirs"]
+    else:
+        for pat in excludes or ():
+            cmd += ["--exclude", pat]
+    if _is_remote(src) or _is_remote(dst):
+        cmd += ["-e", f"ssh -p {ssh_port}"]
+    return cmd + [rs(src), rs(dst)]
+
+
+def sync(
+    src: str,
+    dst: str,
+    includes: Optional[Sequence[str]] = None,
+    excludes: Optional[Sequence[str]] = None,
+    delete: bool = False,
+    retries: int = 3,
+    ssh_port: int = 22,
+    runner: Runner = _default_runner,
+) -> "subprocess.CompletedProcess":
+    """Sync `src` → `dst` with scheme dispatch and retry/backoff.
+
+    Raises RuntimeError with the tool's stderr after `retries` failures.
+    """
+    cmd = _build_command(src, dst, includes, excludes, delete, ssh_port)
+    last = None
+    for attempt in range(retries):
+        try:
+            last = runner(cmd)
+        except FileNotFoundError:
+            # transfer tool not installed. Local↔local still works through a
+            # pure-python fallback (minimal images — like TPU-VM containers —
+            # often ship no rsync); remote schemes genuinely need the tool.
+            if cmd[0] == "rsync" and not (_is_remote(src) or _is_remote(dst)):
+                _local_sync(src, dst, includes, excludes, delete)
+                return subprocess.CompletedProcess(cmd, 0, "local python fallback", "")
+            raise RuntimeError(
+                f"`{cmd[0]}` is not installed; install it (or use a local "
+                "destination, which falls back to a pure-python copy)"
+            ) from None
+        if last.returncode == 0:
+            return last
+        time.sleep(min(2**attempt, 8))
+    raise RuntimeError(
+        f"sync failed after {retries} attempts: {' '.join(cmd)}\n{last.stderr}"
+    )
+
+
+def _local_sync(src, dst, includes, excludes, delete):
+    """Pure-python local mirror honoring the include/exclude semantics."""
+    import fnmatch
+    import shutil
+
+    src_p, dst_p = Path(src), Path(dst)
+    # rsync semantics: `src/` copies contents, `src` copies the folder itself
+    if not str(src).endswith("/"):
+        dst_p = dst_p / src_p.name
+    copied = set()
+    for f in src_p.rglob("*"):
+        if not f.is_file():
+            continue
+        rel = f.relative_to(src_p)
+        name = f.name
+        if includes and not any(fnmatch.fnmatch(name, p) for p in includes):
+            continue
+        if not includes and any(
+            fnmatch.fnmatch(name, p) or fnmatch.fnmatch(str(rel), p)
+            for p in excludes or ()
+        ):
+            continue
+        target = dst_p / rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(f, target)
+        copied.add(rel)
+    if delete and dst_p.exists():
+        for f in list(dst_p.rglob("*")):
+            if f.is_file() and f.relative_to(dst_p) not in copied:
+                f.unlink()
+
+
+def _remote_base(remote: Optional[str]) -> str:
+    remote = remote or os.environ.get("SC_TPU_REMOTE", "")
+    if not remote:
+        raise ValueError(
+            "no remote given: pass remote=... or set SC_TPU_REMOTE "
+            "(e.g. 'gs://my-bucket/sparse_coding' or 'host:sparse_coding')"
+        )
+    return remote.rstrip("/")
+
+
+# -- task-level wrappers (the reference's entry points) ------------------------
+
+def push_outputs(output_folder, remote: Optional[str] = None, **kw):
+    """Upload a sweep's output folder (reference `sync` / `upload_outputs`)."""
+    base = _remote_base(remote)
+    return sync(str(output_folder).rstrip("/"), f"{base}/outputs/", **kw)
+
+
+def pull_outputs(remote: Optional[str] = None, local="outputs", **kw):
+    """Mirror the remote outputs tree locally (reference `autointerp_sync`,
+    minus its hardcoded host path)."""
+    base = _remote_base(remote)
+    return sync(f"{base}/outputs/", str(local), **kw)
+
+
+def push_dataset(dataset_folder, remote: Optional[str] = None, **kw):
+    """Upload an activation-chunk dataset folder (reference `datasets_sync`,
+    which only moved csv files; chunk stores move wholesale)."""
+    base = _remote_base(remote)
+    return sync(str(dataset_folder).rstrip("/"), f"{base}/datasets/", **kw)
+
+
+def pull_latest_outputs(
+    remote: Optional[str] = None,
+    local="outputs",
+    ssh_port: int = 22,
+    runner: Runner = _default_runner,
+    **kw,
+):
+    """Fetch the most recently modified run folder under the remote outputs
+    tree (reference `copy_recent`). ssh-remote only — cloud stores list
+    differently and their consoles do this better."""
+    base = _remote_base(remote)
+    if base.startswith(("gs://", "s3://")):
+        raise ValueError("pull_latest_outputs supports ssh remotes only")
+    host, _, root = base.partition(":")
+    probe = runner(
+        ["ssh", "-p", str(ssh_port), host, f"ls -td {root}/outputs/*/ | head -1"]
+    )
+    if probe.returncode != 0 or not probe.stdout.strip():
+        raise RuntimeError(f"could not list remote outputs: {probe.stderr}")
+    newest = probe.stdout.strip().rstrip("/")
+    name = newest.rsplit("/", 1)[-1]
+    dest = Path(local) / name
+    dest.mkdir(parents=True, exist_ok=True)
+    return sync(f"{host}:{newest}/", str(dest), ssh_port=ssh_port, runner=runner, **kw)

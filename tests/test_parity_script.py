@@ -106,3 +106,23 @@ def test_dictpar_quick(tmp_path):
         # not training quality (the full-run script asserts pareto slopes)
         assert len(pts) == len(report["config"]["l1_alpha_grid"])
         assert all(p["l0"] >= 0 and p["fvu"] >= 0 for p in pts)
+
+
+@pytest.mark.slow
+def test_interp_subject_quick(tmp_path):
+    """The pretrained-subject autointerp artifact script runs end to end in
+    quick CPU mode (pretrain → harvest → SAE → offline autointerp → report)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "interp_subject_run.py"),
+         "--quick", "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / f"INTERP_{ROUND}_quick.json").read_text())
+    assert set(report["scores"]) == {
+        "tied_sae_l1=0.001", "random_dict", "identity_relu"
+    }
+    for rec in report["scores"].values():
+        assert rec["n"] > 0 and -1.0 <= rec["mean"] <= 1.0
+    assert report["pretrain"]["loss_last"] < report["pretrain"]["loss_first"]
