@@ -94,6 +94,7 @@ def _jitted_capture(
     names: Tuple[str, ...],
     stop_at: int,
     compute_dtype=None,
+    attn: str = "dense",
 ):
     """One compiled capture forward per (config, hook set, dtype) — repeated
     `make_activation_dataset` calls in a process reuse the executable.
@@ -109,6 +110,16 @@ def _jitted_capture(
     fp16 store quantizes harder than the bf16 error anyway for downstream
     SAE training. Default None is exact fp32."""
 
+    if attn == "dense":
+        attn_impl = lm_model.dense_attention
+    elif attn == "blockwise":
+        # single-chip long-context: O(S*block) memory flash-style recurrence
+        from sparse_coding__tpu.lm.ring_attention import blockwise_attention
+
+        attn_impl = blockwise_attention()
+    else:
+        raise ValueError(f"unknown single-device attn impl: {attn}")
+
     def f(p, t):
         # params arrive pre-cast (once per harvest, `_cast_params`); the
         # astype here is a traced no-op then, and only does work for direct
@@ -116,7 +127,7 @@ def _jitted_capture(
         if compute_dtype is not None:
             p = _cast_params(p, compute_dtype)
         _, cache = lm_model.run_with_cache(
-            p, t, lm_cfg, list(names), stop_at_layer=stop_at
+            p, t, lm_cfg, list(names), stop_at_layer=stop_at, attn_impl=attn_impl
         )
         return {k: v.astype(jnp.float16) for k, v in cache.items()}
 
@@ -196,7 +207,8 @@ def _harvest_plan(
 
 
 def _build_capture(
-    lm_cfg, names: Dict, stop_at: int, mesh, seq_attn: str, compute_dtype=None
+    lm_cfg, names: Dict, stop_at: int, mesh, seq_attn: str, compute_dtype=None,
+    attn: str = "dense",
 ):
     """The compiled capture forward, single-device or sequence-parallel; both
     cast to fp16 ON DEVICE inside the jitted program (halved fetch bytes).
@@ -205,9 +217,14 @@ def _build_capture(
     compute_dtype = _canon_dtype(compute_dtype)
     if compute_dtype is not None and mesh is not None:
         raise ValueError("compute_dtype is a single-device capture option")
+    if attn != "dense" and mesh is not None:
+        raise ValueError(
+            "attn is a single-device capture option; with a mesh choose the "
+            "sequence-parallel impl via seq_attn ('ring' | 'ulysses')"
+        )
     if mesh is None:
         return _jitted_capture(
-            lm_cfg, tuple(names.values()), stop_at, compute_dtype
+            lm_cfg, tuple(names.values()), stop_at, compute_dtype, attn
         )
     from sparse_coding__tpu.lm.ring_attention import make_sequence_parallel_fn
 
@@ -249,6 +266,7 @@ def make_activation_dataset(
     single_folder: bool = False,
     compute_dtype=None,
     store_dtype=np.float16,
+    attn: str = "dense",
 ) -> Dict[Tuple[int, str], Path]:
     """Run the subject LM over `tokens` `[N, S]`, capturing every requested
     (layer, layer_loc) in one pass; write fp16 chunks per capture point.
@@ -276,7 +294,7 @@ def make_activation_dataset(
         f.mkdir(parents=True, exist_ok=True)
 
     compute_dtype = _canon_dtype(compute_dtype)
-    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype)
+    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype, attn)
     if compute_dtype is not None:
         params = _cast_params_jit(params, compute_dtype)  # pay the cast once
 
@@ -341,6 +359,7 @@ def harvest_to_device(
     seq_attn: str = "ring",
     save_folder: Optional[Union[str, Path]] = None,
     compute_dtype=None,
+    attn: str = "dense",
 ):
     """Fused harvest→train streaming: yield HBM-resident activation chunks,
     never round-tripping through the host.
@@ -363,7 +382,7 @@ def harvest_to_device(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
     )
     compute_dtype = _canon_dtype(compute_dtype)
-    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype)
+    capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype, attn)
     if compute_dtype is not None:
         params = _cast_params_jit(params, compute_dtype)  # pay the cast once
 
