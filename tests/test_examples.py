@@ -11,7 +11,8 @@ REPO = Path(__file__).resolve().parent.parent
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "script", ["ensemble_training_example.py", "streaming_sweep_example.py"]
+    "script", ["ensemble_training_example.py", "streaming_sweep_example.py",
+               "autointerp_example.py", "elastic_resume_example.py"]
 )
 def test_example_runs(script):
     proc = subprocess.run(
