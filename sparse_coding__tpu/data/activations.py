@@ -437,6 +437,7 @@ def setup_data(
     max_lines: int = 100_000,
     skip_chunks: int = 0,
     compute_dtype=None,
+    store_dtype="float16",
 ) -> int:
     """Full pipeline: HF model + dataset → tokenize → harvest → chunk store
     (reference `setup_data`, `activation_dataset.py:400-460`). Needs the HF
@@ -464,6 +465,7 @@ def setup_data(
         skip_chunks=skip_chunks, center_dataset=center_dataset,
         single_folder=single,
         compute_dtype=compute_dtype,
+        store_dtype=np.dtype(store_dtype),
     )
     return sum(ChunkStore(f).n_datapoints() for f in folders.values())
 
@@ -485,12 +487,16 @@ def main(argv=None):
     p.add_argument("--skip_chunks", type=int, default=0)
     p.add_argument("--compute_dtype", default=None,
                    help="e.g. bfloat16: run the capture forward MXU-native")
+    p.add_argument("--store_dtype", default="float16", choices=("float16", "int8"),
+                   help="chunk store format; int8 halves disk/transfer bytes "
+                   "(per-row absmax, on-device dequant)")
     args = p.parse_args(argv)
     n = setup_data(
         args.model_name, args.dataset_name, args.dataset_folder,
         layer=args.layers, layer_loc=args.layer_locs, n_chunks=args.n_chunks,
         chunk_size_gb=args.chunk_size_gb, center_dataset=args.center_dataset,
         skip_chunks=args.skip_chunks, compute_dtype=args.compute_dtype,
+        store_dtype=args.store_dtype,
     )
     print(f"wrote {n} datapoints")
 

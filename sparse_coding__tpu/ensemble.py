@@ -450,6 +450,7 @@ class Ensemble:
 
         self.state = mesh_lib.shard_state(self.state, mesh, self.n_models, shard_dict)
         self._mesh = mesh
+        self._shard_dict = shard_dict
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
         self._pm_batch_sharding = mesh_lib.per_model_batch_sharding(mesh)
         return self
@@ -530,6 +531,28 @@ class Ensemble:
             "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
             "fused": self.fused,
             "state": jax.device_get(self.state),
+        }
+
+    def state_template(self) -> Dict[str, Any]:
+        """`state_dict` WITHOUT the host copy: the "state" entry is the live
+        (possibly mesh-sharded) device pytree. For orbax restore templates —
+        restoring against sharded template leaves places shards directly on
+        their devices instead of materializing the whole state on device 0
+        first (the difference between resuming and OOMing for ensembles that
+        only fit HBM when distributed). Do NOT mutate or step the ensemble
+        between building this template and restoring through it (donation
+        invalidates the referenced buffers)."""
+        if self.optimizer_name == "custom":
+            raise ValueError("state_template() needs a string optimizer name")
+        return {
+            "n_models": self.n_models,
+            "sig": f"{self.sig.__module__}.{self.sig.__qualname__}",
+            "optimizer_name": self.optimizer_name,
+            "optimizer_kwargs": self.optimizer_kwargs,
+            "unstacked": self.unstacked,
+            "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
+            "fused": self.fused,
+            "state": self.state,  # live device pytree, no host copy
         }
 
     @staticmethod

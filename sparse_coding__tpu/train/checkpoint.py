@@ -131,12 +131,26 @@ def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = N
     ensembles) used to recover exact leaf *types* — without it orbax returns
     plain dicts/lists, losing the `EnsembleState` dataclass and optax's
     NamedTuple optimizer states that the compiled step expects.
+
+    Sharded restore: when template leaves are mesh-sharded `jax.Array`s
+    (build the template with `Ensemble.state_template()` on sharded
+    ensembles), orbax places each shard directly on its device — the restore
+    never materializes the full state on one device, so ensembles that only
+    fit HBM when distributed can actually resume.
     """
     ckpt_dir = Path(ckpt_dir).absolute()
     if not ckpt_dir.exists():
         return None
     ckpt = _checkpointer()
     if template is not None:
+        import jax
+        import orbax.checkpoint as ocp
+
+        if any(
+            isinstance(leaf, jax.Array) for leaf in jax.tree.leaves(template)
+        ):
+            restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+            return ckpt.restore(ckpt_dir, item=template, restore_args=restore_args)
         return ckpt.restore(ckpt_dir, item=template)
     return ckpt.restore(ckpt_dir)
 

@@ -271,6 +271,7 @@ def init_model_dataset(cfg) -> ChunkStore:
         chunk_size_gb=cfg.chunk_size_gb,
         center_dataset=cfg.center_dataset,
         compute_dtype=cfg.harvest_compute_dtype,
+        store_dtype=cfg.harvest_store_dtype,
     )
     return store
 
@@ -319,9 +320,11 @@ def sweep(
     if resume:
         latest = ckpt_lib.latest_checkpoint(cfg.output_folder)
         if latest is not None:
+            # live-state templates: sharded ensembles restore shard-by-shard
+            # onto their devices (never materialized whole on device 0)
             template = {
                 "cursor": {"chunk": 0},
-                "ensembles": {name: ens.state_dict() for ens, _a, name in ensembles},
+                "ensembles": {name: ens.state_template() for ens, _a, name in ensembles},
                 "args": {name: _a for _e, _a, name in ensembles},
             }
             tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
@@ -329,7 +332,15 @@ def sweep(
             restored = []
             for ens, args, name in ensembles:
                 sd = tree["ensembles"][name]
-                restored.append((Ensemble.from_state(sd, sig=ens.sig), args, name))
+                new_ens = Ensemble.from_state(sd, sig=ens.sig)
+                # keep the init_func's mesh placement: a sharded sweep must
+                # resume sharded (elastic: the CURRENT mesh may be a
+                # different factorization than the one that saved)
+                if getattr(ens, "_mesh", None) is not None:
+                    new_ens = new_ens.shard(
+                        ens._mesh, shard_dict=getattr(ens, "_shard_dict", True)
+                    )
+                restored.append((new_ens, args, name))
             ensembles = restored
             print(f"Resumed from {latest} at chunk {start_chunk}")
 

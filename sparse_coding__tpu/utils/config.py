@@ -157,6 +157,9 @@ class TrainArgs(BaseArgs):
     center_activations: bool = False
     # bf16 subject forward for the harvest (data.activations._jitted_capture)
     harvest_compute_dtype: Optional[str] = None
+    # chunk store format: "float16" (reference contract) or "int8" (half the
+    # disk/transfer bytes, per-row absmax, on-device dequant — data.chunks)
+    harvest_store_dtype: str = "float16"
     # multi-epoch sweeps with HBM-sized datasets: upload chunks once, not
     # once per epoch (train/sweep.py)
     hbm_cache_chunks: bool = False
@@ -168,6 +171,11 @@ class TrainArgs(BaseArgs):
             raise ValueError(
                 f"harvest_compute_dtype must be one of {sorted(DTYPES)} or None, "
                 f"got {self.harvest_compute_dtype}"
+            )
+        if self.harvest_store_dtype not in ("float16", "int8"):
+            raise ValueError(
+                f"harvest_store_dtype must be 'float16' or 'int8', "
+                f"got {self.harvest_store_dtype}"
             )
         # exactly the set lm.model.make_tensor_name/get_activation_size accept
         if self.layer_loc not in ("residual", "mlp", "mlpout", "attn"):

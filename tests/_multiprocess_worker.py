@@ -1,7 +1,8 @@
 """Worker for tests/test_multiprocess.py — one simulated 'host' of a pod.
 
-Each process owns 4 virtual CPU devices; two processes form an 8-device
-global mesh. The worker builds the framework's (model, data, dict) mesh over
+Each process owns 8//n_proc virtual CPU devices; together the processes form
+an 8-device global mesh (2 procs x 4 devices or 4 procs x 2 — the pod
+topology is a parameter). The worker builds the framework's (model, data, dict) mesh over
 the GLOBAL device set, shards an ensemble across it, feeds a globally-sharded
 batch through `parallel.distributed.host_local_to_global` (each process
 contributing its `local_batch_slice`), steps, and prints the all-gathered
@@ -14,8 +15,10 @@ import sys
 
 def main():
     proc_id, n_proc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    dpp = 8 // n_proc  # devices per simulated host
     os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={dpp}"
     ).strip()
     import jax
 
@@ -29,7 +32,7 @@ def main():
 
     assert initialize_distributed(coord, n_proc, proc_id)
     assert jax.process_count() == n_proc
-    assert len(jax.devices()) == 4 * n_proc
+    assert len(jax.devices()) == 8
 
     import numpy as np
     from jax.experimental import multihost_utils

@@ -65,9 +65,16 @@ def pytest_collection_modifyitems(config, items):
             matched.add(key)
             item.add_marker(pytest.mark.smoke)
     # Drift guard: a renamed/deleted test must not silently drop a subsystem
-    # out of the smoke tier. Only enforced for files actually collected, so
-    # running a subset (`pytest tests/test_lm.py`) still works.
-    stale = {k for k in _SMOKE - matched if k[0] in collected_files}
+    # out of the smoke tier. Only enforced for files collected WHOLE —
+    # running a file subset (`pytest tests/test_lm.py`) still checks that
+    # file, but node-id selection (`pytest f.py::test_x`) skips the guard.
+    node_selected_files = {
+        Path(str(a).split("::", 1)[0]).name for a in config.args if "::" in str(a)
+    }
+    stale = {
+        k for k in _SMOKE - matched
+        if k[0] in collected_files and k[0] not in node_selected_files
+    }
     if stale:
         raise pytest.UsageError(f"_SMOKE entries match no collected test: {sorted(stale)}")
 

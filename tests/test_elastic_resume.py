@@ -97,3 +97,36 @@ def test_orbax_checkpoint_reshards(tmp_path, devices):
     for ref, b in zip(control_losses, _batches(2, start=4)):
         got = np.asarray(jax.device_get(resumed.step_batch(b)[0]["loss"]))
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_orbax_restores_directly_sharded(tmp_path, devices):
+    """Restoring through a LIVE sharded template (`Ensemble.state_template`)
+    yields arrays already placed on the mesh — the path that avoids
+    materializing pod-sized states on one device."""
+    mesh = make_mesh(2, 2, 2, devices=devices)
+    ens = _build().shard(mesh)
+    for b in _batches(2):
+        ens.step_batch(b)
+    ckpt_lib.save_ensemble_checkpoint(
+        tmp_path / "ckpt", [(ens, {}, "sweep")], chunk_cursor=1
+    )
+    control = [
+        np.asarray(jax.device_get(ens.step_batch(b)[0]["loss"]))
+        for b in _batches(2, start=2)
+    ]
+
+    fresh = _build().shard(mesh)
+    template = {
+        "cursor": {"chunk": 0},
+        "ensembles": {"sweep": fresh.state_template()},
+        "args": {"sweep": {}},
+    }
+    tree = ckpt_lib.restore_ensemble_checkpoint(tmp_path / "ckpt", template=template)
+    restored_state = tree["ensembles"]["sweep"]["state"]
+    enc = restored_state.params["encoder"]
+    # already sharded exactly like the template — no single-device stopover
+    assert enc.sharding.is_equivalent_to(fresh.state.params["encoder"].sharding, enc.ndim)
+    resumed = Ensemble.from_state(tree["ensembles"]["sweep"]).shard(mesh)
+    for ref, b in zip(control, _batches(2, start=2)):
+        got = np.asarray(jax.device_get(resumed.step_batch(b)[0]["loss"]))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -1,7 +1,7 @@
 """True multi-process distributed training test (SURVEY.md §2.4 P6).
 
-Two OS processes — each a simulated pod 'host' owning 4 virtual CPU devices —
-are wired into one 8-device global mesh by `parallel.distributed.
+N OS processes (2 and 4 tested) — each a simulated pod 'host' owning
+8//N virtual CPU devices — are wired into one 8-device global mesh by `parallel.distributed.
 initialize_distributed` (gloo transport standing in for ICI/DCN; the jax
 program is identical to a real pod's). Each runs the framework's sharded
 ensemble step over the (model=2, data=2, dict=2) mesh with globally-sharded
@@ -33,18 +33,19 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_sharded_step_matches_single_process(devices):
+@pytest.mark.parametrize("n_proc", [2, 4])
+def test_n_process_sharded_step_matches_single_process(devices, n_proc):
     port = _free_port()
     procs = [
         subprocess.Popen(
             [
                 sys.executable,
                 str(REPO / "tests" / "_multiprocess_worker.py"),
-                str(pid), "2", f"127.0.0.1:{port}",
+                str(pid), str(n_proc), f"127.0.0.1:{port}",
             ],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        for pid in range(2)
+        for pid in range(n_proc)
     ]
     outs = []
     for p in procs:
@@ -55,8 +56,9 @@ def test_two_process_sharded_step_matches_single_process(devices):
     for out in outs:
         line = next(l for l in out.splitlines() if l.startswith("LOSSES="))
         losses.append(np.array([float(v) for v in line[7:].split(",")]))
-    # both processes observe the same global losses
-    np.testing.assert_array_equal(losses[0], losses[1])
+    # every process observes the same global losses
+    for other in losses[1:]:
+        np.testing.assert_array_equal(losses[0], other)
 
     # single-process reference on the same 8-device mesh, same seeds/batches
     from sparse_coding__tpu import build_ensemble

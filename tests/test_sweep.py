@@ -149,3 +149,40 @@ def test_hbm_cache_chunks_matches_streaming(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(ld_a.get_learned_dict()), np.asarray(ld_b.get_learned_dict())
         )
+
+
+def test_sharded_sweep_resumes_sharded(tmp_path, devices):
+    """A sweep whose init_func shards its ensembles must come back SHARDED
+    after resume (round-3 fix: restore used to silently drop the mesh), and
+    the resumed state must equal the trained state."""
+    from sparse_coding__tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 2, 2, devices=devices)
+
+    def sharded_init(cfg):
+        ensembles, eh, bh, ranges = l1_ensemble_init(cfg)
+        return [(e.shard(mesh), a, n) for e, a, n in ensembles], eh, bh, ranges
+
+    cfg = make_cfg(tmp_path, n_epochs=1)
+    dicts_first = sweep(sharded_init, cfg)
+
+    # spy on Ensemble.shard: the resume path must call it once MORE than the
+    # init_func does (the restored ensemble gets re-placed on the mesh)
+    from sparse_coding__tpu.ensemble import Ensemble
+
+    calls = []
+    orig_shard = Ensemble.shard
+
+    def spy_shard(self, mesh_, shard_dict=True):
+        calls.append(mesh_)
+        return orig_shard(self, mesh_, shard_dict)
+
+    Ensemble.shard = spy_shard
+    try:
+        dicts_resumed = sweep(sharded_init, cfg, resume=True)
+    finally:
+        Ensemble.shard = orig_shard
+    assert len(calls) == 2, f"restore did not re-shard (shard calls: {len(calls)})"
+    d0 = np.asarray(dicts_first[0][0].get_learned_dict())
+    d1 = np.asarray(dicts_resumed[0][0].get_learned_dict())
+    np.testing.assert_allclose(d0, d1, atol=1e-6)

@@ -106,3 +106,36 @@ def test_step_timer_and_trace(tmp_path):
         with annotate("toy"):
             jax.device_get(jnp.ones((8,)) * 2)
     assert any((tmp_path / "trace").rglob("*")), "no trace files written"
+
+
+def test_log_image_wandb_path(tmp_path, monkeypatch):
+    """With wandb live, images go through wandb.log WITHOUT an explicit step
+    (scalar logging advances the run step per batch; a smaller explicit step
+    would be dropped by wandb's monotonic rule) and carry the chunk index as
+    a sibling metric. Stubbed wandb — no network."""
+    import sys
+    import types
+
+    import matplotlib.pyplot as plt
+
+    calls = []
+    stub = types.ModuleType("wandb")
+    stub.Image = lambda fig: ("IMG", fig)
+    stub.init = lambda **kw: types.SimpleNamespace(
+        log=lambda payload, **kw2: calls.append((payload, kw2)),
+        finish=lambda: None,
+    )
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="t", use_wandb=True)
+    fig = plt.figure()
+    try:
+        assert logger.log_image(7, "mmcs_grid", fig) is None
+    finally:
+        plt.close(fig)
+    (payload, kwargs), = [c for c in calls if "mmcs_grid" in c[0]]
+    assert payload["mmcs_grid"][0] == "IMG"
+    assert payload["mmcs_grid_chunk"] == 7
+    assert "step" not in kwargs  # no monotonic-step violation
+    # file fallback NOT used when wandb is live
+    assert not (tmp_path / "images").exists()
