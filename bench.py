@@ -141,6 +141,38 @@ def bench_fista() -> float:
     return BATCH / best
 
 
+def bench_harvest_longctx() -> float:
+    """Tokens/sec of the blockwise (flash-style) capture at seq 4096 — the
+    single-chip long-context surface (`lm.ring_attention.blockwise_attention`;
+    the reference caps sequences at 256 tokens)."""
+    import numpy as np
+
+    from sparse_coding__tpu.data.activations import _jitted_capture
+    from sparse_coding__tpu.lm import LMConfig, init_params
+
+    cfg = LMConfig(
+        arch="neox", n_layers=6, d_model=D_ACT, n_heads=8, d_mlp=4 * D_ACT,
+        vocab_size=50304, n_ctx=8192, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    S, B = 4096, 4
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S), dtype=np.int32)
+    )
+    cap = _jitted_capture(
+        cfg, ("blocks.2.hook_resid_post",), 3, jnp.dtype(jnp.bfloat16), "blockwise"
+    )
+    out = cap(params, toks)
+    jax.device_get(jnp.ravel(out["blocks.2.hook_resid_post"])[0])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cap(params, toks)
+        jax.device_get(jnp.ravel(out["blocks.2.hook_resid_post"])[0])
+        best = min(best, time.perf_counter() - t0)
+    return B * S / best
+
+
 def bench_topk() -> float:
     """Steps/sec of the BASELINE config-4 top-k train step (7-member k-sweep,
     gpt2-small geometry, `TopKEncoderApprox` + bf16 + scan-8 — the r3
@@ -287,6 +319,7 @@ def main(argv=None):
     stream_q8_rps = bench_stream("int8")
     fista_cps = bench_fista()
     topk_sps = bench_topk()
+    longctx_tps = bench_harvest_longctx()
     print(
         json.dumps(
             {
@@ -302,6 +335,7 @@ def main(argv=None):
                 "stream_int8_rows_per_sec": round(stream_q8_rps, 1),
                 "fista500_codes_per_sec": round(fista_cps, 1),
                 "topk_steps_per_sec": round(topk_sps, 1),
+                "harvest_seq4096_tokens_per_sec": round(longctx_tps, 1),
                 # profiled numbers include jax.profiler overhead — marked so
                 # they can't be mistaken for clean measurements
                 **({"profiled": True} if args.profile else {}),

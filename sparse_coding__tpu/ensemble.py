@@ -515,23 +515,7 @@ class Ensemble:
         to XLA on every step, so a by-reference snapshot would be invalidated by
         the next `step_batch`.
         """
-        if self.optimizer_name == "custom":
-            raise ValueError(
-                "state_dict() cannot record a custom optax transformation; "
-                "construct the Ensemble with a string optimizer name (e.g. "
-                "'adam') for checkpointable state, or restore manually with "
-                "Ensemble.from_state(sd, tx=your_tx)."
-            )
-        return {
-            "n_models": self.n_models,
-            "sig": f"{self.sig.__module__}.{self.sig.__qualname__}",
-            "optimizer_name": self.optimizer_name,
-            "optimizer_kwargs": self.optimizer_kwargs,
-            "unstacked": self.unstacked,
-            "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
-            "fused": self.fused,
-            "state": jax.device_get(self.state),
-        }
+        return {**self.state_template(), "state": jax.device_get(self.state)}
 
     def state_template(self) -> Dict[str, Any]:
         """`state_dict` WITHOUT the host copy: the "state" entry is the live
@@ -543,7 +527,11 @@ class Ensemble:
         between building this template and restoring through it (donation
         invalidates the referenced buffers)."""
         if self.optimizer_name == "custom":
-            raise ValueError("state_template() needs a string optimizer name")
+            raise ValueError(
+                "checkpointable state needs a string optimizer name (e.g. "
+                "'adam'); for a custom optax transformation restore manually "
+                "with Ensemble.from_state(sd, tx=your_tx)."
+            )
         return {
             "n_models": self.n_models,
             "sig": f"{self.sig.__module__}.{self.sig.__qualname__}",

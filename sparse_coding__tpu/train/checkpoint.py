@@ -108,15 +108,21 @@ def save_ensemble_checkpoint(
     chunk_cursor: int = 0,
     extra: Optional[Dict[str, Any]] = None,
 ):
-    """Save full sweep state: every ensemble's `state_dict` + the cursor.
+    """Save full sweep state: every ensemble's metadata + LIVE state + cursor.
 
-    `ensembles` is the sweep's `[(Ensemble, args, name), ...]` list.
+    `ensembles` is the sweep's `[(Ensemble, args, name), ...]` list. The
+    state is saved from the live (possibly mesh-sharded) device arrays —
+    orbax writes each process's addressable shards locally, so pod-scale
+    states are never gathered to one host (`jax.device_get` on a multi-host
+    global array would raise on non-addressable shards, and even
+    single-host it would needlessly round-trip the whole state through host
+    RAM). Pairs with the sharded restore in `restore_ensemble_checkpoint`.
     """
     ckpt_dir = Path(ckpt_dir).absolute()
     tree = {
         "cursor": {"chunk": chunk_cursor, **(extra or {})},
         "ensembles": {
-            name: ens.state_dict() for ens, _args, name in ensembles
+            name: ens.state_template() for ens, _args, name in ensembles
         },
         "args": {name: _args for _ens, _args, name in ensembles},
     }
@@ -143,7 +149,6 @@ def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = N
         return None
     ckpt = _checkpointer()
     if template is not None:
-        import jax
         import orbax.checkpoint as ocp
 
         if any(
