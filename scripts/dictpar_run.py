@@ -164,6 +164,12 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
     ap.add_argument("--out", default=None, help="output prefix (default repo root)")
     ap.add_argument("--mesh-validate", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument(
+        "--pretrain", type=int, default=-1,
+        help="subject pretraining steps on the synthetic trigram corpus "
+        "(-1 = auto: 2000 for full runs, 0 for --quick; 0 = random-init "
+        "subject)",
+    )
     args = ap.parse_args(argv)
 
     if args.mesh_validate:
@@ -201,21 +207,27 @@ def main(argv=None):
     seeds = (0, 1)
     eval_rows = 2048 if quick else 4096
 
-    print(f"Building subject model (pythia-410m geometry, random init, d={d_act})...")
+    print(f"Building subject model (pythia-410m geometry, d={d_act})...")
     lm_cfg, params = build_subject_model(quick)
 
-    from parity_run import synth_tokens
+    from parity_run import corpus_tokens, maybe_pretrain
 
-    tokens = synth_tokens(
-        lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+    pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
+    params, lang, pretrain_stats = maybe_pretrain(
+        params, lm_cfg, quick, pretrain_steps
+    )
+    # seed=0 keeps the --pretrain 0 path token-identical to the round-2 runs
+    tokens = corpus_tokens(
+        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len,
+        n_chunks + 1, seed=0 if lang is None else 13,
     )
     n_rows = tokens.shape[0]
 
     # two capture depths from ONE single-pass forward (the reference's
     # multi-layer harvest shape, `make_activation_dataset_hf`,
-    # `activation_dataset.py:326-391`): layer 2 keeps more of the
-    # token-embedding structure of the random-init subject; the spec's mid
-    # layer dilutes it with depth and is the harder target.
+    # `activation_dataset.py:326-391`): layer 2 sits close to the token
+    # embedding (an easier reconstruction target); the spec's mid layer
+    # mixes context with depth and is the harder one.
     cap_layers = [layer] if quick else [2, layer]
     # 1e-3 collapses the 32768-dim ensemble's high-l1 members (all-zero
     # codes). LR_COLLAPSE_r03.json: fp32 control collapses identically, so
@@ -226,7 +238,8 @@ def main(argv=None):
     report: dict = {
         "config": {
             "baseline_config": 5,
-            "subject": f"neox d={d_act} L={n_layers} (pythia-410m geometry, random init)",
+            "subject": f"neox d={d_act} L={n_layers} (pythia-410m geometry, "
+            f"{'trigram-pretrained' if lang is not None else 'random init'})",
             "model": "FunctionalTiedSAE",
             "layers": cap_layers, "mid_layer": layer, "layer_loc": "residual",
             "seq_len": seq_len, "dict_ratio": RATIO, "n_dict": n_dict,
@@ -234,8 +247,10 @@ def main(argv=None):
             "n_epochs": n_epochs, "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         },
+        **({"pretrain": pretrain_stats} if pretrain_stats else {}),
         "notes": (
-            "random-init subject; activations standardized by a per-layer "
+            f"{'trigram-pretrained' if lang is not None else 'random-init'} "
+            "subject; activations standardized by a per-layer "
             "scalar std before training (recorded below). lr 3e-4: lr 1e-3 "
             "kills the high-l1 members (LR_COLLAPSE_r03: fp32 collapses "
             "identically - l1 x Adam-lr dynamics, not bf16). "
