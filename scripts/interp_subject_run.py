@@ -73,9 +73,11 @@ def main(argv=None):
     layer, layer_loc = (1, "residual") if quick else (2, "residual")
     ratio = 2 if quick else 4
     sae_batch = 256 if quick else 2048
-    n_feats_explain = 6 if quick else 40
-    df_n_feats = 12 if quick else 120
-    n_fragments = 256 if quick else 2000
+    n_feats_explain = 6 if quick else 80
+    # the df lives in a tempdir and dies with the run: sizing it beyond the
+    # explained set is pure dead work here
+    df_n_feats = 12 if quick else 80
+    n_fragments = 256 if quick else 4000
     pretrain_steps = args.pretrain if args.pretrain is not None else (
         40 if quick else 2000
     )
