@@ -192,7 +192,7 @@ def _harvest_plan(
 
     def width(loc, name):
         try:
-            return lm_model.get_activation_size(lm_cfg, loc)
+            return lm_model.get_activation_size(lm_cfg, loc, seq_len=seq_len)
         except ValueError:
             # unregistered qualified name: size it by shape-probing the
             # forward (no compute, no compile)

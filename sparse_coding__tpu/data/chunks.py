@@ -16,6 +16,7 @@ called for in SURVEY.md §7 stage 4).
 
 from __future__ import annotations
 
+import functools
 import os
 import queue
 import threading
@@ -48,11 +49,37 @@ def quantize_rows_int8(array: np.ndarray):
     return q, scales
 
 
-@jax.jit
-def _dequant_int8(q: jax.Array, scales: jax.Array) -> jax.Array:
-    """On-device dequant to fp16 (the store's logical dtype); jitted so the
-    int8→fp16 widen never exists host-side."""
+def _dequant_int8_impl(q: jax.Array, scales: jax.Array) -> jax.Array:
     return q.astype(jnp.float16) * scales[:, None].astype(jnp.float16)
+
+
+# On-device dequant to fp16 (the store's logical dtype); jitted so the
+# int8→fp16 widen never exists host-side.
+_dequant_int8 = jax.jit(_dequant_int8_impl)
+
+
+def _row_sharding(sharding):
+    """Sharding for the per-row ``[N]`` scales matching an ``[N, d]`` chunk
+    sharding: placed along the chunk's row axis, feature axis dropped.
+    NamedSharding only — other kinds return None and the caller leaves the
+    scales uncommitted (pre-ADVICE-r3 behavior)."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(sharding, NamedSharding):
+            row = sharding.spec[0] if len(sharding.spec) else None
+            return NamedSharding(sharding.mesh, PartitionSpec(row))
+    except (ImportError, TypeError):
+        pass
+    return None
+
+
+@functools.lru_cache(maxsize=16)
+def _dequant_int8_to(sharding):
+    """Dequant jitted with an explicit output sharding, so the result's
+    layout is the requested one rather than compiler-chosen (ADVICE r3 —
+    fragile on multi-host meshes otherwise). Cached per sharding."""
+    return jax.jit(_dequant_int8_impl, out_shardings=sharding)
 
 
 def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
@@ -130,9 +157,16 @@ class ChunkStore:
             s = jnp.asarray(scales)
             if sharding is not None:
                 q = jax.device_put(q, sharding)
-            elif device is not None:
-                q, s = jax.device_put(q, device), jax.device_put(s, device)
-            x = _dequant_int8(q, s)
+                row_sh = _row_sharding(sharding)
+                if row_sh is not None:
+                    s = jax.device_put(s, row_sh)
+                    x = _dequant_int8_to(sharding)(q, s)
+                else:
+                    x = _dequant_int8(q, s)
+            else:
+                if device is not None:
+                    q, s = jax.device_put(q, device), jax.device_put(s, device)
+                x = _dequant_int8(q, s)
         else:
             x = jnp.asarray(arr)
             if sharding is not None:

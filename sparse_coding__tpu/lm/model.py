@@ -113,8 +113,14 @@ def config_for(model_name: str) -> LMConfig:
     raise ValueError(f"Unknown model name: {model_name}")
 
 
-def get_activation_size(model_name_or_cfg, layer_loc: str) -> int:
-    """(reference `get_activation_size`, `activation_dataset.py:51-69`)"""
+def get_activation_size(model_name_or_cfg, layer_loc: str, seq_len: Optional[int] = None) -> int:
+    """(reference `get_activation_size`, `activation_dataset.py:51-69`)
+
+    ``"pattern"`` rows have last dim = the harvested sequence length, not a
+    model constant — pass ``seq_len`` to size it, otherwise it raises like
+    other unsized locations so callers route to the `jax.eval_shape` probe
+    (ADVICE r3: returning ``n_ctx`` sized buffers wrongly at
+    ``seq_len != n_ctx``)."""
     cfg = (
         model_name_or_cfg
         if isinstance(model_name_or_cfg, LMConfig)
@@ -126,8 +132,8 @@ def get_activation_size(model_name_or_cfg, layer_loc: str) -> int:
         return cfg.d_mlp
     if layer_loc in ("attn", "attn_q", "attn_k", "attn_v"):
         return cfg.n_heads * cfg.d_head
-    if layer_loc == "pattern":
-        return cfg.n_ctx  # upper bound; the true last dim is the seq length
+    if layer_loc == "pattern" and seq_len is not None:
+        return seq_len
     raise ValueError(
         f"Layer location {layer_loc} has no registered size; harvest sizes "
         "unregistered qualified names via a jax.eval_shape probe"

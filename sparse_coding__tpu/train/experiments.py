@@ -292,11 +292,14 @@ def run_single_layer(layer: int = 2, layer_loc: str = "residual", tied: bool = T
 
     `experiment` overrides the swept builder (default the paper's
     `dense_l1_range_experiment`)."""
+    from sparse_coding__tpu.data.activations import MAX_SENTENCE_LEN
     from sparse_coding__tpu.lm.model import get_activation_size
 
     model_name = overrides.pop("model_name", "EleutherAI/pythia-70m-deduped")
     width = overrides.pop(
-        "activation_width", get_activation_size(model_name, layer_loc)
+        "activation_width",
+        # seq_len sizes 'pattern' rows (the harvest default, 256 tokens)
+        get_activation_size(model_name, layer_loc, seq_len=MAX_SENTENCE_LEN),
     )
     cfg = EnsembleArgs(
         model_name=model_name,

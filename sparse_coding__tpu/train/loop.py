@@ -76,8 +76,14 @@ def warn_if_ensemble_dead(ensemble: Ensemble, batch, context: str = "") -> bool:
                 )
             )
         )
-    except Exception:
-        return False  # signatures without a standard aux contract: skip
+    except (KeyError, TypeError, AttributeError, ValueError) as e:
+        # signatures without a standard aux contract: skip — but only for the
+        # expected contract failures; a real device error must propagate
+        # rather than silently disable the watchdog (ADVICE r3)
+        import logging
+
+        logging.getLogger(__name__).debug("dead-ensemble probe skipped: %r", e)
+        return False
     if dead:
         warnings.warn(
             f"DEAD ENSEMBLE{' (' + context + ')' if context else ''}: every "

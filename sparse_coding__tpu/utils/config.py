@@ -177,9 +177,16 @@ class TrainArgs(BaseArgs):
                 f"harvest_store_dtype must be 'float16' or 'int8', "
                 f"got {self.harvest_store_dtype}"
             )
-        # exactly the set lm.model.make_tensor_name/get_activation_size accept
-        if self.layer_loc not in ("residual", "mlp", "mlpout", "attn"):
-            raise ValueError(f"unknown layer_loc {self.layer_loc}")
+        # exactly the surface lm.model.make_tensor_name resolves: HOOK_TEMPLATES
+        # shorthands (residual/mlp/attn_out/mlp_pre/...), `{layer}`-templated
+        # names, and fully-qualified hook names (ADVICE r3: the old list
+        # lagged behind the generic-capture surface)
+        from ..lm.model import make_tensor_name
+
+        try:
+            make_tensor_name(0, self.layer_loc)
+        except (ValueError, TypeError):  # TypeError: non-string (YAML ints etc.)
+            raise ValueError(f"unknown layer_loc {self.layer_loc!r}")
         if self.batch_size <= 0 or self.n_chunks <= 0:
             raise ValueError("batch_size and n_chunks must be positive")
 

@@ -35,10 +35,16 @@ def _default_runner(cmd: List[str]) -> "subprocess.CompletedProcess":
 
 
 def _is_remote(path: str) -> bool:
-    return (
-        path.startswith(("gs://", "s3://", "ssh://"))
-        or (":" in path and not Path(path.split(":", 1)[0]).exists() and "/" not in path.split(":", 1)[0])
-    )
+    """rsync's own convention, made deterministic: any ``host:rest`` whose
+    host part contains no path separator is remote. No filesystem probing —
+    the old existence check made the same string mean different things
+    depending on what directories happened to exist in cwd (ADVICE r3). A
+    local filename containing a colon must be disambiguated the way rsync
+    itself requires: prefix it with ``./``."""
+    if path.startswith(("gs://", "s3://", "ssh://")):
+        return True
+    head, sep, _ = path.partition(":")
+    return bool(sep) and "/" not in head and "\\" not in head
 
 
 def _build_command(

@@ -36,6 +36,18 @@ def test_validation():
         cfg.update({"nonexistent": 3})
 
 
+def test_layer_loc_accepts_full_capture_surface():
+    """Config validation tracks make_tensor_name exactly (ADVICE r3): all
+    HOOK_TEMPLATES shorthands, `{layer}`-templated names, and fully-qualified
+    hook names are valid layer_locs for config-driven sweeps."""
+    from sparse_coding__tpu.lm.model import HOOK_TEMPLATES
+
+    for loc in HOOK_TEMPLATES:
+        TrainArgs(layer_loc=loc)
+    TrainArgs(layer_loc="blocks.{layer}.attn.hook_q")
+    TrainArgs(layer_loc="blocks.3.mlp.hook_pre")
+
+
 def test_inheritance_and_yaml_roundtrip(tmp_path):
     cfg = SyntheticEnsembleArgs(activation_width=128, feature_num_nonzero=7)
     assert cfg.lr == 1e-3  # inherited TrainArgs default

@@ -30,6 +30,21 @@ def test_local_rsync_command():
     assert "-e" not in r.calls[0]  # local: no ssh transport
 
 
+def test_is_remote_deterministic(tmp_path, monkeypatch):
+    """Remote detection never probes the filesystem (ADVICE r3): the same
+    string classifies identically whatever exists in cwd, and rsync's own
+    `./` prefix disambiguates colon-containing local names."""
+    assert S._is_remote("host:proj")
+    assert S._is_remote("user@host:proj")
+    assert S._is_remote("gs://bucket/x") and S._is_remote("ssh://pod1/d")
+    assert not S._is_remote("./weird:name")
+    assert not S._is_remote("/abs/weird:name")
+    # existence of a directory named like the host must not flip the answer
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "host").mkdir()
+    assert S._is_remote("host:proj")
+
+
 def test_ssh_rsync_with_port_and_excludes():
     r = Recorder()
     S.sync("/a/", "host:proj", excludes=["*.hdf", ".git"], ssh_port=2222, runner=r)
