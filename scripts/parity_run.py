@@ -307,6 +307,11 @@ def main(argv=None):
         "(-1 = auto: 2000 for full l1/basic runs, 0 otherwise)",
     )
     ap.add_argument(
+        "--topk-recall", type=float, default=None,
+        help="approx_max_k recall_target for the topk config "
+        "(default: TopKEncoderApprox.RECALL)",
+    )
+    ap.add_argument(
         "--config", choices=("l1", "topk", "fista", "basic"), default="l1",
         help="l1: pythia-70m-geometry tied-SAE l1 sweep (BASELINE config 2); "
         "topk: gpt2-small-geometry 16x TopK k-sweep (BASELINE config 4); "
@@ -353,7 +358,8 @@ def main(argv=None):
         ratio, n_epochs = (2, 1) if quick else (16, 3)
         hp_name, arch = "sparsity", "gpt2"
         cap = int(max(grid))
-        mk_hp = lambda v: {"sparsity": int(v), "sparsity_cap": cap}
+        recall_kw = {} if args.topk_recall is None else {"recall": args.topk_recall}
+        mk_hp = lambda v: {"sparsity": int(v), "sparsity_cap": cap, **recall_kw}
         hp_key = lambda v: str(int(v))  # report keys/values stay integers
         subject = "gpt2-small geometry, random init"
     else:

@@ -54,6 +54,13 @@ class DictSignature(Protocol):
         differentiable in ``params``.
       - ``to_learned_dict(params, buffers) -> LearnedDict``: export one model
         (host-side, unstacked) for evaluation.
+      - ``bind_static(stacked_buffers) -> signature`` (OPTIONAL): called by
+        `Ensemble._build_steps` with the CONCRETE (un-traced) stacked buffers
+        before jitting; returns a signature specialized on trace-time-static
+        values mined from them (e.g. `TopKEncoderApprox`'s recall palette —
+        `approx_max_k`'s recall_target cannot be traced). Must return a
+        stable object per palette (cache it) so shared-step caching works;
+        checkpoints still record the UNBOUND signature.
     """
 
     @staticmethod
@@ -356,6 +363,12 @@ class Ensemble:
     _SHARED_STEPS_MAX = 32
 
     def _build_steps(self, donate: bool = True):
+        # trace-time specialization on concrete buffer values (see
+        # DictSignature.bind_static); execution-only — self.sig stays the
+        # user-facing signature for checkpoints and to_learned_dicts
+        sig_exec = self.sig
+        if hasattr(self.sig, "bind_static"):
+            sig_exec = self.sig.bind_static(self.state.buffers)
         fused_adam = None
         if (
             getattr(self, "fused", False)
@@ -397,7 +410,7 @@ class Ensemble:
             isinstance(v, _scalar) for v in self.optimizer_kwargs.values()
         ):
             cache_key = (
-                self.sig,
+                sig_exec,
                 self.optimizer_name,
                 tuple(sorted((k, str(v)) for k, v in self.optimizer_kwargs.items())),
                 self.unstacked,
@@ -413,19 +426,19 @@ class Ensemble:
                 return
 
         self._step = jax.jit(
-            make_ensemble_step(self.sig, self.tx, per_model_batch=False, **kw),
+            make_ensemble_step(sig_exec, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
         )
         self._step_pm = jax.jit(
-            make_ensemble_step(self.sig, self.tx, per_model_batch=True, **kw),
+            make_ensemble_step(sig_exec, self.tx, per_model_batch=True, **kw),
             donate_argnums=donate_argnums,
         )
         self._multi = jax.jit(
-            make_ensemble_multi_step(self.sig, self.tx, per_model_batch=False, **kw),
+            make_ensemble_multi_step(sig_exec, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
         )
         self._multi_pm = jax.jit(
-            make_ensemble_multi_step(self.sig, self.tx, per_model_batch=True, **kw),
+            make_ensemble_multi_step(sig_exec, self.tx, per_model_batch=True, **kw),
             donate_argnums=donate_argnums,
         )
         if cache_key is not None:

@@ -82,7 +82,15 @@ def tied_vs_not_experiment(cfg: EnsembleArgs, mesh=None):
 def topk_experiment(cfg: EnsembleArgs, mesh=None):
     """k-sparse sweep: sparsity 1..160 step 10 × dict ratios {0.5,1,2,4}
     (reference `:233-264`). The reference needs `no_stacking` Python loops;
-    our top-k is vmappable with traced k, so each ratio is one stack."""
+    our top-k is vmappable with traced k, so each ratio is one stack.
+
+    `cfg.topk_recall` switches to hardware-approximate selection
+    (`TopKEncoderApprox` at that recall_target); None trains exact top-k."""
+    from sparse_coding__tpu.models import TopKEncoderApprox
+
+    recall = getattr(cfg, "topk_recall", None)
+    sig = TopKEncoder if recall is None else TopKEncoderApprox
+    recall_kw = {} if recall is None else {"recall": float(recall)}
     sparsity_levels = list(np.arange(1, 161, 10))
     dict_ratios = [0.5, 1, 2, 4]
     ensembles = []
@@ -93,12 +101,12 @@ def topk_experiment(cfg: EnsembleArgs, mesh=None):
         keys = jax.random.split(_key(cfg, int(r * 2)), len(sparsity_levels))
         cap = min(max(sparsity_levels), dict_size)
         models = [
-            TopKEncoder.init(k, cfg.activation_width, dict_size, min(s, dict_size),
-                             sparsity_cap=cap)
+            sig.init(k, cfg.activation_width, dict_size, min(s, dict_size),
+                     sparsity_cap=cap, **recall_kw)
             for k, s in zip(keys, sparsity_levels)
         ]
         ensembles.append(
-            _ensemble(TopKEncoder, models, cfg, dict_size, f"topk_r{r}", mesh=mesh)
+            _ensemble(sig, models, cfg, dict_size, f"topk_r{r}", mesh=mesh)
         )
     return (
         ensembles,

@@ -185,7 +185,9 @@ class TrainArgs(BaseArgs):
 
         try:
             make_tensor_name(0, self.layer_loc)
-        except (ValueError, TypeError):  # TypeError: non-string (YAML ints etc.)
+        except (ValueError, TypeError, KeyError, IndexError):
+            # TypeError: non-string (YAML ints); Key/IndexError: template
+            # placeholders other than {layer}
             raise ValueError(f"unknown layer_loc {self.layer_loc!r}")
         if self.batch_size <= 0 or self.n_chunks <= 0:
             raise ValueError("batch_size and n_chunks must be positive")
@@ -198,6 +200,9 @@ class EnsembleArgs(TrainArgs):
     activation_width: int = 512
     use_synthetic_dataset: bool = False
     bias_decay: float = 0.0
+    # topk sweeps: approx_max_k recall_target. None → exact TopKEncoder in
+    # `topk_experiment`; set (e.g. 0.95) → TopKEncoderApprox at that recall
+    topk_recall: Optional[float] = None
 
 
 @dataclass
