@@ -12,6 +12,16 @@ Round-2 throughput path (see THROUGHPUT.md for the profile that led here):
     the ~10 ms tunneled-dispatch latency amortizes to ~0.08 ms/step;
   - batches fed in bf16 (halves batch HBM traffic).
 
+Measurement protocol (round 4, VERDICT r3 weak #1/#4): every key is the
+MEDIAN of ROUNDS (default 5, recorded in the output's `rounds` field; a
+smaller --rounds is a smoke run, not the protocol) timed windows, with the
+[min, max] range reported alongside as `<key>_spread`.
+The windows for different keys are INTERLEAVED round-robin,
+so a shared-chip load spike pollutes all keys equally instead of silently
+biasing whichever bench it landed on. Setup/compile runs once per bench
+before any timing. Docs must quote these driver-captured medians, not best
+runs.
+
 Metric: activation vectors consumed per second per chip (each vector is
 processed by all 8 ensemble members — fwd+bwd+adam). MFU is reported against
 the actual matmul FLOPs of the tied-SAE step (5 matmul passes: 2 fwd + 3 bwd)
@@ -27,6 +37,7 @@ v4-32 pod; this bench reports the single-chip number.)
 
 import json
 import shutil
+import statistics
 import tempfile
 import time
 
@@ -36,6 +47,7 @@ import jax.numpy as jnp
 N_MODELS, D_ACT, N_DICT, BATCH = 8, 512, 4096, 2048
 A100_BASELINE_ACTS_PER_SEC = 0.78e6
 SCAN_STEPS = 128
+ROUNDS = 5  # timed windows per key, interleaved across keys
 TPU_PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
 
 
@@ -59,40 +71,43 @@ def _harvest_setup():
     return cfg, params, tokens, batch_size, chunk_gb, n_chunks
 
 
-def bench_harvest() -> float:
+def prep_harvest(stack):
     """Tokens/sec through `make_activation_dataset` on a Pythia-70M-shaped
     random-init LM (the reference's real bottleneck: a 4-sentence eager
     forward per batch, `activation_dataset.py:37`; here one jitted
     64-sentence capture forward, cached per config). On this tunneled
     backend the number is device→host transfer-bound (~20 MiB/s tunnel,
-    THROUGHPUT.md) — see `bench_harvest_fused` for the path that avoids the
+    THROUGHPUT.md) — see `prep_harvest_fused` for the path that avoids the
     transfer entirely."""
     from sparse_coding__tpu.data.activations import make_activation_dataset
+    from sparse_coding__tpu.data.chunks import ChunkStore
 
     cfg, params, tokens, batch_size, chunk_gb, n_chunks = _harvest_setup()
-    tmp = tempfile.mkdtemp(prefix="bench_harvest_")
-    try:
-        from sparse_coding__tpu.data.chunks import ChunkStore
+    tmp = stack.enter_context(tempfile.TemporaryDirectory(prefix="bench_harvest_"))
+    # warmup: compiles the capture forward (reused via the per-config cache)
+    make_activation_dataset(
+        params, cfg, tokens, f"{tmp}/warm", [2], ["residual"],
+        batch_size=batch_size, chunk_size_gb=chunk_gb, n_chunks=1,
+    )
+    calls = [0]
 
-        # warmup: compiles the capture forward (reused via the per-config cache)
-        make_activation_dataset(
-            params, cfg, tokens, f"{tmp}/warm", [2], ["residual"],
-            batch_size=batch_size, chunk_size_gb=chunk_gb, n_chunks=1,
-        )
+    def measure() -> float:
+        out = f"{tmp}/run{calls[0]}"
+        calls[0] += 1
         t0 = time.perf_counter()
         folders = make_activation_dataset(
-            params, cfg, tokens, f"{tmp}/run", [2], ["residual"],
+            params, cfg, tokens, out, [2], ["residual"],
             batch_size=batch_size, chunk_size_gb=chunk_gb, n_chunks=n_chunks,
         )
         dt = time.perf_counter() - t0
-        # tokens actually harvested = rows written (one activation per token)
         n_tokens = ChunkStore(folders[(2, "residual")]).n_datapoints()
-    finally:
-        shutil.rmtree(tmp, ignore_errors=True)
-    return n_tokens / dt
+        shutil.rmtree(out, ignore_errors=True)
+        return n_tokens / dt
+
+    return measure
 
 
-def bench_harvest_fused() -> float:
+def prep_harvest_fused(stack):
     """Tokens/sec through `harvest_to_device` — the fused harvest→train
     streaming path (SURVEY §7 hard part #1): activation chunks stay
     HBM-resident for the consuming train step; the host never touches them.
@@ -109,20 +124,26 @@ def bench_harvest_fused() -> float:
     # warmup (compile via the shared capture cache)
     for chunk in harvest_to_device(params, cfg, tokens, n_chunks=1, **kw):
         jax.device_get(reduce_fn(chunk[(2, "residual")]))
-    t0 = time.perf_counter()
-    n_tokens = 0
-    for chunk in harvest_to_device(params, cfg, tokens, n_chunks=n_chunks, **kw):
-        arr = chunk[(2, "residual")]
-        jax.device_get(reduce_fn(arr))
-        n_tokens += arr.shape[0]
-    return n_tokens / (time.perf_counter() - t0)
+
+    def measure() -> float:
+        t0 = time.perf_counter()
+        n_tokens = 0
+        for chunk in harvest_to_device(params, cfg, tokens, n_chunks=n_chunks, **kw):
+            arr = chunk[(2, "residual")]
+            jax.device_get(reduce_fn(arr))
+            n_tokens += arr.shape[0]
+        return n_tokens / (time.perf_counter() - t0)
+
+    return measure
 
 
-def bench_fista() -> float:
+def prep_fista(stack):
     """Codes/sec through the auto-selected FISTA solver (the fork's hot inner
     loop: 500 iterations of two matmuls + shrinkage per solve,
     `fista.py:99-128`) at the bench dictionary shape — `fista_solve` picks
-    the VMEM kernel or the XLA loop per shape."""
+    the VMEM kernel or the XLA loop per shape. Historically 3-5x noisy on
+    the shared chip (single 1-4 s dispatches); the median + spread now says
+    so in the output instead of a footnote."""
     from sparse_coding__tpu.ops.fista_pallas import fista_solve
 
     d = jax.random.normal(jax.random.PRNGKey(0), (N_DICT, D_ACT))
@@ -130,18 +151,17 @@ def bench_fista() -> float:
     x = jax.random.normal(jax.random.PRNGKey(1), (BATCH, D_ACT))
     solve = jax.jit(lambda xx, dd: fista_solve(xx, dd, 1e-3, None, num_iter=500)[0])
     jax.device_get(solve(x, d)).sum()  # warmup/compile
-    # single 1-4 s dispatches vary 3-5x run-to-run on the shared chip
-    # (THROUGHPUT.md r3) — report the best of 5, not a polluted mean
-    best = float("inf")
-    for _ in range(5):
+
+    def measure() -> float:
         t0 = time.perf_counter()
         ahat = solve(x, d)
         jax.device_get(ahat).sum()
-        best = min(best, time.perf_counter() - t0)
-    return BATCH / best
+        return BATCH / (time.perf_counter() - t0)
+
+    return measure
 
 
-def bench_harvest_longctx() -> float:
+def prep_harvest_longctx(stack):
     """Tokens/sec of the blockwise (flash-style) capture at seq 4096 — the
     single-chip long-context surface (`lm.ring_attention.blockwise_attention`;
     the reference caps sequences at 256 tokens)."""
@@ -164,16 +184,17 @@ def bench_harvest_longctx() -> float:
     )
     out = cap(params, toks)
     jax.device_get(jnp.ravel(out["blocks.2.hook_resid_post"])[0])
-    best = float("inf")
-    for _ in range(3):
+
+    def measure() -> float:
         t0 = time.perf_counter()
         out = cap(params, toks)
         jax.device_get(jnp.ravel(out["blocks.2.hook_resid_post"])[0])
-        best = min(best, time.perf_counter() - t0)
-    return B * S / best
+        return B * S / (time.perf_counter() - t0)
+
+    return measure
 
 
-def bench_topk() -> float:
+def prep_topk(stack):
     """Steps/sec of the BASELINE config-4 top-k train step (7-member k-sweep,
     gpt2-small geometry, `TopKEncoderApprox` + bf16 + scan-8 — the r3
     PartialReduce threshold path, THROUGHPUT.md r3a; r2's argsort path ran
@@ -199,16 +220,17 @@ def bench_topk() -> float:
         np.random.default_rng(0).standard_normal((S, 2048, 768), dtype=np.float32)
     )
     jax.device_get(ens.step_scan(batches)["loss"])  # compile
-    best = float("inf")
-    for _ in range(4):
+
+    def measure() -> float:
         t0 = time.perf_counter()
         losses = ens.step_scan(batches)
         jax.device_get(losses["loss"])
-        best = min(best, (time.perf_counter() - t0) / S)
-    return 1.0 / best
+        return S / (time.perf_counter() - t0)
+
+    return measure
 
 
-def bench_stream(store_dtype="float16") -> float:
+def prep_stream(stack, store_dtype="float16"):
     """Rows/sec through `ChunkStore.iter_chunks` (disk → host → HBM with
     double-buffered prefetch), fenced by an on-device reduction per chunk.
 
@@ -221,31 +243,39 @@ def bench_stream(store_dtype="float16") -> float:
 
     n_chunks, rows = 4, 40960
     reduce_fn = jax.jit(lambda x: x.sum())
-    tmp = tempfile.mkdtemp(prefix="bench_stream_")
-    try:
-        rng = np.random.default_rng(0)
-        for i in range(n_chunks):
-            save_chunk(
-                tmp, i, rng.standard_normal((rows, D_ACT), dtype=np.float32),
-                dtype=np.dtype(store_dtype),
-            )
-        store = ChunkStore(tmp)
-        # warmup pass compiles the reduce and touches the page cache
-        for chunk in store.iter_chunks([0]):
-            jax.device_get(reduce_fn(chunk))
+    tmp = stack.enter_context(
+        tempfile.TemporaryDirectory(prefix=f"bench_stream_{store_dtype}_")
+    )
+    rng = np.random.default_rng(0)
+    for i in range(n_chunks):
+        save_chunk(
+            tmp, i, rng.standard_normal((rows, D_ACT), dtype=np.float32),
+            dtype=np.dtype(store_dtype),
+        )
+    store = ChunkStore(tmp)
+    # warmup pass compiles the reduce and touches the page cache
+    for chunk in store.iter_chunks([0]):
+        jax.device_get(reduce_fn(chunk))
+
+    def measure() -> float:
         t0 = time.perf_counter()
         total = 0
         for chunk in store.iter_chunks(list(range(n_chunks))):
             jax.device_get(reduce_fn(chunk))
             total += chunk.shape[0]
-        dt = time.perf_counter() - t0
-    finally:
-        shutil.rmtree(tmp, ignore_errors=True)
-    return total / dt
+        return total / (time.perf_counter() - t0)
+
+    return measure
+
+
+def median_spread(vals):
+    vals = sorted(float(v) for v in vals)
+    return statistics.median(vals), [vals[0], vals[-1]]
 
 
 def main(argv=None):
     import argparse
+    import contextlib
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -253,6 +283,10 @@ def main(argv=None):
         metavar="DIR",
         help="write a jax.profiler trace of the timed training scan to DIR "
         "(view with TensorBoard / ui.perfetto.dev)",
+    )
+    ap.add_argument(
+        "--rounds", type=int, default=ROUNDS,
+        help="timed windows per key (interleaved round-robin across keys)",
     )
     args = ap.parse_args(argv)
 
@@ -288,60 +322,62 @@ def main(argv=None):
     losses = ens.step_scan(batches)
     jax.device_get(losses["loss"])
 
-    # ~2.5s measured window: the shared tunneled chip shows ±3-5% run-to-run
-    # variance, and longer windows average more of it out
-    reps = 8
-    import contextlib
+    # ~0.9 s per headline window (3 x 128 fused steps); ROUNDS interleaved
+    # windows replace round-3's single 2.5 s window
+    reps = 3
 
-    ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
-    with ctx:
+    def measure_headline() -> float:
         t0 = time.perf_counter()
         for _ in range(reps):
             losses = ens.step_scan(batches)
         jax.device_get(losses["loss"])
-        dt = time.perf_counter() - t0
+        return reps * SCAN_STEPS * BATCH / (time.perf_counter() - t0)
+
     if args.profile:
+        # the trace runs as a SEPARATE, discarded window: the reported
+        # medians below are always clean of jax.profiler overhead
+        with trace(args.profile):
+            measure_headline()
         print(f"# trace written to {args.profile}")
 
-    n_steps = reps * SCAN_STEPS
-    acts_per_sec = n_steps * BATCH / dt
+    with contextlib.ExitStack() as stack:
+        benches = {
+            "harvest_tokens_per_sec": prep_harvest(stack),
+            "harvest_fused_tokens_per_sec": prep_harvest_fused(stack),
+            "stream_rows_per_sec": prep_stream(stack),
+            "stream_int8_rows_per_sec": prep_stream(stack, "int8"),
+            "fista500_codes_per_sec": prep_fista(stack),
+            "topk_steps_per_sec": prep_topk(stack),
+            "harvest_seq4096_tokens_per_sec": prep_harvest_longctx(stack),
+        }
+        samples = {k: [] for k in ["headline", *benches]}
+        for _ in range(max(2, args.rounds)):
+            samples["headline"].append(measure_headline())
+            for k, m in benches.items():
+                samples[k].append(m())
+
+    acts_per_sec, acts_spread = median_spread(samples["headline"])
     # true matmul work of the tied-SAE step: 5 passes (fwd c, fwd x_hat;
     # bwd dc, and the two dictionary-gradient contractions)
     flops_per_act = N_MODELS * 5 * 2 * D_ACT * N_DICT
     peak = TPU_PEAK_TFLOPS.get(jax.devices()[0].device_kind, 197.0)
     mfu = acts_per_sec * flops_per_act / (peak * 1e12)
 
-    # secondary benches: the harvest pipeline (SURVEY §7 hard part #1) and
-    # chunk-store streaming — reported as extra fields on the one JSON line
-    harvest_tps = bench_harvest()
-    harvest_fused_tps = bench_harvest_fused()
-    stream_rps = bench_stream()
-    stream_q8_rps = bench_stream("int8")
-    fista_cps = bench_fista()
-    topk_sps = bench_topk()
-    longctx_tps = bench_harvest_longctx()
-    print(
-        json.dumps(
-            {
-                "metric": "ensemble_sae_train_throughput (8x tied-SAE 512->4096, batch 2048, bf16+scan128)",
-                "value": round(acts_per_sec, 1),
-                "unit": "activations/sec/chip",
-                "vs_baseline": round(acts_per_sec / A100_BASELINE_ACTS_PER_SEC, 3),
-                "mfu": round(mfu, 3),
-                "device": jax.devices()[0].device_kind,
-                "harvest_tokens_per_sec": round(harvest_tps, 1),
-                "harvest_fused_tokens_per_sec": round(harvest_fused_tps, 1),
-                "stream_rows_per_sec": round(stream_rps, 1),
-                "stream_int8_rows_per_sec": round(stream_q8_rps, 1),
-                "fista500_codes_per_sec": round(fista_cps, 1),
-                "topk_steps_per_sec": round(topk_sps, 1),
-                "harvest_seq4096_tokens_per_sec": round(longctx_tps, 1),
-                # profiled numbers include jax.profiler overhead — marked so
-                # they can't be mistaken for clean measurements
-                **({"profiled": True} if args.profile else {}),
-            }
-        )
-    )
+    out = {
+        "metric": "ensemble_sae_train_throughput (8x tied-SAE 512->4096, batch 2048, bf16+scan128)",
+        "value": round(acts_per_sec, 1),
+        "unit": "activations/sec/chip",
+        "vs_baseline": round(acts_per_sec / A100_BASELINE_ACTS_PER_SEC, 3),
+        "mfu": round(mfu, 3),
+        "device": jax.devices()[0].device_kind,
+        "rounds": max(2, args.rounds),
+        "value_spread": [round(v, 1) for v in acts_spread],
+    }
+    for k in benches:
+        med, spread = median_spread(samples[k])
+        out[k] = round(med, 1)
+        out[f"{k}_spread"] = [round(v, 1) for v in spread]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,468 @@
+"""Quantified pod scale-out model: collective traffic + v4-32 projection.
+
+VERDICT r3 next #2: the ≥3×/chip north star (BASELINE.json) was a pod-scale-out
+*story* with zero numbers attached. This script attaches the numbers this
+environment can produce:
+
+1. **Measured collective traffic.** For each relevant mesh factorization of a
+   16-device virtual CPU mesh (v4-32 = 16 chips: v4 TensorCores are
+   megacore-fused, one JAX device per chip), compile the REAL sharded train
+   step — the same `Ensemble.shard` + jit program a pod would run (the
+   dryrun's path; only `jax.distributed.initialize` differs) — and read the
+   per-step collective operations straight out of the optimized SPMD HLO:
+   op counts, shard bytes, and the ring-model wire bytes per chip implied by
+   each op's replica-group size. XLA's own `cost_analysis` flops/bytes are
+   recorded alongside.
+
+   Workloads:
+     - config 2 (the bench headline): 8-member tied-SAE l1 sweep,
+       512 → 4096, batch 2048/step — `big_sweep_experiments.py:295-341`.
+     - config 5 (the pod workload): 4-member tied-SAE ensemble at 32×
+       overcomplete (1024 → 32768), batch 2048 — `:546-644` + BASELINE
+       config 5, the shape `scripts/dictpar_run.py` trains for real.
+
+2. **Analytic weak-scaling projection** (`project()`): combine the measured
+   single-chip v5e step time (BENCH/THROUGHPUT) with the HLO-measured wire
+   bytes and public v4 constants (peak bf16 FLOP/s, ICI link bandwidth,
+   torus axes) into predicted acts/s/chip at 16 chips, with a ±2× ICI
+   bandwidth sensitivity band — the conclusion must not hinge on the exact
+   link constant. No-overlap (conservative) and full-overlap (optimistic)
+   bounds are both reported.
+
+Writes SCALEOUT_<round>.json at the repo root. Run time: a few minutes of
+CPU compiles; no TPU needed (and none used — safe to run alongside chip jobs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+N_VIRTUAL_DEVICES = 16  # v4-32 slice = 16 megacore chips
+
+# -- public hardware constants (assumptions stated in the artifact) ----------
+V4 = dict(
+    name="TPU v4 (v4-32 slice, 16 chips, 3D torus)",
+    peak_bf16_flops=275e12,
+    hbm_bytes_per_sec=1.2e12,
+    # ICI: one-way bandwidth per link. v4 runs a 3D torus; a collective over
+    # one mesh axis rides that axis's bidirectional ring = 2 links.
+    ici_link_oneway_bytes_per_sec=4.5e10,
+    links_per_axis=2,  # bidirectional ring on the axis
+)
+V5P = dict(
+    name="TPU v5p (16 chips)",
+    peak_bf16_flops=459e12,
+    hbm_bytes_per_sec=2.8e12,
+    ici_link_oneway_bytes_per_sec=9.0e10,
+    links_per_axis=2,
+)
+
+# measured on the single v5e chip (BENCH_r03 / THROUGHPUT.md): the headline
+# step sustains MFU ~0.74 on its matmul FLOPs; projections assume the same
+# achieved MFU transfers to v4 (same XLA program, same operand shapes).
+MEASURED_SINGLE_CHIP = dict(
+    device="TPU v5 lite",
+    peak_bf16_flops=197e12,
+    headline_acts_per_sec=871_187.0,  # driver-captured BENCH_r03 (median r4 may differ)
+    mfu=0.742,
+)
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4, "u16": 2,
+    "u8": 1, "pred": 1,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Bytes of an HLO shape string like 'f32[8,512,4096]{...}' or a tuple
+    '(f32[8], f32[8])'."""
+    total = 0
+    for m in re.finditer(r"(\w+)\[([0-9,]*)\]", shape_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _group_size(line: str, n_devices: int) -> int:
+    """Participants per replica group of a collective HLO line."""
+    m = re.search(r"replica_groups=\{\{([^}]*)\}", line)
+    if m:
+        return len([t for t in m.group(1).split(",") if t.strip() != ""])
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)  # iota form [n,g]
+    if m:
+        return int(m.group(2))
+    return n_devices
+
+
+def collective_traffic(hlo_text: str, n_devices: int) -> dict:
+    """Per-step collective inventory from optimized SPMD HLO.
+
+    Wire bytes per chip use the standard ring models (scaling-book):
+      all-reduce:      2 * (g-1)/g * shard_bytes   (reduce-scatter+all-gather)
+      all-gather:      (g-1)/g * gathered_bytes    (output shape is gathered)
+      reduce-scatter:  (g-1)/g * input_bytes ≈ (g-1) * shard_bytes
+      all-to-all:      (g-1)/g * bytes
+      collective-permute: bytes (one hop)
+    """
+    ops = []
+    wire_total = 0.0
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # async collectives come as -start/-done pairs: count -start (it
+        # carries the op + shapes), never -done (same traffic, second match
+        # would double-count). Sync forms have the name followed by "(".
+        m = re.match(r"(?:ROOT )?%?[\w.\-]+ = (.*?) (all-reduce|all-gather|"
+                     r"reduce-scatter|all-to-all|collective-permute)"
+                     r"(-start)?\(", s)
+        if not m:
+            continue
+        out_shape, kind = m.group(1), m.group(2)
+        b = _shape_bytes(out_shape)
+        g = _group_size(s, n_devices)
+        if g <= 1:
+            wire = 0.0
+        elif kind == "all-reduce":
+            wire = 2 * (g - 1) / g * b
+        elif kind == "all-gather":
+            wire = (g - 1) / g * b
+        elif kind == "reduce-scatter":
+            wire = (g - 1) * b  # b is the scattered (output) shard
+        elif kind == "all-to-all":
+            wire = (g - 1) / g * b
+        else:  # collective-permute
+            wire = float(b)
+        ops.append({"op": kind, "out_bytes": b, "group_size": g,
+                    "wire_bytes_per_chip": round(wire)})
+        wire_total += wire
+    summary = {}
+    for o in ops:
+        k = o["op"]
+        summary.setdefault(k, {"count": 0, "wire_bytes_per_chip": 0})
+        summary[k]["count"] += 1
+        summary[k]["wire_bytes_per_chip"] += o["wire_bytes_per_chip"]
+    return {
+        "ops": ops,
+        "summary": summary,
+        "wire_bytes_per_chip_per_step": round(wire_total),
+    }
+
+
+def compile_case(name, n_models, d_act, n_dict, batch, mesh_shape, note=""):
+    """Build the real sharded ensemble step, compile it for the virtual mesh,
+    and extract collective traffic + XLA cost analysis."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.parallel import make_mesh
+
+    model, data, dict_ = mesh_shape
+    t0 = time.time()
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 10 ** (-4 + i * 0.25)} for i in range(n_models)],
+        optimizer_kwargs={"learning_rate": 3e-4},
+        activation_size=d_act,
+        n_dict_components=n_dict,
+    )
+    mesh = make_mesh(model, data, dict_)
+    ens.shard(mesh)
+    from sparse_coding__tpu.parallel.mesh import batch_sharding
+
+    batch_arr = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (batch, d_act)),
+        batch_sharding(mesh),
+    )
+    lowered = ens._step.lower(ens.state, batch_arr)
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    traffic = collective_traffic(hlo, N_VIRTUAL_DEVICES)
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        cost = {
+            "flops_per_step_per_chip": float(ca.get("flops", float("nan"))),
+            "hbm_bytes_per_step_per_chip": float(
+                ca.get("bytes accessed", float("nan"))
+            ),
+        }
+    except Exception as e:  # cost_analysis is best-effort across backends
+        cost = {"error": repr(e)}
+    try:
+        mem = compiled.memory_analysis()
+        cost["argument_bytes_per_chip"] = int(mem.argument_size_in_bytes)
+        cost["temp_bytes_per_chip"] = int(mem.temp_size_in_bytes)
+    except Exception:
+        pass
+    # analytic matmul FLOPs of the tied-SAE step (5 matmul passes), whole step
+    flops_step_total = n_models * 5 * 2 * d_act * n_dict * batch
+    case = {
+        "name": name,
+        "note": note,
+        "workload": {
+            "n_models": n_models, "d_act": d_act, "n_dict": n_dict,
+            "batch_per_step": batch,
+        },
+        "mesh": {"model": model, "data": data, "dict": dict_},
+        "matmul_flops_per_step_total": flops_step_total,
+        "matmul_flops_per_step_per_chip": flops_step_total // N_VIRTUAL_DEVICES,
+        "collectives": traffic,
+        "xla_cost_analysis": cost,
+        "compile_seconds": round(time.time() - t0, 1),
+    }
+    del ens
+    return case
+
+
+def project(case: dict, hw: dict, mfu: float) -> dict:
+    """Weak-scaling projection for one compiled case on `hw`.
+
+    T_compute = matmul FLOPs per chip / (mfu * peak); T_ici = wire bytes per
+    chip / (links_per_axis * link bandwidth). Efficiency bounds: no-overlap
+    (serialize compute+comm) and full-overlap (max of the two). The ±2×
+    bandwidth band shows whether the conclusion survives the ICI constant
+    being off."""
+    flops_chip = case["matmul_flops_per_step_per_chip"]
+    wire = case["collectives"]["wire_bytes_per_chip_per_step"]
+    batch = case["workload"]["batch_per_step"]
+    t_compute = flops_chip / (mfu * hw["peak_bf16_flops"])
+    out = {"hardware": hw["name"], "assumed_mfu": mfu}
+    for tag, scale in [("ici_x1", 1.0), ("ici_x0.5", 0.5), ("ici_x2", 2.0)]:
+        bw = hw["links_per_axis"] * hw["ici_link_oneway_bytes_per_sec"] * scale
+        t_ici = wire / bw
+        t_no_overlap = t_compute + t_ici
+        t_overlap = max(t_compute, t_ici)
+        out[tag] = {
+            "t_compute_us": round(t_compute * 1e6, 1),
+            "t_ici_us": round(t_ici * 1e6, 1),
+            "comm_fraction_no_overlap": round(t_ici / t_no_overlap, 4),
+            # whole-step batch / whole-step time, divided over the chips
+            "acts_per_sec_per_chip_no_overlap": round(
+                batch / t_no_overlap / N_VIRTUAL_DEVICES
+            ),
+            "acts_per_sec_per_chip_overlap": round(
+                batch / t_overlap / N_VIRTUAL_DEVICES
+            ),
+        }
+    return out
+
+
+def main():
+    # force the virtual CPU mesh BEFORE backend init; never touches the TPU
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_VIRTUAL_DEVICES}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    cases = [
+        # config 2 — the bench headline, pod-fanned. Sweep members are
+        # embarrassingly parallel: a pure model-axis mesh must carry ZERO
+        # per-step collectives (the assert below holds the HLO to it).
+        compile_case(
+            "config2_sweep_fanout", 16, 512, 4096, 2048,
+            (16, 1, 1),
+            note="16-member l1 sweep, one member per chip, batch replicated; "
+            "the pod analogue of the reference's process-per-GPU dispatch",
+        ),
+        # config 2 — hybrid fan-out x data parallelism: each 2-chip data
+        # group all-reduces its members' gradients every step.
+        compile_case(
+            "config2_hybrid_dp2", 16, 512, 4096, 2048 * 2,
+            (8, 2, 1),
+            note="16 members over 8 model-shards x data 2: the per-step "
+            "gradient all-reduce a data axis buys",
+        ),
+        # config 2 — pure data parallelism (the DDP shape): gradient psum of
+        # all 8 members' params every step. The anti-pattern to quantify.
+        compile_case(
+            "config2_pure_dp", 8, 512, 4096, 2048 * 16,
+            (1, 16, 1),
+            note="8-member ensemble replicated, batch sharded 16-way: "
+            "per-step gradient all-reduce of every parameter",
+        ),
+        # config 5 — dict-parallel pod workload (dictpar_run.py's shape).
+        compile_case(
+            "config5_dictpar", 4, 1024, 32768, 2048 * 4,
+            (1, 4, 4),
+            note="4-member 32x-overcomplete ensemble, dict sharded 4-way x "
+            "data 4-way (BASELINE config 5)",
+        ),
+        # config 5 — same workload, model+data only (no dict sharding).
+        compile_case(
+            "config5_model_data", 4, 1024, 32768, 2048 * 4,
+            (4, 4, 1),
+            note="members on the model axis instead: what dict sharding buys "
+            "or costs vs pure fan-out at the same chip count",
+        ),
+    ]
+
+    projections = {}
+    for case in cases:
+        projections[case["name"]] = {
+            "v4": project(case, V4, MEASURED_SINGLE_CHIP["mfu"]),
+            "v5p": project(case, V5P, MEASURED_SINGLE_CHIP["mfu"]),
+        }
+
+    # headline per-chip ceiling math against the A100 analytic baseline
+    # (bench.py: 0.78e6 acts/s at 6-matmul-pass accounting; our step does 5)
+    a100 = 0.78e6
+    base_flops_per_act = 8 * 5 * 2 * 512 * 4096  # config-2 matmul work
+    ceilings = {}
+    for hw, mfu_pts in [(V4, (MEASURED_SINGLE_CHIP["mfu"], 0.85, 1.0)),
+                        (V5P, (MEASURED_SINGLE_CHIP["mfu"], 0.85, 1.0))]:
+        ceilings[hw["name"]] = {
+            f"mfu_{m}": round(
+                m * hw["peak_bf16_flops"] / base_flops_per_act / a100, 2
+            )
+            for m in mfu_pts
+        }
+    measured = MEASURED_SINGLE_CHIP | {
+        "vs_baseline": round(
+            MEASURED_SINGLE_CHIP["headline_acts_per_sec"] / a100, 3
+        )
+    }
+
+    report = {
+        "round": ROUND_TAG,
+        "method": (
+            "Real sharded train-step programs (Ensemble.shard + jit, the "
+            "dryrun path) compiled for a 16-device virtual CPU mesh; "
+            "collective ops, replica groups and shard bytes parsed from the "
+            "optimized SPMD HLO; ring-model wire bytes per chip; analytic "
+            "projection = measured-MFU compute time + wire/ICI time. "
+            "Multi-chip hardware is unreachable from this environment "
+            "(BASELINE.md), so these are the strongest numbers available "
+            "in-image: the program is the real one, the wire bytes are "
+            "measured, only the link-rate constants are assumed (with a "
+            "±2x sensitivity band)."
+        ),
+        "measured_single_chip": measured,
+        "hardware_constants": {"v4": V4, "v5p": V5P},
+        "cases": cases,
+        "projections": projections,
+        "per_chip_ceiling_vs_a100_baseline": {
+            "explanation": (
+                "acts/s/chip is INVARIANT under sweep fan-out (splitting "
+                "members across chips divides both work and throughput "
+                "equally), so the >=3x/chip target reduces to single-chip "
+                "MFU x peak. Values = vs_baseline ceiling at given MFU."
+            ),
+            "ceilings": ceilings,
+        },
+    }
+
+    # the load-bearing claims, asserted from the measurements:
+    fanout = next(c for c in cases if c["name"] == "config2_sweep_fanout")
+    assert fanout["collectives"]["wire_bytes_per_chip_per_step"] == 0, (
+        "sweep fan-out must be collective-free; HLO says otherwise: "
+        + json.dumps(fanout["collectives"]["summary"])
+    )
+    dictpar = next(c for c in cases if c["name"] == "config5_dictpar")
+    assert dictpar["collectives"]["wire_bytes_per_chip_per_step"] > 0
+
+    # comm-amortization crossover: gradient wire bytes are batch-invariant,
+    # compute scales with batch, so batch*/shard where comm = 10% of compute
+    # is (wire/bw) * 10 * mfu * peak / flops_per_row
+    def crossover_batch(case, hw):
+        wire = case["collectives"]["wire_bytes_per_chip_per_step"]
+        rows = case["workload"]["batch_per_step"]
+        flops_per_row_chip = case["matmul_flops_per_step_per_chip"] / rows
+        bw = hw["links_per_axis"] * hw["ici_link_oneway_bytes_per_sec"]
+        t_ici = wire / bw
+        return int(
+            t_ici * 10 * MEASURED_SINGLE_CHIP["mfu"] * hw["peak_bf16_flops"]
+            / flops_per_row_chip / N_VIRTUAL_DEVICES
+        ) * N_VIRTUAL_DEVICES
+
+    report["conclusions"] = {
+        "1_sweep_fanout_is_collective_free": (
+            "Measured: the (model=16) program contains ZERO collective ops — "
+            "sweep members are embarrassingly parallel, total throughput "
+            "scales linearly with chips, acts/s/chip is invariant."
+        ),
+        "2_per_chip_target": (
+            "Because fan-out leaves per-chip throughput invariant, the "
+            ">=3x/chip target reduces to single-chip MFU x peak. v4 ceiling: "
+            f"{ceilings[V4['name']]['mfu_1.0']}x at MFU 1.0 "
+            f"({ceilings[V4['name']]['mfu_' + str(MEASURED_SINGLE_CHIP['mfu'])]}x "
+            "at the measured 0.742) — >=3x vs the generous analytic A100 "
+            "baseline is NOT reachable on v4-32; the binding constraint is "
+            "chip peak FLOPs, not communication. On v5p-class chips the "
+            f"ceiling is {ceilings[V5P['name']]['mfu_1.0']}x and >=3x needs "
+            "MFU >= 0.85."
+        ),
+        "3_dp_needs_big_batches": {
+            "statement": (
+                "Gradient all-reduce wire bytes are batch-invariant, so the "
+                "comm fraction falls as 1/batch. Measured wire + v4 ICI give "
+                "these per-step batch sizes for <=10% comm overhead "
+                "(no overlap assumed):"
+            ),
+            "batch_rows_for_10pct_comm": {
+                c["name"]: crossover_batch(c, V4)
+                for c in cases
+                if c["collectives"]["wire_bytes_per_chip_per_step"] > 0
+            },
+        },
+        "4_tied_grad_double_allreduce": (
+            "The compiled SPMD programs all-reduce TWO encoder-grad-sized "
+            "partials (hybrid case: 2x16.8 MB where the summed grad is "
+            "16.8 MB) — the encode-path and decode-path cotangents of the "
+            "tied weights are reduced separately instead of being added "
+            "before the collective. psum(a)+psum(b)==psum(a+b), so this is "
+            "a compiler scheduling artifact worth re-checking on real pod "
+            "hardware: fixing it halves gradient wire traffic. Projections "
+            "use the measured (worse) number."
+        ),
+        "5_caveats": (
+            "HLO measured on the CPU SPMD partitioner (the TPU partitioner "
+            "may schedule differently); ICI link constants assumed from "
+            "public figures with a +-2x sensitivity band in `projections`; "
+            "MFU transfer from the measured v5e 0.742 assumed."
+        ),
+    }
+
+    out = REPO / f"SCALEOUT_{ROUND_TAG}.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {out}")
+    for c in cases:
+        s = c["collectives"]
+        print(
+            f"  {c['name']}: mesh {c['mesh']} -> "
+            f"{s['wire_bytes_per_chip_per_step'] / 1e6:.2f} MB/chip/step wire, "
+            f"ops={ {k: v['count'] for k, v in s['summary'].items()} }"
+        )
+    return report
+
+
+if __name__ == "__main__":
+    main()

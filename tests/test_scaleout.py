@@ -1,0 +1,69 @@
+"""HLO collective-traffic accounting used by scripts/scaleout_model.py.
+
+The projection artifact's load-bearing numbers come from parsing collective
+ops out of optimized SPMD HLO; these tests pin the parser on representative
+HLO lines (shapes, tuple outputs, replica-group forms) and the ring-model
+wire math. The full script (compiles 5 sharded programs on a 16-device
+virtual mesh) runs as the SCALEOUT artifact, not in the suite.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+from scaleout_model import _group_size, _shape_bytes, collective_traffic
+
+
+def test_shape_bytes():
+    assert _shape_bytes("f32[8,512,4096]{2,1,0}") == 8 * 512 * 4096 * 4
+    assert _shape_bytes("bf16[2048,1024]") == 2048 * 1024 * 2
+    # tuple outputs sum their elements
+    assert _shape_bytes("(f32[8], f32[8,16])") == 8 * 4 + 8 * 16 * 4
+    assert _shape_bytes("pred[]") == 1  # 0-d scalar: one element
+
+
+def test_group_size_forms():
+    assert _group_size("all-reduce(...), replica_groups={{0,1},{2,3}}", 16) == 2
+    assert _group_size("all-reduce(...), replica_groups=[4,4]<=[16]", 16) == 4
+    assert _group_size("all-reduce(...)", 16) == 16  # default: all devices
+
+
+def test_collective_traffic_ring_models():
+    hlo = """
+HloModule jit_step
+%ar = f32[2,4096,512]{2,1,0} all-reduce(f32[2,4096,512] %g), replica_groups={{0,1}}, to_apply=%add
+%ag = f32[16,1024]{1,0} all-gather(f32[1,1024] %x), replica_groups=[1,16]<=[16], dimensions={0}
+%cp = bf16[128]{0} collective-permute(bf16[128] %y), source_target_pairs={{0,1}}
+"""
+    t = collective_traffic(hlo, 16)
+    by_op = {o["op"]: o for o in t["ops"]}
+    ar_bytes = 2 * 4096 * 512 * 4
+    # all-reduce over group 2: 2*(g-1)/g*b == b
+    assert by_op["all-reduce"]["wire_bytes_per_chip"] == ar_bytes
+    # all-gather: (g-1)/g of the gathered output
+    ag_bytes = 16 * 1024 * 4
+    assert by_op["all-gather"]["wire_bytes_per_chip"] == round(15 / 16 * ag_bytes)
+    # permute: one hop
+    assert by_op["collective-permute"]["wire_bytes_per_chip"] == 128 * 2
+    assert t["wire_bytes_per_chip_per_step"] == sum(
+        o["wire_bytes_per_chip"] for o in t["ops"]
+    )
+
+
+def test_async_collectives_counted_once():
+    """TPU HLO emits async -start/-done pairs; traffic must count once."""
+    hlo = """
+%s0 = f32[1024]{0} all-reduce-start(f32[1024] %g), replica_groups={{0,1}}, to_apply=%add
+%d0 = f32[1024]{0} all-reduce-done(f32[1024] %s0)
+"""
+    t = collective_traffic(hlo, 2)
+    assert len(t["ops"]) == 1
+    assert t["ops"][0]["op"] == "all-reduce"
+    assert t["wire_bytes_per_chip_per_step"] == 1024 * 4  # 2*(1/2)*b
+
+
+def test_non_collective_lines_ignored():
+    hlo = "%d = f32[4096,512] dot(f32[4096,2048] %a, f32[2048,512] %b)"
+    t = collective_traffic(hlo, 8)
+    assert t["ops"] == [] and t["wire_bytes_per_chip_per_step"] == 0
