@@ -142,6 +142,37 @@ def corpus_tokens(lang, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chun
     return lang.sample(n_rows, seq_len, seed=seed)
 
 
+def mmcs_random_floor(n_feats: int, d_act: int, n_pairs: int = 3, seed: int = 1234) -> dict:
+    """Cross-seed MMCS of pairs of RANDOM unit-row dictionaries at the given
+    shape — the null value a trained dictionary's cross-seed MMCS must clear
+    before any feature-consistency claim (VERDICT r3 next #6: r3's top-k
+    MMCS sat flat at 0.140 and nobody compared it to this floor).
+
+    E[max_j cos(u, v_j)] over N random directions in R^d concentrates around
+    sqrt(2 ln(N) / d); the empirical values are reported alongside it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu.metrics import standard as sm
+
+    vals = []
+    for i in range(n_pairs):
+        ka, kb = jax.random.split(jax.random.PRNGKey(seed + i))
+        a = jax.random.normal(ka, (n_feats, d_act))
+        b = jax.random.normal(kb, (n_feats, d_act))
+        a = a / jnp.linalg.norm(a, axis=1, keepdims=True)
+        b = b / jnp.linalg.norm(b, axis=1, keepdims=True)
+        vals.append(round(float(sm.mmcs(a, b)), 4))
+    return {
+        "n_feats": n_feats,
+        "d_act": d_act,
+        "empirical_pairs": vals,
+        "mean": round(float(np.mean(vals)), 4),
+        "analytic_sqrt_2lnN_over_d": round(float(np.sqrt(2 * np.log(n_feats) / d_act)), 4),
+    }
+
+
 def run_basic(args):
     """BASELINE config 1: Pythia-70M-geometry residual layer-2, SINGLE dict /
     single l1, trained through the `train.basic_l1_sweep` driver itself (the
@@ -328,8 +359,7 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from sparse_coding__tpu import build_ensemble, metrics as sm
-    from sparse_coding__tpu.data.activations import make_activation_dataset
-    from sparse_coding__tpu.data.chunks import ChunkStore
+    from sparse_coding__tpu.data.activations import harvest_to_device
     from sparse_coding__tpu.models import (
         FunctionalFista,
         FunctionalTiedSAE,
@@ -344,18 +374,25 @@ def main(argv=None):
     fista = args.config == "fista"
     seq_len = 32 if quick else args.seq_len
     batch_rows = 16 if quick else 64
-    chunk_gb = 0.002 if quick else 0.0625
     sae_batch = 256 if quick else 2048
     seeds = (0, 1)
+    # convergence-scale protocol (VERDICT r3 next #1): each ensemble trains
+    # until its held-out mean FVU improves <plateau_tol for 2 consecutive
+    # epochs (or max_epochs); the whole FVU trajectory lands in the artifact.
+    plateau_tol = 0.003
+    eval_rows = 2048 if quick else 16384
     if topk:
         # GPT-2-small residual, 16x dict, k-sweep (one mid layer stands in
         # for the reference's layers 0-11 loop)
         layer, layer_loc = (1, "residual") if quick else (5, "residual")
-        n_chunks = 2 if quick else 3  # last chunk held out for eval
+        # r3 trained on 2x0.0625 GB; r4: 6x0.5 GB resident (~2.1M rows) with
+        # plateau epochs on top — 2 orders of magnitude more rows consumed
+        chunk_gb = 0.002 if quick else 0.5
+        n_chunks = 2 if quick else 6  # last chunk held out for eval
         # the reference's sparsity_levels span 1..151 (`:234`); a denser k
         # than ~150 needs far more training than a parity run's budget
         grid = [2, 8] if quick else [1, 11, 31, 61, 91, 121, 151]
-        ratio, n_epochs = (2, 1) if quick else (16, 3)
+        ratio, max_epochs = (2, 1) if quick else (16, 12)
         hp_name, arch = "sparsity", "gpt2"
         cap = int(max(grid))
         recall_kw = {} if args.topk_recall is None else {"recall": args.topk_recall}
@@ -364,23 +401,27 @@ def main(argv=None):
         subject = "gpt2-small geometry, random init"
     else:
         layer, layer_loc = (1, "residual") if quick else (2, "residual")
-        n_chunks = 3 if quick else 5
+        chunk_gb = 0.002 if quick else 0.5
+        n_chunks = 3 if quick else 12  # r3: 5x0.0625 GB; r4: ~6.3M rows resident
         grid = [1e-4, 1e-3] if quick else list(np.logspace(-4, -2, 8))
-        ratio, n_epochs = (2, 1) if quick else (4, 3)
+        ratio, max_epochs = (2, 1) if quick else (4, 30)
         hp_name, arch = "l1_alpha", "neox"
         mk_hp = lambda v: {"l1_alpha": float(v)}
         hp_key = lambda v: f"{v:.2e}"
         subject = "pythia-70m geometry, random init"
         if fista:
             # the per-step 500-iteration decoder update bounds the budget:
-            # fewer grid points, one epoch, fewer chunks
+            # fewer grid points, one epoch, fewer chunks (unchanged from r3 —
+            # VERDICT's convergence demand names configs 2/4/5)
+            chunk_gb = 0.002 if quick else 0.0625
             n_chunks = 2 if quick else 3
             grid = [1e-4, 1e-3] if quick else [1e-4, 3e-4, 1e-3, 3e-3]
-            n_epochs = 1
+            max_epochs = 1
 
-    pretrain_steps = args.pretrain if args.pretrain >= 0 else (
-        0 if (quick or topk or fista) else 2000
-    )
+    # r3 ran ALL full parity artifacts on trigram-pretrained subjects (the
+    # flag was explicit then; ROUND3.md header) — r4 makes that the default
+    # so topk/fista no longer silently fall back to random-init subjects
+    pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
     print(f"Building subject model ({subject})...")
     lm_cfg, params = build_subject_model(quick, arch)
     d_act = lm_cfg.d_model
@@ -407,242 +448,305 @@ def main(argv=None):
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
             "dict_ratio": ratio, "n_dict": n_dict,
             f"{hp_name}_grid": [mk_hp(a)[hp_name] for a in grid],
-            "sae_batch": sae_batch, "n_epochs": n_epochs, "seeds": list(seeds),
+            "sae_batch": sae_batch, "max_epochs": max_epochs,
+            "plateau_tol": plateau_tol, "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         }
     }
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
 
-    with tempfile.TemporaryDirectory(prefix="parity_") as tmp:
-        print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens)...")
-        t0 = time.time()
-        folders = make_activation_dataset(
-            params, lm_cfg, tokens, f"{tmp}/acts", [layer], [layer_loc],
-            batch_size=batch_rows, chunk_size_gb=chunk_gb, n_chunks=n_chunks + 1,
-        )
-        store = ChunkStore(folders[(layer, layer_loc)])
-        harvest_s = time.time() - t0
-        n_train_rows = sum(
-            np.load(store.folder / f"{i}.npy", mmap_mode="r").shape[0]
-            for i in range(n_chunks)
-        )
-        report["harvest"] = {
-            "seconds": round(harvest_s, 1),
-            "tokens_per_sec": round(n_rows * seq_len / harvest_s, 1),
-            "train_rows": int(n_train_rows),
-        }
-        print(f"  {harvest_s:.0f}s ({report['harvest']['tokens_per_sec']:.0f} tok/s)")
-
-        # chunks stay resident in HBM across epochs: one H2D per chunk total
-        train_chunks = [store.load(i) for i in range(n_chunks)]
-        eval_chunk = store.load(n_chunks)
-
-        if topk:
-            # TopKEncoderApprox: hardware PartialReduce selection (~22x the
-            # round-2 argsort step on v5e); export/eval stays exact top-k
-            families = {"": (TopKEncoderApprox, {"d_activation": d_act, "n_features": n_dict})}
+    # fused harvest -> HBM-resident bf16 chunks (VERDICT r3 next #1: the
+    # convergence-scale path; the disk store is exercised by --config basic
+    # and the bench). One H2D per chunk total, re-used across all epochs.
+    print(f"Harvesting {n_chunks + 1} chunks ({n_rows * seq_len:,} tokens, fused)...")
+    t0 = time.time()
+    # train chunks go to bf16 (halves residency; quick keeps the fp32 CI
+    # numerics); the held-out eval chunk upcasts from the harvest fp16
+    # DIRECTLY to fp32 — never through bf16's 7 mantissa bits
+    train_dtype = jnp.float32 if quick else jnp.bfloat16
+    train_chunks = []
+    eval_chunk = None
+    for i, chunk in enumerate(harvest_to_device(
+        params, lm_cfg, tokens, [layer], [layer_loc],
+        batch_size=batch_rows, chunk_size_gb=chunk_gb, n_chunks=n_chunks + 1,
+    )):
+        arr = chunk[(layer, layer_loc)]
+        if i < n_chunks:
+            train_chunks.append(arr.astype(train_dtype))
         else:
-            size_kw = {"activation_size": d_act, "n_dict_components": n_dict}
-            families = (
-                {"fista": (FunctionalFista, size_kw), "tied": (FunctionalTiedSAE, size_kw)}
-                if fista
-                else {"": (FunctionalTiedSAE, size_kw)}
-            )
-        tag = lambda fam, seed: f"{fam}_{seed}" if fam else str(seed)
-        fista_iters = 20 if quick else 500
-        ensembles = {}
-        t0 = time.time()
-        for fam, (sig, size_kw) in families.items():
-            for seed in seeds:
-                ens = build_ensemble(
-                    sig, jax.random.PRNGKey(seed),
-                    [mk_hp(v) for v in grid],
-                    optimizer_kwargs={"learning_rate": 1e-3},
-                    compute_dtype=None if quick else jnp.bfloat16,
-                    **size_kw,
-                )
-                losses_first = losses_last = None
-                key = jax.random.PRNGKey(100 + seed)
-                for epoch in range(n_epochs):
-                    for chunk in train_chunks:
-                        key, k = jax.random.split(key)
-                        losses = ensemble_train_loop(
-                            ens, chunk, batch_size=sae_batch, key=k,
-                            fista_iters=fista_iters,
-                        )
-                        if losses_first is None:
-                            losses_first = np.asarray(jax.device_get(losses["loss"]))
-                        losses_last = np.asarray(jax.device_get(losses["loss"]))
-                ensembles[(fam, seed)] = ens
-                report[f"train_{tag(fam, seed)}"] = {
-                    "loss_first_chunk": [float(x) for x in losses_first],
-                    "loss_last_chunk": [float(x) for x in losses_last],
-                }
-        report["train_seconds"] = round(time.time() - t0, 1)
-        print(f"Trained {len(ensembles)} ensembles in {report['train_seconds']}s")
+            eval_chunk = arr[:eval_rows].astype(jnp.float32)
+        del arr
+    jax.device_get(eval_chunk[0, 0])  # fence for honest timing
+    harvest_s = time.time() - t0
+    n_train_rows = sum(int(c.shape[0]) for c in train_chunks)
+    report["harvest"] = {
+        "seconds": round(harvest_s, 1),
+        "tokens_per_sec": round(n_rows * seq_len / harvest_s, 1),
+        "train_rows": int(n_train_rows),
+        "path": "harvest_to_device (HBM-resident, no host round trip)",
+    }
+    print(f"  {harvest_s:.0f}s ({report['harvest']['tokens_per_sec']:.0f} tok/s)")
 
-        # steady-state throughput: the wall time above is dominated by one-off
-        # XLA compilation on this backend (remote compile, no stable persistent
-        # cache); re-running an epoch on compiled programs measures training.
-        # A FRESH probe ensemble (same config -> shared jitted steps, no new
-        # compile) keeps the evaluated seeds' training budgets untouched. The
-        # probe uses the run's PRIMARY family — for --config fista that is
-        # FunctionalFista (whose per-step FISTA decoder update dominates), not
-        # whatever family the loop iterated last.
-        probe_family, (probe_sig, probe_kw) = next(iter(families.items()))
-        probe = build_ensemble(
-            probe_sig, jax.random.PRNGKey(9999),
-            [mk_hp(v) for v in grid],
-            optimizer_kwargs={"learning_rate": 1e-3},
-            compute_dtype=None if quick else jnp.bfloat16,
-            **probe_kw,
+    if topk:
+        # TopKEncoderApprox: hardware PartialReduce selection (~22x the
+        # round-2 argsort step on v5e); export/eval stays exact top-k
+        families = {"": (TopKEncoderApprox, {"d_activation": d_act, "n_features": n_dict})}
+    else:
+        size_kw = {"activation_size": d_act, "n_dict_components": n_dict}
+        families = (
+            {"fista": (FunctionalFista, size_kw), "tied": (FunctionalTiedSAE, size_kw)}
+            if fista
+            else {"": (FunctionalTiedSAE, size_kw)}
         )
-        key, k = jax.random.split(key)
-        jax.device_get(ensemble_train_loop(  # warm: any residual compiles
-            probe, train_chunks[0], batch_size=sae_batch, key=k,
-            fista_iters=fista_iters)["loss"])
-        t1 = time.time()
-        key, k = jax.random.split(key)
-        jax.device_get(ensemble_train_loop(
-            probe, train_chunks[0], batch_size=sae_batch, key=k,
-            fista_iters=fista_iters)["loss"])
-        steady_s = time.time() - t1
-        steps = train_chunks[0].shape[0] // sae_batch
-        report["steady_state"] = {
-            "seconds_per_chunk_epoch": round(steady_s, 2),
-            "ms_per_step": round(steady_s / max(1, steps) * 1e3, 1),
-            "rows_per_sec": round(steps * sae_batch / steady_s, 1),
-            "n_members": len(grid),
-            "family": probe_family or "default",
-        }
-        print(f"  steady-state: {report['steady_state']['ms_per_step']} ms/step")
-
-        # -- evaluation on the held-out chunk ---------------------------------
-        t0 = time.time()
-        pareto = {}
-        for (fam, seed), ens in ensembles.items():
-            dicts = ens.to_learned_dicts()
-            rows = sm.evaluate_dicts(dicts, eval_chunk)  # vmapped P4 fan-out
-            dead = [
-                int(ld.n_feats) - sm.batched_calc_feature_n_ever_active(
-                    ld, eval_chunk, threshold=10
+    tag = lambda fam, seed: f"{fam}_{seed}" if fam else str(seed)
+    fista_iters = 20 if quick else 500
+    ensembles = {}
+    total_rows_consumed = 0
+    total_train_wall = 0.0
+    t0 = time.time()
+    for fam, (sig, size_kw) in families.items():
+        for seed in seeds:
+            ens = build_ensemble(
+                sig, jax.random.PRNGKey(seed),
+                [mk_hp(v) for v in grid],
+                optimizer_kwargs={"learning_rate": 1e-3},
+                compute_dtype=None if quick else jnp.bfloat16,
+                **size_kw,
+            )
+            losses_first = losses_last = None
+            key = jax.random.PRNGKey(100 + seed)
+            traj = []
+            prev = None
+            stall = 0
+            consumed = 0
+            t_train = 0.0
+            for epoch in range(max_epochs):
+                te = time.time()
+                for chunk in train_chunks:
+                    key, k = jax.random.split(key)
+                    losses = ensemble_train_loop(
+                        ens, chunk, batch_size=sae_batch, key=k,
+                        fista_iters=fista_iters,
+                    )
+                    if losses_first is None:
+                        losses_first = np.asarray(jax.device_get(losses["loss"]))
+                losses_last = np.asarray(jax.device_get(losses["loss"]))  # fence
+                t_train += time.time() - te
+                consumed += n_train_rows
+                # held-out FVU probe: the plateau criterion and the recorded
+                # trajectory (VERDICT r3 next #1a); one vmapped eval dispatch
+                # for the whole stack (P4 fan-out), not a per-member loop
+                fvus = [
+                    float(r["fvu"])
+                    for r in sm.evaluate_dicts(ens.to_learned_dicts(), eval_chunk)
+                ]
+                cur = float(np.mean(fvus))
+                traj.append(
+                    {"epoch": epoch, "mean_fvu": round(cur, 5),
+                     "fvu": [round(f, 5) for f in fvus]}
                 )
-                for ld in dicts
-            ]
-            pareto[tag(fam, seed)] = [
+                if prev is not None and (prev - cur) < plateau_tol * max(prev, 1e-9):
+                    stall += 1
+                else:
+                    stall = 0
+                prev = cur
+                if stall >= 2:
+                    break
+            ensembles[(fam, seed)] = ens
+            total_rows_consumed += consumed
+            total_train_wall += t_train
+            report[f"train_{tag(fam, seed)}"] = {
+                "loss_first_chunk": [float(x) for x in losses_first],
+                "loss_last_chunk": [float(x) for x in losses_last],
+                "epochs_run": len(traj),
+                "plateau_reached": bool(stall >= 2),
+                "rows_consumed": int(consumed),
+                "train_seconds": round(t_train, 1),
+                # includes the first epoch's compile: the honest whole-run
+                # number; `steady_state` below isolates the compiled rate
+                "sustained_rows_per_sec": (
+                    round(consumed / t_train, 1) if t_train > 0 else None
+                ),
+                "fvu_trajectory": traj,
+            }
+            print(
+                f"  {tag(fam, seed)}: {len(traj)} epochs, "
+                f"{consumed:,} rows, mean FVU "
+                f"{traj[0]['mean_fvu']:.4f} -> {traj[-1]['mean_fvu']:.4f}"
+                f"{' (plateau)' if stall >= 2 else ''}"
+            )
+    report["train_seconds"] = round(time.time() - t0, 1)
+    report["sustained_acts_per_sec_all_ensembles"] = (
+        round(total_rows_consumed / total_train_wall, 1) if total_train_wall else None
+    )
+    report["rows_consumed_total"] = int(total_rows_consumed)
+    print(f"Trained {len(ensembles)} ensembles in {report['train_seconds']}s "
+          f"({total_rows_consumed:,} rows consumed)")
+
+    # steady-state throughput: the wall time above is dominated by one-off
+    # XLA compilation on this backend (remote compile, no stable persistent
+    # cache); re-running an epoch on compiled programs measures training.
+    # A FRESH probe ensemble (same config -> shared jitted steps, no new
+    # compile) keeps the evaluated seeds' training budgets untouched. The
+    # probe uses the run's PRIMARY family — for --config fista that is
+    # FunctionalFista (whose per-step FISTA decoder update dominates), not
+    # whatever family the loop iterated last.
+    probe_family, (probe_sig, probe_kw) = next(iter(families.items()))
+    probe = build_ensemble(
+        probe_sig, jax.random.PRNGKey(9999),
+        [mk_hp(v) for v in grid],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        compute_dtype=None if quick else jnp.bfloat16,
+        **probe_kw,
+    )
+    key, k = jax.random.split(key)
+    jax.device_get(ensemble_train_loop(  # warm: any residual compiles
+        probe, train_chunks[0], batch_size=sae_batch, key=k,
+        fista_iters=fista_iters)["loss"])
+    t1 = time.time()
+    key, k = jax.random.split(key)
+    jax.device_get(ensemble_train_loop(
+        probe, train_chunks[0], batch_size=sae_batch, key=k,
+        fista_iters=fista_iters)["loss"])
+    steady_s = time.time() - t1
+    steps = train_chunks[0].shape[0] // sae_batch
+    report["steady_state"] = {
+        "seconds_per_chunk_epoch": round(steady_s, 2),
+        "ms_per_step": round(steady_s / max(1, steps) * 1e3, 1),
+        "rows_per_sec": round(steps * sae_batch / steady_s, 1),
+        "n_members": len(grid),
+        "family": probe_family or "default",
+    }
+    print(f"  steady-state: {report['steady_state']['ms_per_step']} ms/step")
+
+    # -- evaluation on the held-out chunk ---------------------------------
+    t0 = time.time()
+    pareto = {}
+    for (fam, seed), ens in ensembles.items():
+        dicts = ens.to_learned_dicts()
+        rows = sm.evaluate_dicts(dicts, eval_chunk)  # vmapped P4 fan-out
+        dead = [
+            int(ld.n_feats) - sm.batched_calc_feature_n_ever_active(
+                ld, eval_chunk, threshold=10
+            )
+            for ld in dicts
+        ]
+        pareto[tag(fam, seed)] = [
+            {
+                hp_name: mk_hp(a)[hp_name], "fvu": row["fvu"], "l0": row["l0"],
+                "r2": row["r2"], "n_dead": int(d), "n_feats": int(ld.n_feats),
+            }
+            for a, row, d, ld in zip(grid, rows, dead, dicts)
+        ]
+    report["pareto"] = pareto
+
+    # cross-seed MMCS at each grid point: the paper's consistency check
+    # (computed on the first family — labeled so the artifact is explicit)
+    fam0 = next(iter(families))
+    dicts0 = ensembles[(fam0, seeds[0])].to_learned_dicts()
+    dicts1 = ensembles[(fam0, seeds[1])].to_learned_dicts()
+    report["mmcs_cross_seed"] = {
+        hp_key(a): float(sm.mmcs(d0, d1))
+        for a, d0, d1 in zip(grid, dicts0, dicts1)
+    }
+    report["mmcs_cross_seed_family"] = fam0 or report["config"]["model"]
+    # the null every trained value must clear (VERDICT r3 next #6)
+    report["mmcs_random_floor"] = mmcs_random_floor(n_dict, d_act)
+
+    if fista:
+        # BASELINE config 3: FVU at MATCHED L0 — the tied pareto is
+        # piecewise-linearly interpolated at each FISTA dict's L0 (nearest
+        # grid points can sit at very different sparsities, which would
+        # make the delta an artifact of the mismatch)
+        f_pts = pareto[tag("fista", seeds[0])]
+        t_pts = sorted(pareto[tag("tied", seeds[0])], key=lambda t: t["l0"])
+        t_l0s = [t["l0"] for t in t_pts]
+        t_fvus = [t["fvu"] for t in t_pts]
+        report["matched_l0"] = []
+        for fp in f_pts:
+            tied_fvu = float(np.interp(fp["l0"], t_l0s, t_fvus))
+            report["matched_l0"].append(
                 {
-                    hp_name: mk_hp(a)[hp_name], "fvu": row["fvu"], "l0": row["l0"],
-                    "r2": row["r2"], "n_dead": int(d), "n_feats": int(ld.n_feats),
+                    "fista_l0": fp["l0"], "fista_fvu": fp["fvu"],
+                    "tied_fvu_interp_at_l0": tied_fvu,
+                    "extrapolated": bool(
+                        fp["l0"] < t_l0s[0] or fp["l0"] > t_l0s[-1]
+                    ),
+                    "fvu_delta_fista_minus_tied": fp["fvu"] - tied_fvu,
                 }
-                for a, row, d, ld in zip(grid, rows, dead, dicts)
-            ]
-        report["pareto"] = pareto
-
-        # cross-seed MMCS at each grid point: the paper's consistency check
-        # (computed on the first family — labeled so the artifact is explicit)
-        fam0 = next(iter(families))
-        dicts0 = ensembles[(fam0, seeds[0])].to_learned_dicts()
-        dicts1 = ensembles[(fam0, seeds[1])].to_learned_dicts()
-        report["mmcs_cross_seed"] = {
-            hp_key(a): float(sm.mmcs(d0, d1))
-            for a, d0, d1 in zip(grid, dicts0, dicts1)
-        }
-        report["mmcs_cross_seed_family"] = fam0 or report["config"]["model"]
-
-        if fista:
-            # BASELINE config 3: FVU at MATCHED L0 — the tied pareto is
-            # piecewise-linearly interpolated at each FISTA dict's L0 (nearest
-            # grid points can sit at very different sparsities, which would
-            # make the delta an artifact of the mismatch)
-            f_pts = pareto[tag("fista", seeds[0])]
-            t_pts = sorted(pareto[tag("tied", seeds[0])], key=lambda t: t["l0"])
-            t_l0s = [t["l0"] for t in t_pts]
-            t_fvus = [t["fvu"] for t in t_pts]
-            report["matched_l0"] = []
-            for fp in f_pts:
-                tied_fvu = float(np.interp(fp["l0"], t_l0s, t_fvus))
-                report["matched_l0"].append(
-                    {
-                        "fista_l0": fp["l0"], "fista_fvu": fp["fvu"],
-                        "tied_fvu_interp_at_l0": tied_fvu,
-                        "extrapolated": bool(
-                            fp["l0"] < t_l0s[0] or fp["l0"] > t_l0s[-1]
-                        ),
-                        "fvu_delta_fista_minus_tied": fp["fvu"] - tied_fvu,
-                    }
-                )
-
-        # perplexity under reconstruction: low/mid/high grid point PER FAMILY
-        # (family-labeled rows) + one identity control
-        eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
-        picks = sorted({0, len(grid) // 2, len(grid) - 1})
-        ppl_dicts = []
-        for fam in families:
-            fam_dicts = ensembles[(fam, seeds[0])].to_learned_dicts()
-            ppl_dicts.extend(
-                (fam_dicts[i], {**mk_hp(grid[i]), **({"family": fam} if fam else {})})
-                for i in picks
             )
-        ppl_dicts.append((Identity(d_act), {"baseline": "identity"}))
-        base_loss, ppl = sm.calculate_perplexity(
-            params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
-            batch_size=4 if quick else 8,
+
+    # perplexity under reconstruction: low/mid/high grid point PER FAMILY
+    # (family-labeled rows) + one identity control
+    eval_tokens = jnp.asarray(tokens[: (4 if quick else 16)])
+    picks = sorted({0, len(grid) // 2, len(grid) - 1})
+    ppl_dicts = []
+    for fam in families:
+        fam_dicts = ensembles[(fam, seeds[0])].to_learned_dicts()
+        ppl_dicts.extend(
+            (fam_dicts[i], {**mk_hp(grid[i]), **({"family": fam} if fam else {})})
+            for i in picks
         )
-        report["perplexity"] = {
-            "base_lm_loss": float(base_loss),
-            "under_reconstruction": [
-                {**hp, "lm_loss": float(loss)} for hp, loss in ppl
-            ],
-        }
-        report["eval_seconds"] = round(time.time() - t0, 1)
-        report["total_seconds"] = round(time.time() - t_start, 1)
+    ppl_dicts.append((Identity(d_act), {"baseline": "identity"}))
+    base_loss, ppl = sm.calculate_perplexity(
+        params, lm_cfg, ppl_dicts, (layer, layer_loc), eval_tokens,
+        batch_size=4 if quick else 8,
+    )
+    report["perplexity"] = {
+        "base_lm_loss": float(base_loss),
+        "under_reconstruction": [
+            {**hp, "lm_loss": float(loss)} for hp, loss in ppl
+        ],
+    }
+    report["eval_seconds"] = round(time.time() - t0, 1)
+    report["total_seconds"] = round(time.time() - t_start, 1)
 
-        # sanity: the pareto must slope the right way, identity must be ~base
-        fvus = [p["fvu"] for p in pareto[tag(fam0, seeds[0])]]
-        l0s = [p["l0"] for p in pareto[tag(fam0, seeds[0])]]
-        if topk:
-            # ascending k ⇒ denser codes, better reconstruction
-            assert fvus[-1] < fvus[0] and l0s[-1] > l0s[0], "pareto slope wrong"
-        else:
-            # ascending l1 ⇒ sparser codes, worse reconstruction
-            assert fvus[-1] > fvus[0] and l0s[-1] < l0s[0], "pareto slope wrong"
-        ident_loss = report["perplexity"]["under_reconstruction"][-1]["lm_loss"]
-        assert abs(ident_loss - base_loss) < 1e-3, "identity hook changed the LM"
+    # sanity: the pareto must slope the right way, identity must be ~base
+    fvus = [p["fvu"] for p in pareto[tag(fam0, seeds[0])]]
+    l0s = [p["l0"] for p in pareto[tag(fam0, seeds[0])]]
+    if topk:
+        # ascending k ⇒ denser codes, better reconstruction
+        assert fvus[-1] < fvus[0] and l0s[-1] > l0s[0], "pareto slope wrong"
+    else:
+        # ascending l1 ⇒ sparser codes, worse reconstruction
+        assert fvus[-1] > fvus[0] and l0s[-1] < l0s[0], "pareto slope wrong"
+    ident_loss = report["perplexity"]["under_reconstruction"][-1]["lm_loss"]
+    assert abs(ident_loss - base_loss) < 1e-3, "identity hook changed the LM"
 
-        out_prefix = Path(args.out) if args.out else REPO
-        out_prefix.mkdir(parents=True, exist_ok=True)
-        suffix = (
-            ("_topk" if topk else "") + ("_fista" if fista else "")
-            + ("_quick" if quick else "")
-        )
-        json_path = out_prefix / f"PARITY_{ROUND_TAG}{suffix}.json"
-        with open(json_path, "w") as f:
-            json.dump(report, f, indent=1)
-        print(f"Wrote {json_path}")
+    out_prefix = Path(args.out) if args.out else REPO
+    out_prefix.mkdir(parents=True, exist_ok=True)
+    suffix = (
+        ("_topk" if topk else "") + ("_fista" if fista else "")
+        + ("_quick" if quick else "")
+    )
+    json_path = out_prefix / f"PARITY_{ROUND_TAG}{suffix}.json"
+    with open(json_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {json_path}")
 
-        import matplotlib
+    import matplotlib
 
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
 
-        model_label = "TopK" if topk else "tied SAE"
-        fig, ax = plt.subplots(figsize=(7, 5))
-        for key, pts in pareto.items():
-            xs = [p["l0"] for p in pts]
-            ys = [p["fvu"] for p in pts]
-            label = key if fista else f"{model_label} r{ratio} seed {key}"
-            ax.plot(xs, ys, "o-", label=label)
-        ax.set_xlabel("mean L0 (active features/example)")
-        ax.set_ylabel("FVU")
-        ax.set_title(
-            f"FVU vs L0, {hp_name} sweep — layer {layer} {layer_loc}, "
-            f"{report['config']['subject']}"
-        )
-        ax.legend()
-        fig_path = out_prefix / f"parity_pareto_{ROUND_TAG}{suffix}.png"
-        fig.savefig(fig_path, dpi=150, bbox_inches="tight")
-        print(f"Wrote {fig_path}")
+    model_label = "TopK" if topk else "tied SAE"
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for key, pts in pareto.items():
+        xs = [p["l0"] for p in pts]
+        ys = [p["fvu"] for p in pts]
+        label = key if fista else f"{model_label} r{ratio} seed {key}"
+        ax.plot(xs, ys, "o-", label=label)
+    ax.set_xlabel("mean L0 (active features/example)")
+    ax.set_ylabel("FVU")
+    ax.set_title(
+        f"FVU vs L0, {hp_name} sweep — layer {layer} {layer_loc}, "
+        f"{report['config']['subject']}"
+    )
+    ax.legend()
+    fig_path = out_prefix / f"parity_pareto_{ROUND_TAG}{suffix}.png"
+    fig.savefig(fig_path, dpi=150, bbox_inches="tight")
+    print(f"Wrote {fig_path}")
 
     return report
 

@@ -275,8 +275,9 @@ def make_activation_dataset(
     (reference `:351-358`); `center_dataset` subtracts the first chunk's mean
     from all chunks (reference `:308-311, 379-381`); `mesh` switches the
     forward to sequence parallelism (`seq_attn`: "ring" | "ulysses",
-    `lm.ring_attention`); `store_dtype=np.int8` writes quantized chunks
-    (half the disk/transfer bytes, on-device dequant — `data.chunks`).
+    `lm.ring_attention`); `store_dtype=np.int8` ("int4") writes quantized
+    chunks at half (a quarter of) the disk/transfer bytes, dequantized
+    on device (`data.chunks`).
     """
     names, stop_at, batches_per_chunk = _harvest_plan(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
@@ -465,7 +466,8 @@ def setup_data(
         skip_chunks=skip_chunks, center_dataset=center_dataset,
         single_folder=single,
         compute_dtype=compute_dtype,
-        store_dtype=np.dtype(store_dtype),
+        # "int4" is a save_chunk format tag, not a numpy dtype
+        store_dtype=store_dtype if str(store_dtype) == "int4" else np.dtype(store_dtype),
     )
     return sum(ChunkStore(f).n_datapoints() for f in folders.values())
 
@@ -487,9 +489,10 @@ def main(argv=None):
     p.add_argument("--skip_chunks", type=int, default=0)
     p.add_argument("--compute_dtype", default=None,
                    help="e.g. bfloat16: run the capture forward MXU-native")
-    p.add_argument("--store_dtype", default="float16", choices=("float16", "int8"),
-                   help="chunk store format; int8 halves disk/transfer bytes "
-                   "(per-row absmax, on-device dequant)")
+    p.add_argument("--store_dtype", default="float16",
+                   choices=("float16", "int8", "int4"),
+                   help="chunk store format; int8 halves / int4 quarters the "
+                   "disk/transfer bytes (per-row absmax, on-device dequant)")
     args = p.parse_args(argv)
     n = setup_data(
         args.model_name, args.dataset_name, args.dataset_folder,

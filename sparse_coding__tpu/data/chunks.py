@@ -49,13 +49,45 @@ def quantize_rows_int8(array: np.ndarray):
     return q, scales
 
 
+def quantize_rows_int4(array: np.ndarray):
+    """Symmetric per-row absmax 4-bit quantization, two values per byte.
+
+    QUARTER the fp16 bytes on disk and over the host→device link (VERDICT r3
+    next #5: the tunneled link moves ~20 MiB/s and int8 still starved the
+    chip ~14x). Levels are -7..7 (scale = absmax/7), stored offset-by-8 in
+    nibbles: byte = ((hi+8)<<4) | (lo+8), so the on-disk dtype is uint8 at
+    width d/2 — which is also how `ChunkStore.load` recognizes the format.
+    Per-element error ≤ absmax/14: coarse, but SAE-training parity holds
+    (tests/test_chunk_quant.py) because the quantization noise is i.i.d.
+    and far below the activation signal the dictionary fits.
+
+    Requires even d (every model width in the zoo is)."""
+    a = np.asarray(array, dtype=np.float32)
+    if a.shape[1] % 2 != 0:
+        raise ValueError(f"int4 packing needs an even feature dim, got {a.shape[1]}")
+    absmax = np.abs(a).max(axis=1)
+    scales = np.where(absmax > 0, absmax / 7.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(a / scales[:, None]), -7, 7).astype(np.int8) + 8
+    packed = ((q[:, 0::2].astype(np.uint8) << 4) | q[:, 1::2].astype(np.uint8))
+    return packed, scales
+
+
 def _dequant_int8_impl(q: jax.Array, scales: jax.Array) -> jax.Array:
     return q.astype(jnp.float16) * scales[:, None].astype(jnp.float16)
 
 
+def _dequant_int4_impl(packed: jax.Array, scales: jax.Array) -> jax.Array:
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    lo = (packed & 0xF).astype(jnp.int8) - 8
+    n, half = packed.shape
+    q = jnp.stack([hi, lo], axis=-1).reshape(n, half * 2)
+    return q.astype(jnp.float16) * scales[:, None].astype(jnp.float16)
+
+
 # On-device dequant to fp16 (the store's logical dtype); jitted so the
-# int8→fp16 widen never exists host-side.
+# widened array never exists host-side.
 _dequant_int8 = jax.jit(_dequant_int8_impl)
+_dequant_int4 = jax.jit(_dequant_int4_impl)
 
 
 def _row_sharding(sharding):
@@ -82,6 +114,11 @@ def _dequant_int8_to(sharding):
     return jax.jit(_dequant_int8_impl, out_shardings=sharding)
 
 
+@functools.lru_cache(maxsize=16)
+def _dequant_int4_to(sharding):
+    return jax.jit(_dequant_int4_impl, out_shardings=sharding)
+
+
 def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
     """Write chunk `i` as `[N, d]` .npy.
 
@@ -89,13 +126,19 @@ def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
     (`activation_dataset.py:393-397`). ``dtype=np.int8``: symmetric per-row
     absmax quantization with an fp32 `{i}.scale.npy` side file — HALF the
     bytes on disk and over the host→device link, dequantized on device by
-    `ChunkStore.load`. Built for slow links (the tunneled bench host moves
-    ~20 MiB/s, VERDICT r2 weak #2); SAE training on int8-roundtripped
-    activations is asserted on-par with fp16 in tests/test_chunk_quant.py."""
+    `ChunkStore.load`. ``dtype="int4"``: nibble-packed 4-bit tier — QUARTER
+    the fp16 bytes (`quantize_rows_int4`). Built for slow links (the
+    tunneled bench host moves ~20 MiB/s, VERDICT r2 weak #2 / r3 next #5);
+    SAE training on quantize-roundtripped activations is asserted on-par
+    with fp16 in tests/test_chunk_quant.py for both tiers."""
     path = chunk_path(folder, i)
     path.parent.mkdir(parents=True, exist_ok=True)
     host = np.asarray(jax.device_get(array))
-    if np.dtype(dtype) == np.int8:
+    if isinstance(dtype, str) and dtype == "int4":
+        packed, scales = quantize_rows_int4(host)
+        np.save(path, packed)
+        np.save(scale_path(folder, i), scales)
+    elif np.dtype(dtype) == np.int8:
         q, scales = quantize_rows_int8(host)
         np.save(path, q)
         np.save(scale_path(folder, i), scales)
@@ -151,7 +194,14 @@ class ChunkStore:
         fp16 for both store formats (the store's logical dtype)."""
         arr = np.load(chunk_path(self.folder, i))
         sp = scale_path(self.folder, i)
-        if arr.dtype == np.int8 and sp.exists():
+        if arr.dtype in (np.int8, np.uint8) and sp.exists():
+            # int8 = signed bytes; uint8 = nibble-packed int4 (save_chunk's
+            # two quantized tiers)
+            int4 = arr.dtype == np.uint8
+            dequant, dequant_to = (
+                (_dequant_int4, _dequant_int4_to) if int4
+                else (_dequant_int8, _dequant_int8_to)
+            )
             scales = np.load(sp)
             q = jnp.asarray(arr)
             s = jnp.asarray(scales)
@@ -160,13 +210,13 @@ class ChunkStore:
                 row_sh = _row_sharding(sharding)
                 if row_sh is not None:
                     s = jax.device_put(s, row_sh)
-                    x = _dequant_int8_to(sharding)(q, s)
+                    x = dequant_to(sharding)(q, s)
                 else:
-                    x = _dequant_int8(q, s)
+                    x = dequant(q, s)
             else:
                 if device is not None:
                     q, s = jax.device_put(q, device), jax.device_put(s, device)
-                x = _dequant_int8(q, s)
+                x = dequant(q, s)
         else:
             x = jnp.asarray(arr)
             if sharding is not None:

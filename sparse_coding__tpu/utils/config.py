@@ -157,8 +157,9 @@ class TrainArgs(BaseArgs):
     center_activations: bool = False
     # bf16 subject forward for the harvest (data.activations._jitted_capture)
     harvest_compute_dtype: Optional[str] = None
-    # chunk store format: "float16" (reference contract) or "int8" (half the
-    # disk/transfer bytes, per-row absmax, on-device dequant — data.chunks)
+    # chunk store format: "float16" (reference contract), "int8" (half the
+    # disk/transfer bytes) or "int4" (a quarter); per-row absmax, on-device
+    # dequant — data.chunks
     harvest_store_dtype: str = "float16"
     # multi-epoch sweeps with HBM-sized datasets: upload chunks once, not
     # once per epoch (train/sweep.py)
@@ -172,9 +173,9 @@ class TrainArgs(BaseArgs):
                 f"harvest_compute_dtype must be one of {sorted(DTYPES)} or None, "
                 f"got {self.harvest_compute_dtype}"
             )
-        if self.harvest_store_dtype not in ("float16", "int8"):
+        if self.harvest_store_dtype not in ("float16", "int8", "int4"):
             raise ValueError(
-                f"harvest_store_dtype must be 'float16' or 'int8', "
+                f"harvest_store_dtype must be 'float16', 'int8' or 'int4', "
                 f"got {self.harvest_store_dtype}"
             )
         # exactly the surface lm.model.make_tensor_name resolves: HOOK_TEMPLATES

@@ -86,10 +86,11 @@ def test_iter_chunks_dequantizes(tmp_path):
     np.testing.assert_allclose(np.asarray(out[1]), a, atol=np.abs(a).max() / 120)
 
 
-def test_training_parity_int8_vs_fp16(tmp_path):
-    """Same data stored both ways; same-init ensembles train to within a few
-    percent of each other — the int8 transport does not change what the
-    sweep learns."""
+def test_training_parity_quantized_vs_fp16(tmp_path):
+    """Same data stored fp16 / int8 / int4; same-init ensembles train to
+    within a few percent of each other — the quantized transports do not
+    change what the sweep learns. int4's tolerance is looser (per-element
+    error absmax/14 vs absmax/254) but must stay within ~10%."""
     gen = RandomDatasetGenerator(
         activation_dim=32, n_ground_truth_components=64, batch_size=4096,
         feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
@@ -98,10 +99,11 @@ def test_training_parity_int8_vs_fp16(tmp_path):
     data = np.asarray(next(gen))
     save_chunk(tmp_path / "fp16", 0, data)
     save_chunk(tmp_path / "int8", 0, data, dtype=np.int8)
+    save_chunk(tmp_path / "int4", 0, data, dtype="int4")
 
     losses, fvus = {}, {}
     eval_batch = jnp.asarray(data[:1024])
-    for fmt in ("fp16", "int8"):
+    for fmt in ("fp16", "int8", "int4"):
         chunk = ChunkStore(tmp_path / fmt).load(0)
         ens = build_ensemble(
             FunctionalTiedSAE,
@@ -118,6 +120,58 @@ def test_training_parity_int8_vs_fp16(tmp_path):
         fvus[fmt] = float(
             fraction_variance_unexplained(ens.to_learned_dicts()[0], eval_batch)
         )
-    assert np.isfinite(losses["int8"])
+    assert np.isfinite(losses["int8"]) and np.isfinite(losses["int4"])
     np.testing.assert_allclose(losses["int8"], losses["fp16"], rtol=0.05)
     np.testing.assert_allclose(fvus["int8"], fvus["fp16"], rtol=0.05, atol=0.02)
+    np.testing.assert_allclose(losses["int4"], losses["fp16"], rtol=0.10)
+    np.testing.assert_allclose(fvus["int4"], fvus["fp16"], rtol=0.10, atol=0.03)
+
+
+def test_int4_roundtrip_and_store(tmp_path):
+    from sparse_coding__tpu.data.chunks import quantize_rows_int4
+
+    a = _data(rows=256, d=64)
+    packed, s = quantize_rows_int4(a)
+    assert packed.dtype == np.uint8 and packed.shape == (256, 32)
+    # unpack on host and check the error bound: <= scale/2 = absmax/14
+    hi = (packed >> 4).astype(np.int8) - 8
+    lo = (packed & 0xF).astype(np.int8) - 8
+    q = np.stack([hi, lo], axis=-1).reshape(256, 64)
+    deq = q.astype(np.float32) * s[:, None]
+    absmax = np.abs(a).max(axis=1, keepdims=True)
+    assert np.abs(deq - a).max() <= (absmax / 14 + 1e-6).max()
+
+    save_chunk(tmp_path, 0, a, dtype="int4")
+    save_chunk(tmp_path, 1, a)  # fp16
+    store = ChunkStore(tmp_path)
+    assert store.n_datapoints() == 512
+    # quarter the fp16 bytes on disk (plus the npy header)
+    assert chunk_path(tmp_path, 0).stat().st_size < 0.3 * chunk_path(tmp_path, 1).stat().st_size
+    x4 = np.asarray(store.load(0))
+    assert x4.shape == a.shape and x4.dtype == np.float32
+    np.testing.assert_allclose(x4, a, atol=float((np.abs(a).max(axis=1) / 13).max()))
+    assert store.load(0, dtype=None).dtype == jnp.float16
+    # zero rows exact; odd feature dims refuse loudly
+    z = np.zeros((4, 8), np.float32)
+    pz, sz = quantize_rows_int4(z)
+    np.testing.assert_array_equal(
+        ((pz >> 4).astype(np.int8) - 8).astype(np.float32) * sz[:, None], z[:, 0::2]
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="even"):
+        quantize_rows_int4(np.zeros((2, 7), np.float32))
+
+
+def test_int4_sharded_load_honors_sharding(tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    a = _data(rows=64 * len(jax.devices()), d=32)
+    save_chunk(tmp_path, 0, a, dtype="int4")
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+    sh = NamedSharding(mesh, P("data", None))
+    x = ChunkStore(tmp_path).load(0, dtype=jnp.float32, sharding=sh)
+    assert x.sharding == sh
+    np.testing.assert_allclose(
+        np.asarray(x), a, atol=float((np.abs(a).max(axis=1) / 13).max())
+    )
