@@ -31,7 +31,7 @@ from pathlib import Path
 import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
-ROUND_TAG = os.environ.get("PARITY_ROUND", "r03")  # artifact round tag
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")  # artifact round tag
 
 
 if str(REPO) not in sys.path:
@@ -518,7 +518,7 @@ def main(argv=None):
             key = jax.random.PRNGKey(100 + seed)
             traj = []
             prev = None
-            stall = 0
+            stall = diverge = 0
             consumed = 0
             t_train = 0.0
             for epoch in range(max_epochs):
@@ -546,12 +546,18 @@ def main(argv=None):
                     {"epoch": epoch, "mean_fvu": round(cur, 5),
                      "fvu": [round(f, 5) for f in fvus]}
                 )
-                if prev is not None and (prev - cur) < plateau_tol * max(prev, 1e-9):
-                    stall += 1
-                else:
-                    stall = 0
+                if prev is not None:
+                    delta = prev - cur  # positive = improvement
+                    if delta < -plateau_tol * prev:
+                        diverge += 1
+                        stall = 0
+                    elif delta < plateau_tol * prev:
+                        stall += 1
+                        diverge = 0
+                    else:
+                        stall = diverge = 0
                 prev = cur
-                if stall >= 2:
+                if stall >= 2 or diverge >= 2:
                     break
             ensembles[(fam, seed)] = ens
             total_rows_consumed += consumed
@@ -561,6 +567,7 @@ def main(argv=None):
                 "loss_last_chunk": [float(x) for x in losses_last],
                 "epochs_run": len(traj),
                 "plateau_reached": bool(stall >= 2),
+                "diverged": bool(diverge >= 2),
                 "rows_consumed": int(consumed),
                 "train_seconds": round(t_train, 1),
                 # includes the first epoch's compile: the honest whole-run
