@@ -203,6 +203,12 @@ def ensemble_train_loop(
     n_batches = n // batch_size
     # host-side permutation; the data itself stays wherever it lives (HBM)
     perm = np.asarray(jax.random.permutation(key, n))
+    # single-shard device-resident datasets gather each batch INSIDE the
+    # compiled scan (one dispatch per k steps, no staged [k, B, d] copy —
+    # measured 6.7 -> ~2.5 ms/step on the r4 parity loop, THROUGHPUT r4b)
+    in_scan_gather = (
+        isinstance(dataset, jax.Array) and getattr(ensemble, "_mesh", None) is None
+    )
 
     loss_dict: Dict[str, jax.Array] = {}
     i = 0
@@ -210,7 +216,10 @@ def ensemble_train_loop(
         k = scan_steps if n_batches - i >= scan_steps else 1
         if k > 1:
             idxs = perm[i * batch_size : (i + k) * batch_size].reshape(k, batch_size)
-            losses = ensemble.step_scan(dataset[idxs])
+            if in_scan_gather:
+                losses = ensemble.step_scan_idx(dataset, idxs)
+            else:
+                losses = ensemble.step_scan(dataset[idxs])
             loss_dict = {name: v[-1] for name, v in losses.items()}
             if logger is not None:
                 for j in range(k):

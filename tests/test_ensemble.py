@@ -173,3 +173,64 @@ def test_state_dict_roundtrip():
     l_a, _ = ens.step_batch(b1)
     l_b, _ = clone.step_batch(b1)
     np.testing.assert_allclose(np.asarray(l_a["loss"]), np.asarray(l_b["loss"]), rtol=1e-6)
+
+
+def test_step_scan_idx_matches_step_scan():
+    """In-scan gathering (`step_scan_idx`) is bit-identical to gathering on
+    the host side and scanning the staged batches (`step_scan`) — it only
+    removes a dispatch, never changes the math."""
+    dataset = jnp.asarray(np.random.default_rng(0).standard_normal((1024, D_ACT), dtype=np.float32))
+    idxs = np.random.default_rng(1).permutation(1024)[: 4 * 128].reshape(4, 128)
+    kw = dict(
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT, n_dict_components=N_DICT,
+    )
+    hp = [{"l1_alpha": 1e-3}, {"l1_alpha": 1e-2}]
+    ens_a = build_ensemble(FunctionalTiedSAE, jax.random.PRNGKey(7), hp, **kw)
+    ens_b = build_ensemble(FunctionalTiedSAE, jax.random.PRNGKey(7), hp, **kw)
+    la = ens_a.step_scan_idx(dataset, idxs)
+    lb = ens_b.step_scan(dataset[jnp.asarray(idxs)])
+    np.testing.assert_array_equal(np.asarray(la["loss"]), np.asarray(lb["loss"]))
+    # states advanced identically: the next shared batch gives equal losses
+    nxt = dataset[:128]
+    np.testing.assert_array_equal(
+        np.asarray(ens_a.step_batch(nxt)[0]["loss"]),
+        np.asarray(ens_b.step_batch(nxt)[0]["loss"]),
+    )
+
+
+def test_step_scan_idx_respects_unstacked():
+    """The idx-scan step honors the `unstacked` escape hatch like every
+    other step variant (it must not silently vmap a loss the user asked to
+    run member-by-member)."""
+    models = [
+        FunctionalTiedSAE.init(jax.random.PRNGKey(i), D_ACT, N_DICT, l1_alpha=1e-3)
+        for i in range(2)
+    ]
+    ens_u = Ensemble(models, FunctionalTiedSAE, unstacked=True,
+                     optimizer_kwargs={"learning_rate": 1e-3})
+    ens_v = Ensemble(models, FunctionalTiedSAE, unstacked=False,
+                     optimizer_kwargs={"learning_rate": 1e-3})
+    dataset = jnp.asarray(
+        np.random.default_rng(2).standard_normal((512, D_ACT), dtype=np.float32)
+    )
+    idxs = np.arange(2 * 128).reshape(2, 128)
+    lu = ens_u.step_scan_idx(dataset, idxs)
+    lv = ens_v.step_scan_idx(dataset, idxs)
+    np.testing.assert_allclose(
+        np.asarray(lu["loss"]), np.asarray(lv["loss"]), rtol=1e-6
+    )
+
+
+def test_step_scan_idx_rejects_sharded():
+    from sparse_coding__tpu.parallel import make_mesh
+
+    ens = build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-3}] * 2,
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT, n_dict_components=N_DICT,
+    )
+    ens.shard(make_mesh(2, 4, 1))
+    with pytest.raises(ValueError, match="single-shard"):
+        ens.step_scan_idx(jnp.zeros((256, D_ACT)), np.zeros((2, 128), np.int32))
