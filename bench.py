@@ -354,7 +354,11 @@ def main(argv=None):
         FunctionalTiedSAE,
         jax.random.PRNGKey(0),
         [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(N_MODELS)],
-        optimizer_kwargs={"learning_rate": 1e-3},
+        # bf16 first Adam moment: the fused step is HBM-bound above its MXU
+        # floor (THROUGHPUT r4c roofline) and mu is a third of the stream —
+        # measured +6% at loss deltas ~1e-6 (r2g called this a wash for the
+        # UNFUSED path and kept f32; the fused kernel changes the verdict)
+        optimizer_kwargs={"learning_rate": 1e-3, "mu_dtype": "bfloat16"},
         activation_size=D_ACT,
         n_dict_components=N_DICT,
         compute_dtype=jnp.bfloat16,

@@ -281,12 +281,13 @@ def make_ensemble_multi_step_idx(
     resident dataset inside the compiled scan (`multi_step_idx(state,
     dataset, idxs[K, B]) -> (state, loss_dicts)`).
 
-    This is the `ensemble_train_loop` hot path: with the gather outside
+    `ensemble_train_loop`'s zero-copy route: with the gather outside
     (``dataset[idxs]`` then `step_scan`) every K steps cost two dispatches —
     the gather and the scan — each carrying the backend's ~10 ms tunnel
     latency, plus a [K, B, d] staged copy in HBM. In-scan gathering makes it
-    one dispatch and no staging (measured: the r4 parity loop ran 6.7
-    ms/step against the bench kernel's ~2.4 — mostly this, THROUGHPUT r4b).
+    one dispatch and no staging; the loop's DEFAULT resident path goes
+    further (bulk shuffle + whole-chunk scan, THROUGHPUT r4b) but costs a
+    chunk-sized copy this one avoids.
     Shared-batch, single-shard only (a sharded loop feeds presharded batches
     through `step_scan`). Signature mirrors `make_ensemble_multi_step` so
     `_build_steps` passes the SAME `**kw` to every step builder — hand-picked
@@ -556,11 +557,12 @@ class Ensemble:
         the resident `dataset` INSIDE the compiled scan (`idxs`: [K, batch]
         int32 row indices; returns the loss dict with leading dim K).
 
-        The `ensemble_train_loop` hot path: vs ``step_scan(dataset[idxs])``
-        this saves the separate gather dispatch (~10 ms tunnel latency each
-        on this backend) and the [K, batch, d] staged copy. Single-shard,
-        shared-batch only — a sharded loop feeds presharded batches through
-        `step_scan`.
+        `ensemble_train_loop`'s zero-copy route (for chunks too big to
+        bulk-shuffle, and progress-callback callers): vs
+        ``step_scan(dataset[idxs])`` this saves the separate gather dispatch
+        (~10 ms tunnel latency each on this backend) and the [K, batch, d]
+        staged copy. Single-shard, shared-batch only — a sharded loop feeds
+        presharded batches through `step_scan`.
         """
         if getattr(self, "_mesh", None) is not None:
             raise ValueError(

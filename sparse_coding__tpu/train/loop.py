@@ -38,6 +38,15 @@ from sparse_coding__tpu.models.learned_dict import _norm_rows
 from sparse_coding__tpu.utils.logging import MetricLogger
 
 
+@lru_cache(maxsize=32)
+def _shuffler(n_batches: int, batch_size: int) -> Callable:
+    """Jitted bulk shuffle for the whole-chunk train path: gather the
+    permuted rows in one pass and batch them `[n_batches, batch_size, d]`."""
+    return jax.jit(
+        lambda d, p: jnp.take(d, p, axis=0).reshape(n_batches, batch_size, d.shape[1])
+    )
+
+
 @lru_cache(maxsize=8)
 def _dead_ensemble_probe(sig):
     """Cached jit: True iff EVERY member's code tensor is all-zero on a probe
@@ -182,16 +191,23 @@ def ensemble_train_loop(
     progress_callback: Optional[Callable[[int, int], None]] = None,
     scan_steps: int = 8,
     dead_check: bool = True,
+    bulk_shuffle_max_bytes: int = 2 << 30,
 ) -> Dict[str, jax.Array]:
     """Train the ensemble for one pass over `dataset` ([N, d] activations).
 
     Returns the final on-device loss dict. `fista_update=None` auto-detects
     from the signature (`has_fista_decoder_update`).
 
-    `scan_steps`: batches dispatched per compiled call (`Ensemble.step_scan`)
-    — the throughput path that amortizes per-dispatch tunnel latency
-    (THROUGHPUT.md). Forced to 1 when the FISTA decoder update is active (it
-    needs each step's `aux["c"]` warm start between gradient steps).
+    Path selection (THROUGHPUT.md r4b): single-shard device-resident
+    datasets whose shuffled copy fits `bulk_shuffle_max_bytes` run the
+    whole-chunk fast path — on-device permutation, ONE bulk shuffle, ONE
+    scan dispatch over every batch (`scan_steps` and `progress_callback`
+    granularity do not apply there; pass a progress_callback or set
+    `scan_steps=1` to opt out). Otherwise batches go `scan_steps` per
+    dispatch through `step_scan_idx` (device-resident, zero staged copy) or
+    `step_scan` (host arrays / sharded ensembles). `scan_steps` is forced
+    to 1 when the FISTA decoder update is active (it needs each step's
+    `aux["c"]` warm start between gradient steps).
     """
     if fista_update is None:
         fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
@@ -201,29 +217,65 @@ def ensemble_train_loop(
 
     n = dataset.shape[0]
     n_batches = n // batch_size
-    # host-side permutation; the data itself stays wherever it lives (HBM)
-    perm = np.asarray(jax.random.permutation(key, n))
-    # single-shard device-resident datasets gather each batch INSIDE the
-    # compiled scan (one dispatch per k steps, no staged [k, B, d] copy —
-    # measured 6.7 -> ~2.5 ms/step on the r4 parity loop, THROUGHPUT r4b)
-    in_scan_gather = (
+    resident = (
         isinstance(dataset, jax.Array) and getattr(ensemble, "_mesh", None) is None
     )
 
-    loss_dict: Dict[str, jax.Array] = {}
+    def log_scan_losses(offset: int, losses: Dict[str, jax.Array], k: int):
+        if logger is None:
+            return
+        for j in range(k):
+            logger.log(offset + j, {name: v[j] for name, v in losses.items()})
+            if (offset + j + 1) % log_every == 0:
+                logger.flush()
+
+    # whole-chunk fast path: permutation AND shuffle stay on device (a
+    # host-side perm is ~4 MB crossing the ~20 MiB/s tunnel every chunk;
+    # random-row gathers run ~4 GB/s on v5e, so one bulk pass beats 256
+    # per-step gathers ~2x), then ONE scan dispatch over every batch.
+    # Measured on the r4 parity-l1 loop: 6.7 -> ~3.2 ms/step end to end
+    # (THROUGHPUT r4b). Costs one transient chunk-sized copy — chunks
+    # bigger than `bulk_shuffle_max_bytes` take the zero-copy
+    # `step_scan_idx` route below instead.
+    if (
+        fista_fn is None
+        and n_batches > 0
+        and resident
+        and scan_steps > 1
+        and progress_callback is None
+        and dataset.nbytes <= bulk_shuffle_max_bytes
+    ):
+        perm = jax.random.permutation(key, n)  # device-resident
+        shuffled = _shuffler(n_batches, batch_size)(
+            dataset, perm[: n_batches * batch_size]
+        )
+        losses = ensemble.step_scan(shuffled)
+        del shuffled
+        loss_dict = {name: v[-1] for name, v in losses.items()}
+        log_scan_losses(0, losses, n_batches)
+        if logger is not None:
+            logger.flush()
+        if dead_check:
+            warn_if_ensemble_dead(
+                ensemble, dataset[perm[:64]], context="after chunk pass"
+            )
+        return loss_dict
+
+    # host-side permutation; the data itself stays wherever it lives (HBM)
+    perm = np.asarray(jax.random.permutation(key, n))
+    loss_dict = {}
     i = 0
     while i < n_batches:
         k = scan_steps if n_batches - i >= scan_steps else 1
         if k > 1:
             idxs = perm[i * batch_size : (i + k) * batch_size].reshape(k, batch_size)
-            if in_scan_gather:
+            if resident:
+                # in-scan gather: no staged [k, B, d] copy (THROUGHPUT r4b)
                 losses = ensemble.step_scan_idx(dataset, idxs)
             else:
                 losses = ensemble.step_scan(dataset[idxs])
             loss_dict = {name: v[-1] for name, v in losses.items()}
-            if logger is not None:
-                for j in range(k):
-                    logger.log(i + j, {name: v[j] for name, v in losses.items()})
+            log_scan_losses(i, losses, k)
         else:
             idxs = perm[i * batch_size : (i + 1) * batch_size]
             batch = dataset[idxs]
