@@ -338,6 +338,10 @@ def main(argv=None):
         "(-1 = auto: 2000 for full l1/basic runs, 0 otherwise)",
     )
     ap.add_argument(
+        "--max-epochs", type=int, default=None,
+        help="override the config's plateau-training epoch cap",
+    )
+    ap.add_argument(
         "--topk-recall", type=float, default=None,
         help="approx_max_k recall_target for the topk config "
         "(default: TopKEncoderApprox.RECALL)",
@@ -418,6 +422,10 @@ def main(argv=None):
             grid = [1e-4, 1e-3] if quick else [1e-4, 3e-4, 1e-3, 3e-3]
             max_epochs = 1
 
+    if args.max_epochs is not None:
+        if args.max_epochs < 1:
+            ap.error("--max-epochs must be >= 1")
+        max_epochs = args.max_epochs
     # r3 ran ALL full parity artifacts on trigram-pretrained subjects (the
     # flag was explicit then; ROUND3.md header) — r4 makes that the default
     # so topk/fista no longer silently fall back to random-init subjects

@@ -430,6 +430,29 @@ def n_active_over_time(
     return fig
 
 
+def convergence_trajectories(
+    trajectories: Dict[str, Sequence[Dict[str, Any]]],
+    title: str = "Held-out FVU vs training epoch",
+    log_y: bool = False,
+):
+    """Plateau-training convergence curves (round-4 parity protocol): one
+    line per run from the artifact's `fvu_trajectory` records
+    (`[{"epoch": i, "mean_fvu": v, ...}, ...]` — `scripts/parity_run.py`).
+    The judge-facing view of "trained to plateau, not smoke-trained"."""
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for name, traj in sorted(trajectories.items()):
+        xs = [int(t["epoch"]) for t in traj]
+        ys = [float(t["mean_fvu"]) for t in traj]
+        ax.plot(xs, ys, "o-", label=name, markersize=3)
+    if log_y:
+        ax.set_yscale("log")
+    ax.set_xlabel("epoch")
+    ax.set_ylabel("mean held-out FVU (grid average)")
+    ax.set_title(title)
+    ax.legend(fontsize=8)
+    return fig
+
+
 def save_figure(fig, path):
     from pathlib import Path
 

@@ -46,6 +46,16 @@ def test_all_figures_render(tmp_path, trained):
         "fista_cmp": plotting.fista_comparison_plot(lds[:1], lds[1:], batch),
         "grid": plotting.grid_heatmap(np.random.rand(3, 4), [1, 2, 3, 4], [0.1, 0.2, 0.3], "x", "y"),
         "hist": plotting.histogram(np.random.rand(100), "value"),
+        "convergence": plotting.convergence_trajectories(
+            {
+                "l1_seed0": [
+                    {"epoch": i, "mean_fvu": 0.4 * 0.9**i} for i in range(6)
+                ],
+                "l1_seed1": [
+                    {"epoch": i, "mean_fvu": 0.39 * 0.9**i} for i in range(4)
+                ],
+            }
+        ),
     }
     for name, fig in figs.items():
         path = plotting.save_figure(fig, tmp_path / f"{name}.png")
