@@ -172,7 +172,13 @@ def main(argv=None):
         "(-1 = auto: 2000 for full runs, 0 for --quick; 0 = random-init "
         "subject)",
     )
+    ap.add_argument(
+        "--max-epochs", type=int, default=None,
+        help="override the plateau-training epoch cap",
+    )
     args = ap.parse_args(argv)
+    if args.max_epochs is not None and args.max_epochs < 1:
+        ap.error("--max-epochs must be >= 1")
 
     if args.mesh_validate:
         # child mode: force the virtual CPU mesh BEFORE jax backend init
@@ -209,6 +215,8 @@ def main(argv=None):
     sae_batch = 256 if quick else 2048
     n_chunks = 2 if quick else 40
     max_epochs = 1 if quick else 8
+    if args.max_epochs is not None:
+        max_epochs = args.max_epochs
     plateau_tol = 0.003
     grid = [1e-4, 1e-3] if quick else [1e-4, 3e-4, 1e-3, 3e-3]
     seeds = (0, 1)
