@@ -74,9 +74,15 @@ class DictSignature(Protocol):
 
 
 def optim_str_to_func(optim_str: str) -> Callable[..., optax.GradientTransformation]:
-    """Name → optax factory. Parity with reference `ensemble.py:25-31`."""
+    """Name → optax factory. Parity with reference `ensemble.py:25-31`.
+
+    "adam" resolves to `utils.optim.adam`, which IS `optax.adam` unless the
+    extra `nu_dtype` storage knob is passed (bf16 second moment via
+    stochastic rounding — THROUGHPUT §r4d)."""
     if optim_str == "adam":
-        return optax.adam
+        from sparse_coding__tpu.utils.optim import adam
+
+        return adam
     if optim_str == "sgd":
         return optax.sgd
     raise ValueError(f"Unknown optimizer string: {optim_str}")
@@ -420,10 +426,15 @@ class Ensemble:
             # the in-kernel update is vanilla Adam: refuse kwargs that change
             # optax.adam's semantics (nesterov, eps_root, ...). mu_dtype is
             # supported — the kernel reads/writes mu in the state's dtype and
-            # accumulates in f32, exactly like optax
-            and set(self.optimizer_kwargs) <= {"learning_rate", "b1", "b2", "eps", "mu_dtype"}
+            # accumulates in f32, exactly like optax. nu_dtype=bfloat16 is
+            # supported via the kernel's stochastic-rounding store (same
+            # contract as utils.optim.adam, THROUGHPUT §r4d)
+            and set(self.optimizer_kwargs)
+            <= {"learning_rate", "b1", "b2", "eps", "mu_dtype", "nu_dtype"}
             # the kernel is only validated for f32/bf16 moment storage
             and jnp.dtype(self.optimizer_kwargs.get("mu_dtype") or jnp.float32)
+            in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+            and jnp.dtype(self.optimizer_kwargs.get("nu_dtype") or jnp.float32)
             in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
         ):
             fused_adam = dict(

@@ -303,6 +303,9 @@ class FunctionalTiedSAE:
         bc1 = 1.0 - jnp.power(b1, tf)
         bc2 = 1.0 - jnp.power(b2, tf)
         bc = jnp.stack([bc1, bc2], axis=-1)
+        # step count seeds the in-kernel stochastic-rounding stream for bf16
+        # nu storage (all members share the count; ignored for f32 nu)
+        seed = t.reshape(-1)[0].astype(jnp.int32)
         d_new, mu_d, nu_d, g_bias, l_rec, l_l1_raw = tied_sae_adam_step_stacked(
             params["encoder"],
             params["encoder_bias"],
@@ -311,6 +314,7 @@ class FunctionalTiedSAE:
             batch,
             buffers["l1_alpha"],
             bc,
+            seed,
             float(lr),
             float(b1),
             float(b2),
@@ -326,14 +330,25 @@ class FunctionalTiedSAE:
         # storage is cast back — expression shape mirrors optax's
         # update_moment lambda for bit parity
         mu_b_prev = adam_st.mu["encoder_bias"]
+        nu_b_prev = adam_st.nu["encoder_bias"]
         mu_b = (1.0 - b1) * g_bias + b1 * mu_b_prev
-        nu_b = b2 * adam_st.nu["encoder_bias"] + (1.0 - b2) * g_bias * g_bias
+        nu_b = b2 * nu_b_prev.astype(jnp.float32) + (1.0 - b2) * g_bias * g_bias
         bias_new = b - lr * (mu_b / bc1[:, None]) / (jnp.sqrt(nu_b / bc2[:, None]) + eps)
+        if nu_b_prev.dtype == jnp.bfloat16:
+            # mirror the kernel's storage contract for the (tiny) bias leaf:
+            # f32 EMA + unbiased bf16 store (utils/optim.py)
+            from sparse_coding__tpu.utils.optim import stochastic_round
+
+            nu_b_store = stochastic_round(
+                nu_b, jax.random.fold_in(jax.random.PRNGKey(0x5AE), seed), jnp.bfloat16
+            )
+        else:
+            nu_b_store = nu_b
         new_params = {"encoder": d_new, "encoder_bias": bias_new}
         new_adam = adam_st._replace(
             count=t,
             mu={"encoder": mu_d, "encoder_bias": mu_b.astype(mu_b_prev.dtype)},
-            nu={"encoder": nu_d, "encoder_bias": nu_b},
+            nu={"encoder": nu_d, "encoder_bias": nu_b_store},
         )
         new_opt_state = (new_adam,) + tuple(opt_state[1:])
         l_l1 = buffers["l1_alpha"] * l_l1_raw

@@ -41,6 +41,48 @@ from jax.experimental.pallas import tpu as pltpu
 
 f32 = jnp.float32
 bf16 = jnp.bfloat16
+u32 = jnp.uint32
+
+
+def _mix32(h):
+    """murmur3 finalizer: full-avalanche 32-bit integer hash (jnp ops only,
+    so it lowers identically in Mosaic and interpret mode — the pltpu.prng_*
+    primitives have no interpret path in this JAX version)."""
+    h = h ^ (h >> 16)
+    h = h * u32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * u32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _stochastic_round_bf16(x, seed_u32, hw_prng: bool):
+    """Unbiasedly round f32 `x` (2D tile, finite values) to bf16:
+    E[round(x)] = x exactly (the bit trick of `utils.optim.stochastic_round`:
+    add 16 uniform low bits to the f32 pattern, truncate to the upper half).
+
+    Two bit sources, both deterministic given `seed_u32`:
+      - compiled (`hw_prng=True`): the on-core hardware PRNG
+        (`pltpu.prng_seed`/`prng_random_bits`) — effectively free; the
+        counter-hash alternative measured ~0.04 ms/step of VPU time at the
+        bench shape, eating the bandwidth saving it was meant to buy.
+      - interpret (`hw_prng=False`): `_mix32` counter hash over (seed,
+        element index) — the pltpu prng primitives have no interpret path in
+        this JAX version.
+    The streams differ across modes (and from jax.random's threefry); all
+    are unbiased, which is the only property the nu EMA needs
+    (utils/optim.py module doc, reason 2).
+    """
+    if hw_prng:
+        pltpu.prng_seed(seed_u32)
+        bits = pltpu.prng_random_bits(x.shape).astype(u32)
+    else:
+        r = jax.lax.broadcasted_iota(u32, x.shape, 0)
+        c = jax.lax.broadcasted_iota(u32, x.shape, 1)
+        bits = _mix32((r * u32(x.shape[1]) + c) ^ seed_u32)
+    xb = jax.lax.bitcast_convert_type(x, u32)
+    up = ((xb + (bits & u32(0xFFFF))) >> 16).astype(jnp.uint16)
+    return jax.lax.bitcast_convert_type(up, bf16)
 
 
 def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):
@@ -113,9 +155,10 @@ def _bwd_kernel(l1b_ref, x_ref, dxh_ref, d_ref, nrm_ref, c_ref, gd_ref, gb_ref):
 
 
 def _bwd_adam_kernel(
-    l1b_ref, hp_ref, bc_ref, x_ref, dxh_ref, nrm_ref, c_ref,
+    l1b_ref, hp_ref, bc_ref, seed_ref, x_ref, dxh_ref, nrm_ref, c_ref,
     draw_ref, mu_ref, nu_ref,
     dnew_ref, munew_ref, nunew_ref, gb_ref,
+    *, hw_prng: bool,
 ):
     """`_bwd_kernel` + the Adam update for the encoder, all in VMEM: the
     encoder gradient is consumed by the moment/param updates without ever
@@ -126,9 +169,13 @@ def _bwd_adam_kernel(
     Extra prefetch: hp_ref [6] f32 = (lr, b1, b2, eps, 1-b1, 1-b2), the
     complements computed in python-float precision by the caller (see the
     moment-update comment below); bc_ref [M, 2] f32 =
-    per-member bias corrections (1-b1^t, 1-b2^t). Blocks: draw [1, Nt, D]
-    f32 raw encoder; mu/nu [1, Nt, D] Adam moments (mu may be bf16 when the
-    optimizer uses `mu_dtype=bfloat16`); outputs dnew/munew/nunew.
+    per-member bias corrections (1-b1^t, 1-b2^t); seed_ref [1] int32 step
+    seed for the nu stochastic-rounding stream (unused for f32 nu). Blocks:
+    draw [1, Nt, D] f32 raw encoder; mu/nu [1, Nt, D] Adam moments (mu may
+    be bf16 when the optimizer uses `mu_dtype=bfloat16`; nu may be bf16 with
+    `nu_dtype=bfloat16`, stored via stochastic rounding — see
+    `utils/optim.py` for why round-to-nearest would freeze the EMA); outputs
+    dnew/munew/nunew.
     """
     m = pl.program_id(0)
     x = x_ref[:]
@@ -156,11 +203,23 @@ def _bwd_adam_kernel(
     # mu_dtype=bfloat16 that means a bf16-rounded b1 and product), only the
     # sum in f32 — mirroring optax bit-for-bit.
     mu = (b1.astype(mu_ref.dtype) * mu_ref[0]).astype(f32) + hp_ref[4] * g
-    nu = b2 * nu_ref[0] + hp_ref[5] * g * g
+    # nu EMA ALWAYS in f32 (for bf16 storage the upcast is explicit; for f32
+    # it is a no-op): a storage-dtype decay multiply would round b2=0.999 to
+    # bf16 0.996 and silently shorten the EMA horizon 4x (utils/optim.py)
+    nu = b2 * nu_ref[0].astype(f32) + hp_ref[5] * g * g
     mhat = mu / bc_ref[m, 0]
     vhat = nu / bc_ref[m, 1]
     munew_ref[0, :, :] = mu.astype(munew_ref.dtype)
-    nunew_ref[0, :, :] = nu
+    if nunew_ref.dtype == bf16:
+        # per-(step, member, dict-tile) seed; element index decorrelates lanes
+        seed = _mix32(
+            seed_ref[0].astype(u32)
+            ^ (jnp.asarray(m).astype(u32) * u32(0x9E3779B9))
+            ^ (jnp.asarray(pl.program_id(1)).astype(u32) * u32(0x7FEB352D))
+        )
+        nunew_ref[0, :, :] = _stochastic_round_bf16(nu, seed, hw_prng)
+    else:
+        nunew_ref[0, :, :] = nu
     dnew_ref[0, :, :] = draw_ref[0] - lr * mhat / (jnp.sqrt(vhat) + eps)
 
 
@@ -176,6 +235,7 @@ def tied_sae_adam_step_stacked(
     batch: jax.Array,
     l1_alpha: jax.Array,
     bc: jax.Array,
+    seed: jax.Array,
     lr: float,
     b1: float,
     b2: float,
@@ -186,10 +246,12 @@ def tied_sae_adam_step_stacked(
 ):
     """Fused fwd + bwd + encoder-Adam for the stacked tied-SAE ensemble.
 
-    d_raw [M, N, D] f32 raw encoder; mu_d/nu_d its Adam moments; bc [M, 2]
-    bias corrections (1-b1^t, 1-b2^t) for THIS step. Returns
-    (d_new, mu_new, nu_new, g_bias, l_rec, l_l1_raw). The bias' own Adam
-    update (tiny) is left to the caller.
+    d_raw [M, N, D] f32 raw encoder; mu_d/nu_d its Adam moments (mu bf16 with
+    `mu_dtype=bfloat16`; nu bf16 with `nu_dtype=bfloat16`, stored via
+    stochastic rounding seeded by `seed` [1] int32 — pass the step count so
+    the stream differs per step); bc [M, 2] bias corrections (1-b1^t, 1-b2^t)
+    for THIS step. Returns (d_new, mu_new, nu_new, g_bias, l_rec, l_l1_raw).
+    The bias' own Adam update (tiny) is left to the caller.
     """
     M, N, D = d_raw.shape
     B = batch.shape[0]
@@ -235,9 +297,9 @@ def tied_sae_adam_step_stacked(
     hp = jnp.asarray([lr, b1, b2, eps, 1 - b1, 1 - b2], f32)
     tile3 = lambda m, j, *_: (m, j, 0)
     d_new, mu_new, nu_new, g_bias = pl.pallas_call(
-        _bwd_adam_kernel,
+        partial(_bwd_adam_kernel, hw_prng=not interpret),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(M, N // dict_tile),
             in_specs=[
                 pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
@@ -258,16 +320,20 @@ def tied_sae_adam_step_stacked(
         out_shape=[
             jax.ShapeDtypeStruct((M, N, D), f32),
             jax.ShapeDtypeStruct((M, N, D), mu_d.dtype),
-            jax.ShapeDtypeStruct((M, N, D), f32),
+            jax.ShapeDtypeStruct((M, N, D), nu_d.dtype),
             jax.ShapeDtypeStruct((M, 1, N), f32),
         ],
         # write the new encoder/moments into the donated input buffers: inside
         # a scanned train step the carry must live in fixed buffers, and
         # without aliasing XLA inserts a 67 MB copy per array per step
         # (indices count the scalar-prefetch operands)
-        input_output_aliases={7: 0, 8: 1, 9: 2},
+        input_output_aliases={8: 0, 9: 1, 10: 2},
         interpret=interpret,
-    )(l1_over_b, hp, bc.astype(f32), xb, dxh, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d)
+    )(
+        l1_over_b, hp, bc.astype(f32),
+        jnp.asarray(seed, jnp.int32).reshape(1),
+        xb, dxh, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d,
+    )
 
     l_rec = lrec[:, 0] / (B * D)
     l_l1_raw = ll1[:, 0] / B

@@ -1,0 +1,160 @@
+"""Adam with compressed second-moment storage (``nu_dtype``) via stochastic rounding.
+
+Why this exists (THROUGHPUT.md §r4c): the fused tied-SAE train step is
+memory-bound on its parameter/optimizer stream — params 134 MB + Adam moments
+268 MB read+write per step at the bench shape. optax ships ``mu_dtype`` (first
+moment in bf16, adopted in r4c for +6%) but has NO ``nu_dtype``, and naively
+storing ``nu`` in bf16 with round-to-nearest is genuinely unsafe, for two
+distinct reasons this module is built to avoid:
+
+1. **EMA-horizon corruption**: optax's ``update_moment_per_elem_norm`` runs the
+   decay multiply in the storage dtype (weak typing), so a bf16-stored ``nu``
+   would round ``b2 = 0.999`` to bf16 ``0.99609``, silently changing the EMA
+   horizon from 1000 to ~256 steps. Here the EMA is ALWAYS computed in fp32
+   (``b2·nu + (1-b2)·g²`` with ``nu`` upcast) and only the *storage* is
+   compressed.
+2. **Round-to-nearest freeze**: the per-step increment ``(1-b2)(g² - nu)`` is
+   ~0.1% of ``nu`` while a bf16 ulp is ~0.8% of ``nu`` — with deterministic
+   rounding the stored value re-rounds to itself and the second moment FREEZES
+   once it is within ~4× of g² (test_optim.py demonstrates the freeze).
+   Stochastic rounding makes each store unbiased, so the EMA tracks in
+   expectation with ~0.2% relative storage noise (≈0.1% on the ``sqrt(nu)``
+   denominator — per-parameter lr jitter far below Adam's own noise floor).
+
+The fused Pallas kernel mirrors this contract with the on-core PRNG
+(`ops/tied_sae_kernel.py:_bwd_adam_kernel`); this module is the XLA/CPU path
+and the reference semantics.
+
+The reference framework has no counterpart (torchopt adam keeps fp32 moments;
+`/root/reference/autoencoders/ensemble.py:85-95` inits torchopt state) — this
+is a TPU-HBM-bandwidth optimization with measured loss parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_MASK16 = jnp.uint32(0xFFFF)
+
+
+def stochastic_round(x: jax.Array, key: jax.Array, dtype) -> jax.Array:
+    """Unbiasedly round fp32 ``x`` to ``dtype`` (bf16) using randomness from ``key``.
+
+    Classic bit trick: add 16 uniform random low bits to the fp32 bit pattern
+    and truncate to the upper 16 (bf16 is fp32's upper half). The carry from
+    the mantissa add performs the round-up with probability equal to the
+    truncated fraction, so ``E[round(x)] = x`` exactly for finite values.
+    Non-finite values pass through a plain cast (bit-pattern adds would
+    corrupt inf/nan).
+    """
+    dtype = jnp.dtype(dtype)
+    if dtype != jnp.bfloat16:
+        raise ValueError(f"stochastic_round targets bfloat16, got {dtype}")
+    xf = x.astype(jnp.float32)
+    bits = jax.random.bits(key, xf.shape, jnp.uint32) & _MASK16
+    xb = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    up = ((xb + bits) >> 16).astype(jnp.uint16)
+    out = jax.lax.bitcast_convert_type(up, jnp.bfloat16)
+    return jnp.where(jnp.isfinite(xf), out, xf.astype(jnp.bfloat16))
+
+
+def scale_by_adam_compressed(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    eps_root: float = 0.0,
+    mu_dtype=None,
+    nu_dtype=None,
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """`optax.scale_by_adam` + a ``nu_dtype`` storage policy (see module doc).
+
+    Bit-compatibility contract:
+      - ``nu_dtype=None`` → the update math IS optax's (same expressions, same
+        python-float complements); only code identity differs.
+      - ``mu_dtype`` follows optax exactly (decay multiply in storage dtype,
+        cast-back at the end) so existing mu_dtype=bf16 numbers carry over.
+      - ``nu_dtype=bfloat16`` → fp32 EMA + bias-corrected update from the
+        UNROUNDED fp32 value; only the carried state is stochastically rounded.
+        The rounding stream is derived from (seed, step) — deterministic given
+        the seed, and NOT correlated step-to-step. State layout stays
+        `optax.ScaleByAdamState`, so checkpoints/fused-kernel plumbing that
+        read ``.count/.mu/.nu`` keep working.
+    """
+    mu_dtype = None if mu_dtype is None else jnp.dtype(mu_dtype)
+    nu_dtype = None if nu_dtype is None else jnp.dtype(nu_dtype)
+    if nu_dtype not in (None, jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(f"nu_dtype must be None/float32/bfloat16, got {nu_dtype}")
+
+    def init_fn(params):
+        mu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(updates, state, params=None):
+        del params
+        # mu: optax's update_moment expression verbatim (storage-dtype decay
+        # multiply under weak typing — bit parity with optax mu_dtype runs)
+        mu = jax.tree.map(lambda g, t: (1 - b1) * g + b1 * t, updates, state.mu)
+        # nu: fp32 EMA regardless of storage dtype (reason 1 in module doc)
+        nu = jax.tree.map(
+            lambda g, t: (1 - b2) * jnp.square(g.astype(jnp.float32))
+            + b2 * t.astype(jnp.float32),
+            updates,
+            state.nu,
+        )
+        count_inc = optax.safe_increment(state.count)
+        tf = count_inc.astype(jnp.float32)
+        bc1 = 1 - jnp.power(jnp.float32(b1), tf)
+        bc2 = 1 - jnp.power(jnp.float32(b2), tf)
+        new_updates = jax.tree.map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2 + eps_root) + eps), mu, nu
+        )
+        mu = jax.tree.map(lambda t: t.astype(mu_dtype) if mu_dtype else t, mu)
+        if nu_dtype == jnp.bfloat16:
+            # one key per step; leaves decorrelated by fold_in(leaf index).
+            # Under the ensemble's vmap all members share `count`, so members
+            # share a bit stream — harmless: their nu VALUES differ, so the
+            # rounding outcomes are independent where it matters.
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), count_inc)
+            leaves, treedef = jax.tree.flatten(nu)
+            leaves = [
+                stochastic_round(leaf, jax.random.fold_in(key, i), jnp.bfloat16)
+                for i, leaf in enumerate(leaves)
+            ]
+            nu = jax.tree.unflatten(treedef, leaves)
+        elif nu_dtype is not None:
+            nu = jax.tree.map(lambda t: t.astype(nu_dtype), nu)
+        return new_updates, optax.ScaleByAdamState(count=count_inc, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    mu_dtype=None,
+    nu_dtype=None,
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """Drop-in `optax.adam` with the extra ``nu_dtype`` knob.
+
+    ``nu_dtype=None`` returns literal `optax.adam` (bit-identical programs and
+    shared-step cache identity); ``nu_dtype='bfloat16'`` swaps in
+    `scale_by_adam_compressed`. This is what `ensemble.optim_str_to_func`
+    resolves ``"adam"`` to.
+    """
+    if nu_dtype is None:
+        return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)
+    return optax.chain(
+        scale_by_adam_compressed(
+            b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype, nu_dtype=nu_dtype, seed=seed
+        ),
+        optax.scale_by_learning_rate(learning_rate),
+    )
