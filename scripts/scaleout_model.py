@@ -433,14 +433,20 @@ def main():
             },
         },
         "4_tied_grad_double_allreduce": (
-            "The compiled SPMD programs all-reduce TWO encoder-grad-sized "
-            "partials (hybrid case: 2x16.8 MB where the summed grad is "
-            "16.8 MB) — the encode-path and decode-path cotangents of the "
-            "tied weights are reduced separately instead of being added "
-            "before the collective. psum(a)+psum(b)==psum(a+b), so this is "
-            "a compiler scheduling artifact worth re-checking on real pod "
-            "hardware: fixing it halves gradient wire traffic. Projections "
-            "use the measured (worse) number."
+            "FOUND AND FIXED (this round): plain autodiff gave the tied "
+            "weights TWO grad-sized cotangent partials (encode-path + "
+            "decode-path transposes) that GSPMD all-reduced separately — "
+            "2x the gradient wire (hybrid case measured 2x16.8 MB). "
+            "`FunctionalTiedSAE.bind_mesh` now swaps in a custom-VJP loss on "
+            "data-parallel meshes whose tied backward is ONE contraction "
+            "over a doubled batch axis (models/sae.py:_tied_pair_dp), so "
+            "the partitioner emits a single grad-sized all-reduce operand. "
+            "The wire numbers in `cases` are measured from the FIXED "
+            "programs (hybrid 16.8 MB and pure-DP 126 MB, both half the "
+            "r4-initial capture; dictpar 252 MB = 0.56x — its ~50 MB decode "
+            "all-reduce is untouched); "
+            "tests/test_parallel.py::test_dp_hlo_single_gradient_allreduce_"
+            "operand pins the HLO to one operand."
         ),
         "5_caveats": (
             "HLO measured on the CPU SPMD partitioner (the TPU partitioner "

@@ -399,6 +399,7 @@ class Ensemble:
             step=jnp.zeros((), jnp.int32),
         )
 
+        self._donate = donate
         self._build_steps(donate=donate)
 
     # Shared jitted step functions: two Ensembles with the same (signature,
@@ -411,12 +412,17 @@ class Ensemble:
     _SHARED_STEPS_MAX = 32
 
     def _build_steps(self, donate: bool = True):
-        # trace-time specialization on concrete buffer values (see
-        # DictSignature.bind_static); execution-only — self.sig stays the
-        # user-facing signature for checkpoints and to_learned_dicts
+        # execution-only signature specializations — self.sig stays the
+        # user-facing signature for checkpoints and to_learned_dicts:
+        #   bind_mesh: mesh-dependent loss variants (e.g. the tied-SAE DP
+        #     backward that halves gradient all-reduce wire); re-applied by
+        #     `shard`, which rebuilds the steps
+        #   bind_static: trace-time specialization on concrete buffer values
         sig_exec = self.sig
-        if hasattr(self.sig, "bind_static"):
-            sig_exec = self.sig.bind_static(self.state.buffers)
+        if getattr(self, "_mesh", None) is not None and hasattr(self.sig, "bind_mesh"):
+            sig_exec = self.sig.bind_mesh(self._mesh)
+        if hasattr(sig_exec, "bind_static"):
+            sig_exec = sig_exec.bind_static(self.state.buffers)
         fused_adam = None
         if (
             getattr(self, "fused", False)
@@ -429,8 +435,10 @@ class Ensemble:
             # accumulates in f32, exactly like optax. nu_dtype=bfloat16 is
             # supported via the kernel's stochastic-rounding store (same
             # contract as utils.optim.adam, THROUGHPUT §r4d)
+            # "seed" is harmless here: the kernel derives its rounding stream
+            # from the step count, not utils.optim.adam's seed
             and set(self.optimizer_kwargs)
-            <= {"learning_rate", "b1", "b2", "eps", "mu_dtype", "nu_dtype"}
+            <= {"learning_rate", "b1", "b2", "eps", "mu_dtype", "nu_dtype", "seed"}
             # the kernel is only validated for f32/bf16 moment storage
             and jnp.dtype(self.optimizer_kwargs.get("mu_dtype") or jnp.float32)
             in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
@@ -523,6 +531,9 @@ class Ensemble:
         self._shard_dict = shard_dict
         self._batch_sharding = mesh_lib.batch_sharding(mesh)
         self._pm_batch_sharding = mesh_lib.per_model_batch_sharding(mesh)
+        # mesh-dependent signature specializations (bind_mesh) take effect now
+        if hasattr(self.sig, "bind_mesh"):
+            self._build_steps(donate=getattr(self, "_donate", True))
         return self
 
     # -- training ------------------------------------------------------------

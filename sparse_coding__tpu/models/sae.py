@@ -215,6 +215,20 @@ class FunctionalTiedSAE:
             norm_encoder=True,
         )
 
+    @staticmethod
+    def bind_mesh(mesh):
+        """Mesh-time signature specialization (`Ensemble.shard`): on a mesh
+        with a real data axis, swap in the DP loss whose tied-weight backward
+        is a single contraction — halving the gradient all-reduce wire
+        (SCALEOUT r4a finding #4; see `_tied_pair_dp`). Pure fan-out /
+        single-chip keeps the standard loss: the fused backward pays two
+        chunk-sized operand copies that only the comm saving justifies."""
+        from sparse_coding__tpu.parallel.mesh import DATA_AXIS
+
+        if mesh.shape.get(DATA_AXIS, 1) > 1:
+            return FunctionalTiedSAEDP
+        return FunctionalTiedSAE
+
     # -- fused TPU step (ops/tied_sae_kernel.py) -----------------------------
 
     @staticmethod
@@ -366,6 +380,87 @@ class FunctionalTiedSAE:
             jax.tree.map(lambda x: x[0], grads),
             jax.tree.map(lambda x: x[0], loss_data),
         )
+
+
+def _tied_pair_core(d_hat, bias, x):
+    c = jax.nn.relu(_encode_mm(d_hat, x) + px.cast_in(bias))
+    x_hat = _decode_mm(d_hat, c)
+    return c, x_hat
+
+
+@jax.custom_vjp
+def _tied_pair_dp(d_hat, bias, x):
+    """Tied encode+decode `(c, x_hat)` with a data-parallel-friendly backward.
+
+    Under plain autodiff the tied dictionary receives TWO grad-sized
+    cotangent partials (one from the encode-matmul transpose, one from the
+    decode's), and GSPMD all-reduces them over the data axis SEPARATELY
+    before adding — 2× the gradient wire (measured in SCALEOUT r4a finding
+    #4: psum(a)+psum(b) where psum(a+b) suffices). This VJP computes the sum
+    as ONE contraction over a doubled batch axis,
+
+        dD = [dpre; c]^T [x; dxh]   (stack over batch -> single dot)
+
+    so the partitioner sees a single partial-sum and emits a single
+    grad-sized all-reduce operand. The stacked operands cost two extra
+    chunk-sized HBM copies, which only the halved collective justifies —
+    `FunctionalTiedSAE.bind_mesh` therefore selects this path only on
+    meshes with a real data axis.
+    """
+    return _tied_pair_core(d_hat, bias, x)
+
+
+def _tied_pair_dp_fwd(d_hat, bias, x):
+    c, x_hat = _tied_pair_core(d_hat, bias, x)
+    return (c, x_hat), (d_hat, x, c)
+
+
+def _tied_pair_dp_bwd(res, cots):
+    d_hat, x, c = res
+    dc_out, dxh = cots
+    # pre-activation cotangent: l1-path + decode-path, masked by the relu
+    # (c > 0 == pre > 0 except exact ties, where relu's grad is 0 both ways)
+    dc_decode = jnp.einsum("...bd,...nd->...bn", dxh, px.cast_in(d_hat))
+    dpre = jnp.where(px.acc_f32(c) > 0, px.acc_f32(dc_out) + px.acc_f32(dc_decode), 0.0)
+    # the single fused tied-dictionary contraction (module-of-the-art above)
+    lhs = jnp.stack([px.cast_in(dpre), px.cast_in(c)], axis=-3)  # [2, B, N]
+    rhs = jnp.stack([px.cast_in(x), px.cast_in(dxh)], axis=-3)  # [2, B, D]
+    g_dhat = jnp.einsum(
+        "...sbn,...sbd->...nd", lhs, rhs, preferred_element_type=jnp.float32
+    ).astype(d_hat.dtype)
+    g_bias = px.acc_f32(dpre).sum(axis=-2).astype(d_hat.dtype)  # bias shares param dtype
+    g_x = jnp.einsum(
+        "...bn,...nd->...bd",
+        px.cast_in(dpre),
+        px.cast_in(d_hat),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return g_dhat, g_bias, g_x
+
+
+_tied_pair_dp.defvjp(_tied_pair_dp_fwd, _tied_pair_dp_bwd)
+
+
+class FunctionalTiedSAEDP(FunctionalTiedSAE):
+    """`FunctionalTiedSAE` with the fused tied-gradient backward
+    (`_tied_pair_dp`) — execution-only specialization selected by
+    `FunctionalTiedSAE.bind_mesh` on data-parallel meshes; checkpoints always
+    record the plain signature (same contract as `bind_static`). `bind_mesh`
+    is inherited — re-binding is idempotent."""
+
+    @staticmethod
+    def loss(params, buffers, batch):
+        learned_dict = _norm_rows(params["encoder"])
+        batch_centered = FunctionalTiedSAE.center(buffers, batch)
+        c, x_hat_centered = _tied_pair_dp(
+            learned_dict, params["encoder_bias"], batch_centered
+        )
+        l_reconstruction = _mse_f32(x_hat_centered, batch_centered)
+        l_l1 = buffers["l1_alpha"] * _l1(c)
+        l_bias_decay = buffers["bias_decay"] * _safe_l2(params["encoder_bias"])
+        total = l_reconstruction + l_l1 + l_bias_decay
+        loss_data = {"loss": total, "l_reconstruction": l_reconstruction, "l_l1": l_l1}
+        return total, (loss_data, {"c": c})
 
 
 class FunctionalTiedCenteredSAE:

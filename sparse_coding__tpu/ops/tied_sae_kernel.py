@@ -82,7 +82,11 @@ def _stochastic_round_bf16(x, seed_u32, hw_prng: bool):
         bits = _mix32((r * u32(x.shape[1]) + c) ^ seed_u32)
     xb = jax.lax.bitcast_convert_type(x, u32)
     up = ((xb + (bits & u32(0xFFFF))) >> 16).astype(jnp.uint16)
-    return jax.lax.bitcast_convert_type(up, bf16)
+    rounded = jax.lax.bitcast_convert_type(up, bf16)
+    # non-finite passthrough, mirroring utils.optim.stochastic_round: the
+    # bit-add would turn inf into an arbitrary-payload NaN — keep blow-ups
+    # diagnosable (nu can overflow when a run diverges)
+    return jnp.where(jnp.isfinite(x), rounded, x.astype(bf16))
 
 
 def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):

@@ -170,3 +170,112 @@ def test_sharded_fista_ensemble_and_decoder_update(devices):
         np.asarray(sh.state.params["decoder"]),
         rtol=1e-4, atol=1e-6,
     )
+
+
+# -- DP fused tied-gradient backward (bind_mesh -> FunctionalTiedSAEDP) ------
+
+
+def test_bind_mesh_selects_dp_loss_only_for_data_axes(devices):
+    from sparse_coding__tpu.models.sae import FunctionalTiedSAEDP
+
+    assert FunctionalTiedSAE.bind_mesh(make_mesh(8, 1, 1)) is FunctionalTiedSAE
+    assert FunctionalTiedSAE.bind_mesh(make_mesh(1, 8, 1)) is FunctionalTiedSAEDP
+    assert FunctionalTiedSAE.bind_mesh(make_mesh(2, 2, 2)) is FunctionalTiedSAEDP
+    # idempotent: the DP signature binds to itself
+    assert FunctionalTiedSAEDP.bind_mesh(make_mesh(1, 8, 1)) is FunctionalTiedSAEDP
+
+
+def test_dp_loss_grads_match_plain_loss(devices):
+    from sparse_coding__tpu.models.sae import FunctionalTiedSAEDP
+    from sparse_coding__tpu.utils import precision as px
+
+    p, b = FunctionalTiedSAE.init(
+        jax.random.PRNGKey(0), D_ACT, N_DICT, l1_alpha=1e-3, bias_decay=1e-4
+    )
+    p["encoder_bias"] = 0.01 * jax.random.normal(jax.random.PRNGKey(5), (N_DICT,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, D_ACT))
+    for policy, tol in [(None, 1e-5), (jnp.bfloat16, 3e-2)]:
+        with px.compute(policy):
+            g1, (l1, _) = jax.grad(FunctionalTiedSAE.loss, has_aux=True)(p, b, x)
+            g2, (l2, _) = jax.grad(FunctionalTiedSAEDP.loss, has_aux=True)(p, b, x)
+        for k in g1:
+            a, c = np.asarray(g1[k], np.float32), np.asarray(g2[k], np.float32)
+            rel = np.abs(a - c).max() / (np.abs(a).max() + 1e-12)
+            assert rel < tol, (policy, k, rel)
+        np.testing.assert_allclose(
+            float(l1["loss"]), float(l2["loss"]), rtol=1e-5 if policy is None else 2e-2
+        )
+
+
+def test_dp_sharded_tied_step_matches_unsharded(devices):
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4 * (i + 1)} for i in range(4)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    ref = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4 * (i + 1)} for i in range(4)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    ens.shard(make_mesh(2, 4, 1))
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT, n_ground_truth_components=2 * D_ACT, batch_size=64,
+        feature_num_nonzero=4, feature_prob_decay=0.99, correlated=False,
+        key=jax.random.PRNGKey(7),
+    )
+    for _ in range(5):
+        batch = next(gen)
+        ld_s, _ = ens.step_batch(batch)
+        ld_u, _ = ref.step_batch(batch)
+    np.testing.assert_allclose(
+        np.asarray(ld_s["loss"]), np.asarray(ld_u["loss"]), rtol=2e-5
+    )
+
+
+def test_dp_hlo_single_gradient_allreduce_operand(devices):
+    """The point of the DP backward (SCALEOUT r4a finding #4): the tied
+    gradient must cross the wire as ONE grad-sized all-reduce operand, not
+    two partials."""
+    import re
+
+    from sparse_coding__tpu.parallel.mesh import batch_sharding
+
+    def grad_sized_allreduce_operands(sig_builder):
+        ens = build_ensemble(
+            sig_builder,
+            jax.random.PRNGKey(0),
+            [{"l1_alpha": 1e-4 * (i + 1)} for i in range(4)],
+            optimizer_kwargs={"learning_rate": 1e-3},
+            activation_size=D_ACT,
+            n_dict_components=N_DICT,
+        )
+        ens.shard(make_mesh(1, 8, 1))
+        batch = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(1), (64, D_ACT)),
+            batch_sharding(ens._mesh),
+        )
+        hlo = ens._step.lower(ens.state, batch).compile().as_text()
+        big = 4 * N_DICT * D_ACT  # one member's [N, D] f32 gradient in bytes
+        count = 0
+        for ln in hlo.splitlines():
+            m = re.search(r" all-reduce\((.*?)\)", ln)
+            if not m or "get-tuple-element" in ln.split("=")[0]:
+                continue
+            # operand shapes live in the tuple type on the lhs of the '='
+            for shp in re.findall(r"f32\[([\d,]+)\]", ln.split("=")[1].split("all-reduce")[0]):
+                dims = [int(d) for d in shp.split(",")]
+                n = 4
+                for d in dims:
+                    n *= d
+                if n >= big:
+                    count += 1
+        return count
+
+    assert grad_sized_allreduce_operands(FunctionalTiedSAE) == 1
