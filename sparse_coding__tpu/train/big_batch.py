@@ -180,7 +180,16 @@ def train_big_batch(
     )
     if mesh is not None:
         sharding = batch_sharding(mesh)
-    step_fn = make_big_batch_step(sig, tx)
+        # mesh-dependent loss specialization (e.g. the tied-SAE DP backward
+        # that halves gradient all-reduce wire — models/sae.py:_tied_pair_dp);
+        # execution-only: the returned sig for export stays the plain one
+        if hasattr(sig, "bind_mesh"):
+            sig_exec = sig.bind_mesh(mesh)
+        else:
+            sig_exec = sig
+    else:
+        sig_exec = sig
+    step_fn = make_big_batch_step(sig_exec, tx)
     mse_fn = jax.jit(partial(per_example_mse_from_codes, sig))
 
     worst = WorstExamples(worst_k)
