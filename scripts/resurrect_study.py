@@ -1,0 +1,217 @@
+"""Dead-feature resurrection at the flagship 32x-overcomplete shape.
+
+PARITY_r04_dictpar.json measured the science gap the dead-feature story
+leaves open: at dict ratio 32 (n_dict=32768, pythia-410m-geometry mid-layer
+residual) the tied SAE holds ~48% dead features at l1=1e-3 (>10 activations
+over a 65k-row held-out sample). The reference's answer to exactly this is
+worst-example resurrection (`/root/reference/experiments/huge_batch_size.py:
+224-254`: re-init dead rows from the worst-reconstructed examples, reset
+their Adam moments), rebuilt TPU-native in `train/big_batch.py` — but so far
+only toy-tested.
+
+This study trains the flagship shape twice on IDENTICAL data and batch
+sequences (same PRNG stream; resurrection consumes no keys): a control arm
+(no resurrection) and a resurrection arm (every `--reinit-every` steps), and
+reports dead fraction / FVU / L0 for both, plus the per-event resurrection
+log. Writes RESURRECT_<round>.json at the repo root.
+
+Run: `python scripts/resurrect_study.py` (real chip, ~15-25 min incl.
+pretrain+harvest). `--quick` is a CPU-sized smoke mode used by the tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--quick", action="store_true", help="CPU-sized smoke mode")
+    ap.add_argument(
+        "--pretrain", type=int, default=-1,
+        help="trigram-pretrain steps (-1 = auto: 2000 full, 0 quick)",
+    )
+    ap.add_argument("--steps", type=int, default=None, help="train steps per arm")
+    ap.add_argument(
+        "--reinit-every", type=int, default=None,
+        help="resurrection period in steps (resurrect arm only)",
+    )
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dictpar_run import build_subject_model, subject_geometry
+    from parity_run import corpus_tokens, maybe_pretrain
+    from sparse_coding__tpu import metrics as sm
+    from sparse_coding__tpu.data.activations import harvest_to_device
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.train.big_batch import train_big_batch
+
+    t_start = time.time()
+    quick = args.quick
+    d_act, n_layers, _, _, layer = subject_geometry(quick)
+    ratio = 32
+    n_dict = ratio * d_act
+    seq_len = 32 if quick else 256
+    batch_rows = 16 if quick else 64
+    chunk_gb = 0.002 if quick else 0.5
+    n_chunks = 2 if quick else 8  # +1 held out for eval
+    sae_batch = 256 if quick else 4096
+    n_steps = args.steps if args.steps is not None else (40 if quick else 3000)
+    reinit_every = (
+        args.reinit_every if args.reinit_every is not None
+        else (10 if quick else 400)
+    )
+    if n_steps < 1 or reinit_every < 1:
+        ap.error("--steps and --reinit-every must be >= 1")
+    l1_alpha = 1e-3
+    lr = 3e-4  # dictpar_run: 1e-3 collapses high-l1 members at this shape
+    dead_eval_rows = 2048 if quick else 65536
+    eval_rows = 1024 if quick else 8192
+    dead_threshold = 10
+
+    pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
+    print(f"Building subject model (pythia-410m geometry, d={d_act})...")
+    lm_cfg, params = build_subject_model(quick)
+    params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
+    tokens = corpus_tokens(
+        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len,
+        n_chunks + 1, seed=13,
+    )
+
+    report: dict = {
+        "config": {
+            "subject": f"neox d={d_act} L={n_layers} (pythia-410m geometry, "
+            f"{'trigram-pretrained' if lang is not None else 'random init'})",
+            "model": "FunctionalTiedSAE via train.big_batch (huge-batch DP trainer)",
+            "layer": layer, "layer_loc": "residual", "seq_len": seq_len,
+            "dict_ratio": ratio, "n_dict": n_dict, "l1_alpha": l1_alpha,
+            "sae_batch": sae_batch, "n_steps": n_steps, "lr": lr,
+            "reinit_every": reinit_every, "dead_threshold": dead_threshold,
+            "device": jax.devices()[0].device_kind,
+        },
+        **({"pretrain": pretrain_stats} if pretrain_stats else {}),
+    }
+
+    print(f"Harvesting {n_chunks + 1} chunks (fused, device-resident)...")
+    t0 = time.time()
+    # scalar standardization at harvest: the FIRST chunk's std standardizes
+    # every chunk — the same protocol as scripts/dictpar_run.py (which folds
+    # the std into int8 dequant scales instead of materializing standardized
+    # chunks; keep the two in sync if the protocol ever changes)
+    chunks = []
+    act_std = None
+    eval_chunk = dead_eval = None
+    for i, chunk in enumerate(harvest_to_device(
+        params, lm_cfg, tokens, [layer], ["residual"],
+        batch_size=batch_rows, chunk_size_gb=chunk_gb, n_chunks=n_chunks + 1,
+    )):
+        arr = chunk[(layer, "residual")]
+        if act_std is None:
+            act_std = float(arr.astype(jnp.float32).std())
+        std_arr = arr.astype(jnp.float32) / act_std
+        if i < n_chunks:
+            chunks.append(std_arr.astype(jnp.bfloat16))
+        else:
+            dead_eval = std_arr[:dead_eval_rows]
+            eval_chunk = std_arr[:eval_rows]
+        del arr, std_arr
+    dataset = jnp.concatenate(chunks)
+    del chunks
+    jax.device_get(dataset[0, 0])  # fence
+    report["harvest"] = {
+        "seconds": round(time.time() - t0, 1),
+        "dataset_rows": int(dataset.shape[0]),
+        "activation_std": act_std,
+    }
+    print(f"  {report['harvest']['seconds']:.0f}s, "
+          f"{dataset.shape[0]:,} rows bf16-resident")
+
+    # free the subject LM during training (it is not needed again: this
+    # study evaluates dictionaries, not perplexity)
+    params = None
+
+    init_hp = dict(
+        activation_size=d_act, n_dict_components=n_dict, l1_alpha=l1_alpha
+    )
+    arms = {}
+    for arm, reinit in (("control", None), ("resurrect", reinit_every)):
+        log: list = []
+        t0 = time.time()
+        state, sig = train_big_batch(
+            FunctionalTiedSAE, init_hp, dataset,
+            batch_size=sae_batch, n_steps=n_steps,
+            key=jax.random.PRNGKey(0),  # identical batch sequence both arms
+            learning_rate=lr, reinit_every=reinit,
+            compute_dtype=None if quick else jnp.bfloat16,
+            resurrection_log=log,
+        )
+        jax.block_until_ready(state.params["encoder"])
+        train_s = time.time() - t0
+        ld = sig.to_learned_dict(state.params, state.buffers)
+        (row,) = sm.evaluate_dicts([ld], eval_chunk)
+        n_alive = sm.batched_calc_feature_n_ever_active(
+            ld, dead_eval, threshold=dead_threshold
+        )
+        n_dead = int(n_dict - n_alive)
+        arms[arm] = {
+            "train_seconds": round(train_s, 1),
+            "rows_consumed": int(n_steps * sae_batch),
+            "fvu": row["fvu"], "l0": row["l0"], "r2": row["r2"],
+            "n_dead": n_dead, "n_feats": n_dict,
+            "dead_fraction": round(n_dead / n_dict, 4),
+            "dead_eval_rows": int(dead_eval.shape[0]),
+            "resurrection_events": [
+                {"step": int(s), "n_resurrected": int(n)} for s, n in log
+            ],
+        }
+        del state, ld
+        print(f"  {arm}: FVU {row['fvu']:.4f}, L0 {row['l0']:.1f}, "
+              f"dead {n_dead}/{n_dict} ({arms[arm]['dead_fraction']:.1%}) "
+              f"in {train_s:.0f}s")
+    report["arms"] = arms
+    report["dead_fraction_delta"] = round(
+        arms["control"]["dead_fraction"] - arms["resurrect"]["dead_fraction"], 4
+    )
+    report["total_seconds"] = round(time.time() - t_start, 1)
+
+    # write the artifact BEFORE the sanity asserts: a failed assert must not
+    # discard a 15-25 min chip run's diagnostics
+    out_prefix = Path(args.out) if args.out else REPO
+    out_prefix.mkdir(parents=True, exist_ok=True)
+    json_path = out_prefix / (
+        f"RESURRECT_{ROUND_TAG}{'_quick' if quick else ''}.json"
+    )
+    with open(json_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {json_path}")
+
+    # sanity: both arms must train (FVU well below 1 — quick mode's 40-step
+    # random-init run only checks finiteness); the resurrect arm's events
+    # must actually have fired
+    for arm in arms.values():
+        assert np.isfinite(arm["fvu"]), arm
+        if not quick:
+            assert arm["fvu"] < 0.9, arm
+    assert arms["resurrect"]["resurrection_events"], "no resurrection fired"
+    return report
+
+
+if __name__ == "__main__":
+    main()

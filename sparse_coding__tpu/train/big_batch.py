@@ -164,9 +164,30 @@ def train_big_batch(
     mesh=None,
     reinit_every: Optional[int] = 100,
     worst_k: int = 1024,
+    compute_dtype=None,
+    resurrection_log: Optional[list] = None,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
-    resurrection. Returns (final state, sig) for `to_learned_dict` export."""
+    resurrection. Returns (final state, sig) for `to_learned_dict` export.
+
+    ``compute_dtype`` bakes a matmul precision (e.g. ``jnp.bfloat16``) into
+    the step trace via `utils.precision` — same master-weights policy as
+    `Ensemble`. ``resurrection_log`` (a caller-owned list) receives one
+    ``(step, n_dead)`` tuple per resurrection event.
+    """
+    from sparse_coding__tpu.utils import precision as px
+
+    with px.compute(compute_dtype):
+        return _train_big_batch(
+            sig, init_hparams, dataset, batch_size, n_steps, key,
+            learning_rate, mesh, reinit_every, worst_k, resurrection_log,
+        )
+
+
+def _train_big_batch(
+    sig, init_hparams, dataset, batch_size, n_steps, key,
+    learning_rate, mesh, reinit_every, worst_k, resurrection_log,
+) -> Tuple[BigBatchState, Any]:
     k_init, key = jax.random.split(key)
     params, buffers = sig.init(k_init, **init_hparams)
     tx = optax.adam(learning_rate)
@@ -212,6 +233,8 @@ def train_big_batch(
             reps = dataset[np.resize(worst_idx, n_feats)]
             state, n_dead = resurrect_dead_features(state, jnp.asarray(reps))
             worst = WorstExamples(worst_k)
+            if resurrection_log is not None:
+                resurrection_log.append((i + 1, n_dead))
             if n_dead:
                 print(f"step {i+1}: resurrected {n_dead} dead features")
     return state, sig

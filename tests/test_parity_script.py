@@ -126,3 +126,26 @@ def test_interp_subject_quick(tmp_path):
     for rec in report["scores"].values():
         assert rec["n"] > 0 and -1.0 <= rec["mean"] <= 1.0
     assert report["pretrain"]["loss_last"] < report["pretrain"]["loss_first"]
+
+
+@pytest.mark.slow
+def test_resurrect_study_quick(tmp_path):
+    """The resurrection study runs end to end in quick CPU mode: two arms on
+    identical batch sequences, per-event resurrection log in the artifact."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "resurrect_study.py"),
+         "--quick", "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / f"RESURRECT_{ROUND}_quick.json").read_text())
+    arms = report["arms"]
+    assert set(arms) == {"control", "resurrect"}
+    # one event per reinit boundary, whether or not anything was dead
+    events = arms["resurrect"]["resurrection_events"]
+    assert len(events) == report["config"]["n_steps"] // report["config"]["reinit_every"]
+    assert not arms["control"]["resurrection_events"]
+    for arm in arms.values():
+        assert arm["n_feats"] == report["config"]["n_dict"]
+        assert 0 <= arm["n_dead"] <= arm["n_feats"]

@@ -51,6 +51,7 @@ def test_run_layer_baselines(tmp_path, data):
 
 
 def test_big_batch_resurrection(data):
+    log = []
     state, sig = train_big_batch(
         FunctionalTiedSAE,
         dict(activation_size=24, n_dict_components=48, l1_alpha=3e-3),
@@ -59,10 +60,32 @@ def test_big_batch_resurrection(data):
         n_steps=30,
         key=jax.random.PRNGKey(1),
         reinit_every=10,
+        resurrection_log=log,
     )
     ld = sig.to_learned_dict(state.params, state.buffers)
     x_hat = ld.predict(data[:64])
     assert np.isfinite(np.asarray(x_hat)).all()
+    # one entry per reinit boundary (counts may be zero), monotone steps
+    assert [s for s, _ in log] == [10, 20, 30]
+    assert all(n >= 0 for _, n in log)
+
+
+def test_big_batch_compute_dtype_parity(data):
+    """The bf16 policy changes matmul precision, not training viability:
+    both arms reach a similar loss basin from the same key/batches."""
+    kw = dict(
+        init_hparams=dict(activation_size=24, n_dict_components=48, l1_alpha=3e-3),
+        dataset=data, batch_size=256, n_steps=30,
+        key=jax.random.PRNGKey(1), reinit_every=None,
+    )
+    s32, sig = train_big_batch(FunctionalTiedSAE, **kw)
+    s16, _ = train_big_batch(FunctionalTiedSAE, compute_dtype=jnp.bfloat16, **kw)
+    ld32 = sig.to_learned_dict(s32.params, s32.buffers)
+    ld16 = sig.to_learned_dict(s16.params, s16.buffers)
+    m32 = float(((ld32.predict(data[:512]) - data[:512]) ** 2).mean())
+    m16 = float(((ld16.predict(data[:512]) - data[:512]) ** 2).mean())
+    assert np.isfinite(m16) and np.isfinite(m32)
+    assert abs(m16 - m32) < 0.5 * max(m32, 1e-6), (m32, m16)
 
 
 def test_resurrect_dead_features_pure():
