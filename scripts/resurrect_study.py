@@ -50,6 +50,17 @@ def main():
         "--reinit-every", type=int, default=None,
         help="resurrection period in steps (resurrect arm only)",
     )
+    ap.add_argument(
+        "--norm-ratio", type=float, default=0.2,
+        help="re-init row norm as a fraction of the average live-row norm "
+        "(0.2 = the reference's convention)",
+    )
+    ap.add_argument(
+        "--tag", type=str, default="",
+        help="suffix for the artifact filename (e.g. 'nr1' -> "
+        "RESURRECT_<round>_nr1.json), so variant runs don't overwrite "
+        "the main A/B",
+    )
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args()
 
@@ -80,6 +91,10 @@ def main():
     )
     if n_steps < 1 or reinit_every < 1:
         ap.error("--steps and --reinit-every must be >= 1")
+    if args.norm_ratio <= 0:
+        # a zero-norm re-init (with encoder_bias also reset to 0) closes the
+        # ReLU gate forever: the arm would run 15-25 min and mean nothing
+        ap.error("--norm-ratio must be > 0")
     l1_alpha = 1e-3
     lr = 3e-4  # dictpar_run: 1e-3 collapses high-l1 members at this shape
     dead_eval_rows = 2048 if quick else 65536
@@ -104,6 +119,7 @@ def main():
             "dict_ratio": ratio, "n_dict": n_dict, "l1_alpha": l1_alpha,
             "sae_batch": sae_batch, "n_steps": n_steps, "lr": lr,
             "reinit_every": reinit_every, "dead_threshold": dead_threshold,
+            "encoder_norm_ratio": args.norm_ratio,
             "device": jax.devices()[0].device_kind,
         },
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
@@ -161,6 +177,7 @@ def main():
             learning_rate=lr, reinit_every=reinit,
             compute_dtype=None if quick else jnp.bfloat16,
             resurrection_log=log,
+            encoder_norm_ratio=args.norm_ratio,
         )
         jax.block_until_ready(state.params["encoder"])
         train_s = time.time() - t0
@@ -195,8 +212,9 @@ def main():
     # discard a 15-25 min chip run's diagnostics
     out_prefix = Path(args.out) if args.out else REPO
     out_prefix.mkdir(parents=True, exist_ok=True)
+    tag = f"_{args.tag}" if args.tag else ""
     json_path = out_prefix / (
-        f"RESURRECT_{ROUND_TAG}{'_quick' if quick else ''}.json"
+        f"RESURRECT_{ROUND_TAG}{tag}{'_quick' if quick else ''}.json"
     )
     with open(json_path, "w") as f:
         json.dump(report, f, indent=1)

@@ -166,6 +166,7 @@ def train_big_batch(
     worst_k: int = 1024,
     compute_dtype=None,
     resurrection_log: Optional[list] = None,
+    encoder_norm_ratio: float = 0.2,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
     resurrection. Returns (final state, sig) for `to_learned_dict` export.
@@ -173,7 +174,10 @@ def train_big_batch(
     ``compute_dtype`` bakes a matmul precision (e.g. ``jnp.bfloat16``) into
     the step trace via `utils.precision` — same master-weights policy as
     `Ensemble`. ``resurrection_log`` (a caller-owned list) receives one
-    ``(step, n_dead)`` tuple per resurrection event.
+    ``(step, n_dead)`` tuple per resurrection event. ``encoder_norm_ratio``
+    scales re-initialized encoder rows relative to the average live-row norm
+    (the reference's convention is 0.2, `huge_batch_size.py:240`; RESURRECT_r04
+    measures that transplant at the 32x flagship shape).
     """
     from sparse_coding__tpu.utils import precision as px
 
@@ -181,12 +185,14 @@ def train_big_batch(
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
             learning_rate, mesh, reinit_every, worst_k, resurrection_log,
+            encoder_norm_ratio,
         )
 
 
 def _train_big_batch(
     sig, init_hparams, dataset, batch_size, n_steps, key,
     learning_rate, mesh, reinit_every, worst_k, resurrection_log,
+    encoder_norm_ratio,
 ) -> Tuple[BigBatchState, Any]:
     k_init, key = jax.random.split(key)
     params, buffers = sig.init(k_init, **init_hparams)
@@ -231,7 +237,10 @@ def _train_big_batch(
         if reinit_every and (i + 1) % reinit_every == 0:
             worst_idx = worst.get_worst(n_feats)
             reps = dataset[np.resize(worst_idx, n_feats)]
-            state, n_dead = resurrect_dead_features(state, jnp.asarray(reps))
+            state, n_dead = resurrect_dead_features(
+                state, jnp.asarray(reps),
+                encoder_norm_ratio=encoder_norm_ratio,
+            )
             worst = WorstExamples(worst_k)
             if resurrection_log is not None:
                 resurrection_log.append((i + 1, n_dead))

@@ -70,6 +70,30 @@ def test_big_batch_resurrection(data):
     assert all(n >= 0 for _, n in log)
 
 
+def test_big_batch_norm_ratio_passthrough(monkeypatch, data):
+    """encoder_norm_ratio must reach resurrect_dead_features at every
+    resurrection event (feature deaths are dynamics-dependent, so the
+    passthrough is asserted with a spy rather than by engineering deaths)."""
+    import sparse_coding__tpu.train.big_batch as bb
+
+    seen = []
+    orig = bb.resurrect_dead_features
+
+    def spy(state, reps, **kw):
+        seen.append(kw.get("encoder_norm_ratio"))
+        return orig(state, reps, **kw)
+
+    monkeypatch.setattr(bb, "resurrect_dead_features", spy)
+    bb.train_big_batch(
+        FunctionalTiedSAE,
+        dict(activation_size=24, n_dict_components=48, l1_alpha=3e-3),
+        data, batch_size=256, n_steps=20,
+        key=jax.random.PRNGKey(5), reinit_every=10,
+        encoder_norm_ratio=1.5,
+    )
+    assert seen == [1.5, 1.5]
+
+
 def test_big_batch_compute_dtype_parity(data):
     """The bf16 policy changes matmul precision, not training viability:
     both arms reach a similar loss basin from the same key/batches."""
