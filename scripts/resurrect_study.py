@@ -56,6 +56,13 @@ def main():
         "(0.2 = the reference's convention)",
     )
     ap.add_argument(
+        "--l1-warmup-steps", type=int, default=None,
+        help="when set, the A/B becomes control vs l1-WARMUP (no "
+        "resurrection in either arm): ramp l1_alpha linearly over this many "
+        "steps — the anti-dead-feature lever LR_COLLAPSE r3 suggests, which "
+        "the reference does not have",
+    )
+    ap.add_argument(
         "--tag", type=str, default="",
         help="suffix for the artifact filename (e.g. 'nr1' -> "
         "RESURRECT_<round>_nr1.json), so variant runs don't overwrite "
@@ -95,6 +102,10 @@ def main():
         # a zero-norm re-init (with encoder_bias also reset to 0) closes the
         # ReLU gate forever: the arm would run 15-25 min and mean nothing
         ap.error("--norm-ratio must be > 0")
+    if args.l1_warmup_steps is not None and args.l1_warmup_steps < 1:
+        # <1 would select warmup mode but never ramp: a control-vs-control
+        # A/B silently labeled as a treatment
+        ap.error("--l1-warmup-steps must be >= 1")
     l1_alpha = 1e-3
     lr = 3e-4  # dictpar_run: 1e-3 collapses high-l1 members at this shape
     dead_eval_rows = 2048 if quick else 65536
@@ -120,6 +131,7 @@ def main():
             "sae_batch": sae_batch, "n_steps": n_steps, "lr": lr,
             "reinit_every": reinit_every, "dead_threshold": dead_threshold,
             "encoder_norm_ratio": args.norm_ratio,
+            "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
@@ -166,8 +178,17 @@ def main():
     init_hp = dict(
         activation_size=d_act, n_dict_components=n_dict, l1_alpha=l1_alpha
     )
+    # default A/B: control vs worst-example resurrection. With
+    # --l1-warmup-steps: control vs l1-warmup, no resurrection in either arm
+    # (arm spec = (name, reinit_every, l1_warmup_steps)).
+    if args.l1_warmup_steps is not None:
+        arm_specs = (
+            ("control", None, 0), ("l1_warmup", None, args.l1_warmup_steps)
+        )
+    else:
+        arm_specs = (("control", None, 0), ("resurrect", reinit_every, 0))
     arms = {}
-    for arm, reinit in (("control", None), ("resurrect", reinit_every)):
+    for arm, reinit, warmup in arm_specs:
         log: list = []
         t0 = time.time()
         state, sig = train_big_batch(
@@ -178,6 +199,7 @@ def main():
             compute_dtype=None if quick else jnp.bfloat16,
             resurrection_log=log,
             encoder_norm_ratio=args.norm_ratio,
+            l1_warmup_steps=warmup,
         )
         jax.block_until_ready(state.params["encoder"])
         train_s = time.time() - t0
@@ -203,8 +225,9 @@ def main():
               f"dead {n_dead}/{n_dict} ({arms[arm]['dead_fraction']:.1%}) "
               f"in {train_s:.0f}s")
     report["arms"] = arms
+    treatment = arm_specs[1][0]  # "resurrect" or "l1_warmup"
     report["dead_fraction_delta"] = round(
-        arms["control"]["dead_fraction"] - arms["resurrect"]["dead_fraction"], 4
+        arms["control"]["dead_fraction"] - arms[treatment]["dead_fraction"], 4
     )
     report["total_seconds"] = round(time.time() - t_start, 1)
 
@@ -221,13 +244,14 @@ def main():
     print(f"Wrote {json_path}")
 
     # sanity: both arms must train (FVU well below 1 — quick mode's 40-step
-    # random-init run only checks finiteness); the resurrect arm's events
-    # must actually have fired
+    # random-init run only checks finiteness); in resurrection mode the
+    # treatment arm's events must actually have fired
     for arm in arms.values():
         assert np.isfinite(arm["fvu"]), arm
         if not quick:
             assert arm["fvu"] < 0.9, arm
-    assert arms["resurrect"]["resurrection_events"], "no resurrection fired"
+    if treatment == "resurrect":
+        assert arms["resurrect"]["resurrection_events"], "no resurrection fired"
     return report
 
 

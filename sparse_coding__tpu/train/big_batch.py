@@ -59,17 +59,33 @@ class WorstExamples:
         return self.indices[: min(n, self.k)]
 
 
-def make_big_batch_step(sig, tx: optax.GradientTransformation):
+def make_big_batch_step(
+    sig, tx: optax.GradientTransformation, l1_warmup_steps: int = 0
+):
     """Fused single-model step: grads + optimizer + code-activity totals.
     Data parallelism comes from the CALLER placing the batch with a "data"-axis
     sharding (`train_big_batch` does) — the jitted step then partitions and
-    XLA inserts the gradient psum."""
+    XLA inserts the gradient psum.
+
+    ``l1_warmup_steps > 0`` ramps the ``l1_alpha`` buffer linearly from ~0 to
+    its configured value over that many steps (a trace-time branch — the ramp
+    is computed from ``state.step`` inside the jit, so one compiled program
+    serves the whole schedule). Rationale: the round-3 LR_COLLAPSE study
+    showed the l1-pressure x Adam-lr dynamic kills features fastest at the
+    START of training, when reconstruction gradients are weakest; the
+    reference has no equivalent knob."""
 
     grad_fn = jax.grad(sig.loss, has_aux=True)
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: BigBatchState, batch: jax.Array):
-        grads, (loss_dict, aux) = grad_fn(state.params, state.buffers, batch)
+        buffers = state.buffers
+        if l1_warmup_steps > 0 and "l1_alpha" in buffers:
+            ramp = jnp.minimum(
+                (state.step.astype(jnp.float32) + 1.0) / l1_warmup_steps, 1.0
+            )
+            buffers = {**buffers, "l1_alpha": buffers["l1_alpha"] * ramp}
+        grads, (loss_dict, aux) = grad_fn(state.params, buffers, batch)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         c = aux["c"]
@@ -167,6 +183,7 @@ def train_big_batch(
     compute_dtype=None,
     resurrection_log: Optional[list] = None,
     encoder_norm_ratio: float = 0.2,
+    l1_warmup_steps: int = 0,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
     resurrection. Returns (final state, sig) for `to_learned_dict` export.
@@ -177,7 +194,8 @@ def train_big_batch(
     ``(step, n_dead)`` tuple per resurrection event. ``encoder_norm_ratio``
     scales re-initialized encoder rows relative to the average live-row norm
     (the reference's convention is 0.2, `huge_batch_size.py:240`; RESURRECT_r04
-    measures that transplant at the 32x flagship shape).
+    measures that transplant at the 32x flagship shape). ``l1_warmup_steps``
+    linearly ramps l1 pressure from ~0 (see `make_big_batch_step`).
     """
     from sparse_coding__tpu.utils import precision as px
 
@@ -185,14 +203,14 @@ def train_big_batch(
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
             learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-            encoder_norm_ratio,
+            encoder_norm_ratio, l1_warmup_steps,
         )
 
 
 def _train_big_batch(
     sig, init_hparams, dataset, batch_size, n_steps, key,
     learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-    encoder_norm_ratio,
+    encoder_norm_ratio, l1_warmup_steps,
 ) -> Tuple[BigBatchState, Any]:
     k_init, key = jax.random.split(key)
     params, buffers = sig.init(k_init, **init_hparams)
@@ -216,7 +234,7 @@ def _train_big_batch(
             sig_exec = sig
     else:
         sig_exec = sig
-    step_fn = make_big_batch_step(sig_exec, tx)
+    step_fn = make_big_batch_step(sig_exec, tx, l1_warmup_steps=l1_warmup_steps)
     mse_fn = jax.jit(partial(per_example_mse_from_codes, sig))
 
     worst = WorstExamples(worst_k)

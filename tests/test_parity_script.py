@@ -149,3 +149,24 @@ def test_resurrect_study_quick(tmp_path):
     for arm in arms.values():
         assert arm["n_feats"] == report["config"]["n_dict"]
         assert 0 <= arm["n_dead"] <= arm["n_feats"]
+
+
+@pytest.mark.slow
+def test_resurrect_study_warmup_quick(tmp_path):
+    """--l1-warmup-steps switches the A/B to control vs l1-warmup (no
+    resurrection in either arm) and tags the artifact."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "resurrect_study.py"),
+         "--quick", "--l1-warmup-steps", "20", "--tag", "warm",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PARITY_ROUND": ROUND},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(
+        (tmp_path / f"RESURRECT_{ROUND}_warm_quick.json").read_text()
+    )
+    assert set(report["arms"]) == {"control", "l1_warmup"}
+    assert report["config"]["l1_warmup_steps"] == 20
+    for arm in report["arms"].values():
+        assert not arm["resurrection_events"]

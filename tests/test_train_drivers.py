@@ -94,6 +94,32 @@ def test_big_batch_norm_ratio_passthrough(monkeypatch, data):
     assert seen == [1.5, 1.5]
 
 
+def test_big_batch_l1_warmup_ramps(data):
+    """Early in a long warmup the effective l1 is ~0, so codes must be denser
+    (and reconstruction better) than an identically-keyed control trained
+    under full l1 pressure from step 0; the stored buffer keeps the
+    CONFIGURED l1 (the ramp is step-local, recomputed inside the jit)."""
+    l1 = 5e-2  # strong enough that 30 full-pressure steps visibly sparsify
+    kw = dict(
+        init_hparams=dict(activation_size=24, n_dict_components=96, l1_alpha=l1),
+        dataset=data, batch_size=256, n_steps=30,
+        key=jax.random.PRNGKey(7), reinit_every=None,
+    )
+    s_warm, sig = train_big_batch(FunctionalTiedSAE, l1_warmup_steps=300, **kw)
+    s_ctrl, _ = train_big_batch(FunctionalTiedSAE, **kw)
+    ld_w = sig.to_learned_dict(s_warm.params, s_warm.buffers)
+    ld_c = sig.to_learned_dict(s_ctrl.params, s_ctrl.buffers)
+    x = data[:512]
+    l0_w = float((np.asarray(ld_w.encode(x)) != 0).sum(-1).mean())
+    l0_c = float((np.asarray(ld_c.encode(x)) != 0).sum(-1).mean())
+    mse_w = float(((ld_w.predict(x) - x) ** 2).mean())
+    mse_c = float(((ld_c.predict(x) - x) ** 2).mean())
+    assert l0_w > l0_c, (l0_w, l0_c)
+    assert mse_w < mse_c, (mse_w, mse_c)
+    # ramp must not leak into the exported/stored l1
+    assert abs(float(s_warm.buffers["l1_alpha"]) - l1) < 1e-8
+
+
 def test_big_batch_compute_dtype_parity(data):
     """The bf16 policy changes matmul precision, not training viability:
     both arms reach a similar loss basin from the same key/batches."""
