@@ -1,0 +1,205 @@
+"""Batch-scaling MFU study for the flagship fused ensemble step (VERDICT r4
+next #1): close or kill the >=3x/chip question.
+
+Why batch is the lever: THROUGHPUT §r4c showed the fused step sits within
+~10% of its combined roofline at batch 2048 — 1.744 ms of MXU floor plus a
+~340-406 MB/step parameter/Adam stream that is BATCH-INVARIANT. Doubling the
+batch doubles the MXU work per step but leaves the stream fixed, so modeled
+MFU rises from ~0.70 (b2048) toward ~0.9+ (b16384). The bwd kernel keeps the
+whole batch VMEM-resident and caps out near 3k rows; batches beyond that run
+the micro-batch gradient-accumulation path (`ensemble.make_ensemble_step`,
+exact mean-of-micro-grads under one scan).
+
+Protocol (VERDICT r4 weak #1/#7): every (batch, arm) point AND a pinned
+control program (fixed 8192^3 bf16 matmul) are measured in ROUNDS interleaved
+round-robin windows; medians + [min, max] spreads are reported. The control
+isolates chip weather: a session where the control runs k% slow scales every
+other key's expectation by the same k%, so a regression is a point that moves
+AGAINST the control, not with it.
+
+Each window consumes the same number of activation rows (ROWS_PER_WINDOW)
+regardless of batch size, so windows are comparable wall-clock units.
+
+Run: `python scripts/batch_scaling.py` (real chip, ~10-20 min; writes
+BATCHSCALE_<round>.json at the repo root). `--quick` smoke-runs tiny shapes
+on CPU (same code path, meaningless numbers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r05")
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+N_MODELS, D_ACT, N_DICT = 8, 512, 4096
+A100_BASELINE_ACTS_PER_SEC = 0.78e6  # bench.py's analytic A100 estimate
+TPU_PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0,
+}
+
+
+def median_spread(vals):
+    vals = sorted(float(v) for v in vals)
+    return statistics.median(vals), [vals[0], vals[-1]]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true", help="CPU-sized smoke run")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--out", default=None, help="output directory (default repo root)")
+    args = ap.parse_args(argv)
+
+    from sparse_coding__tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    quick = args.quick
+    d_act, n_dict, n_models = (64, 256, 2) if quick else (D_ACT, N_DICT, N_MODELS)
+    batch_sizes = [256, 512] if quick else [2048, 4096, 8192, 16384]
+    rows_per_window = 4096 if quick else 2048 * 128  # bench.py's window size / 3
+    dev = jax.devices()[0].device_kind
+    peak = TPU_PEAK_TFLOPS.get(dev, 197.0)
+    flops_per_act = n_models * 5 * 2 * d_act * n_dict
+
+    # -- pinned control: fixed bf16 matmul, ~1.1 TFLOP -----------------------
+    S = 512 if quick else 8192
+    a = jax.random.normal(jax.random.PRNGKey(0), (S, S), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (S, S), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: (a @ b).sum(dtype=jnp.float32))
+    ctl_reps = 3 if quick else 8
+    jax.device_get(mm(a, b))  # compile
+
+    def measure_control() -> float:
+        t0 = time.perf_counter()
+        for _ in range(ctl_reps):
+            out = mm(a, b)
+        jax.device_get(out)
+        return ctl_reps * 2 * S**3 / (time.perf_counter() - t0) / 1e12
+
+    # -- ensemble arms -------------------------------------------------------
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.standard_normal((rows_per_window, d_act), dtype=np.float32)
+    ).astype(jnp.bfloat16)
+
+    arms = {}
+
+    def make_arm(batch, fused):
+        ens = build_ensemble(
+            FunctionalTiedSAE,
+            jax.random.PRNGKey(2),
+            [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(n_models)],
+            # bf16 mu: the bench headline's configuration (THROUGHPUT r4c)
+            optimizer_kwargs={"learning_rate": 1e-3, "mu_dtype": "bfloat16"},
+            activation_size=d_act,
+            n_dict_components=n_dict,
+            compute_dtype=jnp.bfloat16,
+        )
+        ens.fused = bool(fused)
+        ens._build_steps(donate=True)
+        k = rows_per_window // batch
+        batches = data[: k * batch].reshape(k, batch, d_act)
+        jax.device_get(ens.step_scan(batches)["loss"])  # compile + warm
+
+        def measure() -> float:
+            t0 = time.perf_counter()
+            losses = ens.step_scan(batches)
+            jax.device_get(losses["loss"])
+            return k * batch / (time.perf_counter() - t0)
+
+        return measure
+
+    from sparse_coding__tpu.ops.tied_sae_kernel import on_tpu
+
+    for batch in batch_sizes:
+        if on_tpu():
+            arms[f"fused_b{batch}"] = make_arm(batch, fused=True)
+        arms[f"xla_b{batch}"] = make_arm(batch, fused=False)
+
+    # -- interleaved measurement --------------------------------------------
+    rounds = max(2, args.rounds)
+    samples = {k: [] for k in ["control_matmul_tflops", *arms]}
+    for _ in range(rounds):
+        samples["control_matmul_tflops"].append(measure_control())
+        for k, m in arms.items():
+            samples[k].append(m())
+
+    ctl_med, ctl_spread = median_spread(samples["control_matmul_tflops"])
+    report = {
+        "config": {
+            "workload": f"{n_models}x tied-SAE {d_act}->{n_dict}, bf16+bf16mu, "
+            f"scan over {rows_per_window} rows/window",
+            "batch_sizes": batch_sizes,
+            "rounds": rounds,
+            "device": dev,
+            "peak_tflops_bf16": peak,
+            "flops_per_act": flops_per_act,
+            "a100_baseline_acts_per_sec": A100_BASELINE_ACTS_PER_SEC,
+        },
+        "control": {
+            "what": f"pinned {S}^3 bf16 matmul, x{ctl_reps} per window",
+            "tflops": round(ctl_med, 1),
+            "tflops_spread": [round(v, 1) for v in ctl_spread],
+            "mxu_fraction_of_peak": round(ctl_med / peak, 3),
+        },
+        "points": [],
+    }
+    for k in arms:
+        med, spread = median_spread(samples[k])
+        mfu = med * flops_per_act / (peak * 1e12)
+        report["points"].append(
+            {
+                "arm": k,
+                "acts_per_sec": round(med, 1),
+                "spread": [round(v, 1) for v in spread],
+                "mfu": round(mfu, 3),
+                "vs_a100_baseline": round(med / A100_BASELINE_ACTS_PER_SEC, 3),
+                # weather-corrected MFU: scale by how far the pinned control
+                # sat below its own typical fraction of peak this session
+                "mfu_over_control_fraction": round(mfu / (ctl_med / peak), 3),
+            }
+        )
+        print(json.dumps(report["points"][-1]))
+
+    best = max(report["points"], key=lambda p: p["mfu"])
+    report["conclusion"] = {
+        "best_arm": best["arm"],
+        "best_mfu": best["mfu"],
+        "best_vs_a100": best["vs_a100_baseline"],
+        "note": (
+            "mfu >= 0.80 at some batch => the v5p >=3x projection in "
+            "SCALEOUT_r04.json is within reach; otherwise the >=3x/chip "
+            "target is refuted on this silicon with this curve as evidence"
+        ),
+    }
+
+    out_prefix = Path(args.out) if args.out else REPO
+    out_prefix.mkdir(parents=True, exist_ok=True)
+    path = out_prefix / f"BATCHSCALE_{ROUND_TAG}{'_quick' if quick else ''}.json"
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"Wrote {path}")
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -176,6 +176,13 @@ def main(argv=None):
         "--max-epochs", type=int, default=None,
         help="override the plateau-training epoch cap",
     )
+    ap.add_argument(
+        "--l1-warmup-steps", type=int, default=0,
+        help="ramp l1_alpha from ~0 over this many steps in every ensemble "
+        "(ensemble.make_ensemble_step) — the anti-collapse lever for the "
+        "32x dict's low-l1 dead-fraction (VERDICT r4 next #2; proven at "
+        "this shape in RESURRECT_r04_warmup*.json)",
+    )
     args = ap.parse_args(argv)
     if args.max_epochs is not None and args.max_epochs < 1:
         ap.error("--max-epochs must be >= 1")
@@ -226,7 +233,7 @@ def main(argv=None):
     print(f"Building subject model (pythia-410m geometry, d={d_act})...")
     lm_cfg, params = build_subject_model(quick)
 
-    from parity_run import corpus_tokens, maybe_pretrain
+    from parity_run import SUBJECT_CAVEAT, corpus_tokens, maybe_pretrain
 
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
     params, lang, pretrain_stats = maybe_pretrain(
@@ -260,8 +267,10 @@ def main(argv=None):
             "l1_alpha_grid": grid, "sae_batch": sae_batch,
             "max_epochs": max_epochs, "plateau_tol": plateau_tol,
             "seeds": list(seeds),
+            "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
+        "subject_caveat": SUBJECT_CAVEAT,
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
         "notes": (
             f"{'trigram-pretrained' if lang is not None else 'random-init'} "
@@ -344,6 +353,7 @@ def main(argv=None):
             optimizer_kwargs={"learning_rate": lr},
             compute_dtype=None if quick else jnp.bfloat16,
             activation_size=d_act, n_dict_components=n_dict,
+            l1_warmup_steps=args.l1_warmup_steps,
         )
         # the VMEM gate must refuse the fused kernel at 32x overcomplete
         # and route to the XLA path (the whole point of the gate)

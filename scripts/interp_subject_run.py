@@ -100,6 +100,16 @@ def main(argv=None):
             "client": "TokenLexiconClient (deterministic offline)",
             "device": jax.devices()[0].device_kind,
         },
+        # VERDICT r4 next #8: the offline-proxy caveat at the artifact level
+        "subject_caveat": (
+            "Subject is a trigram-pretrained synthetic-language LM (zero-"
+            "egress image) and the scorer is the offline TokenLexiconClient "
+            "proxy — these scores are NOT comparable to the reference's "
+            "GPT-4-explain/davinci-simulate numbers (interpret.py:334-358); "
+            "they demonstrate the pipeline and the SAE-vs-baseline ordering "
+            "only. Run interp with OpenAIClient on a networked machine for "
+            "comparable scores."
+        ),
         "pretrain": pretrain_stats,
     }
 

@@ -31,7 +31,18 @@ from pathlib import Path
 import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
-ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")  # artifact round tag
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r05")  # artifact round tag
+
+# VERDICT r4 next #8: every artifact carries the evidentiary caveat at the
+# data level, not just in prose docs.
+SUBJECT_CAVEAT = (
+    "All numbers measured on a trigram-pretrained synthetic-language subject "
+    "(zero-egress image: no real pretrained weights downloadable). "
+    "FVU/MMCS/perplexity separations here are necessary but not sufficient "
+    "for parity on real LM activation distributions; run "
+    "scripts/real_subject_run.py on a networked machine for the real-weights "
+    "version of this artifact."
+)
 
 
 if str(REPO) not in sys.path:
@@ -225,7 +236,8 @@ def run_basic(args):
             "l1_alpha": l1_alpha, "sae_batch": sae_batch,
             "fista_iters": fista_iters, "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
-        }
+        },
+        "subject_caveat": SUBJECT_CAVEAT,
     }
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
@@ -342,6 +354,12 @@ def main(argv=None):
         help="override the config's plateau-training epoch cap",
     )
     ap.add_argument(
+        "--l1-warmup-steps", type=int, default=0,
+        help="ramp l1_alpha from ~0 over this many steps in every l1-family "
+        "ensemble (ensemble.make_ensemble_step; ignored for topk grids). "
+        "The anti-collapse lever proven in RESURRECT_r04_warmup*.json",
+    )
+    ap.add_argument(
         "--topk-recall", type=float, default=None,
         help="approx_max_k recall_target for the topk config "
         "(default: TopKEncoderApprox.RECALL)",
@@ -396,7 +414,11 @@ def main(argv=None):
         # the reference's sparsity_levels span 1..151 (`:234`); a denser k
         # than ~150 needs far more training than a parity run's budget
         grid = [2, 8] if quick else [1, 11, 31, 61, 91, 121, 151]
-        ratio, max_epochs = (2, 1) if quick else (16, 12)
+        # r4 (FVU-only criterion, --max-epochs 33) plateaued at 31/33 epochs
+        # with cross-seed MMCS still rising 0.25→0.33; the joint FVU+MMCS
+        # criterion needs headroom beyond that to settle data-bound vs
+        # intrinsic (VERDICT r4 next #5) — 60 is ~2x the r4 budget
+        ratio, max_epochs = (2, 1) if quick else (16, 60)
         hp_name, arch = "sparsity", "gpt2"
         cap = int(max(grid))
         recall_kw = {} if args.topk_recall is None else {"recall": args.topk_recall}
@@ -465,8 +487,10 @@ def main(argv=None):
             f"{hp_name}_grid": [mk_hp(a)[hp_name] for a in grid],
             "sae_batch": sae_batch, "max_epochs": max_epochs,
             "plateau_tol": plateau_tol, "seeds": list(seeds),
+            "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
-        }
+        },
+        "subject_caveat": SUBJECT_CAVEAT,
     }
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
@@ -520,84 +544,146 @@ def main(argv=None):
     total_rows_consumed = 0
     total_train_wall = 0.0
     t0 = time.time()
+    # r5 convergence protocol (VERDICT r4 next #5): the two seed replicas of
+    # each family train IN LOCKSTEP, one epoch at a time, so the cross-seed
+    # MMCS trajectory is measurable per epoch; "trained to plateau" now
+    # requires BOTH the held-out FVU (per seed, rel tol `plateau_tol`, 2
+    # consecutive epochs) AND the cross-seed mean MMCS (abs tol
+    # `mmcs_plateau_tol`, 2 consecutive epochs) to flatten — FVU-only
+    # plateaus could not establish that feature identifiability had stopped
+    # rising (the r4 topk question this answers).
+    mmcs_plateau_tol = 0.005
     for fam, (sig, size_kw) in families.items():
-        for seed in seeds:
-            ens = build_ensemble(
+        enss = {
+            seed: build_ensemble(
                 sig, jax.random.PRNGKey(seed),
                 [mk_hp(v) for v in grid],
                 optimizer_kwargs={"learning_rate": 1e-3},
                 compute_dtype=None if quick else jnp.bfloat16,
+                l1_warmup_steps=(
+                    args.l1_warmup_steps if "l1_alpha" in mk_hp(grid[0]) else 0
+                ),
                 **size_kw,
             )
-            losses_first = losses_last = None
-            key = jax.random.PRNGKey(100 + seed)
-            traj = []
-            prev = None
-            stall = diverge = 0
-            consumed = 0
-            t_train = 0.0
-            for epoch in range(max_epochs):
+            for seed in seeds
+        }
+        st = {
+            seed: dict(
+                key=jax.random.PRNGKey(100 + seed), losses_first=None,
+                losses_last=None, traj=[], prev=None, stall=0, diverge=0,
+                fvu_plateau_epoch=None, consumed=0, t_train=0.0,
+            )
+            for seed in seeds
+        }
+        mmcs_traj = []
+        mmcs_prev, mmcs_stall = None, 0
+        for epoch in range(max_epochs):
+            for seed in seeds:
+                s = st[seed]
                 te = time.time()
                 for chunk in train_chunks:
-                    key, k = jax.random.split(key)
+                    s["key"], k = jax.random.split(s["key"])
                     losses = ensemble_train_loop(
-                        ens, chunk, batch_size=sae_batch, key=k,
+                        enss[seed], chunk, batch_size=sae_batch, key=k,
                         fista_iters=fista_iters,
                     )
-                    if losses_first is None:
-                        losses_first = np.asarray(jax.device_get(losses["loss"]))
-                losses_last = np.asarray(jax.device_get(losses["loss"]))  # fence
-                t_train += time.time() - te
-                consumed += n_train_rows
+                    if s["losses_first"] is None:
+                        s["losses_first"] = np.asarray(jax.device_get(losses["loss"]))
+                s["losses_last"] = np.asarray(jax.device_get(losses["loss"]))  # fence
+                s["t_train"] += time.time() - te
+                s["consumed"] += n_train_rows
                 # held-out FVU probe: the plateau criterion and the recorded
                 # trajectory (VERDICT r3 next #1a); one vmapped eval dispatch
                 # for the whole stack (P4 fan-out), not a per-member loop
+                s["dicts"] = enss[seed].to_learned_dicts()  # reused by MMCS below
                 fvus = [
                     float(r["fvu"])
-                    for r in sm.evaluate_dicts(ens.to_learned_dicts(), eval_chunk)
+                    for r in sm.evaluate_dicts(s["dicts"], eval_chunk)
                 ]
                 cur = float(np.mean(fvus))
-                traj.append(
+                s["traj"].append(
                     {"epoch": epoch, "mean_fvu": round(cur, 5),
                      "fvu": [round(f, 5) for f in fvus]}
                 )
-                if prev is not None:
-                    delta = prev - cur  # positive = improvement
-                    if delta < -plateau_tol * prev:
-                        diverge += 1
-                        stall = 0
-                    elif delta < plateau_tol * prev:
-                        stall += 1
-                        diverge = 0
+                if s["prev"] is not None:
+                    delta = s["prev"] - cur  # positive = improvement
+                    if delta < -plateau_tol * s["prev"]:
+                        s["diverge"] += 1
+                        s["stall"] = 0
+                    elif delta < plateau_tol * s["prev"]:
+                        s["stall"] += 1
+                        s["diverge"] = 0
                     else:
-                        stall = diverge = 0
-                prev = cur
-                if stall >= 2 or diverge >= 2:
-                    break
-            ensembles[(fam, seed)] = ens
-            total_rows_consumed += consumed
-            total_train_wall += t_train
+                        s["stall"] = s["diverge"] = 0
+                s["prev"] = cur
+                if s["stall"] >= 2 and s["fvu_plateau_epoch"] is None:
+                    s["fvu_plateau_epoch"] = epoch
+            # cross-seed MMCS, per grid point + mean, every epoch (dict
+            # stacks reused from this epoch's FVU probe)
+            mm = [
+                float(sm.mmcs(a, b))
+                for a, b in zip(st[seeds[0]]["dicts"], st[seeds[1]]["dicts"])
+            ]
+            mmean = float(np.mean(mm))
+            mmcs_traj.append(
+                {"epoch": epoch, "mean_mmcs": round(mmean, 4),
+                 "mmcs": [round(v, 4) for v in mm]}
+            )
+            if mmcs_prev is not None and abs(mmean - mmcs_prev) < mmcs_plateau_tol:
+                mmcs_stall += 1
+            elif mmcs_prev is not None:
+                mmcs_stall = 0
+            mmcs_prev = mmean
+            fvu_done = all(s["stall"] >= 2 for s in st.values())
+            diverged = any(s["diverge"] >= 2 for s in st.values())
+            if (fvu_done and mmcs_stall >= 2) or diverged:
+                break
+        for seed in seeds:
+            s = st[seed]
+            ensembles[(fam, seed)] = enss[seed]
+            total_rows_consumed += s["consumed"]
+            total_train_wall += s["t_train"]
             report[f"train_{tag(fam, seed)}"] = {
-                "loss_first_chunk": [float(x) for x in losses_first],
-                "loss_last_chunk": [float(x) for x in losses_last],
-                "epochs_run": len(traj),
-                "plateau_reached": bool(stall >= 2),
-                "diverged": bool(diverge >= 2),
-                "rows_consumed": int(consumed),
-                "train_seconds": round(t_train, 1),
+                "loss_first_chunk": [float(x) for x in s["losses_first"]],
+                "loss_last_chunk": [float(x) for x in s["losses_last"]],
+                "epochs_run": len(s["traj"]),
+                # "ever formally plateaued" — consistent with
+                # fvu_plateau_epoch under the lockstep protocol, where a
+                # seed can keep training (and its stall counter reset) while
+                # waiting on the other seed / the MMCS criterion
+                "plateau_reached": s["fvu_plateau_epoch"] is not None,
+                "fvu_plateau_epoch": s["fvu_plateau_epoch"],
+                "diverged": bool(s["diverge"] >= 2),
+                "rows_consumed": int(s["consumed"]),
+                "train_seconds": round(s["t_train"], 1),
                 # includes the first epoch's compile: the honest whole-run
                 # number; `steady_state` below isolates the compiled rate
                 "sustained_rows_per_sec": (
-                    round(consumed / t_train, 1) if t_train > 0 else None
+                    round(s["consumed"] / s["t_train"], 1) if s["t_train"] > 0 else None
                 ),
-                "fvu_trajectory": traj,
+                "fvu_trajectory": s["traj"],
             }
             print(
-                f"  {tag(fam, seed)}: {len(traj)} epochs, "
-                f"{consumed:,} rows, mean FVU "
-                f"{traj[0]['mean_fvu']:.4f} -> {traj[-1]['mean_fvu']:.4f}"
-                f"{' (plateau)' if stall >= 2 else ''}"
+                f"  {tag(fam, seed)}: {len(s['traj'])} epochs, "
+                f"{s['consumed']:,} rows, mean FVU "
+                f"{s['traj'][0]['mean_fvu']:.4f} -> {s['traj'][-1]['mean_fvu']:.4f}"
+                f"{' (plateau)' if s['fvu_plateau_epoch'] is not None else ''}"
             )
+        report[f"mmcs_trajectory{('_' + fam) if fam else ''}"] = {
+            "values": mmcs_traj,
+            "plateau_reached": bool(mmcs_stall >= 2),
+            "plateau_tol_abs": mmcs_plateau_tol,
+            "note": (
+                "cross-seed mean MMCS per epoch; training stops only when "
+                "both seeds' held-out FVU AND this trajectory flatten"
+            ),
+        }
+        print(
+            f"  mmcs[{fam or 'default'}]: "
+            f"{mmcs_traj[0]['mean_mmcs']:.3f} -> {mmcs_traj[-1]['mean_mmcs']:.3f}"
+            f" over {len(mmcs_traj)} epochs"
+            f"{' (plateau)' if mmcs_stall >= 2 else ' (STILL RISING at cap)'}"
+        )
     report["train_seconds"] = round(time.time() - t0, 1)
     report["sustained_acts_per_sec_all_ensembles"] = (
         round(total_rows_consumed / total_train_wall, 1) if total_train_wall else None
@@ -622,6 +708,7 @@ def main(argv=None):
         compute_dtype=None if quick else jnp.bfloat16,
         **probe_kw,
     )
+    key = jax.random.PRNGKey(4242)
     key, k = jax.random.split(key)
     jax.device_get(ensemble_train_loop(  # warm: any residual compiles
         probe, train_chunks[0], batch_size=sae_batch, key=k,

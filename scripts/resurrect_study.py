@@ -68,14 +68,24 @@ def main():
         "RESURRECT_<round>_nr1.json), so variant runs don't overwrite "
         "the main A/B",
     )
-    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument(
+        "--out", type=str, default=None,
+        help="output DIRECTORY for the RESURRECT_<round>.json artifact "
+        "(default: repo root); created if missing",
+    )
     args = ap.parse_args()
+    if args.out and (Path(args.out).is_file() or Path(args.out).suffix == ".json"):
+        # ADVICE r4: `--out RESURRECT.json` would otherwise mkdir a directory
+        # of that name (the flag names a directory, not the artifact file) —
+        # and it must fail HERE, not after a 15-25 min chip run. The suffix
+        # check catches the common not-yet-existing `--out FOO.json` case.
+        ap.error(f"--out must be a directory, got {args.out}")
 
     import jax
     import jax.numpy as jnp
 
     from dictpar_run import build_subject_model, subject_geometry
-    from parity_run import corpus_tokens, maybe_pretrain
+    from parity_run import SUBJECT_CAVEAT, corpus_tokens, maybe_pretrain
     from sparse_coding__tpu import metrics as sm
     from sparse_coding__tpu.data.activations import harvest_to_device
     from sparse_coding__tpu.models import FunctionalTiedSAE
@@ -134,6 +144,7 @@ def main():
             "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
+        "subject_caveat": SUBJECT_CAVEAT,
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
     }
 

@@ -88,6 +88,37 @@ def optim_str_to_func(optim_str: str) -> Callable[..., optax.GradientTransformat
     raise ValueError(f"Unknown optimizer string: {optim_str}")
 
 
+def l1_warmup_buffers(buffers: Pytree, step: jax.Array, warmup_steps: int, sig=None):
+    """THE l1-warmup schedule: return ``buffers`` with ``l1_alpha`` scaled by
+    a linear ramp from ~0 to 1 over ``warmup_steps`` steps of ``step`` (a
+    traced scalar — the ramp is computed inside the jit, so one compiled
+    program serves the whole schedule). ``warmup_steps <= 0`` is the identity.
+
+    Raises when the buffers have no ``l1_alpha`` key: a silent no-op would
+    hand the caller an unflagged control run (ADVICE r4). Shared by the
+    ensemble step and `train.big_batch` so the schedule and the error policy
+    exist exactly once.
+
+    Rationale: the l1-pressure x Adam-lr dynamic kills features fastest at
+    the START of training, when reconstruction gradients are weakest
+    (LR_COLLAPSE_r03); ramping the pressure in is measured to cut dead
+    features at zero FVU cost where the reference's worst-example
+    resurrection (`huge_batch_size.py:224-254`) is net-negative
+    (RESURRECT_r04*.json). The reference has no equivalent knob.
+    """
+    if warmup_steps <= 0:
+        return buffers
+    if "l1_alpha" not in buffers:
+        name = getattr(sig, "__name__", sig)
+        raise ValueError(
+            f"l1_warmup_steps={warmup_steps} but {name} buffers have no "
+            f"'l1_alpha' key ({sorted(buffers)}); warmup would silently be "
+            "a no-op — drop the flag for this signature"
+        )
+    ramp = jnp.minimum((step.astype(jnp.float32) + 1.0) / warmup_steps, 1.0)
+    return {**buffers, "l1_alpha": buffers["l1_alpha"] * ramp}
+
+
 def stack_pytrees(trees: Sequence[Pytree]) -> Pytree:
     """Stack a list of identically-shaped pytrees along a new leading axis.
 
@@ -128,6 +159,7 @@ def make_ensemble_step(
     compute_dtype=None,
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
+    l1_warmup_steps: int = 0,
 ) -> Callable:
     """Build the fused train step for a stacked ensemble.
 
@@ -151,6 +183,16 @@ def make_ensemble_step(
       fused_adam: dict(lr, b1, b2, eps) — additionally run the optimizer
         update inside the kernel (`fused_adam_step`); only valid when `tx`
         IS optax.adam with those exact constants.
+      l1_warmup_steps: > 0 ramps every member's ``l1_alpha`` buffer linearly
+        from ~0 to its configured value over that many steps, computed from
+        ``state.step`` inside the trace (one compiled program serves the whole
+        schedule; resume keeps the ramp phase because ``step`` is part of the
+        checkpointed state). Same mechanism as `train.big_batch`'s warmup,
+        promoted into the ensemble/sweep path (VERDICT r4 next #2) because it
+        measurably cuts dead features at zero FVU cost where the reference's
+        worst-example resurrection (`huge_batch_size.py:224-254`) is
+        net-negative (RESURRECT_r04*.json). The stored buffers are never
+        mutated — only the loss sees the ramped value.
     """
 
     grad_fn = jax.grad(sig.loss, has_aux=True)
@@ -167,6 +209,9 @@ def make_ensemble_step(
         # `px.compute` is a trace-time policy: it runs while jit traces this
         # body, so the chosen precision is baked into the compiled program.
         with px.compute(compute_dtype):
+            exec_buffers = l1_warmup_buffers(
+                state.buffers, state.step, l1_warmup_steps, sig
+            )
             # Fused Pallas path: one kernel launch for the whole stack (the
             # model axis is a grid dim — vmapping the kernel would serialize
             # it). Static trace-time condition; shared-batch only.
@@ -190,10 +235,77 @@ def make_ensemble_step(
                     )
                 )
             )
+            # Large-batch fused path: when the batch exceeds the bwd kernel's
+            # VMEM-resident limit (~3k rows at the bench shape), split it
+            # into the largest supported micro-batch and accumulate exact
+            # gradients under one `lax.scan` — mean-of-micro-grads IS the
+            # full-batch gradient (equal micro sizes; every loss term is a
+            # per-example mean). One optimizer update per call, so the
+            # semantics stay "one step on this batch". This is the lever
+            # that amortizes the batch-invariant ~400 MB/step param/Adam
+            # stream (THROUGHPUT §r4c) at batch 4096+ (BATCHSCALE_r05).
+            fused_accum_micro = None
+            if (
+                not fused_ok
+                and fused
+                and not per_model_batch
+                and not unstacked
+                and hasattr(sig, "fused_grads_stacked")
+                and hasattr(sig, "fused_batch_supported")
+            ):
+                for cand in (4096, 2048, 1024, 512, 256):
+                    if (
+                        cand < batch.shape[0]
+                        and batch.shape[0] % cand == 0
+                        and sig.fused_batch_supported(
+                            state.params, cand, adam_fused=False
+                        )
+                    ):
+                        fused_accum_micro = cand
+                        break
+            if fused_accum_micro is not None:
+                n_micro = batch.shape[0] // fused_accum_micro
+                micros = batch.reshape(
+                    (n_micro, fused_accum_micro) + batch.shape[1:]
+                )
+                g_shape, l_shape = jax.eval_shape(
+                    lambda p, bu, xb: sig.fused_grads_stacked(p, bu, xb),
+                    state.params, exec_buffers, micros[0],
+                )
+                zeros = lambda tree: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), tree
+                )
+
+                def acc_body(carry, xb):
+                    g_acc, l_acc = carry
+                    g, l = sig.fused_grads_stacked(state.params, exec_buffers, xb)
+                    return (
+                        jax.tree.map(jnp.add, g_acc, g),
+                        jax.tree.map(jnp.add, l_acc, l),
+                    ), None
+
+                (g_sum, l_sum), _ = jax.lax.scan(
+                    acc_body, (zeros(g_shape), zeros(l_shape)), micros
+                )
+                grads = jax.tree.map(lambda x: x / n_micro, g_sum)
+                loss_dict = jax.tree.map(lambda x: x / n_micro, l_sum)
+                updates, opt_state = jax.vmap(tx.update)(
+                    grads, state.opt_state, state.params
+                )
+                params = optax.apply_updates(state.params, updates)
+                return (
+                    EnsembleState(
+                        params=params,
+                        buffers=state.buffers,
+                        opt_state=opt_state,
+                        step=state.step + 1,
+                    ),
+                    (loss_dict, {}),
+                )
             if fused_ok:
                 if fused_adam is not None and hasattr(sig, "fused_adam_step"):
                     params, opt_state, loss_dict = sig.fused_adam_step(
-                        state.params, state.buffers, batch, state.opt_state, **fused_adam
+                        state.params, exec_buffers, batch, state.opt_state, **fused_adam
                     )
                     return (
                         EnsembleState(
@@ -204,7 +316,7 @@ def make_ensemble_step(
                         ),
                         (loss_dict, {}),
                     )
-                grads, loss_dict = sig.fused_grads_stacked(state.params, state.buffers, batch)
+                grads, loss_dict = sig.fused_grads_stacked(state.params, exec_buffers, batch)
                 updates, opt_state = jax.vmap(tx.update)(grads, state.opt_state, state.params)
                 params = optax.apply_updates(state.params, updates)
                 return (
@@ -218,16 +330,16 @@ def make_ensemble_step(
                 )
             if unstacked:
                 if per_model_batch:
-                    xs = (state.params, state.buffers, state.opt_state, batch)
+                    xs = (state.params, exec_buffers, state.opt_state, batch)
                     f = lambda args: one_model(*args)
                 else:
-                    xs = (state.params, state.buffers, state.opt_state)
+                    xs = (state.params, exec_buffers, state.opt_state)
                     f = lambda args: one_model(*args, batch)
                 params, opt_state, loss_dict, aux = jax.lax.map(f, xs)
             else:
                 params, opt_state, loss_dict, aux = jax.vmap(
                     one_model, in_axes=(0, 0, 0, batch_axis)
-                )(state.params, state.buffers, state.opt_state, batch)
+                )(state.params, exec_buffers, state.opt_state, batch)
         new_state = EnsembleState(
             params=params,
             buffers=state.buffers,
@@ -247,6 +359,7 @@ def make_ensemble_multi_step(
     compute_dtype=None,
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
+    l1_warmup_steps: int = 0,
 ) -> Callable:
     """K fused train steps under ONE compiled program via `lax.scan`.
 
@@ -261,7 +374,8 @@ def make_ensemble_multi_step(
     and lets XLA keep params/opt-state resident in HBM across steps.
     """
     step = make_ensemble_step(
-        sig, tx, per_model_batch, unstacked, compute_dtype, fused, fused_adam
+        sig, tx, per_model_batch, unstacked, compute_dtype, fused, fused_adam,
+        l1_warmup_steps,
     )
 
     def multi_step(state: EnsembleState, batches: jax.Array):
@@ -282,6 +396,7 @@ def make_ensemble_multi_step_idx(
     compute_dtype=None,
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
+    l1_warmup_steps: int = 0,
 ) -> Callable:
     """`make_ensemble_multi_step`, but each step's batch is GATHERED from the
     resident dataset inside the compiled scan (`multi_step_idx(state,
@@ -304,6 +419,7 @@ def make_ensemble_multi_step_idx(
     step = make_ensemble_step(
         sig, tx, per_model_batch=False, unstacked=unstacked,
         compute_dtype=compute_dtype, fused=fused, fused_adam=fused_adam,
+        l1_warmup_steps=l1_warmup_steps,
     )
 
     def multi_step_idx(state: EnsembleState, dataset: jax.Array, idxs: jax.Array):
@@ -355,12 +471,20 @@ class Ensemble:
         donate: bool = True,
         compute_dtype=None,
         fused: Optional[bool] = None,
+        l1_warmup_steps: int = 0,
     ):
         if not models:
             raise ValueError("Ensemble requires at least one (params, buffers) model")
+        if l1_warmup_steps > 0 and "l1_alpha" not in models[0][1]:
+            raise ValueError(
+                f"l1_warmup_steps={l1_warmup_steps} requested but "
+                f"{getattr(sig, '__name__', sig)} buffers have no 'l1_alpha' "
+                "key — warmup would silently be a control run"
+            )
         self.sig = sig
         self.n_models = len(models)
         self.unstacked = unstacked
+        self.l1_warmup_steps = int(l1_warmup_steps)
         self.compute_dtype = None if compute_dtype is None else jnp.dtype(compute_dtype)
         if fused is None:
             # auto: Pallas fused step on real TPU when the signature supports
@@ -456,6 +580,7 @@ class Ensemble:
             compute_dtype=self.compute_dtype,
             fused=getattr(self, "fused", False),
             fused_adam=fused_adam,
+            l1_warmup_steps=getattr(self, "l1_warmup_steps", 0),
         )
         donate_argnums = (0,) if donate else ()
 
@@ -478,6 +603,7 @@ class Ensemble:
                 self.compute_dtype,
                 kw["fused"],
                 None if fused_adam is None else tuple(sorted(fused_adam.items())),
+                kw["l1_warmup_steps"],
                 donate,
             )
             if cache_key in Ensemble._SHARED_STEPS:
@@ -643,6 +769,7 @@ class Ensemble:
             "unstacked": self.unstacked,
             "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
             "fused": self.fused,
+            "l1_warmup_steps": getattr(self, "l1_warmup_steps", 0),
             "state": self.state,  # live device pytree, no host copy
         }
 
@@ -671,6 +798,9 @@ class Ensemble:
         from sparse_coding__tpu.ops.tied_sae_kernel import on_tpu
 
         self.fused = bool(state_dict.get("fused", False)) and on_tpu()
+        # resume keeps the ramp phase: `step` is in the restored state, the
+        # length comes from the checkpoint (absent in pre-r5 checkpoints)
+        self.l1_warmup_steps = int(state_dict.get("l1_warmup_steps", 0))
         self.tx = tx if tx is not None else optim_str_to_func(self.optimizer_name)(**self.optimizer_kwargs)
         self.state = jax.tree.map(jnp.asarray, state_dict["state"])
         self._build_steps()
@@ -684,6 +814,7 @@ def build_ensemble(
     optimizer: str = "adam",
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compute_dtype=None,
+    l1_warmup_steps: int = 0,
     **common_hparams,
 ) -> Ensemble:
     """Convenience: init N models of `sig` (one per hparams dict) and stack them.
@@ -697,4 +828,7 @@ def build_ensemble(
     models = [
         sig.init(k, **common_hparams, **hp) for k, hp in zip(keys, hparams_list)
     ]
-    return Ensemble(models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype)
+    return Ensemble(
+        models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype,
+        l1_warmup_steps=l1_warmup_steps,
+    )

@@ -79,12 +79,13 @@ def make_big_batch_step(
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: BigBatchState, batch: jax.Array):
-        buffers = state.buffers
-        if l1_warmup_steps > 0 and "l1_alpha" in buffers:
-            ramp = jnp.minimum(
-                (state.step.astype(jnp.float32) + 1.0) / l1_warmup_steps, 1.0
-            )
-            buffers = {**buffers, "l1_alpha": buffers["l1_alpha"] * ramp}
+        # shared schedule + error policy (raises on missing l1_alpha,
+        # ADVICE r4): sparse_coding__tpu.ensemble.l1_warmup_buffers
+        from sparse_coding__tpu.ensemble import l1_warmup_buffers
+
+        buffers = l1_warmup_buffers(
+            state.buffers, state.step, l1_warmup_steps, sig
+        )
         grads, (loss_dict, aux) = grad_fn(state.params, buffers, batch)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

@@ -36,7 +36,21 @@ from sparse_coding__tpu.utils.config import EnsembleArgs, SyntheticEnsembleArgs
 
 
 def _ensemble(sig, models, cfg, dict_size, name, extra_args=None, mesh=None):
-    ens = Ensemble(models, sig, "adam", {"learning_rate": cfg.lr})
+    # cfg.l1_warmup_steps reaches every l1-family builder through here; for
+    # signatures without an l1_alpha buffer (e.g. TopK) a requested warmup
+    # warns instead of raising — one sweep may mix model families
+    warmup = getattr(cfg, "l1_warmup_steps", 0)
+    if warmup > 0 and "l1_alpha" not in models[0][1]:
+        import warnings
+
+        warnings.warn(
+            f"l1_warmup_steps={warmup} ignored for {sig.__name__} "
+            "(no l1_alpha buffer)"
+        )
+        warmup = 0
+    ens = Ensemble(
+        models, sig, "adam", {"learning_rate": cfg.lr}, l1_warmup_steps=warmup
+    )
     if mesh is not None:
         ens.shard(mesh)
     args = {"batch_size": cfg.batch_size, "dict_size": dict_size, **(extra_args or {})}

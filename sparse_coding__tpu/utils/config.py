@@ -164,6 +164,11 @@ class TrainArgs(BaseArgs):
     # multi-epoch sweeps with HBM-sized datasets: upload chunks once, not
     # once per epoch (train/sweep.py)
     hbm_cache_chunks: bool = False
+    # > 0: ramp every member's l1_alpha linearly from ~0 over this many steps
+    # (ensemble.make_ensemble_step). Prevents the early-training feature
+    # collapse the l1 x Adam-lr dynamic causes at high l1 (LR_COLLAPSE_r03);
+    # measured to cut dead features at zero FVU cost (RESURRECT_r04_warmup*)
+    l1_warmup_steps: int = 0
 
     def validate(self):
         if self.dtype not in DTYPES:

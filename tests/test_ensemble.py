@@ -234,3 +234,94 @@ def test_step_scan_idx_rejects_sharded():
     ens.shard(make_mesh(2, 4, 1))
     with pytest.raises(ValueError, match="single-shard"):
         ens.step_scan_idx(jnp.zeros((256, D_ACT)), np.zeros((2, 128), np.int32))
+
+
+def test_l1_warmup_ramps_and_converges_to_control():
+    """l1_warmup_steps ramps the EFFECTIVE l1 pressure: during warmup the
+    observed l1 loss term corresponds to step/warmup x l1_alpha, the stored
+    buffers are untouched, and past the ramp the step function is the same
+    program as a control ensemble's (VERDICT r4 next #2: the knob promoted
+    from train.big_batch into the ensemble/sweep path)."""
+    W = 8
+    mk = lambda warm: build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-2}, {"l1_alpha": 1e-1}],
+        optimizer_kwargs={"learning_rate": 0.0},  # freeze params: isolate loss
+        activation_size=D_ACT, n_dict_components=N_DICT,
+        l1_warmup_steps=warm,
+    )
+    ens_w, ens_c = mk(W), mk(0)
+    gen = make_gen()
+    batch = next(gen)
+    for k in range(W + 2):
+        lw, _ = ens_w.step_batch(batch)
+        lc, _ = ens_c.step_batch(batch)
+        ramp = min((k + 1.0) / W, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(lw["l_l1"]), ramp * np.asarray(lc["l_l1"]), rtol=1e-5
+        )
+    # stored buffers keep the CONFIGURED l1 (only the loss sees the ramp)
+    np.testing.assert_allclose(
+        np.asarray(ens_w.state.buffers["l1_alpha"]), [1e-2, 1e-1], rtol=1e-6
+    )
+
+
+def test_l1_warmup_cuts_early_feature_collapse():
+    """The behavioral claim: at aggressively high l1, warmup keeps more
+    features alive than a cold start at matched reconstruction quality
+    (the LR_COLLAPSE r3 dynamic the knob exists for)."""
+    gen = make_gen()
+    mk = lambda warm: build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(0),
+        [{"l1_alpha": 3e-2}],
+        optimizer_kwargs={"learning_rate": 1e-2},
+        activation_size=D_ACT, n_dict_components=N_DICT,
+        l1_warmup_steps=warm,
+    )
+    ens_w, ens_c = mk(60), mk(0)
+    batches = [next(gen) for _ in range(80)]
+    for b in batches:
+        ens_w.step_batch(b)
+        ens_c.step_batch(b)
+    probe = batches[-1]
+    alive = {}
+    for name, ens in (("warm", ens_w), ("cold", ens_c)):
+        (ld,) = ens.to_learned_dicts()
+        alive[name] = int((np.asarray(ld.encode(probe)) != 0).any(axis=0).sum())
+    assert alive["warm"] > alive["cold"], alive
+
+
+def test_l1_warmup_resume_keeps_ramp_phase():
+    """A checkpoint taken mid-ramp restores with BOTH the step counter and
+    the warmup length, so the restored ensemble continues the ramp instead
+    of restarting or skipping it."""
+    gen = make_gen()
+    ens = build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-2}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT, n_dict_components=N_DICT,
+        l1_warmup_steps=16,
+    )
+    for _ in range(4):
+        batch = next(gen)
+        ens.step_batch(batch)
+    sd = ens.state_dict()
+    restored = Ensemble.from_state(sd)
+    assert restored.l1_warmup_steps == 16
+    assert int(restored.state.step) == 4
+    nxt = next(gen)
+    np.testing.assert_allclose(
+        np.asarray(ens.step_batch(nxt)[0]["loss"]),
+        np.asarray(restored.step_batch(nxt)[0]["loss"]),
+        rtol=1e-6,
+    )
+
+
+def test_l1_warmup_rejects_signature_without_l1():
+    models = [
+        TopKEncoder.init(jax.random.PRNGKey(0), D_ACT, N_DICT, sparsity=4)
+    ]
+    with pytest.raises(ValueError, match="l1_alpha"):
+        Ensemble(models, TopKEncoder, optimizer_kwargs={"learning_rate": 1e-3},
+                 l1_warmup_steps=8)

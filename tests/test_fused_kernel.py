@@ -242,3 +242,85 @@ def test_fused_auto_selection_respects_vmem(monkeypatch):
     params = {"encoder": jnp.zeros((1, 2048, 1024))}
     assert FunctionalTiedSAE.fused_batch_supported(params, 256)
     assert not FunctionalTiedSAE.fused_batch_supported(params, 2048)
+
+
+def test_fused_large_batch_accumulation_matches_full_batch():
+    """The large-batch fused path (micro-batch gradient accumulation under
+    one scan, ensemble.make_ensemble_step) is EXACT: mean-of-micro-grads on
+    a batch the bwd kernel cannot hold resident equals the full-batch step.
+    Driven through make_ensemble_step with an interpret-mode signature so it
+    runs on CPU; on chip the same dispatch engages for batch >= ~4096 at the
+    bench shape (BATCHSCALE_r05)."""
+    from functools import partial
+
+    import optax
+
+    from sparse_coding__tpu.ensemble import EnsembleState, make_ensemble_step
+
+    B_big = 1024  # 4 micros of 256
+
+    class InterpTied(FunctionalTiedSAE):
+        # force the accumulation path: full batch "doesn't fit", micro does
+        @staticmethod
+        def fused_batch_supported(stacked_params, batch_size, adam_fused=True):
+            return batch_size <= 256
+
+        fused_grads_stacked = staticmethod(
+            partial(FunctionalTiedSAE.fused_grads_stacked, interpret=True)
+        )
+
+    key = jax.random.PRNGKey(0)
+    models = [
+        FunctionalTiedSAE.init(k, D, N, l1_alpha=a, bias_decay=1e-4)
+        for k, a in zip(jax.random.split(key, M), [1e-3, 3e-3])
+    ]
+    params = stack_pytrees([p for p, _ in models])
+    buffers = stack_pytrees([b for _, b in models])
+    batch = jax.random.normal(jax.random.PRNGKey(1), (B_big, D))
+    tx = optax.adam(1e-3)
+    mk_state = lambda: EnsembleState(
+        params=jax.tree.map(jnp.copy, params),
+        buffers=buffers,
+        opt_state=jax.vmap(tx.init)(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    accum_step = make_ensemble_step(
+        InterpTied, tx, compute_dtype=jnp.bfloat16, fused=True
+    )
+    ref_step = make_ensemble_step(
+        FunctionalTiedSAE, tx, compute_dtype=jnp.bfloat16, fused=False
+    )
+    sa, (la, _) = accum_step(mk_state(), batch)
+    sr, (lr, _) = ref_step(mk_state(), batch)
+    np.testing.assert_allclose(
+        np.asarray(la["loss"]), np.asarray(lr["loss"]), rtol=2e-2
+    )
+    for k in ["encoder", "encoder_bias"]:
+        a, b = np.asarray(sa.params[k]), np.asarray(sr.params[k])
+        # params moved by ~lr; compare the MOVEMENT, not the params
+        da = a - np.asarray(params[k])
+        db = b - np.asarray(params[k])
+        cos = (da.ravel() @ db.ravel()) / (
+            np.linalg.norm(da) * np.linalg.norm(db) + 1e-12
+        )
+        assert cos > 0.99, k
+
+
+def test_fused_accum_is_exact_mean_of_micros():
+    """Pure-math check, no kernels: the accumulation identity the large-batch
+    path relies on — full-batch grads == mean of equal-size micro-batch
+    grads for the tied-SAE loss (every term is a per-example mean)."""
+    key = jax.random.PRNGKey(3)
+    p, b = FunctionalTiedSAE.init(key, D, N, l1_alpha=1e-3, bias_decay=1e-4)
+    batch = jax.random.normal(jax.random.PRNGKey(4), (512, D))
+    g_full, _ = jax.grad(FunctionalTiedSAE.loss, has_aux=True)(p, b, batch)
+    micros = batch.reshape(4, 128, D)
+    gs = [
+        jax.grad(FunctionalTiedSAE.loss, has_aux=True)(p, b, m)[0]
+        for m in micros
+    ]
+    g_mean = jax.tree.map(lambda *x: sum(x) / len(x), *gs)
+    for k in g_full:
+        np.testing.assert_allclose(
+            np.asarray(g_full[k]), np.asarray(g_mean[k]), rtol=1e-5, atol=1e-7
+        )
