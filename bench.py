@@ -37,18 +37,23 @@ v4-32 pod; this bench reports the single-chip number.)
 
 import json
 import shutil
-import statistics
 import tempfile
 import time
 
 import jax
 import jax.numpy as jnp
 
+from sparse_coding__tpu.utils.bench_common import (
+    A100_BASELINE_ACTS_PER_SEC,
+    make_control,
+    median_spread,
+    peak_tflops,
+    tied_sae_flops_per_act,
+)
+
 N_MODELS, D_ACT, N_DICT, BATCH = 8, 512, 4096, 2048
-A100_BASELINE_ACTS_PER_SEC = 0.78e6
 SCAN_STEPS = 128
 ROUNDS = 5  # timed windows per key, interleaved across keys
-TPU_PEAK_TFLOPS = {"TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0}
 
 
 def _harvest_setup():
@@ -323,9 +328,50 @@ def prep_sweep_disk(stack):
     return measure
 
 
-def median_spread(vals):
-    vals = sorted(float(v) for v in vals)
-    return statistics.median(vals), [vals[0], vals[-1]]
+def prep_control(stack):
+    """Pinned-control program (utils.bench_common.make_control): fixed
+    8192^3 bf16 matmul, TFLOP/s. Isolates chip weather from code
+    regressions (VERDICT r4 weak #1/#7): a key that moves AGAINST the
+    control across sessions moved because the code did."""
+    return make_control()
+
+
+def prep_bigbatch(stack):
+    """acts/s of the SAME flagship ensemble at batch 16384 through the
+    batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
+    param/Adam stream is paid once per 16384 rows instead of once per 2048,
+    so this point runs closer to the MXU roofline (BATCHSCALE_r05 has the
+    full batch-MFU curve). Same rows per window as the headline."""
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    B = 16384
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(3),
+        [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(N_MODELS)],
+        optimizer_kwargs={"learning_rate": 1e-3, "mu_dtype": "bfloat16"},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+        compute_dtype=jnp.bfloat16,
+    )
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT, n_ground_truth_components=2 * D_ACT,
+        batch_size=B, feature_num_nonzero=8, feature_prob_decay=0.996,
+        correlated=False, key=jax.random.PRNGKey(4),
+    )
+    k = SCAN_STEPS * BATCH // B  # 16 steps == one headline window of rows
+    batches = jnp.stack([next(gen) for _ in range(k)]).astype(jnp.bfloat16)
+    jax.device_get(ens.step_scan(batches)["loss"])  # compile
+
+    def measure() -> float:
+        t0 = time.perf_counter()
+        losses = ens.step_scan(batches)
+        jax.device_get(losses["loss"])
+        return k * B / (time.perf_counter() - t0)
+
+    return measure
 
 
 def main(argv=None):
@@ -410,6 +456,8 @@ def main(argv=None):
             "fista500_codes_per_sec": prep_fista(stack),
             "topk_steps_per_sec": prep_topk(stack),
             "harvest_seq4096_tokens_per_sec": prep_harvest_longctx(stack),
+            "control_matmul_tflops": prep_control(stack),
+            "bigbatch16k_acts_per_sec": prep_bigbatch(stack),
         }
         samples = {k: [] for k in ["headline", *benches]}
         for _ in range(max(2, args.rounds)):
@@ -420,8 +468,8 @@ def main(argv=None):
     acts_per_sec, acts_spread = median_spread(samples["headline"])
     # true matmul work of the tied-SAE step: 5 passes (fwd c, fwd x_hat;
     # bwd dc, and the two dictionary-gradient contractions)
-    flops_per_act = N_MODELS * 5 * 2 * D_ACT * N_DICT
-    peak = TPU_PEAK_TFLOPS.get(jax.devices()[0].device_kind, 197.0)
+    flops_per_act = tied_sae_flops_per_act(N_MODELS, D_ACT, N_DICT)
+    peak = peak_tflops(jax.devices()[0].device_kind)
     mfu = acts_per_sec * flops_per_act / (peak * 1e12)
 
     out = {
@@ -438,6 +486,13 @@ def main(argv=None):
         med, spread = median_spread(samples[k])
         out[k] = round(med, 1)
         out[f"{k}_spread"] = [round(v, 1) for v in spread]
+    # derived: big-batch MFU and the control's fraction of peak (chip-weather
+    # normalizer — divide any key's session-over-session ratio by the
+    # control's ratio to see the code-attributable part)
+    out["bigbatch16k_mfu"] = round(
+        out["bigbatch16k_acts_per_sec"] * flops_per_act / (peak * 1e12), 3
+    )
+    out["control_fraction_of_peak"] = round(out["control_matmul_tflops"] / peak, 3)
     print(json.dumps(out))
 
 

@@ -30,7 +30,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import sys
 import time
 from pathlib import Path
@@ -43,16 +42,15 @@ ROUND_TAG = os.environ.get("PARITY_ROUND", "r05")
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
+from sparse_coding__tpu.utils.bench_common import (  # noqa: E402
+    A100_BASELINE_ACTS_PER_SEC,
+    make_control,
+    median_spread,
+    peak_tflops,
+    tied_sae_flops_per_act,
+)
+
 N_MODELS, D_ACT, N_DICT = 8, 512, 4096
-A100_BASELINE_ACTS_PER_SEC = 0.78e6  # bench.py's analytic A100 estimate
-TPU_PEAK_TFLOPS = {
-    "TPU v5 lite": 197.0, "TPU v4": 275.0, "TPU v5": 459.0, "TPU v6 lite": 918.0,
-}
-
-
-def median_spread(vals):
-    vals = sorted(float(v) for v in vals)
-    return statistics.median(vals), [vals[0], vals[-1]]
 
 
 def main(argv=None):
@@ -77,23 +75,13 @@ def main(argv=None):
     batch_sizes = [256, 512] if quick else [2048, 4096, 8192, 16384]
     rows_per_window = 4096 if quick else 2048 * 128  # bench.py's window size / 3
     dev = jax.devices()[0].device_kind
-    peak = TPU_PEAK_TFLOPS.get(dev, 197.0)
-    flops_per_act = n_models * 5 * 2 * d_act * n_dict
+    peak = peak_tflops(dev)
+    flops_per_act = tied_sae_flops_per_act(n_models, d_act, n_dict)
 
-    # -- pinned control: fixed bf16 matmul, ~1.1 TFLOP -----------------------
+    # -- pinned control: the SAME program bench.py's control key runs --------
     S = 512 if quick else 8192
-    a = jax.random.normal(jax.random.PRNGKey(0), (S, S), jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (S, S), jnp.bfloat16)
-    mm = jax.jit(lambda a, b: (a @ b).sum(dtype=jnp.float32))
     ctl_reps = 3 if quick else 8
-    jax.device_get(mm(a, b))  # compile
-
-    def measure_control() -> float:
-        t0 = time.perf_counter()
-        for _ in range(ctl_reps):
-            out = mm(a, b)
-        jax.device_get(out)
-        return ctl_reps * 2 * S**3 / (time.perf_counter() - t0) / 1e12
+    measure_control = make_control(side=S, reps=ctl_reps)
 
     # -- ensemble arms -------------------------------------------------------
     rng = np.random.default_rng(0)

@@ -51,7 +51,7 @@ from pathlib import Path
 import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
-ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")  # artifact round tag
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r05")  # artifact round tag
 
 
 if str(REPO) not in sys.path:
@@ -68,11 +68,14 @@ def subject_geometry(quick: bool):
     return 1024, 24, 16, 4096, 12
 
 
-def build_subject_model(quick: bool):
+def build_subject_model(quick: bool, checkpoint: str = None):
     """Thin wrapper over `parity_run.build_subject_model` with the
-    pythia-410m geometry (the scripts share one subject builder)."""
+    pythia-410m geometry (the scripts share one subject builder).
+    ``checkpoint`` loads real weights instead (real_subject_run path)."""
     from parity_run import build_subject_model as build
 
+    if checkpoint:
+        return build(quick, checkpoint=checkpoint)
     d, L, h, mlp, _ = subject_geometry(quick)
     return build(
         quick, "neox",
@@ -183,6 +186,17 @@ def main(argv=None):
         "32x dict's low-l1 dead-fraction (VERDICT r4 next #2; proven at "
         "this shape in RESURRECT_r04_warmup*.json)",
     )
+    ap.add_argument(
+        "--subject", default=None,
+        help="REAL subject weights: HF model name or local save_pretrained "
+        "dir via lm.convert.load_model (disables trigram pretraining). "
+        "Driven by scripts/real_subject_run.py",
+    )
+    ap.add_argument(
+        "--tokens-file", default=None,
+        help=".npy [rows, >=seq_len] pre-tokenized harvest text "
+        "(pairs with --subject)",
+    )
     args = ap.parse_args(argv)
     if args.max_epochs is not None and args.max_epochs < 1:
         ap.error("--max-epochs must be >= 1")
@@ -230,20 +244,40 @@ def main(argv=None):
     eval_rows = 2048 if quick else 8192
     dead_eval_rows = 2048 if quick else 65536
 
-    print(f"Building subject model (pythia-410m geometry, d={d_act})...")
-    lm_cfg, params = build_subject_model(quick)
+    print("Building subject model "
+          + (f"(REAL weights: {args.subject})..." if args.subject
+             else f"(pythia-410m geometry, d={d_act})..."))
+    lm_cfg, params = build_subject_model(quick, checkpoint=args.subject)
 
-    from parity_run import SUBJECT_CAVEAT, corpus_tokens, maybe_pretrain
+    from parity_run import (
+        SUBJECT_CAVEAT,
+        corpus_tokens,
+        file_tokens,
+        maybe_pretrain,
+    )
 
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
+    if args.subject:
+        pretrain_steps = 0  # real weights
+        # geometry follows the loaded checkpoint, mid layer by the spec
+        # (cap_layers is derived from `layer` below, after this override)
+        d_act, n_layers = lm_cfg.d_model, lm_cfg.n_layers
+        layer = n_layers // 2
+        n_dict = RATIO * d_act
     params, lang, pretrain_stats = maybe_pretrain(
         params, lm_cfg, quick, pretrain_steps
     )
     # seed=0 keeps the --pretrain 0 path token-identical to the round-2 runs
-    tokens = corpus_tokens(
-        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len,
-        n_chunks + 1, seed=0 if lang is None else 13,
-    )
+    if args.tokens_file:
+        tokens = file_tokens(
+            args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
+            seq_len, n_chunks + 1,
+        )
+    else:
+        tokens = corpus_tokens(
+            lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len,
+            n_chunks + 1, seed=0 if lang is None else 13,
+        )
     n_rows = tokens.shape[0]
 
     # r3 captured layer 2 + the mid layer in one pass (that two-depth
@@ -259,8 +293,10 @@ def main(argv=None):
     report: dict = {
         "config": {
             "baseline_config": 5,
-            "subject": f"neox d={d_act} L={n_layers} (pythia-410m geometry, "
-            f"{'trigram-pretrained' if lang is not None else 'random init'})",
+            "subject": f"{lm_cfg.arch} d={d_act} L={n_layers} "
+            + (f"(REAL weights: {args.subject})" if args.subject else
+               f"(pythia-410m geometry, "
+               f"{'trigram-pretrained' if lang is not None else 'random init'})"),
             "model": "FunctionalTiedSAE",
             "layers": cap_layers, "mid_layer": layer, "layer_loc": "residual",
             "seq_len": seq_len, "dict_ratio": RATIO, "n_dict": n_dict,
@@ -270,7 +306,13 @@ def main(argv=None):
             "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": SUBJECT_CAVEAT,
+        "subject_caveat": (
+            f"REAL pretrained subject ({args.subject}); harvest text "
+            + ("from " + args.tokens_file if args.tokens_file
+               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
+            if args.subject
+            else SUBJECT_CAVEAT
+        ),
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
         "notes": (
             f"{'trigram-pretrained' if lang is not None else 'random-init'} "

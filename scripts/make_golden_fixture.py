@@ -1,0 +1,167 @@
+"""Golden trained-dict fixture generator (VERDICT r4 next #7).
+
+Trains the smoke-scale BASELINE-config-2 shape (tied-SAE l1-sweep ensemble on
+synthetic data with a PLANTED ground-truth dictionary) to its FVU plateau,
+then commits the exported dicts + expected metrics to `tests/golden/` —
+the cross-round regression anchor the reference keeps as
+`output_basic_test/` (committed sweep outputs + `filename_explanations.txt`).
+Per-round JSON artifacts record history; THIS is re-verified by CI:
+`tests/test_golden_regression.py` (a) re-evaluates the committed dicts and
+(b) retrains from scratch and compares, so a behavioral change in init /
+loss / optimizer / training loop fails the suite instead of silently
+shifting the next round's artifacts.
+
+Everything is seeded and CPU-deterministic; tolerances in golden.json absorb
+XLA-version numeric drift.
+
+Run: `python scripts/make_golden_fixture.py` (CPU, ~1 min) — only when a
+deliberate behavioral change requires re-pinning; commit the diff it prints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+GOLDEN_DIR = REPO / "tests" / "golden" / "cfg2_smoke"
+
+# smoke-scale config-2 shape: tied SAEs, 4x overcomplete, 3-point l1 grid
+D_ACT = 64
+N_DICT = 256
+# 1e-4: dense near-autoencoding; 1e-3: the feature-recovery point (MMCS to
+# planted truth ~0.6 at plateau); 3e-3: sparse-but-alive. A 1e-2 member
+# collapses at this scale — a dead dict is a weak regression anchor.
+L1_GRID = (1e-4, 1e-3, 3e-3)
+BATCH = 512
+STEPS_PER_EPOCH = 64
+MAX_EPOCHS = 40
+PLATEAU_TOL = 0.002
+SEED = 0
+
+
+def train_fixture_ensemble():
+    """The exact training run the golden numbers pin. Deterministic on CPU:
+    fixed seeds, fixed batch order, fp32 everywhere. Returns (ensemble,
+    eval_batch, ground_truth, fvu_trajectory)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import build_ensemble, metrics as sm
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT,
+        n_ground_truth_components=2 * D_ACT,
+        batch_size=BATCH,
+        feature_num_nonzero=6,
+        feature_prob_decay=0.99,
+        correlated=False,
+        key=jax.random.PRNGKey(SEED + 1000),
+    )
+    # one fixed epoch of data, reused every epoch (plateau needs repetition)
+    chunks = [next(gen) for _ in range(STEPS_PER_EPOCH)]
+    eval_batch = next(gen)
+
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(SEED),
+        [{"l1_alpha": a} for a in L1_GRID],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+    )
+    traj = []
+    prev, stall = None, 0
+    for epoch in range(MAX_EPOCHS):
+        for b in chunks:
+            ens.step_batch(b)
+        fvus = [r["fvu"] for r in sm.evaluate_dicts(ens.to_learned_dicts(), eval_batch)]
+        cur = float(sum(fvus) / len(fvus))
+        traj.append(round(cur, 5))
+        if prev is not None and (prev - cur) < PLATEAU_TOL * prev:
+            stall += 1
+            if stall >= 2:
+                break
+        elif prev is not None:
+            stall = 0
+        prev = cur
+    return ens, eval_batch, gen.feats, traj
+
+
+def fixture_metrics(dicts, eval_batch, ground_truth):
+    import numpy as np
+
+    from sparse_coding__tpu import metrics as sm
+
+    rows = sm.evaluate_dicts(dicts, eval_batch)
+    return [
+        {
+            "l1_alpha": a,
+            "fvu": round(float(r["fvu"]), 5),
+            "l0": round(float(r["l0"]), 2),
+            "mmcs_to_truth": round(float(sm.mmcs(ld, np.asarray(ground_truth))), 4),
+        }
+        for a, ld, r in zip(L1_GRID, dicts, rows)
+    ]
+
+
+def main():
+    # CPU: the fixture must evaluate identically on any dev machine / CI
+    os.environ.setdefault("XLA_FLAGS", "")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+    ens, eval_batch, truth, traj = train_fixture_ensemble()
+    dicts = ens.to_learned_dicts()
+    metrics = fixture_metrics(dicts, eval_batch, truth)
+
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    save_learned_dicts(
+        GOLDEN_DIR / "learned_dicts.pkl",
+        [(ld, {"l1_alpha": a}) for ld, a in zip(dicts, L1_GRID)],
+    )
+    golden = {
+        "what": (
+            "smoke-scale BASELINE-config-2 tied-SAE l1 sweep trained to FVU "
+            "plateau on seeded synthetic data with planted ground truth; "
+            "regenerate ONLY via scripts/make_golden_fixture.py"
+        ),
+        "config": {
+            "d_act": D_ACT, "n_dict": N_DICT, "l1_grid": list(L1_GRID),
+            "batch": BATCH, "steps_per_epoch": STEPS_PER_EPOCH,
+            "plateau_tol": PLATEAU_TOL, "seed": SEED,
+        },
+        "epochs_run": len(traj),
+        "fvu_trajectory": traj,
+        "members": metrics,
+        "tolerances": {
+            # committed dicts re-evaluated on regenerated data: only numeric
+            # drift (XLA version) — tight
+            "reeval_fvu_rtol": 0.02,
+            "reeval_l0_rtol": 0.05,
+            # from-scratch retrain: optimizer/compiler drift — loose but
+            # regression-meaningful
+            "retrain_fvu_rtol": 0.15,
+            "retrain_l0_rtol": 0.30,
+            "retrain_mmcs_to_committed_min": 0.85,
+        },
+    }
+    with open(GOLDEN_DIR / "golden.json", "w") as f:
+        json.dump(golden, f, indent=1)
+    print(json.dumps(golden["members"], indent=1))
+    print(f"Wrote {GOLDEN_DIR}/learned_dicts.pkl + golden.json "
+          f"({(GOLDEN_DIR / 'learned_dicts.pkl').stat().st_size / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,14 +49,27 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 
-def build_subject_model(quick: bool, arch: str = "neox", hf_kwargs: dict = None):
+def build_subject_model(
+    quick: bool, arch: str = "neox", hf_kwargs: dict = None,
+    checkpoint: str = None,
+):
     """Random-init subject model (zero-egress image: no weights downloadable),
     converted through `lm.convert` (logit-exactness vs torch is proven by
     `tests/test_lm.py`). ``hf_kwargs`` overrides the NeoX geometry entirely
-    (used by `dictpar_run.py` for the pythia-410m shape)."""
+    (used by `dictpar_run.py` for the pythia-410m shape).
+
+    ``checkpoint`` (an HF model name or a local `save_pretrained` directory)
+    loads REAL weights through `lm.convert.load_model` instead — the
+    real-subject path `scripts/real_subject_run.py` drives (VERDICT r4 next
+    #3); `arch`/`quick`/`hf_kwargs` are ignored then."""
     import torch
 
     from sparse_coding__tpu.lm import config_from_hf, params_from_hf
+
+    if checkpoint:
+        from sparse_coding__tpu.lm.convert import load_model
+
+        return load_model(checkpoint)
 
     torch.manual_seed(0)
     if arch == "gpt2":
@@ -153,6 +166,31 @@ def corpus_tokens(lang, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chun
     return lang.sample(n_rows, seq_len, seed=seed)
 
 
+def file_tokens(path, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks):
+    """Harvest tokens from a pre-tokenized `.npy` ([rows, >=seq_len] ints) —
+    the real-text path `real_subject_run.py` feeds after tokenizing an HF
+    dataset. Rows are tiled with a warning if the file is smaller than the
+    requested harvest (truncation would silently shrink the run)."""
+    arr = np.load(path)
+    if arr.ndim != 2 or arr.shape[1] < seq_len:
+        raise ValueError(
+            f"{path}: expected [rows, >={seq_len}] token array, got {arr.shape}"
+        )
+    if int(arr.max()) >= vocab_size:
+        raise ValueError(
+            f"{path}: token id {int(arr.max())} >= subject vocab {vocab_size}"
+        )
+    arr = arr[:, :seq_len]
+    n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    if arr.shape[0] < n_rows:
+        print(
+            f"WARNING: {path} has {arr.shape[0]} rows < {n_rows} requested; "
+            "tiling (the harvest will repeat text)"
+        )
+        arr = np.tile(arr, (int(np.ceil(n_rows / arr.shape[0])), 1))
+    return np.ascontiguousarray(arr[:n_rows]).astype(np.int32)
+
+
 def mmcs_random_floor(n_feats: int, d_act: int, n_pairs: int = 3, seed: int = 1234) -> dict:
     """Cross-seed MMCS of pairs of RANDOM unit-row dictionaries at the given
     shape — the null value a trained dictionary's cross-seed MMCS must clear
@@ -214,22 +252,34 @@ def run_basic(args):
     seeds = (0, 1)
 
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
-    print("Building subject model (pythia-70m geometry, random init)...")
-    lm_cfg, params = build_subject_model(quick, "neox")
+    subject_arg = getattr(args, "subject", None)
+    if subject_arg:
+        pretrain_steps = 0  # real weights
+    print("Building subject model "
+          + (f"(REAL weights: {subject_arg})..." if subject_arg
+             else "(pythia-70m geometry, random init)..."))
+    lm_cfg, params = build_subject_model(quick, "neox", checkpoint=subject_arg)
     d_act = lm_cfg.d_model
     params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
 
-    tokens = corpus_tokens(
-        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
-    )
+    if getattr(args, "tokens_file", None):
+        tokens = file_tokens(
+            args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
+            seq_len, n_chunks + 1,
+        )
+    else:
+        tokens = corpus_tokens(
+            lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+        )
     n_rows = tokens.shape[0]
 
     report: dict = {
         "config": {
             "baseline_config": 1,
             "subject": f"{lm_cfg.arch} d={d_act} L={lm_cfg.n_layers} "
-            f"(pythia-70m geometry, "
-            f"{'trigram-pretrained' if lang is not None else 'random init'})",
+            + (f"(REAL weights: {subject_arg})" if subject_arg else
+               f"(pythia-70m geometry, "
+               f"{'trigram-pretrained' if lang is not None else 'random init'})"),
             "model": "FunctionalFista via train.basic_l1_sweep driver",
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
             "dict_ratio": ratio, "n_dict": int(ratio * d_act),
@@ -237,7 +287,13 @@ def run_basic(args):
             "fista_iters": fista_iters, "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": SUBJECT_CAVEAT,
+        "subject_caveat": (
+            f"REAL pretrained subject ({subject_arg}); harvest text "
+            + ("from " + args.tokens_file if getattr(args, "tokens_file", None)
+               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
+            if subject_arg
+            else SUBJECT_CAVEAT
+        ),
     }
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
@@ -360,6 +416,19 @@ def main(argv=None):
         "The anti-collapse lever proven in RESURRECT_r04_warmup*.json",
     )
     ap.add_argument(
+        "--subject", default=None,
+        help="REAL subject weights: an HF model name (needs network) or a "
+        "local save_pretrained directory, loaded via lm.convert.load_model. "
+        "Disables the trigram pretraining (the weights are already trained). "
+        "Driven by scripts/real_subject_run.py",
+    )
+    ap.add_argument(
+        "--tokens-file", default=None,
+        help=".npy [rows, >=seq_len] pre-tokenized harvest text (pairs with "
+        "--subject; without it the harvest uses random tokens, which is only "
+        "meaningful as a dress rehearsal)",
+    )
+    ap.add_argument(
         "--topk-recall", type=float, default=None,
         help="approx_max_k recall_target for the topk config "
         "(default: TopKEncoderApprox.RECALL)",
@@ -459,17 +528,26 @@ def main(argv=None):
     # flag was explicit then; ROUND3.md header) — r4 makes that the default
     # so topk/fista no longer silently fall back to random-init subjects
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
+    if args.subject:
+        pretrain_steps = 0  # real weights: pretraining would destroy them
+        subject = f"REAL weights: {args.subject}"
     print(f"Building subject model ({subject})...")
-    lm_cfg, params = build_subject_model(quick, arch)
+    lm_cfg, params = build_subject_model(quick, arch, checkpoint=args.subject)
     d_act = lm_cfg.d_model
     n_dict = int(ratio * d_act)
     params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
     if lang is not None:
         subject = subject.replace("random init", "trigram-pretrained")
 
-    tokens = corpus_tokens(
-        lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
-    )
+    if args.tokens_file:
+        tokens = file_tokens(
+            args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
+            seq_len, n_chunks + 1,
+        )
+    else:
+        tokens = corpus_tokens(
+            lang, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks + 1
+        )
     n_rows = tokens.shape[0]
 
     report: dict = {
@@ -490,7 +568,13 @@ def main(argv=None):
             "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": SUBJECT_CAVEAT,
+        "subject_caveat": (
+            f"REAL pretrained subject ({args.subject}); harvest text "
+            + ("from " + args.tokens_file if args.tokens_file
+               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
+            if args.subject
+            else SUBJECT_CAVEAT
+        ),
     }
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats

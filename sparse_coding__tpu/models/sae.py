@@ -256,15 +256,37 @@ class FunctionalTiedSAE:
     def fused_batch_supported(
         stacked_params, batch_size: int, adam_fused: bool = True
     ) -> bool:
-        """Trace-time check that the bwd kernel's batch-dependent VMEM working
-        set fits (`stacked_params` carry the leading model axis).
-        ``adam_fused`` selects which bwd kernel (and tile size) will run —
-        the ensemble step passes whether the in-kernel Adam path is active."""
-        from sparse_coding__tpu.ops.tied_sae_kernel import fused_fits
+        """Trace-time check that a fused bwd kernel covers this batch size
+        (`stacked_params` carry the leading model axis). ``adam_fused``
+        selects which kernel family will run — the ensemble step passes
+        whether the in-kernel Adam path is active.
+
+        The Adam family has TWO kernels: the batch-resident one (fits up to
+        ~3k rows at the bench shape) and the batch-tiled accumulating one
+        (`_bwd_adam_accum_kernel`: batch-independent VMEM footprint, any
+        batch divisible by its 512-row tile) — `tied_sae_adam_step_stacked`
+        dispatches between them with exactly these predicates. The
+        plain-grads kernel stays batch-resident-only (large-batch non-Adam
+        callers use the ensemble's scan-accumulation fallback)."""
+        from sparse_coding__tpu.ops.tied_sae_kernel import (
+            ACCUM_BATCH_TILE,
+            accum_fits,
+            fused_fits,
+        )
 
         n_dict_components, activation_size = stacked_params["encoder"].shape[-2:]
+        if adam_fused:
+            return fused_fits(
+                n_dict_components, activation_size, batch_size, adam_tiles=True
+            ) or (
+                batch_size % ACCUM_BATCH_TILE == 0
+                and accum_fits(n_dict_components, activation_size)
+                # the shared fwd kernel still keeps the whole member dict
+                # VMEM-resident — its batch-independent fit must hold too
+                and fused_fits(n_dict_components, activation_size, None)
+            )
         return fused_fits(
-            n_dict_components, activation_size, batch_size, adam_tiles=adam_fused
+            n_dict_components, activation_size, batch_size, adam_tiles=False
         )
 
     @staticmethod

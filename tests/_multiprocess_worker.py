@@ -13,8 +13,23 @@ import os
 import sys
 
 
+def worker_config(mode: str):
+    """(d_act, n_dict, batch, mesh_shape) per mode — shared with the parent
+    test's single-process reference run."""
+    if mode == "dictpar":
+        # 32x-overcomplete (config-5 geometry scaled down), dict axis 4
+        return 64, 2048, 64, (1, 2, 4)
+    return 32, 128, 64, (2, 2, 2)
+
+
 def main():
     proc_id, n_proc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    # "default": 4-member tied SAE on the (2,2,2) mesh.
+    # "dictpar": the BASELINE config-5 analogue — 32x-overcomplete dict
+    #   sharded over a dict=4 axis that stays WITHIN each host, data=2 axis
+    #   crossing the host (DCN) boundary: the real pod layout for dictpar
+    #   (VERDICT r4 next #6).
+    mode = sys.argv[4] if len(sys.argv) > 4 else "default"
     dpp = 8 // n_proc  # devices per simulated host
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -42,7 +57,7 @@ def main():
     from sparse_coding__tpu.parallel import make_mesh
     from sparse_coding__tpu.parallel.mesh import batch_sharding
 
-    d_act, n_dict, batch = 32, 128, 64
+    d_act, n_dict, batch, mesh_shape = worker_config(mode)
     ens = build_ensemble(
         FunctionalTiedSAE,
         jax.random.PRNGKey(0),
@@ -51,7 +66,7 @@ def main():
         activation_size=d_act,
         n_dict_components=n_dict,
     )
-    mesh = make_mesh(2, 2, 2)  # spans both processes: 8 global devices
+    mesh = make_mesh(*mesh_shape)  # spans all processes: 8 global devices
     ens.shard(mesh)
     # members + dict components live across processes
     assert not ens.state.params["encoder"].is_fully_addressable

@@ -1,0 +1,104 @@
+"""Golden trained-dict regression gate (VERDICT r4 next #7).
+
+`tests/golden/cfg2_smoke/` holds committed trained dictionaries + expected
+metrics (the reference's `output_basic_test/` pattern), generated once by
+`scripts/make_golden_fixture.py`. Two gates:
+
+  1. re-evaluate the COMMITTED dicts on regenerated (seeded) data — catches
+     metric/eval/data-generator drift at tight tolerance;
+  2. RETRAIN the fixture from scratch and compare to golden — catches
+     behavioral drift in init / loss / optimizer / the training step at
+     loose tolerance, plus dictionary-level agreement (MMCS to committed).
+
+Per-round artifact JSONs record history; this is the piece CI re-verifies.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN = REPO / "tests" / "golden" / "cfg2_smoke"
+
+sys.path.insert(0, str(REPO / "scripts"))
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return json.loads((GOLDEN / "golden.json").read_text())
+
+
+@pytest.fixture(scope="module")
+def committed_dicts():
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    return load_learned_dicts(GOLDEN / "learned_dicts.pkl")
+
+
+def test_committed_dicts_reevaluate_to_golden(golden, committed_dicts):
+    from make_golden_fixture import BATCH, D_ACT, SEED, STEPS_PER_EPOCH
+
+    import jax
+
+    from sparse_coding__tpu import metrics as sm
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+
+    cfg = golden["config"]
+    gen = RandomDatasetGenerator(
+        activation_dim=cfg["d_act"],
+        n_ground_truth_components=2 * cfg["d_act"],
+        batch_size=cfg["batch"],
+        feature_num_nonzero=6,
+        feature_prob_decay=0.99,
+        correlated=False,
+        key=jax.random.PRNGKey(cfg["seed"] + 1000),
+    )
+    for _ in range(cfg["steps_per_epoch"]):
+        next(gen)  # identical stream position to the generator script
+    eval_batch = next(gen)
+    truth = np.asarray(gen.feats)
+
+    tol = golden["tolerances"]
+    dicts = [ld for ld, _hp in committed_dicts]
+    rows = sm.evaluate_dicts(dicts, eval_batch)
+    for member, ld, row in zip(golden["members"], dicts, rows):
+        assert float(row["fvu"]) == pytest.approx(
+            member["fvu"], rel=tol["reeval_fvu_rtol"], abs=1e-4
+        ), member
+        assert float(row["l0"]) == pytest.approx(
+            member["l0"], rel=tol["reeval_l0_rtol"]
+        ), member
+        assert float(sm.mmcs(ld, truth)) == pytest.approx(
+            member["mmcs_to_truth"], rel=0.05
+        ), member
+
+
+@pytest.mark.slow
+def test_retrain_matches_golden(golden, committed_dicts):
+    from make_golden_fixture import fixture_metrics, train_fixture_ensemble
+
+    from sparse_coding__tpu import metrics as sm
+
+    ens, eval_batch, truth, traj = train_fixture_ensemble()
+    retrained = ens.to_learned_dicts()
+    metrics = fixture_metrics(retrained, eval_batch, truth)
+
+    tol = golden["tolerances"]
+    for member, got in zip(golden["members"], metrics):
+        assert got["fvu"] == pytest.approx(
+            member["fvu"], rel=tol["retrain_fvu_rtol"], abs=5e-3
+        ), (member, got)
+        assert got["l0"] == pytest.approx(
+            member["l0"], rel=tol["retrain_l0_rtol"]
+        ), (member, got)
+    # dictionary-level agreement with the committed fixture (not just
+    # aggregate metrics): same seeds + deterministic CPU training should
+    # land on essentially the same features
+    for (committed, _hp), new, member in zip(
+        committed_dicts, retrained, golden["members"]
+    ):
+        m = float(sm.mmcs(new, committed))
+        assert m >= tol["retrain_mmcs_to_committed_min"], (member, m)

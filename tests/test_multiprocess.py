@@ -33,15 +33,26 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("n_proc", [2, 4])
-def test_n_process_sharded_step_matches_single_process(devices, n_proc):
+@pytest.mark.parametrize(
+    "n_proc,mode",
+    [
+        (2, "default"),
+        (4, "default"),
+        # 2 hosts x 4 devices at the 32x-overcomplete dictpar shape: the
+        # dict axis stays within each host (ICI), the data axis crosses the
+        # host (DCN) boundary — the real pod layout for BASELINE config 5
+        # (VERDICT r4 next #6)
+        (2, "dictpar"),
+    ],
+)
+def test_n_process_sharded_step_matches_single_process(devices, n_proc, mode):
     port = _free_port()
     procs = [
         subprocess.Popen(
             [
                 sys.executable,
                 str(REPO / "tests" / "_multiprocess_worker.py"),
-                str(pid), str(n_proc), f"127.0.0.1:{port}",
+                str(pid), str(n_proc), f"127.0.0.1:{port}", mode,
             ],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
@@ -65,7 +76,10 @@ def test_n_process_sharded_step_matches_single_process(devices, n_proc):
     from sparse_coding__tpu.models import FunctionalTiedSAE
     from sparse_coding__tpu.parallel import make_mesh
 
-    d_act, n_dict, batch = 32, 128, 64
+    sys.path.insert(0, str(REPO / "tests"))
+    from _multiprocess_worker import worker_config
+
+    d_act, n_dict, batch, mesh_shape = worker_config(mode)
     ens = build_ensemble(
         FunctionalTiedSAE,
         jax.random.PRNGKey(0),
@@ -73,7 +87,7 @@ def test_n_process_sharded_step_matches_single_process(devices, n_proc):
         optimizer_kwargs={"learning_rate": 1e-3},
         activation_size=d_act,
         n_dict_components=n_dict,
-    ).shard(make_mesh(2, 2, 2))
+    ).shard(make_mesh(*mesh_shape))
     for step in range(3):
         full = jax.random.normal(jax.random.PRNGKey(100 + step), (batch, d_act))
         loss_dict, _ = ens.step_batch(full)

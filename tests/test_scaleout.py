@@ -67,3 +67,102 @@ def test_non_collective_lines_ignored():
     hlo = "%d = f32[4096,512] dot(f32[4096,2048] %a, f32[2048,512] %b)"
     t = collective_traffic(hlo, 8)
     assert t["ops"] == [] and t["wire_bytes_per_chip_per_step"] == 0
+
+
+# -- compiled-program collective-structure regression gates -------------------
+# (VERDICT r4 next #6: the SCALEOUT artifact measured these once; a sharding
+# regression — like the double gradient all-reduce SCALEOUT_r04
+# conclusions.4 caught and fixed — must now fail CI, not wait for the next
+# artifact run.) Each case compiles the REAL sharded ensemble step (the
+# exact `Ensemble.shard` + jit path the pod runs) on the 8-device test mesh
+# at a scaled-down shape and pins the collective op counts and ring-model
+# wire bytes parsed from the optimized SPMD HLO.
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+D, N = 128, 512  # scaled-down tied-SAE shape; grads = (N*D + N) f32 per member
+GRAD_BYTES_PER_MEMBER = (N * D + N) * 4
+
+
+def _compile_traffic(n_models, mesh_shape, batch=256):
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.parallel import make_mesh
+    from sparse_coding__tpu.parallel.mesh import batch_sharding
+
+    import numpy as np
+
+    n_dev = int(np.prod(mesh_shape))
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 10 ** (-4 + i * 0.25)} for i in range(n_models)],
+        optimizer_kwargs={"learning_rate": 3e-4},
+        activation_size=D,
+        n_dict_components=N,
+    )
+    mesh = make_mesh(*mesh_shape, devices=jax.devices()[:n_dev])
+    ens.shard(mesh)
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (batch, D)),
+        batch_sharding(mesh),
+    )
+    hlo = ens._step.lower(ens.state, b).compile().as_text()
+    return collective_traffic(hlo, n_dev)
+
+
+def test_sweep_fanout_program_is_collective_free():
+    """Pure model-axis fan-out (the pod sweep layout) must carry ZERO
+    per-step collectives — members are embarrassingly parallel. Any
+    collective here is a sharding bug costing wire every step."""
+    t = _compile_traffic(4, (4, 1, 1))
+    assert t["ops"] == [], t["summary"]
+    assert t["wire_bytes_per_chip_per_step"] == 0
+
+
+def test_hybrid_dp_program_has_single_halved_allreduce():
+    """model=2 x data=2: exactly ONE gradient all-reduce; with the tied-SAE
+    DP backward (models/sae.py FunctionalTiedSAEDP, which all-reduces the
+    single fused gradient operand) its ring wire at group 2 equals the
+    per-chip gradient bytes (2 members x (N*D + N) f32) plus a few scalar
+    loss psums — NOT 2x (the double-all-reduce regression class)."""
+    t = _compile_traffic(4, (2, 2, 1))
+    assert t["summary"]["all-reduce"]["count"] == 1, t["summary"]
+    grad_bytes = 2 * GRAD_BYTES_PER_MEMBER
+    wire = t["wire_bytes_per_chip_per_step"]
+    # ring all-reduce at g=2: 2*(g-1)/g * b == b; allow 1 KB of scalar psums
+    assert grad_bytes <= wire <= grad_bytes + 1024, (wire, grad_bytes)
+
+
+def test_pure_dp_program_wire_matches_ring_model():
+    """data=8 (the DDP shape): one all-reduce of every member's gradients,
+    ring wire = 2*(g-1)/g * grad bytes at g=8."""
+    t = _compile_traffic(2, (1, 8, 1))
+    assert t["summary"]["all-reduce"]["count"] == 1, t["summary"]
+    grad_bytes = 2 * GRAD_BYTES_PER_MEMBER
+    expect = 2 * 7 / 8 * grad_bytes
+    wire = t["wire_bytes_per_chip_per_step"]
+    assert expect <= wire <= expect + 1024, (wire, expect)
+
+
+@pytest.mark.parametrize(
+    "mesh_shape,golden_wire",
+    [
+        # goldens measured at authoring time (r5) from the optimized HLO of
+        # the shipped program; a changed count or >10% byte drift means the
+        # partitioner or our sharding specs changed — investigate, then
+        # re-pin deliberately.
+        ((2, 2, 2), 198156),
+        ((1, 2, 4), 330268),  # dictpar DCN-analogue: data x dict
+    ],
+)
+def test_dict_sharded_program_collective_structure(mesh_shape, golden_wire):
+    """Dict-axis sharding adds exactly ONE more all-reduce (the decode psum
+    over dict shards) on top of the data-axis gradient all-reduce — two
+    total, with pinned wire bytes."""
+    t = _compile_traffic(2, mesh_shape)
+    assert t["summary"]["all-reduce"]["count"] == 2, t["summary"]
+    wire = t["wire_bytes_per_chip_per_step"]
+    assert abs(wire - golden_wire) <= 0.1 * golden_wire, (wire, golden_wire)
