@@ -254,6 +254,7 @@ def main(argv=None):
         corpus_tokens,
         file_tokens,
         maybe_pretrain,
+        real_subject_caveat,
     )
 
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
@@ -307,11 +308,7 @@ def main(argv=None):
             "device": jax.devices()[0].device_kind,
         },
         "subject_caveat": (
-            f"REAL pretrained subject ({args.subject}); harvest text "
-            + ("from " + args.tokens_file if args.tokens_file
-               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
-            if args.subject
-            else SUBJECT_CAVEAT
+            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT
         ),
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
         "notes": (

@@ -45,18 +45,15 @@ PLATEAU_TOL = 0.002
 SEED = 0
 
 
-def train_fixture_ensemble():
-    """The exact training run the golden numbers pin. Deterministic on CPU:
-    fixed seeds, fixed batch order, fp32 everywhere. Returns (ensemble,
-    eval_batch, ground_truth, fvu_trajectory)."""
+def make_generator():
+    """THE seeded data generator the golden numbers are pinned on — the
+    regression test must rebuild the identical stream, so the constructor
+    lives here and only here."""
     import jax
-    import jax.numpy as jnp
 
-    from sparse_coding__tpu import build_ensemble, metrics as sm
     from sparse_coding__tpu.data import RandomDatasetGenerator
-    from sparse_coding__tpu.models import FunctionalTiedSAE
 
-    gen = RandomDatasetGenerator(
+    return RandomDatasetGenerator(
         activation_dim=D_ACT,
         n_ground_truth_components=2 * D_ACT,
         batch_size=BATCH,
@@ -65,6 +62,18 @@ def train_fixture_ensemble():
         correlated=False,
         key=jax.random.PRNGKey(SEED + 1000),
     )
+
+
+def train_fixture_ensemble():
+    """The exact training run the golden numbers pin. Deterministic on CPU:
+    fixed seeds, fixed batch order, fp32 everywhere. Returns (ensemble,
+    eval_batch, ground_truth, fvu_trajectory)."""
+    import jax
+
+    from sparse_coding__tpu import build_ensemble, metrics as sm
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    gen = make_generator()
     # one fixed epoch of data, reused every epoch (plateau needs repetition)
     chunks = [next(gen) for _ in range(STEPS_PER_EPOCH)]
     eval_batch = next(gen)

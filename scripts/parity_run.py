@@ -191,6 +191,17 @@ def file_tokens(path, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks
     return np.ascontiguousarray(arr[:n_rows]).astype(np.int32)
 
 
+def real_subject_caveat(args) -> str:
+    """`subject_caveat` for a real-weights run (parity_run/dictpar_run share
+    this; the synthetic default is SUBJECT_CAVEAT)."""
+    tokens_file = getattr(args, "tokens_file", None)
+    return (
+        f"REAL pretrained subject ({args.subject}); harvest text "
+        + ("from " + tokens_file if tokens_file
+           else "RANDOM tokens — dress-rehearsal only, not a parity claim")
+    )
+
+
 def mmcs_random_floor(n_feats: int, d_act: int, n_pairs: int = 3, seed: int = 1234) -> dict:
     """Cross-seed MMCS of pairs of RANDOM unit-row dictionaries at the given
     shape — the null value a trained dictionary's cross-seed MMCS must clear
@@ -284,15 +295,13 @@ def run_basic(args):
             "layer": layer, "layer_loc": layer_loc, "seq_len": seq_len,
             "dict_ratio": ratio, "n_dict": int(ratio * d_act),
             "l1_alpha": l1_alpha, "sae_batch": sae_batch,
-            "fista_iters": fista_iters, "seeds": list(seeds),
+            "fista_iters": fista_iters,
+            "fista_tol": getattr(args, "fista_tol", 0.0),
+            "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         },
         "subject_caveat": (
-            f"REAL pretrained subject ({subject_arg}); harvest text "
-            + ("from " + args.tokens_file if getattr(args, "tokens_file", None)
-               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
-            if subject_arg
-            else SUBJECT_CAVEAT
+            real_subject_caveat(args) if subject_arg else SUBJECT_CAVEAT
         ),
     }
     if pretrain_stats is not None:
@@ -325,6 +334,7 @@ def run_basic(args):
                 str(train_folder), str(out_dir), activation_width=d_act,
                 l1_values=[l1_alpha], dict_ratio=ratio, batch_size=sae_batch,
                 n_epochs=1, fista_iters=fista_iters, seed=seed,
+                fista_tol=getattr(args, "fista_tol", 0.0),
             )
             # the driver's on-disk export must round-trip to the same dict
             (ld_disk, hp_disk), = load_learned_dicts(
@@ -427,6 +437,12 @@ def main(argv=None):
         help=".npy [rows, >=seq_len] pre-tokenized harvest text (pairs with "
         "--subject; without it the harvest uses random tokens, which is only "
         "meaningful as a dress rehearsal)",
+    )
+    ap.add_argument(
+        "--fista-tol", type=float, default=0.0,
+        help="FISTA solve-to-convergence tolerance for --config fista/basic "
+        "(0 = the reference's blind fixed-500 semantics; 1e-3 exits ~2-5x "
+        "earlier at measured-equivalent codes — tests/test_fista.py)",
     )
     ap.add_argument(
         "--topk-recall", type=float, default=None,
@@ -566,14 +582,11 @@ def main(argv=None):
             "sae_batch": sae_batch, "max_epochs": max_epochs,
             "plateau_tol": plateau_tol, "seeds": list(seeds),
             "l1_warmup_steps": args.l1_warmup_steps,
+            "fista_tol": args.fista_tol,
             "device": jax.devices()[0].device_kind,
         },
         "subject_caveat": (
-            f"REAL pretrained subject ({args.subject}); harvest text "
-            + ("from " + args.tokens_file if args.tokens_file
-               else "RANDOM tokens — dress-rehearsal only, not a parity claim")
-            if args.subject
-            else SUBJECT_CAVEAT
+            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT
         ),
     }
     if pretrain_stats is not None:
@@ -670,6 +683,7 @@ def main(argv=None):
                     losses = ensemble_train_loop(
                         enss[seed], chunk, batch_size=sae_batch, key=k,
                         fista_iters=fista_iters,
+                        fista_tol=args.fista_tol,
                     )
                     if s["losses_first"] is None:
                         s["losses_first"] = np.asarray(jax.device_get(losses["loss"]))
@@ -796,12 +810,12 @@ def main(argv=None):
     key, k = jax.random.split(key)
     jax.device_get(ensemble_train_loop(  # warm: any residual compiles
         probe, train_chunks[0], batch_size=sae_batch, key=k,
-        fista_iters=fista_iters)["loss"])
+        fista_iters=fista_iters, fista_tol=args.fista_tol)["loss"])
     t1 = time.time()
     key, k = jax.random.split(key)
     jax.device_get(ensemble_train_loop(
         probe, train_chunks[0], batch_size=sae_batch, key=k,
-        fista_iters=fista_iters)["loss"])
+        fista_iters=fista_iters, fista_tol=args.fista_tol)["loss"])
     steady_s = time.time() - t1
     steps = train_chunks[0].shape[0] // sae_batch
     report["steady_state"] = {

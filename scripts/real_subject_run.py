@@ -108,10 +108,17 @@ def run_config(n: int, args) -> int:
 
     extra = []
     if not args.rehearsal:
+        import hashlib
+
         from parity_run import harvest_rows
 
         n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
-        tokens_path = Path(args.workdir) / f"tokens_cfg{n}.npy"
+        # cache key carries subject+dataset+shape: a rerun with a different
+        # --dataset (or tokenizer) must NOT silently reuse stale tokens
+        key = hashlib.sha1(
+            f"{subject}|{args.dataset}|{n_rows}x{seq_len}".encode()
+        ).hexdigest()[:10]
+        tokens_path = Path(args.workdir) / f"tokens_cfg{n}_{key}.npy"
         if not tokens_path.exists():
             print(f"[cfg{n}] tokenizing {args.dataset} -> {tokens_path} "
                   f"({n_rows} rows x {seq_len})")
@@ -177,7 +184,13 @@ def main(argv=None):
     )
     args = ap.parse_args(argv)
 
-    ns = list(CONFIGS) if args.config == "all" else [int(args.config)]
+    if args.config == "all":
+        ns = list(CONFIGS)
+    else:
+        try:
+            ns = [int(args.config)]
+        except ValueError:
+            ap.error(f"--config must be 1-5 or 'all', got {args.config!r}")
     for n in ns:
         if n not in CONFIGS:
             ap.error(f"--config must be 1-5 or 'all', got {n}")

@@ -68,7 +68,7 @@ def power_iteration_max_eig(
     return jnp.vdot(v, w) / jnp.maximum(jnp.vdot(v, v), eps)
 
 
-@partial(jax.jit, static_argnames=("num_iter",))
+@partial(jax.jit, static_argnames=("num_iter", "tol"))
 def fista(
     batch: jax.Array,
     learned_dict: jax.Array,
@@ -76,11 +76,19 @@ def fista(
     coefficients: jax.Array,
     num_iter: int = 500,
     eta: Optional[jax.Array] = None,
+    tol: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Non-negative FISTA: argmin_c ½‖x - cD‖² + λ‖c‖₁, c ≥ 0.
 
     Shapes: batch [b, d], learned_dict [n, d], coefficients [b, n] (warm
     start). Returns (ahat, residual). Reference `fista.py:99-128`.
+
+    ``tol > 0`` enables early exit (VERDICT r4 next #4): the loop stops once
+    the largest per-element code change of an iteration falls below
+    ``tol * eta`` (the shrinkage step's own scale), bounded by ``num_iter``.
+    The reference runs a blind fixed 500 (`fista.py:116`); solve-to-tolerance
+    returns the same codes to ~tol while skipping the converged tail.
+    ``tol=0`` reproduces the fixed-iteration loop exactly.
 
     Stays full-f32 on purpose: measured on v5e (THROUGHPUT.md r3), bf16
     matmul operands change the codes (~1% values, ~23% boundary-support
@@ -95,8 +103,7 @@ def fista(
         eta = 1.0 / (1.05 * power_iteration_max_eig(learned_dict, n_iter=50))
     eta = jnp.asarray(eta, batch.dtype)
 
-    def body(_, carry):
-        ahat, ahat_y, tk = carry
+    def update(ahat, ahat_y, tk):
         tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
         res = batch - ahat_y @ learned_dict
         ahat_y = ahat_y + eta * (res @ learned_dict.T)
@@ -104,9 +111,30 @@ def fista(
         ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
         return ahat_new, ahat_y, tk_n
 
-    ahat, _, _ = jax.lax.fori_loop(
-        0, num_iter, body, (coefficients, coefficients, jnp.asarray(1.0, batch.dtype))
-    )
+    if tol > 0.0:
+        thresh = tol * eta
+
+        def cond(carry):
+            _, _, _, it, delta = carry
+            return jnp.logical_and(it < num_iter, delta > thresh)
+
+        def step(carry):
+            ahat, ahat_y, tk, it, _delta = carry
+            ahat_new, ahat_y, tk_n = update(ahat, ahat_y, tk)
+            delta = jnp.max(jnp.abs(ahat_new - ahat))
+            return ahat_new, ahat_y, tk_n, it + 1, delta
+
+        init = (
+            coefficients, coefficients, jnp.asarray(1.0, batch.dtype),
+            jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, batch.dtype),
+        )
+        ahat, _, _, _, _ = jax.lax.while_loop(cond, step, init)
+    else:
+        # fixed-iteration path: no per-iteration convergence reduction
+        ahat, _, _ = jax.lax.fori_loop(
+            0, num_iter, lambda _, c: update(*c),
+            (coefficients, coefficients, jnp.asarray(1.0, batch.dtype)),
+        )
     res = batch - ahat @ learned_dict
     return ahat, res
 

@@ -32,19 +32,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _fista_kernel(eta_ref, l1_ref, x_ref, d_ref, c0_ref, a_out_ref, *, num_iter: int):
-    """One batch tile: full FISTA loop in VMEM.
+def _fista_loop(x, d, eta, l1, c0, num_iter: int, tol: float):
+    """The in-VMEM FISTA iteration shared by both kernels. ``tol > 0``
+    early-exits the TILE once an iteration's largest per-element code change
+    drops below ``tol * eta`` (VERDICT r4 next #4 — the reference runs a
+    blind fixed 500, `fista.py:116`); ``tol=0`` keeps the fixed-count loop
+    with no per-iteration reduction."""
 
-    eta/l1 arrive via scalar prefetch (SMEM); x_ref [Tb, d], d_ref [n, d],
-    c0_ref [Tb, n] warm-start codes, a_out_ref [Tb, n].
-    """
-    eta = eta_ref[0]
-    l1 = l1_ref[0]
-    x = x_ref[:]
-    d = d_ref[:]
-
-    def body(_, carry):
-        ahat, ahat_y, tk = carry
+    def update(ahat, ahat_y, tk):
         tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
         res = x - jnp.dot(ahat_y, d, preferred_element_type=jnp.float32)
         ahat_y = ahat_y + eta * jnp.dot(res, d.T, preferred_element_type=jnp.float32)
@@ -52,14 +47,47 @@ def _fista_kernel(eta_ref, l1_ref, x_ref, d_ref, c0_ref, a_out_ref, *, num_iter:
         ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
         return ahat_new, ahat_y, tk_n
 
+    if tol > 0.0:
+        thresh = tol * eta
+
+        def cond(carry):
+            _, _, _, it, delta = carry
+            return jnp.logical_and(it < num_iter, delta > thresh)
+
+        def step(carry):
+            ahat, ahat_y, tk, it, _ = carry
+            ahat_new, ahat_y, tk_n = update(ahat, ahat_y, tk)
+            delta = jnp.max(jnp.abs(ahat_new - ahat))
+            return ahat_new, ahat_y, tk_n, it + 1, delta
+
+        ahat, _, _, _, _ = jax.lax.while_loop(
+            cond, step,
+            (c0, c0, jnp.float32(1.0), jnp.int32(0), jnp.float32(jnp.inf)),
+        )
+        return ahat
+    ahat, _, _ = jax.lax.fori_loop(
+        0, num_iter, lambda _, c: update(*c), (c0, c0, jnp.float32(1.0))
+    )
+    return ahat
+
+
+def _fista_kernel(
+    eta_ref, l1_ref, x_ref, d_ref, c0_ref, a_out_ref, *, num_iter: int, tol: float
+):
+    """One batch tile: full FISTA loop in VMEM.
+
+    eta/l1 arrive via scalar prefetch (SMEM); x_ref [Tb, d], d_ref [n, d],
+    c0_ref [Tb, n] warm-start codes, a_out_ref [Tb, n].
+    """
     c0 = c0_ref[:].astype(jnp.float32)
-    ahat, _, _ = jax.lax.fori_loop(0, num_iter, body, (c0, c0, jnp.float32(1.0)))
-    a_out_ref[:] = ahat
+    a_out_ref[:] = _fista_loop(
+        x_ref[:], d_ref[:], eta_ref[0], l1_ref[0], c0, num_iter, tol
+    )
 
 
 @partial(
     jax.jit,
-    static_argnames=("num_iter", "batch_tile", "interpret"),
+    static_argnames=("num_iter", "batch_tile", "interpret", "tol"),
 )
 def fista_pallas(
     batch: jax.Array,
@@ -70,6 +98,7 @@ def fista_pallas(
     coefficients: Optional[jax.Array] = None,
     batch_tile: int = 256,
     interpret: bool = False,
+    tol: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Non-negative FISTA codes via the VMEM-resident kernel.
 
@@ -96,7 +125,7 @@ def fista_pallas(
 
     grid = (x.shape[0] // tile,)
     ahat = pl.pallas_call(
-        partial(_fista_kernel, num_iter=num_iter),
+        partial(_fista_kernel, num_iter=num_iter, tol=tol),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -123,7 +152,7 @@ def fista_pallas(
 
 def _fista_kernel_hbm_dict(
     eta_ref, l1_ref, x_ref, d_hbm_ref, c0_ref, a_out_ref, d_vmem, sem,
-    *, num_iter: int
+    *, num_iter: int, tol: float
 ):
     """Batch-tiled FISTA with the dictionary DMA'd HBM→VMEM ONCE.
 
@@ -140,26 +169,13 @@ def _fista_kernel_hbm_dict(
         pltpu.make_async_copy(d_hbm_ref, d_vmem, sem).start()
         pltpu.make_async_copy(d_hbm_ref, d_vmem, sem).wait()
 
-    eta = eta_ref[0]
-    l1 = l1_ref[0]
-    x = x_ref[:]
-    d = d_vmem[:]
-
-    def body(_, carry):
-        ahat, ahat_y, tk = carry
-        tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
-        res = x - jnp.dot(ahat_y, d, preferred_element_type=jnp.float32)
-        ahat_y = ahat_y + eta * jnp.dot(res, d.T, preferred_element_type=jnp.float32)
-        ahat_new = jnp.maximum(ahat_y - eta * l1, 0.0)
-        ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
-        return ahat_new, ahat_y, tk_n
-
     c0 = c0_ref[:].astype(jnp.float32)
-    ahat, _, _ = jax.lax.fori_loop(0, num_iter, body, (c0, c0, jnp.float32(1.0)))
-    a_out_ref[:] = ahat
+    a_out_ref[:] = _fista_loop(
+        x_ref[:], d_vmem[:], eta_ref[0], l1_ref[0], c0, num_iter, tol
+    )
 
 
-@partial(jax.jit, static_argnames=("num_iter", "batch_tile", "interpret"))
+@partial(jax.jit, static_argnames=("num_iter", "batch_tile", "interpret", "tol"))
 def fista_pallas_hbm_dict(
     batch: jax.Array,
     learned_dict: jax.Array,
@@ -169,6 +185,7 @@ def fista_pallas_hbm_dict(
     coefficients: Optional[jax.Array] = None,
     batch_tile: int = 128,
     interpret: bool = False,
+    tol: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """`fista_pallas` for dictionaries too big to double-buffer (see
     `_fista_kernel_hbm_dict`). Same contract and numerics."""
@@ -191,7 +208,7 @@ def fista_pallas_hbm_dict(
 
     grid = (x.shape[0] // tile,)
     ahat = pl.pallas_call(
-        partial(_fista_kernel_hbm_dict, num_iter=num_iter),
+        partial(_fista_kernel_hbm_dict, num_iter=num_iter, tol=tol),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -260,22 +277,31 @@ def fista_solve(
     l1_coef,
     coefficients: Optional[jax.Array],
     num_iter: int = 500,
+    tol: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shape-aware FISTA: the VMEM kernel where it fits (small dictionaries —
     HBM-bound under plain jit), the XLA `fori_loop` otherwise (large shapes —
-    full-batch matmuls keep the MXU fed). Same contract as `models.fista.fista`."""
+    full-batch matmuls keep the MXU fed). Same contract as `models.fista.fista`.
+
+    ``tol > 0`` solves to convergence (early exit when the largest
+    per-element code change of an iteration falls below ``tol * eta``),
+    bounded by ``num_iter`` — measured-equivalent codes at tol=1e-3 with the
+    converged tail skipped (THROUGHPUT §r5). ``tol=0`` is the reference's
+    blind fixed-iteration semantics."""
     from sparse_coding__tpu.models.fista import fista
 
     B, D = batch.shape
     N = learned_dict.shape[0]
     if on_tpu() and pallas_fits(B, N, D):
         return fista_pallas(
-            batch, learned_dict, l1_coef, num_iter=num_iter, coefficients=coefficients
+            batch, learned_dict, l1_coef, num_iter=num_iter,
+            coefficients=coefficients, tol=tol,
         )
     if on_tpu() and pallas_hbm_dict_fits(B, N, D):
         return fista_pallas_hbm_dict(
-            batch, learned_dict, l1_coef, num_iter=num_iter, coefficients=coefficients
+            batch, learned_dict, l1_coef, num_iter=num_iter,
+            coefficients=coefficients, tol=tol,
         )
     if coefficients is None:
         coefficients = jnp.zeros((B, N), batch.dtype)
-    return fista(batch, learned_dict, l1_coef, coefficients, num_iter)
+    return fista(batch, learned_dict, l1_coef, coefficients, num_iter, tol=tol)

@@ -35,6 +35,7 @@ def basic_l1_sweep(
     n_epochs: int = 1,
     lr: float = 1e-3,
     fista_iters: int = 500,
+    fista_tol: float = 0.0,
     seed: int = 0,
     shuffle_chunks: bool = True,
     save_after_every: bool = False,
@@ -46,7 +47,9 @@ def basic_l1_sweep(
     `save_after_every` saves per chunk instead of per epoch, as in the
     reference (`basic_l1_sweep.py:90,110-118`). `hbm_cache` uploads each
     chunk once (native dtype) and reuses it across epochs — see
-    `train.sweep`'s `hbm_cache_chunks`. Returns the final dict list."""
+    `train.sweep`'s `hbm_cache_chunks`. ``fista_tol > 0`` solves each
+    FISTA decoder update to convergence instead of a blind fixed count
+    (`train.loop.make_fista_decoder_update`). Returns the final dict list."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
@@ -90,7 +93,7 @@ def basic_l1_sweep(
             key, k = jax.random.split(key)
             ensemble_train_loop(
                 ens, chunk, batch_size=batch_size, key=k,
-                logger=logger, fista_iters=fista_iters,
+                logger=logger, fista_iters=fista_iters, fista_tol=fista_tol,
             )
             if save_after_every:
                 learned_dicts = export()

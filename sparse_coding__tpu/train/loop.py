@@ -111,7 +111,7 @@ def warn_if_ensemble_dead(ensemble: Ensemble, batch, context: str = "") -> bool:
     return dead
 
 
-def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
+def make_fista_decoder_update(num_iter: int = 500, use_pallas=None, tol: float = 0.0) -> Callable:
     """Build (or fetch the cached) jitted, ensemble-vmapped FISTA decoder update.
 
     ``update(state, batch, c) -> state`` where ``c`` is the `aux["c"]` code
@@ -125,32 +125,40 @@ def make_fista_decoder_update(num_iter: int = 500, use_pallas=None) -> Callable:
     True/False force one path. The kernel composes with the ensemble vmap —
     the model axis becomes an extra grid dimension.
 
-    Cached by `(num_iter, use_pallas)` so repeated `ensemble_train_loop` calls
+    ``tol > 0`` solves to convergence instead of a blind fixed count
+    (early exit when an iteration's largest code change < tol*eta; see
+    `ops.fista_pallas.fista_solve`) — same codes to ~tol, converged tail
+    skipped.
+
+    Cached by `(num_iter, use_pallas, tol)` so repeated `ensemble_train_loop` calls
     across a sweep's chunks reuse one jit object (and XLA's compile cache)
     instead of re-tracing the 500-iteration solve every chunk.
     """
-    return _cached_fista_decoder_update(num_iter, "auto" if use_pallas is None else use_pallas)
+    return _cached_fista_decoder_update(
+        num_iter, "auto" if use_pallas is None else use_pallas, float(tol)
+    )
 
 
 @lru_cache(maxsize=None)
-def _cached_fista_decoder_update(num_iter: int, use_pallas) -> Callable:
+def _cached_fista_decoder_update(num_iter: int, use_pallas, tol: float = 0.0) -> Callable:
     def solve(batch, learned_dict, l1_alpha, c_m):
         if use_pallas == "auto":
             # one shared selector (trace-time shapes); on CPU it always takes
             # the XLA path, so no interpret flag is needed here
             from sparse_coding__tpu.ops.fista_pallas import fista_solve
 
-            return fista_solve(batch, learned_dict, l1_alpha, c_m, num_iter)
+            return fista_solve(batch, learned_dict, l1_alpha, c_m, num_iter, tol=tol)
         if use_pallas:
             from sparse_coding__tpu.ops.fista_pallas import fista_pallas, on_tpu
 
             return fista_pallas(
                 batch, learned_dict, l1_alpha, num_iter=num_iter, coefficients=c_m,
                 interpret=not on_tpu(),  # CPU: interpreter keeps tests honest
+                tol=tol,
             )
         from sparse_coding__tpu.models.fista import fista
 
-        return fista(batch, learned_dict, l1_alpha, c_m, num_iter)
+        return fista(batch, learned_dict, l1_alpha, c_m, num_iter, tol=tol)
 
     @partial(jax.jit, donate_argnums=(0,))
     def update(state: EnsembleState, batch: jax.Array, c: jax.Array) -> EnsembleState:
@@ -188,6 +196,7 @@ def ensemble_train_loop(
     log_every: int = 16,
     fista_update: Optional[bool] = None,
     fista_iters: int = 500,
+    fista_tol: float = 0.0,
     progress_callback: Optional[Callable[[int, int], None]] = None,
     scan_steps: int = 8,
     dead_check: bool = True,
@@ -211,7 +220,11 @@ def ensemble_train_loop(
     """
     if fista_update is None:
         fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
-    fista_fn = make_fista_decoder_update(fista_iters) if fista_update else None
+    fista_fn = (
+        make_fista_decoder_update(fista_iters, tol=fista_tol)
+        if fista_update
+        else None
+    )
     if fista_fn is not None:
         scan_steps = 1
 

@@ -135,3 +135,54 @@ def test_fista_learned_dict_export(planted):
     assert x_hat.shape == x.shape
     a, res = ld.fista(x, jnp.zeros_like(c), jnp.asarray(1e-4), num_iter=200)
     assert float(jnp.mean(res**2)) < float(jnp.mean(x**2))
+
+
+def test_fista_tol_matches_fixed_iteration_solution(planted):
+    """Solve-to-tolerance (tol > 0, the VERDICT-r4-#4 early-exit lever) must
+    return the same codes as the blind fixed-500 solve to ~tol, on both the
+    XLA path and the Pallas kernel (interpret mode)."""
+    from sparse_coding__tpu.ops.fista_pallas import fista_pallas
+
+    D, _, x = planted
+    c0 = jnp.zeros((x.shape[0], D.shape[0]))
+    l1 = jnp.asarray(1e-3)
+
+    a_fixed, _ = fista(x, D, l1, c0, num_iter=500)
+    a_tol, _ = fista(x, D, l1, c0, num_iter=500, tol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(a_tol), np.asarray(a_fixed), rtol=0, atol=2e-3
+    )
+    # support agreement: early exit must not flip active features
+    agree = (np.asarray(a_tol) > 0) == (np.asarray(a_fixed) > 0)
+    assert agree.mean() > 0.999, agree.mean()
+
+    ap_fixed, _ = fista_pallas(x, D, l1, num_iter=500, interpret=True)
+    ap_tol, _ = fista_pallas(x, D, l1, num_iter=500, interpret=True, tol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ap_tol), np.asarray(ap_fixed), rtol=0, atol=2e-3
+    )
+
+
+def test_fista_tol_actually_exits_early():
+    """At a realistic dictionary shape the tol=1e-3 solve converges in
+    ~100-200 iterations (measured) — observable because the loop is
+    iteration-deterministic: if it exits at k iters, every num_iter >= k
+    returns identical codes. (The tiny `planted` fixture never crosses the
+    threshold — FISTA momentum keeps its max-element delta oscillating — in
+    which case tol degrades safely to the fixed-count loop.)"""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    D = jax.random.normal(k1, (1024, 512))
+    D = D / jnp.linalg.norm(D, axis=-1, keepdims=True)
+    mask = jax.random.bernoulli(k3, 0.01, (128, 1024))
+    codes = jax.random.uniform(k2, (128, 1024), minval=0.5, maxval=1.5) * mask
+    x = codes @ D + 0.01 * jax.random.normal(k2, (128, 512))
+    c0 = jnp.zeros((x.shape[0], D.shape[0]))
+    l1 = jnp.asarray(1e-3)
+    a_500, _ = fista(x, D, l1, c0, num_iter=500, tol=1e-3)
+    a_250, _ = fista(x, D, l1, c0, num_iter=250, tol=1e-3)
+    np.testing.assert_array_equal(np.asarray(a_500), np.asarray(a_250))
+    # and the converged solve agrees with the blind fixed-500 solution
+    a_fixed, _ = fista(x, D, l1, c0, num_iter=500)
+    support = (np.asarray(a_500) > 0) == (np.asarray(a_fixed) > 0)
+    # ~0.6% of entries flip at the active/inactive boundary (values ~tol)
+    assert support.mean() > 0.99, support.mean()

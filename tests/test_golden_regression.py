@@ -39,24 +39,14 @@ def committed_dicts():
 
 
 def test_committed_dicts_reevaluate_to_golden(golden, committed_dicts):
-    from make_golden_fixture import BATCH, D_ACT, SEED, STEPS_PER_EPOCH
-
-    import jax
+    # THE fixture's own generator constructor — hand-copied kwargs here
+    # would silently drift from the stream the golden numbers pin
+    from make_golden_fixture import STEPS_PER_EPOCH, make_generator
 
     from sparse_coding__tpu import metrics as sm
-    from sparse_coding__tpu.data import RandomDatasetGenerator
 
-    cfg = golden["config"]
-    gen = RandomDatasetGenerator(
-        activation_dim=cfg["d_act"],
-        n_ground_truth_components=2 * cfg["d_act"],
-        batch_size=cfg["batch"],
-        feature_num_nonzero=6,
-        feature_prob_decay=0.99,
-        correlated=False,
-        key=jax.random.PRNGKey(cfg["seed"] + 1000),
-    )
-    for _ in range(cfg["steps_per_epoch"]):
+    gen = make_generator()
+    for _ in range(STEPS_PER_EPOCH):
         next(gen)  # identical stream position to the generator script
     eval_batch = next(gen)
     truth = np.asarray(gen.feats)
