@@ -19,7 +19,7 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-ROUND_TAG = os.environ.get("PARITY_ROUND", "r04")
+ROUND_TAG = os.environ.get("PARITY_ROUND", "r05")
 
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
@@ -34,6 +34,7 @@ def main():
     from sparse_coding__tpu.plotting import convergence_trajectories, save_figure
 
     trajectories = {}
+    mmcs_trajectories = {}
     # every PARITY_<round>*.json at the artifact root (quick-mode CI outputs
     # excluded); the legend label is the stem suffix ("" -> the l1 config)
     for path in sorted(art_dir.glob(f"PARITY_{ROUND_TAG}*.json")):
@@ -46,6 +47,10 @@ def main():
             if isinstance(rec, dict) and "fvu_trajectory" in rec:
                 run = key.removeprefix("train_")
                 trajectories[f"{label}:{run}"] = rec["fvu_trajectory"]
+            if key.startswith("mmcs_trajectory") and isinstance(rec, dict):
+                fam = key.removeprefix("mmcs_trajectory").lstrip("_")
+                name = f"{label}:{fam}" if fam else label
+                mmcs_trajectories[name] = rec["values"]
     if not trajectories:
         raise SystemExit("no fvu_trajectory records found")
 
@@ -56,6 +61,18 @@ def main():
     out = Path(args.out) if args.out else art_dir / f"parity_convergence_{ROUND_TAG}.png"
     save_figure(fig, out)
     print(f"Wrote {out} ({len(trajectories)} runs)")
+
+    if mmcs_trajectories:
+        # the r5 joint-criterion view: feature identifiability vs epoch
+        fig = convergence_trajectories(
+            mmcs_trajectories,
+            title=f"Cross-seed MMCS vs epoch — lockstep seed pairs ({ROUND_TAG})",
+            value_key="mean_mmcs",
+            y_label="cross-seed mean MMCS (grid average)",
+        )
+        out2 = art_dir / f"parity_mmcs_{ROUND_TAG}.png"
+        save_figure(fig, out2)
+        print(f"Wrote {out2} ({len(mmcs_trajectories)} pairs)")
 
 
 if __name__ == "__main__":

@@ -732,6 +732,12 @@ def main(argv=None):
             elif mmcs_prev is not None:
                 mmcs_stall = 0
             mmcs_prev = mmean
+            print(
+                f"  epoch {epoch}: fvu "
+                + "/".join(f"{st[s]['prev']:.4f}" for s in seeds)
+                + f" mmcs {mmean:.3f}",
+                flush=True,
+            )
             fvu_done = all(s["stall"] >= 2 for s in st.values())
             diverged = any(s["diverge"] >= 2 for s in st.values())
             if (fvu_done and mmcs_stall >= 2) or diverged:

@@ -111,6 +111,20 @@ def fista(
         ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
         return ahat_new, ahat_y, tk_n
 
+    ahat = run_fista_iterations(update, coefficients, num_iter, tol, eta)
+    res = batch - ahat @ learned_dict
+    return ahat, res
+
+
+def run_fista_iterations(update, c0, num_iter: int, tol, eta):
+    """THE FISTA iteration scaffold — shared by the XLA path above and the
+    Pallas kernels (`ops.fista_pallas._fista_loop`), so the early-exit
+    criterion exists exactly once. ``update(ahat, ahat_y, tk) -> (ahat_new,
+    ahat_y, tk_n)`` supplies the math (each caller's own matmul idiom);
+    ``tol > 0`` runs a bounded `while_loop` exiting when an iteration's
+    largest per-element code change falls below ``tol * eta``; ``tol = 0``
+    runs the fixed-count `fori_loop` with no per-iteration reduction."""
+    tk0 = jnp.asarray(1.0, c0.dtype)
     if tol > 0.0:
         thresh = tol * eta
 
@@ -124,19 +138,12 @@ def fista(
             delta = jnp.max(jnp.abs(ahat_new - ahat))
             return ahat_new, ahat_y, tk_n, it + 1, delta
 
-        init = (
-            coefficients, coefficients, jnp.asarray(1.0, batch.dtype),
-            jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, batch.dtype),
-        )
+        init = (c0, c0, tk0, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, c0.dtype))
         ahat, _, _, _, _ = jax.lax.while_loop(cond, step, init)
-    else:
-        # fixed-iteration path: no per-iteration convergence reduction
-        ahat, _, _ = jax.lax.fori_loop(
-            0, num_iter, lambda _, c: update(*c),
-            (coefficients, coefficients, jnp.asarray(1.0, batch.dtype)),
-        )
-    res = batch - ahat @ learned_dict
-    return ahat, res
+        return ahat
+    # fixed-iteration path: no per-iteration convergence reduction
+    ahat, _, _ = jax.lax.fori_loop(0, num_iter, lambda _, c: update(*c), (c0, c0, tk0))
+    return ahat
 
 
 def quadratic_basis_update(

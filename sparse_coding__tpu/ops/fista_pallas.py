@@ -33,11 +33,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _fista_loop(x, d, eta, l1, c0, num_iter: int, tol: float):
-    """The in-VMEM FISTA iteration shared by both kernels. ``tol > 0``
-    early-exits the TILE once an iteration's largest per-element code change
-    drops below ``tol * eta`` (VERDICT r4 next #4 — the reference runs a
-    blind fixed 500, `fista.py:116`); ``tol=0`` keeps the fixed-count loop
-    with no per-iteration reduction."""
+    """The in-VMEM FISTA iteration shared by both kernels: the kernels' own
+    matmul idiom (VMEM `jnp.dot` with f32 accumulation) plugged into the ONE
+    shared scaffold `models.fista.run_fista_iterations`, so the early-exit
+    criterion (VERDICT r4 next #4; the reference runs a blind fixed 500,
+    `fista.py:116`) cannot drift between the XLA and Pallas paths."""
+    from sparse_coding__tpu.models.fista import run_fista_iterations
 
     def update(ahat, ahat_y, tk):
         tk_n = (1.0 + jnp.sqrt(1.0 + 4.0 * tk**2)) / 2.0
@@ -47,28 +48,7 @@ def _fista_loop(x, d, eta, l1, c0, num_iter: int, tol: float):
         ahat_y = ahat_new + (ahat_new - ahat) * ((tk - 1.0) / tk_n)
         return ahat_new, ahat_y, tk_n
 
-    if tol > 0.0:
-        thresh = tol * eta
-
-        def cond(carry):
-            _, _, _, it, delta = carry
-            return jnp.logical_and(it < num_iter, delta > thresh)
-
-        def step(carry):
-            ahat, ahat_y, tk, it, _ = carry
-            ahat_new, ahat_y, tk_n = update(ahat, ahat_y, tk)
-            delta = jnp.max(jnp.abs(ahat_new - ahat))
-            return ahat_new, ahat_y, tk_n, it + 1, delta
-
-        ahat, _, _, _, _ = jax.lax.while_loop(
-            cond, step,
-            (c0, c0, jnp.float32(1.0), jnp.int32(0), jnp.float32(jnp.inf)),
-        )
-        return ahat
-    ahat, _, _ = jax.lax.fori_loop(
-        0, num_iter, lambda _, c: update(*c), (c0, c0, jnp.float32(1.0))
-    )
-    return ahat
+    return run_fista_iterations(update, c0, num_iter, tol, eta)
 
 
 def _fista_kernel(

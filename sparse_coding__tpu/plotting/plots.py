@@ -434,20 +434,24 @@ def convergence_trajectories(
     trajectories: Dict[str, Sequence[Dict[str, Any]]],
     title: str = "Held-out FVU vs training epoch",
     log_y: bool = False,
+    value_key: str = "mean_fvu",
+    y_label: str = "mean held-out FVU (grid average)",
 ):
     """Plateau-training convergence curves (round-4 parity protocol): one
     line per run from the artifact's `fvu_trajectory` records
     (`[{"epoch": i, "mean_fvu": v, ...}, ...]` — `scripts/parity_run.py`).
-    The judge-facing view of "trained to plateau, not smoke-trained"."""
+    The judge-facing view of "trained to plateau, not smoke-trained".
+    ``value_key``/``y_label`` render other per-epoch records with the same
+    shape (e.g. the r5 `mmcs_trajectory` with value_key="mean_mmcs")."""
     fig, ax = plt.subplots(figsize=(7, 5))
     for name, traj in sorted(trajectories.items()):
         xs = [int(t["epoch"]) for t in traj]
-        ys = [float(t["mean_fvu"]) for t in traj]
+        ys = [float(t[value_key]) for t in traj]
         ax.plot(xs, ys, "o-", label=name, markersize=3)
     if log_y:
         ax.set_yscale("log")
     ax.set_xlabel("epoch")
-    ax.set_ylabel("mean held-out FVU (grid average)")
+    ax.set_ylabel(y_label)
     ax.set_title(title)
     ax.legend(fontsize=8)
     return fig

@@ -41,25 +41,38 @@ def median_spread(vals):
 
 
 def make_control(side: int = 8192, reps: int = 8):
-    """The pinned-control program: a FIXED `side`^3 bf16 matmul whose
-    workload never changes across rounds. Returns `measure() -> TFLOP/s`.
-    A session where the control runs k% slow scales every other key's
-    expectation by k% (chip weather); a key that moves AGAINST the control
-    moved because the code did."""
+    """The pinned-control program: `reps` FIXED `side`^3 bf16 matmuls
+    CHAINED inside one jitted program (one dispatch — per-call tunnel
+    latency must not pollute the number; the v1 loop-of-dispatches form
+    measured 29% of peak where the chained form measures the real MXU
+    fraction). Returns `measure() -> TFLOP/s`. A session where the control
+    runs k% slow scales every other key's expectation by k% (chip weather);
+    a key that moves AGAINST the control moved because the code did."""
     import jax
     import jax.numpy as jnp
 
     a = jax.random.normal(jax.random.PRNGKey(11), (side, side), jnp.bfloat16)
     b = jax.random.normal(jax.random.PRNGKey(12), (side, side), jnp.bfloat16)
-    mm = jax.jit(lambda a, b: (a @ b).sum(dtype=jnp.float32))
-    jax.device_get(mm(a, b))  # compile
-    flop = 2 * side**3
+
+    @jax.jit
+    def chain(a, b):
+        # data-dependent chain: each matmul consumes the previous result, so
+        # XLA cannot elide or reorder any of the reps
+        x = a
+        for _ in range(reps):
+            x = jax.lax.dot_general(
+                x, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.bfloat16,
+            )
+        return x.astype(jnp.float32).sum()
+
+    jax.device_get(chain(a, b))  # compile
+    flop = reps * 2 * side**3
 
     def measure() -> float:
         t0 = time.perf_counter()
-        for _ in range(reps):
-            out = mm(a, b)
+        out = chain(a, b)
         jax.device_get(out)
-        return reps * flop / (time.perf_counter() - t0) / 1e12
+        return flop / (time.perf_counter() - t0) / 1e12
 
     return measure

@@ -115,3 +115,26 @@ def test_investigate(tmp_path):
     mean_enn = random_feature_diversity(tmp_path, n=500, d=d)
     # random unit vectors in R^d have ENN well below d but far above 1
     assert 2 < mean_enn < d
+
+
+def test_l1_warmup_reaches_builders_and_warns_for_topk():
+    """EnsembleArgs.l1_warmup_steps flows through the experiment builders to
+    every l1-family Ensemble; a TopK builder warns and drops it instead of
+    raising (one sweep may mix families) — VERDICT r4 next #2 + ADVICE."""
+    import warnings
+
+    from sparse_coding__tpu.train.experiments import (
+        dense_l1_range_experiment,
+        topk_experiment,
+    )
+    from sparse_coding__tpu.utils.config import EnsembleArgs
+
+    cfg = EnsembleArgs(activation_width=16, l1_warmup_steps=7, batch_size=32)
+    (ens_l1, _, _), = dense_l1_range_experiment(cfg)[0]
+    assert ens_l1.l1_warmup_steps == 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ens_topk, _, _ = topk_experiment(cfg)[0][0]  # first of 4 ratio stacks
+    assert ens_topk.l1_warmup_steps == 0
+    assert any("l1_warmup" in str(x.message) for x in w), [str(x.message) for x in w]
