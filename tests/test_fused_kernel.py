@@ -334,7 +334,7 @@ def test_accum_adam_kernel_matches_resident_kernel():
     f32 tolerance: the two kernels sum partial products in different orders."""
     from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
 
-    B_big = 1024  # 2 batch tiles of 512 in the accum kernel
+    B_big = 2048  # 2 batch tiles of 1024 in the accum kernel
     key = jax.random.PRNGKey(0)
     models = [
         FunctionalTiedSAE.init(k, D, N, l1_alpha=a, bias_decay=0.0)
