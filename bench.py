@@ -405,7 +405,21 @@ def main(argv=None):
         "--rounds", type=int, default=ROUNDS,
         help="timed windows per key (interleaved round-robin across keys)",
     )
+    ap.add_argument(
+        "--events", default=None, metavar="DIR",
+        help="also write a telemetry events.jsonl (run fingerprint, compile "
+        "events, counters) under DIR — renderable with "
+        "`python -m sparse_coding__tpu.report DIR`",
+    )
     args = ap.parse_args(argv)
+
+    # telemetry: with --events a full events.jsonl; without, an in-memory
+    # instance whose counters still put compile wall time in the output JSON
+    # (compile is the one cost the interleaved-median protocol can't see)
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    telemetry = RunTelemetry(out_dir=args.events, run_name="bench")
+    telemetry.run_start(config={"rounds": max(2, args.rounds)})
 
     from sparse_coding__tpu import build_ensemble
     from sparse_coding__tpu.data import RandomDatasetGenerator
@@ -509,6 +523,18 @@ def main(argv=None):
         out["bigbatch16k_acts_per_sec"] * flops_per_act / (peak * 1e12), 3
     )
     out["control_fraction_of_peak"] = round(out["control_matmul_tflops"] / peak, 3)
+    # compile activity observed by the jax.monitoring bridge during setup —
+    # the sessions-differ-by-compile-state confound, now in the artifact
+    counters = telemetry.counters
+    out["compile"] = {
+        "backend_compiles": int(counters.get("compile.backend.count", 0)),
+        "backend_compile_seconds": round(
+            counters.get("compile.backend.seconds", 0.0), 2
+        ),
+        "cache_hits": int(counters.get("compile_cache.cache_hits", 0)),
+    }
+    telemetry.run_end(status="ok")
+    telemetry.close()
     print(json.dumps(out))
 
 

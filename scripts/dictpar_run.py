@@ -255,6 +255,7 @@ def main(argv=None):
         file_tokens,
         maybe_pretrain,
         real_subject_caveat,
+        tiling_caveat,
     )
 
     pretrain_steps = args.pretrain if args.pretrain >= 0 else (0 if quick else 2000)
@@ -269,8 +270,9 @@ def main(argv=None):
         params, lm_cfg, quick, pretrain_steps
     )
     # seed=0 keeps the --pretrain 0 path token-identical to the round-2 runs
+    tiling_info = None
     if args.tokens_file:
-        tokens = file_tokens(
+        tokens, tiling_info = file_tokens(
             args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
             seq_len, n_chunks + 1,
         )
@@ -307,9 +309,11 @@ def main(argv=None):
             "l1_warmup_steps": args.l1_warmup_steps,
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": (
-            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT
+        "subject_caveat": tiling_caveat(
+            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT,
+            tiling_info,
         ),
+        **({"harvest_tiling": tiling_info} if tiling_info else {}),
         **({"pretrain": pretrain_stats} if pretrain_stats else {}),
         "notes": (
             f"{'trigram-pretrained' if lang is not None else 'random-init'} "

@@ -169,8 +169,15 @@ def corpus_tokens(lang, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chun
 def file_tokens(path, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks):
     """Harvest tokens from a pre-tokenized `.npy` ([rows, >=seq_len] ints) —
     the real-text path `real_subject_run.py` feeds after tokenizing an HF
-    dataset. Rows are tiled with a warning if the file is smaller than the
-    requested harvest (truncation would silently shrink the run)."""
+    dataset. Rows are tiled if the file is smaller than the requested
+    harvest (truncation would silently shrink the run).
+
+    Returns ``(tokens, tiling_info)``: `tiling_info` is None when the file
+    covered the harvest, else a dict ``{tiled, rows_available,
+    rows_requested, repeat_factor}`` that callers MUST surface in the
+    artifact JSON's `subject_caveat` — repeated text inflates apparent
+    feature consistency, and a caveat that only ever lived on stdout is
+    invisible to anyone reading the artifact."""
     arr = np.load(path)
     if arr.ndim != 2 or arr.shape[1] < seq_len:
         raise ValueError(
@@ -182,13 +189,32 @@ def file_tokens(path, vocab_size, d_act, chunk_gb, batch_rows, seq_len, n_chunks
         )
     arr = arr[:, :seq_len]
     n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    tiling_info = None
     if arr.shape[0] < n_rows:
+        tiling_info = {
+            "tiled": True,
+            "rows_available": int(arr.shape[0]),
+            "rows_requested": int(n_rows),
+            "repeat_factor": round(n_rows / arr.shape[0], 2),
+        }
         print(
             f"WARNING: {path} has {arr.shape[0]} rows < {n_rows} requested; "
             "tiling (the harvest will repeat text)"
         )
         arr = np.tile(arr, (int(np.ceil(n_rows / arr.shape[0])), 1))
-    return np.ascontiguousarray(arr[:n_rows]).astype(np.int32)
+    return np.ascontiguousarray(arr[:n_rows]).astype(np.int32), tiling_info
+
+
+def tiling_caveat(caveat: str, tiling_info) -> str:
+    """Append `file_tokens`' tiling flag to a run's `subject_caveat`."""
+    if not tiling_info:
+        return caveat
+    return (
+        f"{caveat}; HARVEST TEXT TILED {tiling_info['repeat_factor']}x "
+        f"({tiling_info['rows_available']} rows available of "
+        f"{tiling_info['rows_requested']} requested) — repeated text "
+        "inflates apparent cross-seed feature consistency"
+    )
 
 
 def real_subject_caveat(args) -> str:
@@ -273,8 +299,9 @@ def run_basic(args):
     d_act = lm_cfg.d_model
     params, lang, pretrain_stats = maybe_pretrain(params, lm_cfg, quick, pretrain_steps)
 
+    tiling_info = None
     if getattr(args, "tokens_file", None):
-        tokens = file_tokens(
+        tokens, tiling_info = file_tokens(
             args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
             seq_len, n_chunks + 1,
         )
@@ -300,10 +327,13 @@ def run_basic(args):
             "seeds": list(seeds),
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": (
-            real_subject_caveat(args) if subject_arg else SUBJECT_CAVEAT
+        "subject_caveat": tiling_caveat(
+            real_subject_caveat(args) if subject_arg else SUBJECT_CAVEAT,
+            tiling_info,
         ),
     }
+    if tiling_info:
+        report["harvest_tiling"] = tiling_info
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
 
@@ -555,8 +585,9 @@ def main(argv=None):
     if lang is not None:
         subject = subject.replace("random init", "trigram-pretrained")
 
+    tiling_info = None
     if args.tokens_file:
-        tokens = file_tokens(
+        tokens, tiling_info = file_tokens(
             args.tokens_file, lm_cfg.vocab_size, d_act, chunk_gb, batch_rows,
             seq_len, n_chunks + 1,
         )
@@ -585,10 +616,13 @@ def main(argv=None):
             "fista_tol": args.fista_tol,
             "device": jax.devices()[0].device_kind,
         },
-        "subject_caveat": (
-            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT
+        "subject_caveat": tiling_caveat(
+            real_subject_caveat(args) if args.subject else SUBJECT_CAVEAT,
+            tiling_info,
         ),
     }
+    if tiling_info:
+        report["harvest_tiling"] = tiling_info
     if pretrain_stats is not None:
         report["pretrain"] = pretrain_stats
 

@@ -54,7 +54,8 @@ if str(SCRIPTS) not in sys.path:
 # (subject, driver, driver-config, token-row plan) per BASELINE config.
 # The row plans mirror the constants inside parity_run/dictpar_run mains —
 # d_act, chunk_gb, batch_rows, seq_len, n_chunks(+1 eval) — so the token
-# file covers the full harvest; `file_tokens` tiles with a loud warning if
+# file covers the full harvest; `file_tokens` tiles — and flags it in the
+# artifact JSON (`harvest_tiling` + subject_caveat suffix) — if
 # a driver constant grows past this table.
 CONFIGS = {
     1: dict(subject="EleutherAI/pythia-70m-deduped", driver="parity",

@@ -15,6 +15,8 @@ Layout:
   - `train`      — sweep orchestrator, train loops, checkpointing
   - `metrics`    — FVU / MMCS / sparsity / moments / perplexity metrics
   - `interp`     — automated-interpretability pipeline
+  - `telemetry`  — run events, training-health pack, anomaly guard, transfer
+                   audit, `python -m sparse_coding__tpu.report` summaries
 """
 
 from sparse_coding__tpu.ensemble import (

@@ -37,6 +37,14 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from sparse_coding__tpu.telemetry.events import tracked_jit
+from sparse_coding__tpu.telemetry.health import (
+    FIRE_EMA_KEY,
+    HealthConfig,
+    health_pack,
+    init_fire_ema,
+    n_feats_of,
+)
 from sparse_coding__tpu.utils import precision as px
 
 Pytree = Any
@@ -119,6 +127,22 @@ def l1_warmup_buffers(buffers: Pytree, step: jax.Array, warmup_steps: int, sig=N
     return {**buffers, "l1_alpha": buffers["l1_alpha"] * ramp}
 
 
+def _mask_updates(updates: Pytree, mask: jax.Array) -> Pytree:
+    """Zero the optimizer updates of masked-out models, NaN-safely.
+
+    ``mask`` is 1.0=train / 0.0=frozen — 0-d inside the vmapped per-model
+    body, ``[n_models]`` on the stacked fused paths. `jnp.where`, not
+    multiplication: a sick member's gradients are typically already NaN and
+    ``0 * NaN = NaN`` would re-poison the frozen params every step.
+    """
+
+    def one(u):
+        m = mask.reshape(mask.shape + (1,) * (u.ndim - mask.ndim))
+        return jnp.where(m > 0, u, jnp.zeros_like(u))
+
+    return jax.tree.map(one, updates)
+
+
 def stack_pytrees(trees: Sequence[Pytree]) -> Pytree:
     """Stack a list of identically-shaped pytrees along a new leading axis.
 
@@ -160,6 +184,7 @@ def make_ensemble_step(
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """Build the fused train step for a stacked ensemble.
 
@@ -193,19 +218,44 @@ def make_ensemble_step(
         worst-example resurrection (`huge_batch_size.py:224-254`) is
         net-negative (RESURRECT_r04*.json). The stored buffers are never
         mutated — only the loss sees the ramped value.
+      health: a `telemetry.health.HealthConfig` fuses the per-model health
+        pack into the step: ``health_grad_norm`` / ``health_dict_norm`` /
+        ``health_nonfinite`` / ``health_dead_frac`` join the returned loss
+        dict as [n_models] device scalars (they ride the MetricLogger buffer
+        — no host sync), and the firing-frequency EMA persists in the buffers
+        under `FIRE_EMA_KEY`. Incompatible with the fused Pallas paths, which
+        exist precisely to keep grads and the code tensor out of HBM —
+        `Ensemble` forces ``fused=False`` when health is on, and this builder
+        suppresses the fused branches defensively.
+
+    Additionally, a ``buffers["update_mask"]`` key ([n_models] f32, 1=train /
+    0=frozen — see `Ensemble.set_update_mask`) NaN-safely zeroes the masked
+    members' optimizer updates: the anomaly guard's "continue with the sick
+    model masked" action. Key presence is a trace-time (structure) decision,
+    so unmasked ensembles compile the exact program they always did.
     """
 
     grad_fn = jax.grad(sig.loss, has_aux=True)
-
-    def one_model(params, buffers, opt_state, batch):
-        grads, (loss_dict, aux) = grad_fn(params, buffers, batch)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss_dict, aux
-
     batch_axis = 0 if per_model_batch else None
 
     def step(state: EnsembleState, batch: jax.Array):
+        def one_model(params, buffers, opt_state, batch):
+            grads, (loss_dict, aux) = grad_fn(params, buffers, batch)
+            extra = {}
+            if health is not None:
+                h, new_ema = health_pack(
+                    params, grads, loss_dict["loss"], aux,
+                    buffers[FIRE_EMA_KEY], state.step, health,
+                )
+                loss_dict = {**loss_dict, **h}
+                extra[FIRE_EMA_KEY] = new_ema
+            updates, opt_state = tx.update(grads, opt_state, params)
+            mask = buffers.get("update_mask")
+            if mask is not None:
+                updates = _mask_updates(updates, mask)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss_dict, aux, extra
+
         # `px.compute` is a trace-time policy: it runs while jit traces this
         # body, so the chosen precision is baked into the compiled program.
         with px.compute(compute_dtype):
@@ -215,8 +265,18 @@ def make_ensemble_step(
             # Fused Pallas path: one kernel launch for the whole stack (the
             # model axis is a grid dim — vmapping the kernel would serialize
             # it). Static trace-time condition; shared-batch only.
+            # The in-kernel Adam path cannot mask updates (they never reach
+            # HBM), so a masked ensemble runs fused grads + optax instead —
+            # and the VMEM gate below must be checked against the kernel
+            # that will actually execute.
+            adam_kernel_active = (
+                fused_adam is not None
+                and hasattr(sig, "fused_adam_step")
+                and "update_mask" not in exec_buffers
+            )
             fused_ok = (
                 fused
+                and health is None  # health pack needs grads + aux in HBM
                 and not per_model_batch
                 and not unstacked
                 and batch.shape[0] % 256 == 0
@@ -226,12 +286,7 @@ def make_ensemble_step(
                     not hasattr(sig, "fused_batch_supported")
                     or sig.fused_batch_supported(
                         state.params, batch.shape[0],
-                        # mirror the dispatch below: the Adam kernel only runs
-                        # when the signature actually implements it, so the
-                        # VMEM fit must be checked against the kernel that
-                        # will execute
-                        adam_fused=fused_adam is not None
-                        and hasattr(sig, "fused_adam_step"),
+                        adam_fused=adam_kernel_active,
                     )
                 )
             )
@@ -248,6 +303,7 @@ def make_ensemble_step(
             if (
                 not fused_ok
                 and fused
+                and health is None
                 and not per_model_batch
                 and not unstacked
                 and hasattr(sig, "fused_grads_stacked")
@@ -292,6 +348,8 @@ def make_ensemble_step(
                 updates, opt_state = jax.vmap(tx.update)(
                     grads, state.opt_state, state.params
                 )
+                if "update_mask" in exec_buffers:
+                    updates = _mask_updates(updates, exec_buffers["update_mask"])
                 params = optax.apply_updates(state.params, updates)
                 return (
                     EnsembleState(
@@ -303,7 +361,7 @@ def make_ensemble_step(
                     (loss_dict, {}),
                 )
             if fused_ok:
-                if fused_adam is not None and hasattr(sig, "fused_adam_step"):
+                if adam_kernel_active:
                     params, opt_state, loss_dict = sig.fused_adam_step(
                         state.params, exec_buffers, batch, state.opt_state, **fused_adam
                     )
@@ -318,6 +376,8 @@ def make_ensemble_step(
                     )
                 grads, loss_dict = sig.fused_grads_stacked(state.params, exec_buffers, batch)
                 updates, opt_state = jax.vmap(tx.update)(grads, state.opt_state, state.params)
+                if "update_mask" in exec_buffers:
+                    updates = _mask_updates(updates, exec_buffers["update_mask"])
                 params = optax.apply_updates(state.params, updates)
                 return (
                     EnsembleState(
@@ -335,14 +395,18 @@ def make_ensemble_step(
                 else:
                     xs = (state.params, exec_buffers, state.opt_state)
                     f = lambda args: one_model(*args, batch)
-                params, opt_state, loss_dict, aux = jax.lax.map(f, xs)
+                params, opt_state, loss_dict, aux, extra = jax.lax.map(f, xs)
             else:
-                params, opt_state, loss_dict, aux = jax.vmap(
+                params, opt_state, loss_dict, aux, extra = jax.vmap(
                     one_model, in_axes=(0, 0, 0, batch_axis)
                 )(state.params, exec_buffers, state.opt_state, batch)
+        # health writes its firing EMA back into the STORED buffers (never
+        # the warmup-ramped exec view) — `extra` is {} otherwise, a
+        # trace-time structural no-op
+        buffers = {**state.buffers, **extra} if extra else state.buffers
         new_state = EnsembleState(
             params=params,
-            buffers=state.buffers,
+            buffers=buffers,
             opt_state=opt_state,
             step=state.step + 1,
         )
@@ -360,6 +424,7 @@ def make_ensemble_multi_step(
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """K fused train steps under ONE compiled program via `lax.scan`.
 
@@ -375,7 +440,7 @@ def make_ensemble_multi_step(
     """
     step = make_ensemble_step(
         sig, tx, per_model_batch, unstacked, compute_dtype, fused, fused_adam,
-        l1_warmup_steps,
+        l1_warmup_steps, health,
     )
 
     def multi_step(state: EnsembleState, batches: jax.Array):
@@ -397,6 +462,7 @@ def make_ensemble_multi_step_idx(
     fused: bool = False,
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """`make_ensemble_multi_step`, but each step's batch is GATHERED from the
     resident dataset inside the compiled scan (`multi_step_idx(state,
@@ -419,7 +485,7 @@ def make_ensemble_multi_step_idx(
     step = make_ensemble_step(
         sig, tx, per_model_batch=False, unstacked=unstacked,
         compute_dtype=compute_dtype, fused=fused, fused_adam=fused_adam,
-        l1_warmup_steps=l1_warmup_steps,
+        l1_warmup_steps=l1_warmup_steps, health=health,
     )
 
     def multi_step_idx(state: EnsembleState, dataset: jax.Array, idxs: jax.Array):
@@ -472,6 +538,7 @@ class Ensemble:
         compute_dtype=None,
         fused: Optional[bool] = None,
         l1_warmup_steps: int = 0,
+        health: bool | HealthConfig = False,
     ):
         if not models:
             raise ValueError("Ensemble requires at least one (params, buffers) model")
@@ -486,6 +553,16 @@ class Ensemble:
         self.unstacked = unstacked
         self.l1_warmup_steps = int(l1_warmup_steps)
         self.compute_dtype = None if compute_dtype is None else jnp.dtype(compute_dtype)
+        # telemetry health pack (opt-in): per-model grad/dict norms, NaN
+        # flags, dead-feature fraction — computed inside the jitted step.
+        # Forces the fused Pallas paths OFF: they exist to keep grads and
+        # the code tensor out of HBM, which is exactly what health reads.
+        self.health: Optional[HealthConfig] = (
+            health if isinstance(health, HealthConfig)
+            else (HealthConfig() if health else None)
+        )
+        if self.health is not None:
+            fused = False
         if fused is None:
             # auto: Pallas fused step on real TPU when the signature supports
             # this config and the caller opted into bf16 compute.
@@ -515,6 +592,10 @@ class Ensemble:
         params_list, buffers_list = zip(*models)
         params = stack_pytrees(list(params_list))
         buffers = stack_pytrees(list(buffers_list))
+        if self.health is not None:
+            buffers[FIRE_EMA_KEY] = init_fire_ema(
+                self.n_models, n_feats_of(models[0][0])
+            )
         opt_state = jax.vmap(self.tx.init)(params)
         self.state = EnsembleState(
             params=params,
@@ -581,6 +662,7 @@ class Ensemble:
             fused=getattr(self, "fused", False),
             fused_adam=fused_adam,
             l1_warmup_steps=getattr(self, "l1_warmup_steps", 0),
+            health=getattr(self, "health", None),
         )
         donate_argnums = (0,) if donate else ()
 
@@ -604,6 +686,7 @@ class Ensemble:
                 kw["fused"],
                 None if fused_adam is None else tuple(sorted(fused_adam.items())),
                 kw["l1_warmup_steps"],
+                kw["health"],  # frozen dataclass or None: hashable
                 donate,
             )
             if cache_key in Ensemble._SHARED_STEPS:
@@ -611,26 +694,29 @@ class Ensemble:
                  self._multi_idx) = Ensemble._SHARED_STEPS[cache_key]
                 return
 
-        self._step = jax.jit(
+        # tracked_jit: compile activity of each entry point surfaces as named
+        # telemetry events when a RunTelemetry is live (one list check per
+        # dispatch otherwise)
+        self._step = tracked_jit("ensemble.step", jax.jit(
             make_ensemble_step(sig_exec, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
-        )
-        self._step_pm = jax.jit(
+        ))
+        self._step_pm = tracked_jit("ensemble.step_per_model", jax.jit(
             make_ensemble_step(sig_exec, self.tx, per_model_batch=True, **kw),
             donate_argnums=donate_argnums,
-        )
-        self._multi = jax.jit(
+        ))
+        self._multi = tracked_jit("ensemble.step_scan", jax.jit(
             make_ensemble_multi_step(sig_exec, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
-        )
-        self._multi_pm = jax.jit(
+        ))
+        self._multi_pm = tracked_jit("ensemble.step_scan_per_model", jax.jit(
             make_ensemble_multi_step(sig_exec, self.tx, per_model_batch=True, **kw),
             donate_argnums=donate_argnums,
-        )
-        self._multi_idx = jax.jit(
+        ))
+        self._multi_idx = tracked_jit("ensemble.step_scan_idx", jax.jit(
             make_ensemble_multi_step_idx(sig_exec, self.tx, per_model_batch=False, **kw),
             donate_argnums=donate_argnums,
-        )
+        ))
         if cache_key is not None:
             if len(Ensemble._SHARED_STEPS) >= Ensemble._SHARED_STEPS_MAX:
                 Ensemble._SHARED_STEPS.pop(next(iter(Ensemble._SHARED_STEPS)))
@@ -663,6 +749,32 @@ class Ensemble:
         return self
 
     # -- training ------------------------------------------------------------
+
+    def set_update_mask(self, mask) -> "Ensemble":
+        """Freeze members in place: ``mask`` [n_models], 1.0=train, 0.0=frozen.
+
+        The `telemetry.anomaly.AnomalyGuard` "mask" action: the step keeps
+        computing every member's forward/grads (the stacked program's shape
+        cannot drop a member) but `jnp.where`-zeroes the frozen members'
+        optimizer updates — NaN-safe, so an already-poisoned member stops
+        corrupting its params while the healthy members train on untouched.
+        Introducing/changing the mask changes the buffers' structure/value,
+        which triggers ONE retrace on the next step — an emergency lever,
+        not a hot-loop knob. Sharded ensembles: call before `shard`, or the
+        replicated mask is placed on the next dispatch like any host value.
+        """
+        mask = jnp.asarray(mask, jnp.float32)
+        if mask.shape != (self.n_models,):
+            raise ValueError(f"mask shape {mask.shape} != ({self.n_models},)")
+        buffers = dict(self.state.buffers)
+        buffers["update_mask"] = mask
+        self.state = EnsembleState(
+            params=self.state.params,
+            buffers=buffers,
+            opt_state=self.state.opt_state,
+            step=self.state.step,
+        )
+        return self
 
     def step_batch(self, batch: jax.Array, per_model: bool = False):
         """One fused update on a batch shared by (or per-) model.
@@ -770,6 +882,10 @@ class Ensemble:
             "compute_dtype": None if self.compute_dtype is None else self.compute_dtype.name,
             "fused": self.fused,
             "l1_warmup_steps": getattr(self, "l1_warmup_steps", 0),
+            "health": (
+                None if getattr(self, "health", None) is None
+                else dataclasses.asdict(self.health)
+            ),
             "state": self.state,  # live device pytree, no host copy
         }
 
@@ -801,6 +917,10 @@ class Ensemble:
         # resume keeps the ramp phase: `step` is in the restored state, the
         # length comes from the checkpoint (absent in pre-r5 checkpoints)
         self.l1_warmup_steps = int(state_dict.get("l1_warmup_steps", 0))
+        h = state_dict.get("health")
+        self.health = (
+            HealthConfig(**{k: float(v) for k, v in h.items()}) if h else None
+        )
         self.tx = tx if tx is not None else optim_str_to_func(self.optimizer_name)(**self.optimizer_kwargs)
         self.state = jax.tree.map(jnp.asarray, state_dict["state"])
         self._build_steps()
@@ -815,6 +935,7 @@ def build_ensemble(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compute_dtype=None,
     l1_warmup_steps: int = 0,
+    health: bool | HealthConfig = False,
     **common_hparams,
 ) -> Ensemble:
     """Convenience: init N models of `sig` (one per hparams dict) and stack them.
@@ -830,5 +951,5 @@ def build_ensemble(
     ]
     return Ensemble(
         models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype,
-        l1_warmup_steps=l1_warmup_steps,
+        l1_warmup_steps=l1_warmup_steps, health=health,
     )

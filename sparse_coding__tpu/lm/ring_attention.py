@@ -215,7 +215,15 @@ def make_sequence_parallel_fn(
     JAX's compilation cache (building a fresh `shard_map` closure per batch
     would retrace + recompile the whole LM every call). `attn` selects the
     parallel-attention strategy ("ring" | "ulysses", see module docstring)."""
-    shard_map = jax.shard_map
+    # jax.shard_map is top-level only from jax 0.5+; this jaxlib still ships
+    # it under experimental, with the replication check named check_rep
+    try:
+        shard_map = jax.shard_map
+        _check_kw = {"check_vma": False}
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+        _check_kw = {"check_rep": False}
 
     cache_names = tuple(cache_names or ())
     n_shards = mesh.shape[axis_name]
@@ -249,7 +257,7 @@ def make_sequence_parallel_fn(
             mesh=mesh,
             in_specs=(P(), seq_spec),
             out_specs=(out_spec, cache_specs),
-            check_vma=False,
+            **_check_kw,
         )
     )
 

@@ -254,7 +254,11 @@ class FunctionalTiedSAE:
 
     @staticmethod
     def fused_batch_supported(
-        stacked_params, batch_size: int, adam_fused: bool = True
+        stacked_params,
+        batch_size: int,
+        adam_fused: bool = True,
+        batch_tile: int = 256,
+        dict_tile: int = None,
     ) -> bool:
         """Trace-time check that a fused bwd kernel covers this batch size
         (`stacked_params` carry the leading model axis). ``adam_fused``
@@ -264,29 +268,34 @@ class FunctionalTiedSAE:
         The Adam family has TWO kernels: the batch-resident one (fits up to
         ~3k rows at the bench shape) and the batch-tiled accumulating one
         (`_bwd_adam_accum_kernel`: batch-independent VMEM footprint, any
-        batch divisible by its 512-row tile) — `tied_sae_adam_step_stacked`
-        dispatches between them with exactly these predicates. The
-        plain-grads kernel stays batch-resident-only (large-batch non-Adam
-        callers use the ensemble's scan-accumulation fallback)."""
+        batch divisible by its `ACCUM_BATCH_TILE`-row tile) —
+        `tied_sae_adam_step_stacked` dispatches between them with exactly
+        these predicates (shared: `ops.tied_sae_kernel.adam_step_supported`).
+        The plain-grads kernel stays batch-resident-only (large-batch
+        non-Adam callers use the ensemble's scan-accumulation fallback).
+
+        ``batch_tile``/``dict_tile`` mirror `tied_sae_adam_step_stacked`'s
+        tiling knobs so a caller running the kernel at non-default tiles can
+        gate with the SAME predicate the kernel enforces at trace time;
+        ``dict_tile=None`` resolves to each kernel family's default (256 for
+        the Adam kernels, 512 for plain grads — `fused_fits`)."""
         from sparse_coding__tpu.ops.tied_sae_kernel import (
-            ACCUM_BATCH_TILE,
-            accum_fits,
+            adam_step_supported,
             fused_fits,
         )
 
         n_dict_components, activation_size = stacked_params["encoder"].shape[-2:]
         if adam_fused:
-            return fused_fits(
-                n_dict_components, activation_size, batch_size, adam_tiles=True
-            ) or (
-                batch_size % ACCUM_BATCH_TILE == 0
-                and accum_fits(n_dict_components, activation_size)
-                # the shared fwd kernel still keeps the whole member dict
-                # VMEM-resident — its batch-independent fit must hold too
-                and fused_fits(n_dict_components, activation_size, None)
+            return adam_step_supported(
+                n_dict_components, activation_size, batch_size,
+                batch_tile=batch_tile,
+                dict_tile=256 if dict_tile is None else dict_tile,
             )
+        if dict_tile is not None and n_dict_components % dict_tile:
+            return False
         return fused_fits(
-            n_dict_components, activation_size, batch_size, adam_tiles=False
+            n_dict_components, activation_size, batch_size,
+            batch_tile=batch_tile, dict_tile=dict_tile, adam_tiles=False,
         )
 
     @staticmethod

@@ -421,18 +421,13 @@ def tied_sae_adam_step_stacked(
         # gradient lives in a VMEM scratch, params/moments stream ONCE per
         # step whatever the batch (`_bwd_adam_accum_kernel`)
         a_bt = ACCUM_BATCH_TILE
-        if (
-            B % a_bt
-            or not accum_fits(N, D, dict_tile, a_bt)
-            # the fwd kernel above kept the whole member dict VMEM-resident;
-            # its batch-independent fit is part of this path's contract too
-            or not fused_fits(N, D, None)
-        ):
+        if not accum_path_supported(N, D, B, dict_tile):
             raise ValueError(
-                f"no fused Adam kernel covers B={B} at ({N},{D}): resident "
-                f"kernel does not fit and accum kernel needs B%{a_bt}==0, "
-                "accum_fits and the fwd fused_fits — gate callers with "
-                "fused_batch_supported"
+                f"no fused Adam kernel covers B={B} at ({N},{D}) with "
+                f"dict_tile={dict_tile}: resident kernel does not fit and "
+                f"accum kernel needs B%{a_bt}==0, accum_fits and the fwd "
+                "fused_fits — gate callers with fused_batch_supported / "
+                "adam_step_supported"
             )
         n_bt = B // a_bt
         tile_mj = lambda m, j, t, *_: (m, j, 0)
@@ -577,6 +572,41 @@ VMEM_BUDGET_BYTES = 16 * 2**20
 # the stream saving (BATCHSCALE r5: +4% measured at 512-row tiles vs ~+25%
 # modeled); bigger tiles halve the program count within the VMEM budget
 ACCUM_BATCH_TILE = 1024
+
+
+def accum_path_supported(
+    n_dict: int, d_act: int, batch: int, dict_tile: int = 256
+) -> bool:
+    """THE predicate of `tied_sae_adam_step_stacked`'s batch-tiled
+    accumulating branch — the exact condition whose failure raises its
+    trace-time ValueError. One definition, shared by the kernel's guard and
+    `FunctionalTiedSAE.fused_batch_supported`, so the gate and the error can
+    never disagree (they previously duplicated the terms)."""
+    return (
+        batch % ACCUM_BATCH_TILE == 0
+        and accum_fits(n_dict, d_act, dict_tile)
+        # the shared fwd kernel keeps the whole member dict VMEM-resident —
+        # its batch-independent fit is part of this path's contract too
+        and fused_fits(n_dict, d_act, None)
+    )
+
+
+def adam_step_supported(
+    n_dict: int,
+    d_act: int,
+    batch: int,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+) -> bool:
+    """Whether SOME fused-Adam kernel covers (shape, batch, tiles): the
+    batch-resident kernel's VMEM fit, or the accumulating kernel's
+    (`accum_path_supported`). Mirrors `tied_sae_adam_step_stacked`'s
+    dispatch exactly, including its tile-divisibility ValueError."""
+    if batch % batch_tile or n_dict % dict_tile:
+        return False
+    return fused_fits(
+        n_dict, d_act, batch, batch_tile, dict_tile, adam_tiles=True
+    ) or accum_path_supported(n_dict, d_act, batch, dict_tile)
 
 
 def accum_fits(

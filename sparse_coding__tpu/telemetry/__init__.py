@@ -1,0 +1,39 @@
+"""Run telemetry & training-health observability.
+
+Four pieces (docs/observability.md):
+  - `events`  — `RunTelemetry` structured event log (events.jsonl), counters/
+                gauges, `jax.monitoring` compile bridge, `tracked_jit`
+  - `health`  — jit-fused per-model health pack (grad/dict norms, NaN flags,
+                dead-feature fraction from a firing-frequency EMA)
+  - `anomaly` — `AnomalyGuard` flush-boundary detection (NaN/Inf, loss
+                spikes, dead-fraction jumps) with warn/mask/abort policies
+                and diagnostic bundles
+  - `audit`   — `transfer_audit()` makes "zero host transfers in the hot
+                loop" an enforced, testable property
+  - `report`  — `python -m sparse_coding__tpu.report <run_dir>` run summaries
+"""
+
+from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy
+from sparse_coding__tpu.telemetry.audit import TransferViolation, allowed_transfer, transfer_audit
+from sparse_coding__tpu.telemetry.events import (
+    RunTelemetry,
+    read_events,
+    run_fingerprint,
+    tracked_jit,
+)
+from sparse_coding__tpu.telemetry.health import FIRE_EMA_KEY, HealthConfig
+
+__all__ = [
+    "AnomalyAbort",
+    "AnomalyGuard",
+    "AnomalyPolicy",
+    "FIRE_EMA_KEY",
+    "HealthConfig",
+    "RunTelemetry",
+    "TransferViolation",
+    "allowed_transfer",
+    "read_events",
+    "run_fingerprint",
+    "tracked_jit",
+    "transfer_audit",
+]

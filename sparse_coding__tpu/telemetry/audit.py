@@ -1,0 +1,158 @@
+"""Transfer audit: make "the hot loop does zero host transfers" testable.
+
+The repo's throughput story rests on one invariant: between `MetricLogger`
+flushes, training dispatches perform NO device→host transfers (the reference
+stalls on a per-batch `.item()`, `big_sweep.py:224-228`; our loop buffers
+device scalars and syncs once per flush window). Until now that invariant was
+a docstring claim. `transfer_audit()` turns it into an enforced property:
+
+    with transfer_audit():
+        ensemble_train_loop(ens, chunk, ..., logger=logger)
+
+Two enforcement layers, because they cover different backends:
+
+  1. ``jax.transfer_guard_device_to_host("disallow_explicit")`` — the
+     authoritative runtime guard on real accelerators. On the CPU backend it
+     is a silent no-op: host "transfers" are zero-copy views, so jax never
+     consults the guard — which is exactly the backend the test suite runs
+     on.
+  2. A Python interposer on ``jax.Array``'s host-materialization property
+     (``ArrayImpl._value``), installed only while an audit is active: any
+     explicit pull — ``jax.device_get``, ``float(x)``, ``x.tolist()`` — in
+     an audited region raises `TransferViolation` on EVERY backend. (numpy's
+     buffer-protocol fast path, ``np.asarray(x)`` on CPU, cannot be
+     interposed from Python — on accelerators layer 1 catches it.)
+
+Sanctioned sync points mark themselves with `allowed_transfer()`:
+`MetricLogger.flush` (the one batched device_get per window), `StepTimer.
+report`'s fence, the per-chunk dead-ensemble probe, and the train loop's
+once-per-chunk host permutation. A stray in-loop sync therefore fails loudly
+instead of silently costing ~10 ms of tunnel latency per step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["transfer_audit", "allowed_transfer", "TransferViolation"]
+
+
+class TransferViolation(RuntimeError):
+    """An unsanctioned device→host transfer inside a `transfer_audit` block."""
+
+
+_STATE = threading.local()  # .audit_depth / .allow_depth per thread
+_PATCH_LOCK = threading.Lock()
+_PATCH_COUNT = 0
+_ORIG_VALUE = None
+
+
+def _depth(name: str) -> int:
+    return getattr(_STATE, name, 0)
+
+
+def _bump(name: str, d: int):
+    setattr(_STATE, name, _depth(name) + d)
+
+
+def _install_interposer():
+    """Patch ArrayImpl._value (refcounted) so explicit host pulls inside an
+    audit raise. Delegates untouched outside audits / inside allowed()."""
+    global _PATCH_COUNT, _ORIG_VALUE
+    with _PATCH_LOCK:
+        _PATCH_COUNT += 1
+        if _PATCH_COUNT > 1:
+            return
+        try:
+            from jax._src import array as _jarray
+
+            _ORIG_VALUE = _jarray.ArrayImpl._value
+
+            def _audited_value(self):
+                if _depth("audit_depth") > 0 and _depth("allow_depth") == 0:
+                    raise TransferViolation(
+                        "explicit device-to-host transfer (device_get / float /"
+                        " tolist) inside a transfer_audit block — wrap"
+                        " sanctioned sync points in telemetry.audit."
+                        "allowed_transfer"
+                    )
+                return _ORIG_VALUE.fget(self)
+
+            _jarray.ArrayImpl._value = property(_audited_value)
+        except Exception:  # jax internals moved: fall back to layer 1 only
+            _ORIG_VALUE = None
+
+
+def _remove_interposer():
+    global _PATCH_COUNT, _ORIG_VALUE
+    with _PATCH_LOCK:
+        _PATCH_COUNT -= 1
+        if _PATCH_COUNT > 0 or _ORIG_VALUE is None:
+            return
+        from jax._src import array as _jarray
+
+        _jarray.ArrayImpl._value = _ORIG_VALUE
+        _ORIG_VALUE = None
+
+
+@contextlib.contextmanager
+def allowed_transfer():
+    """Mark a sanctioned host-sync point (flush boundaries, fences, probes):
+    transfers inside this context are exempt from any enclosing audit."""
+    _bump("allow_depth", 1)
+    try:
+        with jax.transfer_guard("allow"):
+            yield
+    finally:
+        _bump("allow_depth", -1)
+
+
+@contextlib.contextmanager
+def transfer_audit(telemetry=None, both: bool = False):
+    """Disallow device→host transfers (explicit included) in the block.
+
+    On violation: emits an ``anomaly`` event (kind ``transfer_guard``) to
+    `telemetry` when given, then raises `TransferViolation` — the stack
+    trace points at the offending transfer. ``both=True`` additionally
+    guards host→device uploads via the jax layer (proving a fully
+    device-resident path on real accelerators; feeding batches from host is
+    otherwise legitimate streaming).
+    """
+    guard = (
+        jax.transfer_guard("disallow_explicit")
+        if both
+        else jax.transfer_guard_device_to_host("disallow_explicit")
+    )
+    _install_interposer()
+    _bump("audit_depth", 1)
+    try:
+        with guard:
+            yield
+    except Exception as e:
+        msg = str(e)
+        # jax's guard raises "Disallowed <direction> transfer: ..." — match
+        # that shape specifically, or an unrelated error that merely mentions
+        # "transfer" would be rewrapped and mislabeled as a host-sync bug
+        is_guard_trip = isinstance(e, TransferViolation) or (
+            "disallowed" in msg.lower() and "transfer" in msg.lower()
+        )
+        if not is_guard_trip:
+            raise  # not a guard trip: propagate untouched
+        if telemetry is not None:
+            try:
+                telemetry.anomaly("transfer_guard", error=msg[:500])
+            except Exception:
+                pass
+        if isinstance(e, TransferViolation):
+            raise
+        raise TransferViolation(
+            "host transfer inside an audited hot-loop section "
+            "(wrap sanctioned sync points in telemetry.audit.allowed_transfer): "
+            + msg
+        ) from e
+    finally:
+        _bump("audit_depth", -1)
+        _remove_interposer()

@@ -1,0 +1,338 @@
+"""Structured run-event log: every training run explains itself from artifacts.
+
+`RunTelemetry` writes an append-only `events.jsonl` next to the metrics JSONL
+(`utils.logging.MetricLogger`). One record per line:
+
+    {"seq": <monotonic int>, "ts": <unix float>, "event": <kind>, ...fields}
+
+Kinds (see docs/observability.md for the full schema):
+  - ``run_start``   config + environment fingerprint (git SHA, jax/backend
+                    versions, device/mesh topology, compile-cache state)
+  - ``compile``     one jit compilation: entry-point name + wall seconds
+                    (attributed by `tracked_jit`; aggregate backend counts
+                    additionally arrive via the `jax.monitoring` bridge)
+  - ``chunk_start`` / ``chunk_end``   per training chunk, with wall seconds
+  - ``phase``       a named timed section (`utils.trace.timed`)
+  - ``anomaly``     emitted by `telemetry.anomaly.AnomalyGuard` (or any caller)
+  - ``snapshot``    one flush of ALL monotonic counters + gauges
+  - ``run_end``     exit status, step totals, steps/sec
+
+Counters and gauges are host-side Python scalars — incrementing them never
+touches the device, so telemetry preserves the repo's no-per-step-host-sync
+invariant (SURVEY.md §7). They reach disk only via `snapshot()` (and the
+automatic one inside `run_end`).
+
+The `jax.monitoring` bridge (`_install_jax_listeners`) subscribes ONCE per
+process and fans out to every live RunTelemetry: backend compile durations
+(`/jax/core/compile/backend_compile_duration`) and persistent-compile-cache
+events (`/jax/compilation_cache/*` — the `utils.compile_cache` hit/miss
+signal) become counters. `tracked_jit` adds per-entry-point attribution the
+global events cannot provide: it watches a jitted callable's executable cache
+grow and emits a named ``compile`` event with the call's wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "RunTelemetry",
+    "run_fingerprint",
+    "tracked_jit",
+    "read_events",
+]
+
+
+# Live instances receiving process-global signals (jax.monitoring, tracked_jit
+# compile detections). Appended on construction, removed on close().
+_ACTIVE: List["RunTelemetry"] = []
+_LISTENERS_LOCK = threading.Lock()
+_LISTENERS_INSTALLED = False
+
+
+def _install_jax_listeners() -> None:
+    """Register the process-wide `jax.monitoring` bridge (idempotent)."""
+    global _LISTENERS_INSTALLED
+    with _LISTENERS_LOCK:
+        if _LISTENERS_INSTALLED:
+            return
+        _LISTENERS_INSTALLED = True
+    try:
+        import jax.monitoring as mon
+
+        def on_duration(event: str, duration: float, **kw):
+            if event.endswith("backend_compile_duration"):
+                for t in list(_ACTIVE):
+                    t.counter_inc("compile.backend.count")
+                    t.counter_add_float("compile.backend.seconds", duration)
+
+        def on_event(event: str, **kw):
+            # '/jax/compilation_cache/cache_hits', '.../cache_misses',
+            # '.../compile_requests_use_cache', ... — the persistent
+            # compile-cache traffic enable_persistent_compile_cache turns on
+            if event.startswith("/jax/compilation_cache/"):
+                for t in list(_ACTIVE):
+                    t.counter_inc(f"compile_cache.{event.rsplit('/', 1)[-1]}")
+
+        mon.register_event_duration_secs_listener(on_duration)
+        mon.register_event_listener(on_event)
+    except Exception:  # pragma: no cover - jax without monitoring
+        pass
+
+
+def run_fingerprint(mesh=None) -> Dict[str, Any]:
+    """Environment fingerprint for `run_start`: enough to re-identify how a
+    run was produced from its artifacts alone (the ISSUE-2 requirement), all
+    best-effort — a fingerprint must never fail a training run."""
+    fp: Dict[str, Any] = {"python": sys.version.split()[0]}
+    try:
+        import jax
+        import jaxlib
+
+        fp["jax"] = jax.__version__
+        fp["jaxlib"] = jaxlib.__version__
+        devs = jax.devices()
+        fp["backend"] = devs[0].platform
+        fp["device_kind"] = devs[0].device_kind
+        fp["device_count"] = len(devs)
+        fp["process_index"] = jax.process_index()
+        fp["process_count"] = jax.process_count()
+    except Exception:
+        pass
+    try:
+        repo = Path(__file__).resolve().parents[2]
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
+            text=True, timeout=5,
+        )
+        if sha.returncode == 0:
+            fp["git_sha"] = sha.stdout.strip()
+    except Exception:
+        pass
+    try:
+        from sparse_coding__tpu.utils.compile_cache import compile_cache_info
+
+        fp["compile_cache"] = compile_cache_info()
+    except Exception:
+        pass
+    if mesh is not None:
+        try:
+            fp["mesh"] = {str(k): int(v) for k, v in mesh.shape.items()}
+        except Exception:
+            fp["mesh"] = str(mesh)
+    return fp
+
+
+class RunTelemetry:
+    """Append-only structured event log + monotonic counters/gauges.
+
+    ``out_dir=None`` keeps everything in memory (counters still aggregate —
+    the bench uses this to report compile stats without writing artifacts).
+    The instance is also a context manager: ``__exit__`` writes ``run_end``
+    (status "ok", or "error: <exc>" when exiting on an exception) unless one
+    was already written, then closes the file.
+    """
+
+    def __init__(
+        self,
+        out_dir: Optional[str] = None,
+        run_name: str = "run",
+        config: Optional[Dict[str, Any]] = None,
+        file_name: str = "events.jsonl",
+        install_jax_listeners: bool = True,
+    ):
+        self.run_name = run_name
+        self._config = config
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._t0 = time.time()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._run_end_written = False
+        self._fh = None
+        self.path: Optional[Path] = None
+        if out_dir is not None:
+            d = Path(out_dir)
+            d.mkdir(parents=True, exist_ok=True)
+            self.path = d / file_name
+            self._fh = open(self.path, "a")
+        if install_jax_listeners:
+            _install_jax_listeners()
+        _ACTIVE.append(self)
+
+    # -- raw event plumbing --------------------------------------------------
+
+    def event(self, etype: str, **fields) -> Dict[str, Any]:
+        """Write one event record of type `etype`; returns it (tests and
+        callers may inspect). Field names are free — `anomaly` events carry
+        their detector name under a ``kind`` field, for example."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": time.time(), "event": etype, **fields}
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec, default=str) + "\n")
+                self._fh.flush()
+        return rec
+
+    # -- lifecycle events ----------------------------------------------------
+
+    def run_start(self, config: Optional[Dict[str, Any]] = None, mesh=None):
+        """The first record: run name, caller config, environment fingerprint."""
+        cfg = config if config is not None else self._config
+        return self.event(
+            "run_start",
+            run_name=self.run_name,
+            config=cfg,
+            fingerprint=run_fingerprint(mesh=mesh),
+        )
+
+    def compile(self, name: str, seconds: float, cache_hit: Optional[bool] = None):
+        """One jit compilation of entry point `name` (wall-clock seconds —
+        trace + compile + the triggering dispatch)."""
+        self.counter_inc(f"compile.{name}.count")
+        self.counter_add_float(f"compile.{name}.seconds", seconds)
+        fields = {"name": name, "seconds": round(seconds, 4)}
+        if cache_hit is not None:
+            fields["cache_hit"] = bool(cache_hit)
+        return self.event("compile", **fields)
+
+    def chunk_start(self, chunk: int, **fields):
+        self._chunk_t0 = time.time()
+        return self.event("chunk_start", chunk=int(chunk), **fields)
+
+    def chunk_end(self, chunk: int, **fields):
+        dt = time.time() - getattr(self, "_chunk_t0", time.time())
+        self.counter_inc("chunks")
+        self.counter_add_float("chunk.seconds", dt)
+        return self.event(
+            "chunk_end", chunk=int(chunk), seconds=round(dt, 3), **fields
+        )
+
+    def anomaly(self, kind: str, **fields):
+        self.counter_inc("anomalies")
+        return self.event("anomaly", kind=kind, **fields)
+
+    def run_end(self, status: str = "ok", timer_stats: Optional[Dict] = None, **fields):
+        """Final record: exit status, step totals (from the counters), wall
+        time, and optional `utils.trace.StepTimer.report()` stats. Emits a
+        closing `snapshot` first so every counter survives in the log."""
+        self.snapshot()
+        self._run_end_written = True
+        steps = self._counters.get("train.steps")
+        wall = time.time() - self._t0
+        rec: Dict[str, Any] = {
+            "status": status,
+            "wall_seconds": round(wall, 3),
+            **fields,
+        }
+        if steps is not None:
+            rec["steps"] = int(steps)
+            rec.setdefault("steps_per_sec", round(steps / wall, 3) if wall > 0 else None)
+        if timer_stats:
+            rec["timer"] = {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in timer_stats.items()
+            }
+        return self.event("run_end", **rec)
+
+    # -- counters / gauges ---------------------------------------------------
+
+    def counter_inc(self, name: str, n: int = 1):
+        """Monotonic counter bump — host-side only, no device sync."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter_add_float(self, name: str, v: float):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + float(v)
+
+    def gauge_set(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    @property
+    def counters(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+    def snapshot(self):
+        """ONE flush of every counter and gauge as a single event."""
+        with self._lock:
+            counters = {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in sorted(self._counters.items())
+            }
+            gauges = {k: v for k, v in sorted(self._gauges.items())}
+        return self.event("snapshot", counters=counters, gauges=gauges)
+
+    # -- lifetime ------------------------------------------------------------
+
+    def close(self, status: str = "ok"):
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+        if not self._run_end_written:
+            self.run_end(status=status)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close(status="ok" if exc_type is None else f"error: {exc_type.__name__}: {exc}")
+        return False
+
+
+def read_events(path) -> List[Dict[str, Any]]:
+    """Parse an events.jsonl back into records (the schema round-trip)."""
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class _TrackedJit:
+    """Transparent wrapper around a jitted callable that attributes compiles.
+
+    On each call (only while some RunTelemetry is live — otherwise a single
+    list check and straight through): reads the function's executable-cache
+    size before/after, and when it grew, publishes a named ``compile`` event
+    with the call's wall time to every live telemetry. Also bumps a
+    ``dispatch.<name>`` counter — the per-entry-point step totals `run_end`
+    reports. Attribute access (``.lower``, …) passes through to the jit
+    object, so AOT-lowering tests keep working on wrapped steps.
+    """
+
+    __slots__ = ("_fn", "_name")
+
+    def __init__(self, name: str, fn: Callable):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        if not _ACTIVE:
+            return self._fn(*args, **kwargs)
+        size = getattr(self._fn, "_cache_size", None)
+        before = size() if size is not None else -1
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        for t in list(_ACTIVE):
+            t.counter_inc(f"dispatch.{self._name}")
+        if size is not None and size() > before:
+            for t in list(_ACTIVE):
+                t.compile(self._name, dt)
+        return out
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+
+def tracked_jit(name: str, fn: Callable) -> Callable:
+    """Wrap a jitted callable so its compiles surface as named telemetry
+    events. Near-zero overhead when no RunTelemetry is live."""
+    return _TrackedJit(name, fn)

@@ -1,0 +1,105 @@
+"""On-device per-model training-health pack.
+
+The signals every hand-run failure study needed (LR_COLLAPSE_r03: silent
+all-zero-code collapse; RESURRECT_r04: dead-feature fractions), computed
+INSIDE the jitted ensemble step so they cost one fused reduction each and
+ride the `MetricLogger` device-scalar buffer — the no-per-step-host-sync
+invariant holds (the host first sees them at `flush()`).
+
+Per model (``[n_models]``-shaped step outputs, prefixed ``health_``):
+  - ``health_grad_norm``   global L2 norm of this member's gradient pytree
+  - ``health_dict_norm``   mean L2 row norm of the dictionary param
+                           ("decoder" when present, else "encoder" — the
+                           tied families store the dictionary there)
+  - ``health_nonfinite``   1.0 when this member's total loss is NaN/Inf
+  - ``health_dead_frac``   fraction of features whose bias-corrected firing
+                           EMA is at/below `dead_threshold` — the live
+                           counterpart of the resurrect study's `c_totals`
+
+The firing EMA persists in the ensemble buffers under `FIRE_EMA_KEY`
+([n_models, n_feats]); it is checkpointed with the rest of the state, so
+resume keeps the dead-feature estimate. Signatures whose aux carries no code
+tensor ``"c"`` get ``health_dead_frac = NaN`` and an untouched EMA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["HealthConfig", "FIRE_EMA_KEY", "health_pack", "init_fire_ema", "n_feats_of"]
+
+FIRE_EMA_KEY = "health_fire_ema"
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Knobs for the health pack (hashable: part of the shared-step cache key).
+
+    ``ema_decay``: per-step decay of the firing-frequency EMA (0.99 ≈ a
+    ~100-step window). ``dead_threshold``: a feature is "dead" when its
+    bias-corrected firing frequency is <= this (0.0 = literally never fired
+    within the EMA window's resolution; the resurrect study's criterion was
+    `c_totals == 0`)."""
+
+    ema_decay: float = 0.99
+    dead_threshold: float = 1e-6
+
+
+def n_feats_of(params) -> int:
+    """Dictionary-feature count of one (unstacked) param pytree."""
+    for key in ("encoder", "decoder"):
+        if key in params:
+            return int(params[key].shape[0])
+    raise ValueError(
+        f"health pack needs an 'encoder' or 'decoder' param to size the "
+        f"firing EMA; got keys {sorted(params)}"
+    )
+
+
+def init_fire_ema(n_models: int, n_feats: int) -> jax.Array:
+    return jnp.zeros((n_models, n_feats), jnp.float32)
+
+
+def _global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def health_pack(params, grads, loss, aux, fire_ema, step, cfg: HealthConfig):
+    """Per-model health scalars (called INSIDE the vmapped step body).
+
+    Args are one member's slices: `params`/`grads` pytrees, `loss` the total
+    scalar, `aux` the signature's aux dict (code tensor under "c" when the
+    family exposes one), `fire_ema` this member's [n_feats] EMA row, `step`
+    the shared (traced) step counter. Returns ``(metrics, new_fire_ema)``
+    with every metric a 0-d f32 — vmap stacks them to [n_models].
+    """
+    dict_param = params["decoder"] if "decoder" in params else params["encoder"]
+    metrics = {
+        "health_grad_norm": _global_norm(grads),
+        "health_dict_norm": jnp.linalg.norm(
+            dict_param.astype(jnp.float32), axis=-1
+        ).mean(),
+        "health_nonfinite": jnp.where(jnp.isfinite(loss), 0.0, 1.0),
+    }
+    c = aux.get("c") if isinstance(aux, dict) else None
+    if c is None:
+        new_ema = fire_ema
+        metrics["health_dead_frac"] = jnp.full((), jnp.nan, jnp.float32)
+    else:
+        fire = (c != 0).mean(axis=0).astype(jnp.float32)  # [n_feats]
+        new_ema = cfg.ema_decay * fire_ema + (1.0 - cfg.ema_decay) * fire
+        # Adam-style bias correction: an EMA started at zero under-reports
+        # firing for the first ~1/(1-decay) steps, which would fake a
+        # high-then-falling dead fraction at run start
+        bias = 1.0 - cfg.ema_decay ** (step.astype(jnp.float32) + 1.0)
+        ema_hat = new_ema / jnp.maximum(bias, 1e-12)
+        metrics["health_dead_frac"] = (ema_hat <= cfg.dead_threshold).mean().astype(
+            jnp.float32
+        )
+    return metrics, new_ema

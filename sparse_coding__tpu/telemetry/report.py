@@ -1,0 +1,281 @@
+"""Run report: render a run directory's JSONL artifacts into one summary.
+
+``python -m sparse_coding__tpu.report <run_dir>`` reads every
+``events.jsonl`` / ``*_events.jsonl`` and ``metrics.jsonl`` /
+``*_metrics.jsonl`` under the run directory and prints a markdown summary:
+run fingerprint, compile and throughput stats, a per-model table of final
+metric values (loss family, FVU/L0 when logged, the ``health_*`` pack), and
+the anomaly timeline. Every bench/parity/sweep artifact becomes
+self-describing — no re-running studies to learn what a run did.
+
+Use ``--out report.md`` to also write the summary next to the artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+__all__ = ["load_run", "render_markdown", "main"]
+
+# columns shown first when present; any other metric follows alphabetically
+_PREFERRED_METRICS = [
+    "loss", "l_reconstruction", "l_l1", "fvu", "l0",
+    "health_grad_norm", "health_dict_norm", "health_nonfinite",
+    "health_dead_frac",
+]
+
+
+def _read_jsonl(path: Path) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a torn tail line must not kill the report
+    return out
+
+
+def load_run(run_dir) -> Dict[str, Any]:
+    """Collect events + metrics records from a run directory (recursive —
+    drivers nest per-epoch subfolders)."""
+    d = Path(run_dir)
+    if not d.is_dir():
+        raise FileNotFoundError(f"run dir {d} does not exist")
+    event_files = sorted(
+        {p for p in list(d.rglob("events.jsonl")) + list(d.rglob("*_events.jsonl"))}
+    )
+    metric_files = sorted(
+        {p for p in list(d.rglob("metrics.jsonl")) + list(d.rglob("*_metrics.jsonl"))}
+    )
+    events: List[Dict[str, Any]] = []
+    for p in event_files:
+        events.extend(_read_jsonl(p))
+    metrics: List[Dict[str, Any]] = []
+    for p in metric_files:
+        metrics.extend(_read_jsonl(p))
+    return {
+        "dir": str(d),
+        "event_files": [str(p) for p in event_files],
+        "metric_files": [str(p) for p in metric_files],
+        "events": events,
+        "metrics": metrics,
+    }
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "nan"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _events_of(run, kind: str) -> List[Dict[str, Any]]:
+    return [e for e in run["events"] if e.get("event") == kind]
+
+
+def _fingerprint_section(run, lines: List[str]):
+    starts = _events_of(run, "run_start")
+    lines.append("## Run fingerprint")
+    lines.append("")
+    if not starts:
+        lines.append("_(no run_start event)_")
+        lines.append("")
+        return
+    for s in starts:
+        fp = s.get("fingerprint") or {}
+        lines.append(f"- **run**: {s.get('run_name', '?')}")
+        for key in (
+            "git_sha", "jax", "jaxlib", "backend", "device_kind",
+            "device_count", "process_count", "mesh", "python",
+        ):
+            if key in fp:
+                lines.append(f"- **{key}**: {_fmt(fp[key])}")
+        cc = fp.get("compile_cache")
+        if isinstance(cc, dict):
+            lines.append(
+                f"- **compile_cache**: enabled={cc.get('enabled')} "
+                f"dir={cc.get('dir')} entries={cc.get('entries')}"
+            )
+        cfg = s.get("config")
+        if cfg:
+            lines.append(f"- **config**: `{json.dumps(cfg, default=str)[:500]}`")
+    lines.append("")
+
+
+def _compile_section(run, lines: List[str]):
+    lines.append("## Compile activity")
+    lines.append("")
+    compiles = _events_of(run, "compile")
+    snaps = _events_of(run, "snapshot")
+    counters = snaps[-1].get("counters", {}) if snaps else {}
+    by_name: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
+    for c in compiles:
+        d = by_name.setdefault(c.get("name", "?"), {"count": 0, "seconds": 0.0})
+        d["count"] += 1
+        d["seconds"] += float(c.get("seconds", 0.0))
+    if by_name:
+        lines.append("| entry point | compiles | wall s |")
+        lines.append("|---|---:|---:|")
+        for name, d in by_name.items():
+            lines.append(f"| {name} | {d['count']} | {d['seconds']:.2f} |")
+        lines.append("")
+    total_n = counters.get("compile.backend.count")
+    total_s = counters.get("compile.backend.seconds")
+    if total_n is not None:
+        lines.append(
+            f"Backend compiles: **{int(total_n)}** ({_fmt(total_s)} s total)."
+        )
+    cache = {
+        k.split(".", 1)[1]: int(v)
+        for k, v in counters.items()
+        if k.startswith("compile_cache.")
+    }
+    if cache:
+        lines.append(
+            "Persistent compile cache: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(cache.items()))
+            + "."
+        )
+    if not by_name and total_n is None and not cache:
+        lines.append("_(no compile events recorded)_")
+    lines.append("")
+
+
+def _throughput_section(run, lines: List[str]):
+    lines.append("## Throughput")
+    lines.append("")
+    ends = _events_of(run, "run_end")
+    chunks = _events_of(run, "chunk_end")
+    wrote = False
+    for e in ends:
+        bits = [f"status **{e.get('status', '?')}**"]
+        if "steps" in e:
+            bits.append(f"{e['steps']} steps")
+        if e.get("steps_per_sec") is not None:
+            bits.append(f"{_fmt(e['steps_per_sec'])} steps/s")
+        if "wall_seconds" in e:
+            bits.append(f"{_fmt(e['wall_seconds'])} s wall")
+        timer = e.get("timer")
+        if timer:
+            bits.append(
+                f"StepTimer: {timer.get('steps')} ticks, "
+                f"{_fmt(timer.get('steps_per_sec'))} steps/s, "
+                f"{_fmt(timer.get('mean_step_ms'))} ms/step"
+            )
+        lines.append("- " + ", ".join(bits))
+        wrote = True
+    if chunks:
+        secs = [float(c.get("seconds", 0.0)) for c in chunks]
+        lines.append(
+            f"- {len(chunks)} chunks, mean {sum(secs) / len(secs):.2f} s/chunk"
+        )
+        wrote = True
+    if not wrote:
+        lines.append("_(no run_end / chunk events)_")
+    lines.append("")
+
+
+def final_metric_table(metrics: List[Dict[str, Any]]):
+    """(series -> metric -> final value), 'final' = value at max step."""
+    latest: Dict[str, Dict[str, tuple]] = {}
+    for r in metrics:
+        s, m = r.get("series"), r.get("metric")
+        if s is None or m is None:
+            continue
+        step = int(r.get("step", -1))
+        cur = latest.setdefault(s, {}).get(m)
+        if cur is None or step >= cur[0]:
+            latest[s][m] = (step, r.get("value"))
+    return {s: {m: v for m, (_, v) in row.items()} for s, row in latest.items()}
+
+
+def _health_section(run, lines: List[str]):
+    lines.append("## Per-model health (final values)")
+    lines.append("")
+    table = final_metric_table(run["metrics"])
+    if not table:
+        lines.append("_(no metrics recorded)_")
+        lines.append("")
+        return
+    all_metrics: List[str] = []
+    for row in table.values():
+        for m in row:
+            if m not in all_metrics:
+                all_metrics.append(m)
+    cols = [m for m in _PREFERRED_METRICS if m in all_metrics] + sorted(
+        m for m in all_metrics if m not in _PREFERRED_METRICS
+    )
+    cols = cols[:12]  # keep the table terminal-renderable
+    lines.append("| model | " + " | ".join(cols) + " |")
+    lines.append("|---|" + "---:|" * len(cols))
+    for series in sorted(table):
+        row = table[series]
+        lines.append(
+            f"| {series} | " + " | ".join(_fmt(row.get(c)) for c in cols) + " |"
+        )
+    lines.append("")
+
+
+def _anomaly_section(run, lines: List[str]):
+    lines.append("## Anomaly timeline")
+    lines.append("")
+    anomalies = _events_of(run, "anomaly")
+    if not anomalies:
+        lines.append("_No anomalies recorded._")
+        lines.append("")
+        return
+    lines.append("| step | kind | models | action | bundle |")
+    lines.append("|---:|---|---|---|---|")
+    for a in anomalies:
+        lines.append(
+            f"| {_fmt(a.get('step'))} | {a.get('kind', '?')} "
+            f"| {_fmt(a.get('model_names') or a.get('models'))} "
+            f"| {_fmt(a.get('action'))} | {_fmt(a.get('bundle'))} |"
+        )
+    lines.append("")
+
+
+def render_markdown(run: Dict[str, Any]) -> str:
+    lines: List[str] = [f"# Run report — `{run['dir']}`", ""]
+    lines.append(
+        f"_{len(run['events'])} events from {len(run['event_files'])} file(s); "
+        f"{len(run['metrics'])} metric records from "
+        f"{len(run['metric_files'])} file(s)._"
+    )
+    lines.append("")
+    _fingerprint_section(run, lines)
+    _compile_section(run, lines)
+    _throughput_section(run, lines)
+    _health_section(run, lines)
+    _anomaly_section(run, lines)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.report", description=__doc__
+    )
+    ap.add_argument("run_dir", help="directory holding events/metrics JSONL")
+    ap.add_argument("--out", default=None, help="also write the markdown here")
+    args = ap.parse_args(argv)
+    run = load_run(args.run_dir)
+    md = render_markdown(run)
+    print(md)
+    if args.out:
+        Path(args.out).write_text(md + "\n")
+        print(f"\n[written to {args.out}]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

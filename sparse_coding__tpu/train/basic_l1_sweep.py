@@ -10,6 +10,7 @@ SURVEY.md §2.7) are not replicated.
 
 from __future__ import annotations
 
+import sys
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
 
@@ -20,9 +21,11 @@ import numpy as np
 from sparse_coding__tpu.data.chunks import ChunkStore
 from sparse_coding__tpu.ensemble import build_ensemble
 from sparse_coding__tpu.models import FunctionalFista
+from sparse_coding__tpu.telemetry import AnomalyGuard, AnomalyPolicy, RunTelemetry
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
 from sparse_coding__tpu.train.loop import ensemble_train_loop
 from sparse_coding__tpu.utils.logging import MetricLogger
+from sparse_coding__tpu.utils.trace import StepTimer
 
 
 def basic_l1_sweep(
@@ -40,6 +43,8 @@ def basic_l1_sweep(
     shuffle_chunks: bool = True,
     save_after_every: bool = False,
     hbm_cache: bool = False,
+    health: bool = True,
+    anomaly_policy: Optional[AnomalyPolicy] = None,
 ) -> List[Tuple[object, dict]]:
     """Train a FISTA ensemble over `l1_values` on every chunk in
     `dataset_folder`; save learned dicts per epoch (reference
@@ -49,7 +54,14 @@ def basic_l1_sweep(
     chunk once (native dtype) and reuses it across epochs — see
     `train.sweep`'s `hbm_cache_chunks`. ``fista_tol > 0`` solves each
     FISTA decoder update to convergence instead of a blind fixed count
-    (`train.loop.make_fista_decoder_update`). Returns the final dict list."""
+    (`train.loop.make_fista_decoder_update`). Returns the final dict list.
+
+    Observability (docs/observability.md): the driver writes ``events.jsonl``
+    (run fingerprint, compile + chunk events, run_end) next to its metrics
+    JSONL; ``health=True`` (default) fuses the per-model health pack into
+    the train step; ``anomaly_policy`` governs the flush-boundary
+    `AnomalyGuard` (default: warn + diagnostic bundle). Render the artifacts
+    with ``python -m sparse_coding__tpu.report <output_folder>``."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
@@ -65,8 +77,28 @@ def basic_l1_sweep(
         optimizer_kwargs={"learning_rate": lr},
         activation_size=activation_width,
         n_dict_components=dict_size,
+        health=health,
     )
-    logger = MetricLogger(out_dir=output_folder, run_name="basic_l1_sweep")
+    model_names = [f"l1_{float(a):.2e}" for a in l1_values]
+    telemetry = RunTelemetry(
+        out_dir=output_folder, run_name="basic_l1_sweep",
+        config=dict(
+            dataset_folder=str(dataset_folder), activation_width=activation_width,
+            l1_values=[float(a) for a in l1_values], dict_ratio=dict_ratio,
+            dict_size=dict_size, batch_size=batch_size, n_epochs=n_epochs,
+            lr=lr, fista_iters=fista_iters, fista_tol=fista_tol, seed=seed,
+        ),
+    )
+    telemetry.run_start()
+    guard = AnomalyGuard(
+        telemetry=telemetry, out_dir=output_folder,
+        policy=anomaly_policy, ensemble=ens, model_names=model_names,
+    )
+    logger = MetricLogger(
+        out_dir=output_folder, run_name="basic_l1_sweep",
+        model_names=model_names, on_flush=guard.observe,
+    )
+    timer = StepTimer()
 
     key = jax.random.PRNGKey(seed + 1)
     order_rng = np.random.default_rng(seed)
@@ -79,35 +111,68 @@ def basic_l1_sweep(
             for ld, a in zip(ens.to_learned_dicts(), l1_values)
         ]
 
-    for epoch in range(n_epochs):
-        chunk_order = (
-            order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
-        )
-        for pos, chunk_idx in enumerate(chunk_order):
-            if hbm_cache:
-                if int(chunk_idx) not in cache:
-                    cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
-                chunk = cache[int(chunk_idx)].astype(jnp.float32)
-            else:
-                chunk = store.load(int(chunk_idx))
-            key, k = jax.random.split(key)
-            ensemble_train_loop(
-                ens, chunk, batch_size=batch_size, key=k,
-                logger=logger, fista_iters=fista_iters, fista_tol=fista_tol,
+    status = "ok"
+    loss_fence = None
+    try:
+        for epoch in range(n_epochs):
+            chunk_order = (
+                order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
             )
-            if save_after_every:
-                learned_dicts = export()
-                # named by training-sequence position (like the reference's
-                # enumerate counter, `basic_l1_sweep.py:92,114`), NOT by the
-                # shuffled store index — chunk_{k} is always the k-th state
-                save_learned_dicts(
-                    out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
-                    learned_dicts,
+            for pos, chunk_idx in enumerate(chunk_order):
+                if hbm_cache:
+                    if int(chunk_idx) not in cache:
+                        cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
+                    chunk = cache[int(chunk_idx)].astype(jnp.float32)
+                else:
+                    chunk = store.load(int(chunk_idx))
+                key, k = jax.random.split(key)
+                telemetry.chunk_start(int(chunk_idx), epoch=epoch, position=pos)
+                loss_fence = ensemble_train_loop(
+                    ens, chunk, batch_size=batch_size, key=k,
+                    logger=logger, fista_iters=fista_iters, fista_tol=fista_tol,
+                    telemetry=telemetry,
                 )
-        if not save_after_every:
-            learned_dicts = export()
-            save_learned_dicts(
-                out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
-            )
-    logger.close()
+                timer.tick()  # one tick per chunk pass; fenced at run_end
+                telemetry.chunk_end(
+                    int(chunk_idx), epoch=epoch, position=pos,
+                    steps=chunk.shape[0] // batch_size,
+                )
+                if save_after_every:
+                    learned_dicts = export()
+                    # named by training-sequence position (like the reference's
+                    # enumerate counter, `basic_l1_sweep.py:92,114`), NOT by the
+                    # shuffled store index — chunk_{k} is always the k-th state
+                    save_learned_dicts(
+                        out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
+                        learned_dicts,
+                    )
+            if not save_after_every:
+                learned_dicts = export()
+                save_learned_dicts(
+                    out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
+                )
+    except BaseException as e:
+        status = f"error: {type(e).__name__}: {e}"
+        raise
+    finally:
+        # close() flushes the tail window, which can itself trip the guard
+        # (e.g. AnomalyAbort on the final flush) — run_end/close must still
+        # execute, and an already-unwinding exception must not be replaced
+        close_exc = None
+        try:
+            logger.close()
+        except BaseException as e:
+            close_exc = e
+            if status == "ok":
+                status = f"error: {type(e).__name__}: {e}"
+        telemetry.run_end(
+            status=status,
+            timer_stats=timer.report(
+                fence=None if loss_fence is None else loss_fence.get("loss")
+            ),
+            masked_models=sorted(guard.masked),
+        )
+        telemetry.close()
+        if close_exc is not None and sys.exc_info()[0] is None:
+            raise close_exc  # nothing else unwinding: surface the abort
     return learned_dicts

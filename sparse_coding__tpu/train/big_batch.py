@@ -185,6 +185,7 @@ def train_big_batch(
     resurrection_log: Optional[list] = None,
     encoder_norm_ratio: float = 0.2,
     l1_warmup_steps: int = 0,
+    telemetry=None,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
     resurrection. Returns (final state, sig) for `to_learned_dict` export.
@@ -197,6 +198,10 @@ def train_big_batch(
     (the reference's convention is 0.2, `huge_batch_size.py:240`; RESURRECT_r04
     measures that transplant at the 32x flagship shape). ``l1_warmup_steps``
     linearly ramps l1 pressure from ~0 (see `make_big_batch_step`).
+    ``telemetry`` (a `telemetry.events.RunTelemetry`) additionally records
+    each resurrection as a structured event plus step/resurrection counters
+    — the artifact-side trail the RESURRECT_r04 studies had to reconstruct
+    from stdout.
     """
     from sparse_coding__tpu.utils import precision as px
 
@@ -204,14 +209,14 @@ def train_big_batch(
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
             learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-            encoder_norm_ratio, l1_warmup_steps,
+            encoder_norm_ratio, l1_warmup_steps, telemetry,
         )
 
 
 def _train_big_batch(
     sig, init_hparams, dataset, batch_size, n_steps, key,
     learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-    encoder_norm_ratio, l1_warmup_steps,
+    encoder_norm_ratio, l1_warmup_steps, telemetry=None,
 ) -> Tuple[BigBatchState, Any]:
     k_init, key = jax.random.split(key)
     params, buffers = sig.init(k_init, **init_hparams)
@@ -263,6 +268,15 @@ def _train_big_batch(
             worst = WorstExamples(worst_k)
             if resurrection_log is not None:
                 resurrection_log.append((i + 1, n_dead))
+            if telemetry is not None:
+                telemetry.event(
+                    "resurrection", step=i + 1, n_dead=int(n_dead),
+                    n_feats=int(n_feats),
+                )
+                telemetry.counter_inc("resurrections")
+                telemetry.counter_inc("resurrected_features", int(n_dead))
             if n_dead:
                 print(f"step {i+1}: resurrected {n_dead} dead features")
+        if telemetry is not None:
+            telemetry.counter_inc("train.steps")
     return state, sig

@@ -35,6 +35,8 @@ import numpy as np
 from sparse_coding__tpu.ensemble import Ensemble, EnsembleState
 from sparse_coding__tpu.models.fista import dictionary_update
 from sparse_coding__tpu.models.learned_dict import _norm_rows
+from sparse_coding__tpu.telemetry.audit import allowed_transfer
+from sparse_coding__tpu.telemetry.events import tracked_jit
 from sparse_coding__tpu.utils.logging import MetricLogger
 
 
@@ -42,9 +44,9 @@ from sparse_coding__tpu.utils.logging import MetricLogger
 def _shuffler(n_batches: int, batch_size: int) -> Callable:
     """Jitted bulk shuffle for the whole-chunk train path: gather the
     permuted rows in one pass and batch them `[n_batches, batch_size, d]`."""
-    return jax.jit(
+    return tracked_jit("loop.bulk_shuffle", jax.jit(
         lambda d, p: jnp.take(d, p, axis=0).reshape(n_batches, batch_size, d.shape[1])
-    )
+    ))
 
 
 @lru_cache(maxsize=8)
@@ -78,13 +80,15 @@ def warn_if_ensemble_dead(ensemble: Ensemble, batch, context: str = "") -> bool:
     import warnings
 
     try:
-        dead = bool(
-            jax.device_get(
-                _dead_ensemble_probe(ensemble.sig)(
-                    ensemble.state.params, ensemble.state.buffers, batch[:64]
+        # a sanctioned once-per-chunk sync point (exempt from transfer_audit)
+        with allowed_transfer():
+            dead = bool(
+                jax.device_get(
+                    _dead_ensemble_probe(ensemble.sig)(
+                        ensemble.state.params, ensemble.state.buffers, batch[:64]
+                    )
                 )
             )
-        )
     except (KeyError, TypeError, AttributeError, ValueError) as e:
         # signatures without a standard aux contract: skip — but only for the
         # expected contract failures; a real device error must propagate
@@ -176,6 +180,16 @@ def _cached_fista_decoder_update(num_iter: int, use_pallas, tol: float = 0.0) ->
             return new_dict, new_hessian
 
         new_dicts, new_hessians = jax.vmap(one_model)(state.params, state.buffers, c)
+        # honor the anomaly guard's update mask: a masked (sick) member's
+        # decoder must stay frozen here too, or this update would keep
+        # rewriting it from its NaN codes right after the gradient step was
+        # frozen (jnp.where, not *: NaN-safe)
+        mask = state.buffers.get("update_mask")
+        if mask is not None:
+            keep = (mask > 0).reshape((-1,) + (1,) * (new_dicts.ndim - 1))
+            new_dicts = jnp.where(keep, new_dicts, state.params["decoder"])
+            keep_h = (mask > 0).reshape((-1,) + (1,) * (new_hessians.ndim - 1))
+            new_hessians = jnp.where(keep_h, new_hessians, state.buffers["hessian_diag"])
         params = dict(state.params)
         params["decoder"] = new_dicts
         buffers = dict(state.buffers)
@@ -184,7 +198,7 @@ def _cached_fista_decoder_update(num_iter: int, use_pallas, tol: float = 0.0) ->
             params=params, buffers=buffers, opt_state=state.opt_state, step=state.step
         )
 
-    return update
+    return tracked_jit("loop.fista_decoder_update", update)
 
 
 def ensemble_train_loop(
@@ -201,6 +215,7 @@ def ensemble_train_loop(
     scan_steps: int = 8,
     dead_check: bool = True,
     bulk_shuffle_max_bytes: int = 2 << 30,
+    telemetry=None,
 ) -> Dict[str, jax.Array]:
     """Train the ensemble for one pass over `dataset` ([N, d] activations).
 
@@ -217,6 +232,10 @@ def ensemble_train_loop(
     `step_scan` (host arrays / sharded ensembles). `scan_steps` is forced
     to 1 when the FISTA decoder update is active (it needs each step's
     `aux["c"]` warm start between gradient steps).
+
+    ``telemetry`` (a `telemetry.events.RunTelemetry`) receives host-side
+    step/dispatch counters — Python ints, zero device syncs; chunk-level
+    events stay with the drivers, which know the chunk indices.
     """
     if fista_update is None:
         fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
@@ -264,6 +283,9 @@ def ensemble_train_loop(
         )
         losses = ensemble.step_scan(shuffled)
         del shuffled
+        if telemetry is not None:
+            telemetry.counter_inc("train.steps", n_batches)
+            telemetry.counter_inc("train.dispatches")
         loss_dict = {name: v[-1] for name, v in losses.items()}
         log_scan_losses(0, losses, n_batches)
         if logger is not None:
@@ -274,8 +296,10 @@ def ensemble_train_loop(
             )
         return loss_dict
 
-    # host-side permutation; the data itself stays wherever it lives (HBM)
-    perm = np.asarray(jax.random.permutation(key, n))
+    # host-side permutation; the data itself stays wherever it lives (HBM) —
+    # a sanctioned once-per-chunk transfer, exempt from transfer_audit
+    with allowed_transfer():
+        perm = np.asarray(jax.random.permutation(key, n))
     loss_dict = {}
     i = 0
     while i < n_batches:
@@ -298,6 +322,9 @@ def ensemble_train_loop(
             if logger is not None:
                 logger.log(i, loss_dict)
         i += k
+        if telemetry is not None:
+            telemetry.counter_inc("train.steps", k)
+            telemetry.counter_inc("train.dispatches")
         if logger is not None and (i // log_every) != ((i - k) // log_every):
             logger.flush()
         if progress_callback is not None:

@@ -19,6 +19,7 @@ Additions over the reference:
 from __future__ import annotations
 
 import os
+import sys
 from itertools import product
 from math import isclose
 from pathlib import Path
@@ -32,6 +33,7 @@ from sparse_coding__tpu import metrics as sm
 from sparse_coding__tpu.data.chunks import ChunkStore, generate_synthetic_chunks
 from sparse_coding__tpu.data.synthetic import SparseMixDataset
 from sparse_coding__tpu.ensemble import Ensemble
+from sparse_coding__tpu.telemetry import AnomalyGuard, AnomalyPolicy, RunTelemetry
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.loop import ensemble_train_loop
 from sparse_coding__tpu.utils.logging import (
@@ -39,6 +41,7 @@ from sparse_coding__tpu.utils.logging import (
     format_hyperparam_val,
     make_hyperparam_name,
 )
+from sparse_coding__tpu.utils.trace import timed
 
 SAVE_CHUNKS = {2**j for j in range(3, 10)}  # 8,16,...,512 (reference big_sweep.py:421)
 
@@ -291,11 +294,26 @@ def sweep(
     os.makedirs(cfg.dataset_folder, exist_ok=True)
     os.makedirs(cfg.output_folder, exist_ok=True)
 
-    store = (
-        init_synthetic_dataset(cfg)
-        if getattr(cfg, "use_synthetic_dataset", False)
-        else init_model_dataset(cfg)
+    # run telemetry: events.jsonl beside the metrics JSONL makes every sweep
+    # self-describing (fingerprint, compile + chunk events, anomalies,
+    # run_end) — `python -m sparse_coding__tpu.report <output_folder>`
+    telemetry = RunTelemetry(
+        out_dir=cfg.output_folder,
+        run_name=f"sweep_{Path(cfg.output_folder).name}",
+        config={
+            k: v
+            for k, v in sorted(getattr(cfg, "__dict__", {}).items())
+            if isinstance(v, (int, float, str, bool, type(None), list, tuple))
+        },
     )
+    telemetry.run_start()
+
+    with timed(telemetry, "dataset_init"):
+        store = (
+            init_synthetic_dataset(cfg)
+            if getattr(cfg, "use_synthetic_dataset", False)
+            else init_model_dataset(cfg)
+        )
 
     print("Initialising ensembles...", end=" ")
     ensembles, ensemble_hyperparams, buffer_hyperparams, hyperparam_ranges = (
@@ -303,10 +321,19 @@ def sweep(
     )
     print("Ensembles initialised.")
 
+    # one logger is shared by every ensemble, so the guard's loss-spike
+    # trailing windows would mix members of different ensembles — spikes off,
+    # NaN/Inf + dead-fraction-jump detection on (cfg.anomaly_policy overrides)
+    guard = AnomalyGuard(
+        telemetry=telemetry,
+        out_dir=cfg.output_folder,
+        policy=getattr(cfg, "anomaly_policy", None) or AnomalyPolicy(spikes=False),
+    )
     logger = MetricLogger(
         out_dir=cfg.output_folder,
         run_name=f"sweep_{Path(cfg.output_folder).name}",
         use_wandb=getattr(cfg, "use_wandb", False),
+        on_flush=guard.observe,
     )
 
     n_chunks = len(store)
@@ -375,60 +402,84 @@ def sweep(
         # double-buffered prefetch: next chunk's disk read + H2D transfer
         # overlap the current chunk's training (data.chunks.iter_chunks)
         chunk_iter = store.iter_chunks(remaining_order, dtype=jnp.float32)
-    for i, chunk in zip(range(start_chunk, len(chunk_order)), chunk_iter):
-        print(f"Chunk {i+1}/{len(chunk_order)} (file {int(chunk_order[i])})")
-        if getattr(cfg, "center_activations", False):
-            if means is None:
-                print("Centring activations")
-                means = chunk.mean(axis=0)
-                np.save(means_path, np.asarray(jax.device_get(means)))
-            chunk = chunk - means[None, :]
+    status = "ok"
+    try:
+        for i, chunk in zip(range(start_chunk, len(chunk_order)), chunk_iter):
+            print(f"Chunk {i+1}/{len(chunk_order)} (file {int(chunk_order[i])})")
+            telemetry.chunk_start(i, file=int(chunk_order[i]))
+            if getattr(cfg, "center_activations", False):
+                if means is None:
+                    print("Centring activations")
+                    means = chunk.mean(axis=0)
+                    np.save(means_path, np.asarray(jax.device_get(means)))
+                chunk = chunk - means[None, :]
 
-        for ensemble, args, name in ensembles:
-            rng_key, k = jax.random.split(rng_key)
-            ensemble_train_loop(
-                ensemble,
-                chunk,
-                batch_size=args.get("batch_size", cfg.batch_size),
-                key=k,
-                logger=logger,
-            )
+            for ensemble, args, name in ensembles:
+                rng_key, k = jax.random.split(rng_key)
+                ensemble_train_loop(
+                    ensemble,
+                    chunk,
+                    batch_size=args.get("batch_size", cfg.batch_size),
+                    key=k,
+                    logger=logger,
+                    telemetry=telemetry,
+                )
 
-        # export learned dicts only when something consumes them (save point
-        # or metric log) — unstack + export per chunk is pure waste otherwise
-        want_metrics = getattr(cfg, "wandb_images", False) and i % 10 == 0
-        want_save = i == len(chunk_order) - 1 or (i + 1) in SAVE_CHUNKS
-        if want_metrics or want_save:
-            learned_dicts = []
+            # export learned dicts only when something consumes them (save
+            # point or metric log) — unstack + export per chunk is pure
+            # waste otherwise
+            want_metrics = getattr(cfg, "wandb_images", False) and i % 10 == 0
+            want_save = i == len(chunk_order) - 1 or (i + 1) in SAVE_CHUNKS
+            if want_metrics or want_save:
+                learned_dicts = []
+                for ensemble, args, _name in ensembles:
+                    learned_dicts.extend(
+                        unstacked_to_learned_dicts(
+                            ensemble, args, ensemble_hyperparams, buffer_hyperparams
+                        )
+                    )
+
+            if want_metrics:
+                log_sweep_metrics(
+                    learned_dicts, chunk, i, hyperparam_ranges, logger, cfg.output_folder
+                )
+
+            if want_save:
+                iter_folder = Path(cfg.output_folder) / f"_{i}"
+                iter_folder.mkdir(parents=True, exist_ok=True)
+                ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
+                if hasattr(cfg, "save_yaml"):
+                    cfg.save_yaml(iter_folder / "config.yaml")
+                ckpt_lib.save_ensemble_checkpoint(
+                    Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
+                )
+            telemetry.chunk_end(i, saved=bool(want_save))
+
+        if not learned_dicts:
+            # resumed past the last chunk: export straight from the restored
+            # state
             for ensemble, args, _name in ensembles:
                 learned_dicts.extend(
                     unstacked_to_learned_dicts(
                         ensemble, args, ensemble_hyperparams, buffer_hyperparams
                     )
                 )
-
-        if want_metrics:
-            log_sweep_metrics(
-                learned_dicts, chunk, i, hyperparam_ranges, logger, cfg.output_folder
-            )
-
-        if want_save:
-            iter_folder = Path(cfg.output_folder) / f"_{i}"
-            iter_folder.mkdir(parents=True, exist_ok=True)
-            ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
-            if hasattr(cfg, "save_yaml"):
-                cfg.save_yaml(iter_folder / "config.yaml")
-            ckpt_lib.save_ensemble_checkpoint(
-                Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
-            )
-
-    if not learned_dicts:
-        # resumed past the last chunk: export straight from the restored state
-        for ensemble, args, _name in ensembles:
-            learned_dicts.extend(
-                unstacked_to_learned_dicts(
-                    ensemble, args, ensemble_hyperparams, buffer_hyperparams
-                )
-            )
-    logger.close()
+    except BaseException as e:
+        status = f"error: {type(e).__name__}: {e}"
+        raise
+    finally:
+        # close() flushes the tail window, which can itself trip the guard —
+        # run_end/close must still execute, and an already-unwinding
+        # exception must not be replaced
+        close_exc = None
+        try:
+            logger.close()
+        except BaseException as e:
+            close_exc = e
+            if status == "ok":
+                status = f"error: {type(e).__name__}: {e}"
+        telemetry.run_end(status=status, masked_models=sorted(guard.masked))
+        telemetry.close()
+        if close_exc is not None and sys.exc_info()[0] is None:
+            raise close_exc  # nothing else unwinding: surface the abort
     return learned_dicts

@@ -11,6 +11,24 @@ from __future__ import annotations
 import os
 from pathlib import Path
 
+# resolved cache dir of the last enable call (None = never enabled here) —
+# telemetry.run_fingerprint records it so every events.jsonl says whether a
+# run could hit the cache, and where
+_CACHE_DIR: str | None = None
+
+
+def compile_cache_info() -> dict:
+    """{"enabled", "dir", "entries"} for run fingerprints. `entries` counts
+    cache files currently on disk (an approximation of warmth; -1 when the
+    dir is unreadable). Cheap enough to call once per run_start."""
+    if _CACHE_DIR is None:
+        return {"enabled": False, "dir": None, "entries": 0}
+    try:
+        entries = sum(1 for p in Path(_CACHE_DIR).iterdir() if p.is_file())
+    except OSError:
+        entries = -1
+    return {"enabled": True, "dir": _CACHE_DIR, "entries": entries}
+
 
 def enable_persistent_compile_cache(
     cache_dir: str | os.PathLike | None = None,
@@ -22,14 +40,16 @@ def enable_persistent_compile_cache(
     (default: `<repo>/.jax_cache`)."""
     import jax
 
+    global _CACHE_DIR
     default_dir = Path(__file__).resolve().parents[2] / ".jax_cache"
+    resolved = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", str(cache_dir or default_dir)
+    )
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR", str(cache_dir or default_dir)),
-        )
+        jax.config.update("jax_compilation_cache_dir", resolved)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_time_secs)
         if min_entry_size_bytes is not None:
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes)
+        _CACHE_DIR = resolved
     except Exception:
         pass  # older jax: run uncached

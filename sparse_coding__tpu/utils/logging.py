@@ -16,9 +16,11 @@ from __future__ import annotations
 import json
 import time
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
+
+from sparse_coding__tpu.telemetry.audit import allowed_transfer
 
 
 def format_hyperparam_val(val) -> str:
@@ -41,6 +43,13 @@ class MetricLogger:
     `log(step, tree)` stores device scalars without transfer; `flush()` pulls
     everything in one transfer and writes records
     ``{"step": int, "series": str, "metric": str, "value": float}``.
+
+    ``on_flush(steps, trees)`` (optional) receives each flush window's
+    host-side payload AFTER it is written — `telemetry.anomaly.AnomalyGuard.
+    observe` plugs in here, so anomaly detection costs zero extra device
+    syncs and runs exactly at the flush boundary. Exceptions it raises
+    (e.g. `AnomalyAbort`) propagate to the training loop with the window
+    already safely on disk.
     """
 
     def __init__(
@@ -50,8 +59,10 @@ class MetricLogger:
         use_wandb: bool = False,
         wandb_project: str = "sparse_coding__tpu",
         model_names: Optional[List[str]] = None,
+        on_flush: Optional[Callable[[List[int], List[Dict[str, Any]]], None]] = None,
     ):
         self.model_names = model_names
+        self.on_flush = on_flush
         self._buffer: List = []
         self._wandb = None
         self._jsonl = None
@@ -101,7 +112,10 @@ class MetricLogger:
         if not self._buffer:
             return
         steps = [s for s, _ in self._buffer]
-        trees = jax.device_get([t for _, t in self._buffer])  # ONE transfer
+        # ONE transfer — and THE sanctioned host-sync point of the hot loop,
+        # exempt from any enclosing telemetry.audit.transfer_audit
+        with allowed_transfer():
+            trees = jax.device_get([t for _, t in self._buffer])
         now = time.time()
         for step, tree in zip(steps, trees):
             for metric, values in tree.items():
@@ -126,6 +140,10 @@ class MetricLogger:
         if self._jsonl is not None:
             self._jsonl.flush()
         self._buffer.clear()
+        if self.on_flush is not None:
+            # after the disk write + buffer clear: an aborting guard leaves
+            # the window persisted and close() won't re-log it
+            self.on_flush(steps, trees)
 
     def close(self):
         self.flush()

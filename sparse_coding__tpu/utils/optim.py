@@ -107,7 +107,11 @@ def scale_by_adam_compressed(
             updates,
             state.nu,
         )
-        count_inc = optax.safe_increment(state.count)
+        # optax renamed safe_int32_increment -> safe_increment; this image's
+        # optax only has the old name
+        count_inc = getattr(
+            optax, "safe_increment", getattr(optax, "safe_int32_increment", None)
+        )(state.count)
         tf = count_inc.astype(jnp.float32)
         bc1 = 1 - jnp.power(jnp.float32(b1), tf)
         bc2 = 1 - jnp.power(jnp.float32(b2), tf)

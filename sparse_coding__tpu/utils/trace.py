@@ -8,7 +8,10 @@ counters polled by `progressbar` (`cluster_runs.py:132-154`). Here:
   - `StepTimer`: wall-clock per-step timing with a device-sync fence only at
     report time (no per-step host syncs);
   - `annotate(...)`: `jax.profiler.TraceAnnotation` passthrough for labeling
-    train-loop phases inside a trace.
+    train-loop phases inside a trace;
+  - `timed(...)`: wall-clock a named phase into a run's telemetry event log
+    (`telemetry.events.RunTelemetry`) — the artifact-side counterpart of
+    `annotate`'s profiler-side label.
 """
 
 from __future__ import annotations
@@ -37,6 +40,21 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+@contextlib.contextmanager
+def timed(telemetry, name: str, **fields):
+    """Emit a ``phase`` event with the block's wall seconds to `telemetry`
+    (no-op when it is None) — e.g. ``with timed(tel, "harvest"): ...``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if telemetry is not None:
+            telemetry.event(
+                "phase", name=name,
+                seconds=round(time.perf_counter() - t0, 4), **fields,
+            )
+
+
 class StepTimer:
     """Wall-clock step timing without per-step device syncs.
 
@@ -61,7 +79,12 @@ class StepTimer:
         n_steps = len(self._times) - 1  # ticks only; the fence is not a step
         end = self._times[-1]
         if fence is not None:
-            jax.device_get(fence)
+            # a sanctioned sync point: report() is a flush-boundary act, so
+            # it stays legal inside telemetry.audit.transfer_audit
+            from sparse_coding__tpu.telemetry.audit import allowed_transfer
+
+            with allowed_transfer():
+                jax.device_get(fence)
             end = time.perf_counter()  # extends total time, not the step count
         if n_steps <= 0:
             return {"steps": 0, "total_s": 0.0, "steps_per_sec": 0.0, "mean_step_ms": 0.0}

@@ -17,11 +17,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent XLA compile cache: the suite's wall time is dominated by
-# compilation (VERDICT r2 weak #5); cached executables survive across runs.
-from sparse_coding__tpu.utils.compile_cache import enable_persistent_compile_cache
+# Persistent XLA compile cache: DISABLED for the test suite (was: enabled
+# with min_compile_time_secs=0.2 for wall time, VERDICT r2 weak #5). On this
+# jaxlib's CPU backend, executables DESERIALIZED from the cache are broken:
+# warm-cache runs produced wrong numerics in at least 9 tests (elastic
+# resume, ensemble state-dict round trips, harvest-with-mesh, topk, train
+# loop — all pass cold, fail warm) and glibc heap corruption ("corrupted
+# double-linked list" SIGABRT) when a restored sharded ensemble steps
+# through a cached executable with donated buffers — which killed the whole
+# suite mid-run. Correctness beats wall time; opt back in explicitly with
+# SPARSE_CODING_TPU_TEST_COMPILE_CACHE=1 to reproduce the failure mode.
+if os.environ.get("SPARSE_CODING_TPU_TEST_COMPILE_CACHE") == "1":
+    from sparse_coding__tpu.utils.compile_cache import enable_persistent_compile_cache
 
-enable_persistent_compile_cache(min_compile_time_secs=0.2, min_entry_size_bytes=0)
+    enable_persistent_compile_cache(min_compile_time_secs=0.2, min_entry_size_bytes=0)
 
 import pytest
 

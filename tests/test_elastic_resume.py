@@ -99,6 +99,32 @@ def test_orbax_checkpoint_reshards(tmp_path, devices):
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_compile_cache():
+    """Disable the persistent XLA compile cache for this module.
+
+    Stepping a checkpoint-restored sharded ensemble through an executable
+    DESERIALIZED from the persistent cache hard-aborts the interpreter with
+    glibc heap corruption ("corrupted double-linked list") on this jaxlib's
+    CPU backend — an XLA executable-deserialization + buffer-donation bug,
+    reproducible in a bare script and absent with the cache off. The SIGABRT
+    used to kill the whole tier-1 suite mid-run, hiding every test that
+    sorts after this file. Compiling this module's programs uncached costs
+    seconds; the shared-step cache is cleared so no executable deserialized
+    by an earlier file is reused here."""
+    import jax
+
+    from sparse_coding__tpu.ensemble import Ensemble
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    Ensemble._SHARED_STEPS.clear()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
 def test_orbax_restores_directly_sharded(tmp_path, devices):
     """Restoring through a LIVE sharded template (`Ensemble.state_template`)
     yields arrays already placed on the mesh — the path that avoids

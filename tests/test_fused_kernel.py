@@ -355,7 +355,53 @@ def test_accum_adam_kernel_matches_resident_kernel():
     names = ["d_new", "mu_new", "nu_new", "g_bias", "l_rec", "l_l1_raw"]
     for name, a, b in zip(names, res, acc):
         # tolerance: the two kernels sum the bf16 dot products in different
-        # orders (whole batch vs 512-row partials) — measured <=7e-4 rel
+        # orders (whole batch vs ACCUM_BATCH_TILE-row partials) — measured
+        # <=7e-4 rel
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5, err_msg=name
         )
+
+
+def test_fused_batch_supported_threads_dict_tile(stacked):
+    """The gate (`FunctionalTiedSAE.fused_batch_supported`) and the kernel's
+    trace-time ValueError share ONE predicate (`ops.tied_sae_kernel.
+    adam_step_supported`) — including non-default ``dict_tile``: a tile that
+    does not divide N must be refused by BOTH, not pass the gate and then
+    blow up inside `tied_sae_adam_step_stacked` (the pre-ISSUE-2 skew)."""
+    from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
+
+    params, buffers, batch = stacked
+    mu = jnp.zeros((M, N, D))
+    nu = jnp.zeros((M, N, D))
+    l1 = jnp.asarray([1e-3, 3e-3])
+    bc = jnp.tile(jnp.asarray([[0.1, 0.001]]), (M, 1))
+    seed = jnp.asarray([7], jnp.int32)
+    args = (params["encoder"], params["encoder_bias"], mu, nu, batch, l1, bc, seed)
+    kw = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, interpret=True)
+
+    # dict_tile=384 does not divide N=512: gate says no, kernel raises
+    assert not FunctionalTiedSAE.fused_batch_supported(
+        params, B, adam_fused=True, dict_tile=384
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        tied_sae_adam_step_stacked(*args, **kw, dict_tile=384)
+
+    # dict_tile=128 (non-default, divides N): gate says yes AND the kernel
+    # runs, producing the same step as the default tiling to f32 tolerance
+    # (tiling changes only the summation order)
+    assert FunctionalTiedSAE.fused_batch_supported(
+        params, B, adam_fused=True, dict_tile=128
+    )
+    ref = tied_sae_adam_step_stacked(*args, **kw)
+    got = tied_sae_adam_step_stacked(*args, **kw, dict_tile=128)
+    for name, a, b in zip(
+        ["d_new", "mu_new", "nu_new", "g_bias", "l_rec", "l_l1_raw"], ref, got
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5, err_msg=name
+        )
+
+    # batch_tile indivisibility is part of the same predicate
+    assert not FunctionalTiedSAE.fused_batch_supported(
+        params, B + 32, adam_fused=True
+    )

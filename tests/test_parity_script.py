@@ -170,3 +170,38 @@ def test_resurrect_study_warmup_quick(tmp_path):
     assert report["config"]["l1_warmup_steps"] == 20
     for arm in report["arms"].values():
         assert not arm["resurrection_events"]
+
+
+def test_file_tokens_flags_tiling(tmp_path):
+    """An undersized token file must come back with a machine-readable
+    tiling flag (ISSUE 2 satellite): the repeat caveat belongs in the
+    artifact JSON (`subject_caveat` / `harvest_tiling`), not only stdout."""
+    import numpy as np
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    from parity_run import file_tokens, harvest_rows, tiling_caveat
+
+    d_act, chunk_gb, batch_rows, seq_len, n_chunks = 32, 0.0005, 4, 16, 2
+    n_rows = harvest_rows(d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    assert n_rows > 8  # the fixture below must actually undersupply
+
+    path = tmp_path / "toks.npy"
+    np.save(path, np.arange(8 * seq_len, dtype=np.int64).reshape(8, seq_len) % 50)
+
+    tokens, info = file_tokens(str(path), 64, d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    assert tokens.shape == (n_rows, seq_len)
+    assert info == {
+        "tiled": True,
+        "rows_available": 8,
+        "rows_requested": n_rows,
+        "repeat_factor": round(n_rows / 8, 2),
+    }
+    caveat = tiling_caveat("base caveat", info)
+    assert caveat.startswith("base caveat; HARVEST TEXT TILED")
+    assert f"{info['repeat_factor']}x" in caveat
+
+    # a file that covers the harvest carries no flag and no caveat suffix
+    np.save(path, np.zeros((n_rows, seq_len), dtype=np.int64))
+    tokens, info = file_tokens(str(path), 64, d_act, chunk_gb, batch_rows, seq_len, n_chunks)
+    assert tokens.shape == (n_rows, seq_len) and info is None
+    assert tiling_caveat("base caveat", info) == "base caveat"

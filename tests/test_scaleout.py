@@ -122,14 +122,27 @@ def test_sweep_fanout_program_is_collective_free():
     assert t["wire_bytes_per_chip_per_step"] == 0
 
 
-def test_hybrid_dp_program_has_single_halved_allreduce():
-    """model=2 x data=2: exactly ONE gradient all-reduce; with the tied-SAE
-    DP backward (models/sae.py FunctionalTiedSAEDP, which all-reduces the
-    single fused gradient operand) its ring wire at group 2 equals the
-    per-chip gradient bytes (2 members x (N*D + N) f32) plus a few scalar
-    loss psums — NOT 2x (the double-all-reduce regression class)."""
+def _grad_sync_ops(t, floor=1024):
+    """The structural gradient/decode all-reduces: everything at or above
+    `floor` wire bytes. XLA's all-reduce combiner decides how many HLO ops
+    the per-step sync becomes (this jaxlib emits the encoder-matrix and bias
+    gradient operands as SEPARATE all-reduces where older ones fused them),
+    so total op count is a partitioner artifact — the invariant worth
+    pinning is the byte-weighted structure, with scalar loss psums (a few
+    bytes each) excluded."""
+    return [o for o in t["ops"] if o["op"] == "all-reduce"
+            and o["wire_bytes_per_chip"] >= floor]
+
+
+def test_hybrid_dp_program_has_halved_gradient_allreduce():
+    """model=2 x data=2: the per-step gradient sync is exactly the two
+    gradient operands (encoder matrix + bias — a third large all-reduce is
+    the double-all-reduce regression class, SCALEOUT_r04 conclusions.4);
+    with the tied-SAE DP backward (models/sae.py FunctionalTiedSAEDP) its
+    ring wire at group 2 equals the per-chip gradient bytes (2 members x
+    (N*D + N) f32) plus a few scalar loss psums — NOT 2x."""
     t = _compile_traffic(4, (2, 2, 1))
-    assert t["summary"]["all-reduce"]["count"] == 1, t["summary"]
+    assert len(_grad_sync_ops(t)) == 2, t["ops"]
     grad_bytes = 2 * GRAD_BYTES_PER_MEMBER
     wire = t["wire_bytes_per_chip_per_step"]
     # ring all-reduce at g=2: 2*(g-1)/g * b == b; allow 1 KB of scalar psums
@@ -137,10 +150,10 @@ def test_hybrid_dp_program_has_single_halved_allreduce():
 
 
 def test_pure_dp_program_wire_matches_ring_model():
-    """data=8 (the DDP shape): one all-reduce of every member's gradients,
-    ring wire = 2*(g-1)/g * grad bytes at g=8."""
+    """data=8 (the DDP shape): all-reduce of every member's gradients
+    (matrix + bias operands), ring wire = 2*(g-1)/g * grad bytes at g=8."""
     t = _compile_traffic(2, (1, 8, 1))
-    assert t["summary"]["all-reduce"]["count"] == 1, t["summary"]
+    assert len(_grad_sync_ops(t)) == 2, t["ops"]
     grad_bytes = 2 * GRAD_BYTES_PER_MEMBER
     expect = 2 * 7 / 8 * grad_bytes
     wire = t["wire_bytes_per_chip_per_step"]
@@ -148,21 +161,41 @@ def test_pure_dp_program_wire_matches_ring_model():
 
 
 @pytest.mark.parametrize(
-    "mesh_shape,golden_wire",
+    "mesh_shape",
     [
-        # goldens measured at authoring time (r5) from the optimized HLO of
-        # the shipped program; a changed count or >10% byte drift means the
-        # partitioner or our sharding specs changed — investigate, then
-        # re-pin deliberately.
-        ((2, 2, 2), 198156),
-        ((1, 2, 4), 330268),  # dictpar DCN-analogue: data x dict
+        (2, 2, 2),
+        (1, 2, 4),  # dictpar DCN-analogue: data x dict
     ],
 )
-def test_dict_sharded_program_collective_structure(mesh_shape, golden_wire):
-    """Dict-axis sharding adds exactly ONE more all-reduce (the decode psum
-    over dict shards) on top of the data-axis gradient all-reduce — two
-    total, with pinned wire bytes."""
-    t = _compile_traffic(2, mesh_shape)
-    assert t["summary"]["all-reduce"]["count"] == 2, t["summary"]
+def test_dict_sharded_program_collective_structure(mesh_shape):
+    """Dict-axis sharding adds exactly ONE large collective beyond the
+    gradient sync: the decode psum over dict shards. Wire bytes are DERIVED
+    from the gradient/activation operands and the ring model (previously
+    pinned as absolute goldens 198156/330268, which silently encoded one
+    partitioner version's combiner choices):
+
+      grad sync   = ring(data) * members_per_chip * grad_bytes / dict
+      decode psum = ring(dict) * members_per_chip * (batch/data) * D * f32
+
+    plus small per-chip extras (the bias-gradient / bias-decode psums and
+    scalar loss psums, ≤ 4 KB at this shape)."""
+    n_models, batch = 2, 256
+    model_ax, data_ax, dict_ax = mesh_shape
+    t = _compile_traffic(n_models, mesh_shape, batch=batch)
+
+    ring = lambda g: 2 * (g - 1) / g
+    members = n_models // model_ax
+    grad_wire = ring(data_ax) * members * GRAD_BYTES_PER_MEMBER / dict_ax
+    decode_wire = ring(dict_ax) * members * (batch // data_ax) * D * 4
+    expect = grad_wire + decode_wire
     wire = t["wire_bytes_per_chip_per_step"]
-    assert abs(wire - golden_wire) <= 0.1 * golden_wire, (wire, golden_wire)
+    assert expect <= wire <= expect + 4096, (wire, expect, t["ops"])
+
+    # exactly TWO dominant collectives: the encoder-matrix gradient
+    # all-reduce (group = data axis) and the partial-x_hat decode psum
+    # (group = dict axis) — byte floor excludes the bias-operand psums
+    dominant = _grad_sync_ops(t, floor=16 * 1024)
+    assert len(dominant) == 2, t["ops"]
+    assert sorted(o["group_size"] for o in dominant) == sorted(
+        [data_ax, dict_ax]
+    ), dominant

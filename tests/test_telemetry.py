@@ -1,0 +1,385 @@
+"""Telemetry subsystem: event log, health pack, anomaly guard, transfer
+audit, report CLI (docs/observability.md; ISSUE 2)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.ensemble import Ensemble, EnsembleState, build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.telemetry import (
+    AnomalyAbort,
+    AnomalyGuard,
+    AnomalyPolicy,
+    RunTelemetry,
+    TransferViolation,
+    read_events,
+    tracked_jit,
+    transfer_audit,
+)
+from sparse_coding__tpu.train.loop import ensemble_train_loop
+from sparse_coding__tpu.utils.logging import MetricLogger
+
+D, N = 16, 32
+
+
+def _build(health=True, n_models=2, seed=0):
+    return build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(seed),
+        [{"l1_alpha": 10 ** (-4 + i)} for i in range(n_models)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D,
+        n_dict_components=N,
+        health=health,
+    )
+
+
+def _data(rows=256, seed=1):
+    return jax.random.normal(jax.random.PRNGKey(seed), (rows, D))
+
+
+# -- events.jsonl schema ------------------------------------------------------
+
+def test_event_schema_roundtrip(tmp_path):
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="rt")
+    tel.run_start(config={"alpha": 1e-3})
+    tel.compile("my.step", 1.25)
+    tel.chunk_start(0)
+    tel.chunk_end(0, steps=4)
+    tel.counter_inc("train.steps", 4)
+    tel.gauge_set("lr", 1e-3)
+    tel.anomaly("nonfinite", step=3, models=[1])
+    tel.snapshot()
+    tel.run_end(status="ok")
+    tel.close()
+
+    events = read_events(tmp_path / "events.jsonl")
+    kinds = [e["event"] for e in events]
+    assert kinds == [
+        "run_start", "compile", "chunk_start", "chunk_end", "anomaly",
+        "snapshot", "snapshot", "run_end",  # run_end emits its own snapshot
+    ]
+    # monotonic seq, float timestamps on every record
+    assert [e["seq"] for e in events] == list(range(1, len(events) + 1))
+    assert all(isinstance(e["ts"], float) for e in events)
+    start = events[0]
+    assert start["config"] == {"alpha": 1e-3}
+    assert start["fingerprint"]["jax"] == jax.__version__
+    assert start["fingerprint"]["backend"] == "cpu"
+    assert "git_sha" in start["fingerprint"]
+    snap = events[-2]
+    assert snap["counters"]["train.steps"] == 4
+    assert snap["counters"]["compile.my.step.count"] == 1
+    assert snap["gauges"]["lr"] == 1e-3
+    end = events[-1]
+    assert end["status"] == "ok" and end["steps"] == 4
+    assert end["steps_per_sec"] > 0
+
+
+def test_context_manager_writes_error_status(tmp_path):
+    with pytest.raises(ValueError):
+        with RunTelemetry(out_dir=str(tmp_path)) as tel:
+            tel.run_start()
+            raise ValueError("boom")
+    end = read_events(tmp_path / "events.jsonl")[-1]
+    assert end["event"] == "run_end" and end["status"].startswith("error: ValueError")
+
+
+def test_tracked_jit_attributes_compiles(tmp_path):
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="tj")
+    fn = tracked_jit("unit.square", jax.jit(lambda x: x * x))
+    fn(jnp.ones((4,)))
+    fn(jnp.ones((4,)))          # cached: no second compile event
+    fn(jnp.ones((8,)))          # new shape: recompile
+    tel.close()
+    compiles = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "compile"]
+    assert [c["name"] for c in compiles] == ["unit.square", "unit.square"]
+    assert tel.counters["dispatch.unit.square"] == 3
+
+
+# -- on-device health pack ----------------------------------------------------
+
+def test_health_pack_rides_metric_logger(tmp_path):
+    ens = _build(health=True)
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="hp")
+    loss = ensemble_train_loop(
+        ens, _data(), batch_size=64, key=jax.random.PRNGKey(2), logger=logger,
+        log_every=2,
+    )
+    logger.close()
+    for k in ("health_grad_norm", "health_dict_norm", "health_nonfinite",
+              "health_dead_frac"):
+        assert k in loss and loss[k].shape == (2,), k
+    records = [json.loads(l) for l in open(tmp_path / "hp_metrics.jsonl")]
+    metrics = {r["metric"] for r in records}
+    assert {"loss", "health_grad_norm", "health_dead_frac"} <= metrics
+    # firing EMA persisted in the (checkpointable) buffers
+    ema = np.asarray(jax.device_get(ens.state.buffers["health_fire_ema"]))
+    assert ema.shape == (2, N) and ema.sum() > 0
+    # health config survives the checkpoint round trip
+    resumed = Ensemble.from_state(ens.state_dict())
+    assert resumed.health == ens.health
+    loss2, _ = resumed.step_batch(_data(64, seed=9))
+    assert "health_dead_frac" in loss2
+
+
+def test_health_dead_fraction_flags_dead_model():
+    ens = _build(health=True)
+    # kill member 1 with a very negative encoder bias => ReLU codes all zero
+    # (zeroing the encoder instead would 0/0-NaN the tied row normalization)
+    params = jax.device_get(ens.state.params)
+    bias = np.asarray(params["encoder_bias"]).copy()
+    bias[1] = -10.0
+    ens.state = EnsembleState(
+        params={**params, "encoder_bias": jnp.asarray(bias)},
+        buffers=ens.state.buffers,
+        opt_state=ens.state.opt_state,
+        step=ens.state.step,
+    )
+    for i in range(3):
+        loss, _ = ens.step_batch(_data(128, seed=10 + i))
+    dead = np.asarray(jax.device_get(loss["health_dead_frac"]))
+    assert dead[1] == pytest.approx(1.0), "all-zero-code member must read dead"
+    assert dead[0] < 0.9, "healthy member must not"
+
+
+def test_update_mask_freezes_only_masked_member():
+    ens = _build(health=False)
+    before = np.asarray(jax.device_get(ens.state.params["encoder"]))
+    ens.set_update_mask([0.0, 1.0])
+    ens.step_batch(_data(64, seed=3))
+    after = np.asarray(jax.device_get(ens.state.params["encoder"]))
+    assert np.array_equal(before[0], after[0]), "masked member moved"
+    assert not np.allclose(before[1], after[1]), "live member frozen"
+
+
+# -- anomaly guard ------------------------------------------------------------
+
+def _poison_member(ens, m):
+    params = jax.device_get(ens.state.params)
+    enc = np.asarray(params["encoder"]).copy()
+    enc[m] = np.nan
+    ens.state = EnsembleState(
+        params={**params, "encoder": jnp.asarray(enc)},
+        buffers=ens.state.buffers,
+        opt_state=ens.state.opt_state,
+        step=ens.state.step,
+    )
+
+
+def test_injected_nan_run_ends_with_anomaly_and_bundle(tmp_path):
+    """The acceptance drill: a poisoned member must produce an `anomaly`
+    event + diagnostic bundle and get masked — not silently log NaN losses
+    for the rest of the run."""
+    ens = _build(health=True)
+    _poison_member(ens, 1)
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="nan_run")
+    tel.run_start()
+    guard = AnomalyGuard(
+        telemetry=tel, out_dir=str(tmp_path),
+        policy=AnomalyPolicy(action="mask"), ensemble=ens,
+        model_names=["m0", "m1"],
+    )
+    logger = MetricLogger(
+        out_dir=str(tmp_path), run_name="nan_run", on_flush=guard.observe,
+    )
+    with pytest.warns(RuntimeWarning, match="masked"):
+        ensemble_train_loop(
+            ens, _data(256), batch_size=32, key=jax.random.PRNGKey(4),
+            logger=logger, log_every=2, scan_steps=2, dead_check=False,
+            progress_callback=lambda i, n: None,  # force the chunked path
+        )
+    logger.close()
+    tel.run_end(status="ok", masked_models=sorted(guard.masked))
+    tel.close()
+
+    assert guard.masked == {1}, "wrong member masked"
+    events = read_events(tmp_path / "events.jsonl")
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    assert anomalies and anomalies[0]["kind"] == "nonfinite"
+    assert anomalies[0]["models"] == [1]
+    bundle_path = anomalies[0]["bundle"]
+    bundle = json.load(open(bundle_path))
+    assert bundle["kinds"] == ["nonfinite"]
+    assert bundle["metric_window"], "bundle must carry the trailing window"
+    assert json.load(open(bundle_path))["policy"]["action"] == "mask"
+    # healthy member's loss stayed finite after the masking
+    rec = [json.loads(l) for l in open(tmp_path / "nan_run_metrics.jsonl")]
+    m0_losses = [r["value"] for r in rec if r["series"] == "model_0" and r["metric"] == "loss"]
+    assert np.isfinite(m0_losses).all()
+
+
+def test_loss_spike_detector_fires_on_right_model():
+    guard = AnomalyGuard(policy=AnomalyPolicy(spike_min_window=8, action="warn"))
+    for step in range(16):
+        guard.observe([step], [{"loss": np.asarray([1.0 + 0.01 * step, 2.0])}])
+    with pytest.warns(RuntimeWarning, match="loss_spike"):
+        found = guard.observe([16], [{"loss": np.asarray([50.0, 2.0])}])
+    assert [f["model"] for f in found] == [0]
+    assert found[0]["kind"] == "loss_spike"
+
+
+def test_dead_fraction_jump_detector():
+    guard = AnomalyGuard(policy=AnomalyPolicy(dead_jump=0.2, action="warn"))
+    guard.observe([0], [{"health_dead_frac": np.asarray([0.05, 0.05])}])
+    with pytest.warns(RuntimeWarning, match="dead_feature_jump"):
+        found = guard.observe([1], [{"health_dead_frac": np.asarray([0.06, 0.55])}])
+    assert [f["model"] for f in found] == [1]
+
+
+def test_abort_policy_raises(tmp_path):
+    guard = AnomalyGuard(
+        out_dir=str(tmp_path), policy=AnomalyPolicy(action="abort")
+    )
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(AnomalyAbort):
+            guard.observe([0], [{"loss": np.asarray([np.nan, 1.0])}])
+    bundles = list((tmp_path / "diagnostics").glob("anomaly_*.json"))
+    assert bundles, "abort must still leave the diagnostic bundle"
+
+
+def test_masked_member_not_redetected():
+    guard = AnomalyGuard(policy=AnomalyPolicy(action="mask"))
+    with pytest.warns(RuntimeWarning):
+        guard.observe([0], [{"loss": np.asarray([np.nan, 1.0])}])
+    assert guard.masked == {0}
+    # same poison again: no new anomaly (would warn if redetected)
+    found = guard.observe([1], [{"loss": np.asarray([np.nan, 1.0])}])
+    assert found == []
+
+
+# -- transfer audit -----------------------------------------------------------
+
+def test_transfer_audit_clean_hot_loop_passes(tmp_path):
+    """The resident fast path + buffered logging performs ZERO device->host
+    transfers outside the sanctioned flush/probe points — now enforced, not
+    just claimed."""
+    ens = _build(health=True)
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="audit")
+    data = _data(512)
+    with transfer_audit():
+        ensemble_train_loop(
+            ens, data, batch_size=64, key=jax.random.PRNGKey(5),
+            logger=logger, log_every=4,
+        )
+    logger.close()
+
+
+def test_transfer_audit_catches_in_loop_device_get(tmp_path):
+    ens = _build(health=False)
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="audit_bad")
+    leak = lambda i, n: jax.device_get(ens.state.step)  # the .item() sin
+    with pytest.raises(TransferViolation):
+        with transfer_audit(telemetry=tel):
+            ensemble_train_loop(
+                ens, _data(256), batch_size=32, key=jax.random.PRNGKey(6),
+                progress_callback=leak, dead_check=False,
+            )
+    tel.close()
+    kinds = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "anomaly"]
+    assert kinds and kinds[0]["kind"] == "transfer_guard"
+
+
+# -- report CLI ---------------------------------------------------------------
+
+def test_report_cli_renders_fixture_run_dir(tmp_path, capsys):
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="fixture")
+    tel.run_start(config={"batch": 64})
+    tel.compile("ensemble.step", 0.5)
+    tel.counter_inc("train.steps", 128)
+    tel.anomaly("nonfinite", step=7, models=[1], model_names=["m1"],
+                action="mask", bundle=None)
+    tel.run_end(status="ok")
+    tel.close()
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="fixture")
+    logger.log(0, {"loss": jnp.asarray([1.0, 2.0]),
+                   "health_dead_frac": jnp.asarray([0.0, 0.4])})
+    logger.flush()
+    logger.close()
+
+    from sparse_coding__tpu.report import main
+
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for section in ("Run fingerprint", "Compile activity", "Throughput",
+                    "Per-model health", "Anomaly timeline"):
+        assert section in out, f"missing section {section}"
+    assert "git_sha" in out
+    assert "ensemble.step" in out
+    assert "nonfinite" in out
+    assert "health_dead_frac" in out
+
+
+def test_report_cli_on_missing_dir_errors(tmp_path):
+    from sparse_coding__tpu.report import main
+
+    with pytest.raises(FileNotFoundError):
+        main([str(tmp_path / "nope")])
+
+
+# -- driver integration -------------------------------------------------------
+
+def test_basic_l1_sweep_writes_telemetry_artifacts(tmp_path, capsys):
+    """The acceptance smoke: the driver's artifacts alone render into a full
+    report — fingerprint, compile stats, health table, (empty) anomalies."""
+    from sparse_coding__tpu.data.chunks import save_chunk
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        save_chunk(str(tmp_path / "chunks"), i,
+                   rng.standard_normal((128, D), dtype=np.float32))
+    out_dir = tmp_path / "run"
+    dicts = basic_l1_sweep(
+        str(tmp_path / "chunks"), str(out_dir), activation_width=D,
+        l1_values=[1e-4, 1e-3], dict_ratio=2.0, batch_size=32, n_epochs=1,
+        fista_iters=4,
+    )
+    assert len(dicts) == 2
+    events = read_events(out_dir / "events.jsonl")
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert kinds.count("chunk_start") == 2 and kinds.count("chunk_end") == 2
+    assert "compile" in kinds
+    end = events[-1]
+    assert end["status"] == "ok" and end["steps"] == 8  # 2 chunks x 128/32
+    assert (out_dir / "basic_l1_sweep_metrics.jsonl").exists()
+
+    from sparse_coding__tpu.report import main
+
+    main([str(out_dir)])
+    out = capsys.readouterr().out
+    assert "No anomalies recorded" in out
+    assert "health_dead_frac" in out
+    assert "chunks, mean" in out
+
+
+def test_update_mask_freezes_fista_decoder_update():
+    """The FISTA decoder update (the non-optimizer param write in
+    `basic_l1_sweep`'s family) must honor the anomaly guard's mask too —
+    otherwise a masked member's decoder keeps being rewritten from its sick
+    codes every step."""
+    from sparse_coding__tpu.models import FunctionalFista
+
+    ens = build_ensemble(
+        FunctionalFista, jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D, n_dict_components=N,
+    )
+    ens.set_update_mask([0.0, 1.0])
+    dec_before = np.asarray(jax.device_get(ens.state.params["decoder"]))
+    hess_before = np.asarray(jax.device_get(ens.state.buffers["hessian_diag"]))
+    ensemble_train_loop(
+        ens, _data(128), batch_size=64, key=jax.random.PRNGKey(1),
+        fista_iters=10, dead_check=False,
+    )
+    dec_after = np.asarray(jax.device_get(ens.state.params["decoder"]))
+    hess_after = np.asarray(jax.device_get(ens.state.buffers["hessian_diag"]))
+    assert np.array_equal(dec_before[0], dec_after[0]), "masked decoder moved"
+    assert np.array_equal(hess_before[0], hess_after[0]), "masked hessian moved"
+    assert not np.allclose(dec_before[1], dec_after[1]), "live decoder frozen"
