@@ -33,6 +33,13 @@ matmul-FLOPs x 512 x 4096 x (fwd+2 bwd) ≈ 201 MFLOP per activation vector;
 A100 bf16 at a generous 50% MXU utilization ≈ 156 TFLOP/s → ~0.78M
 activations/sec. (The BASELINE.json north star is 3x this per chip on a
 v4-32 pod; this bench reports the single-chip number.)
+
+Performance attribution (docs/observability.md §4): the output carries a
+`roofline` block (XLA `cost_analysis` FLOPs/HBM bytes per dispatch vs the
+chip's peak TFLOP/s and HBM GB/s: compute- vs bandwidth-bound, achieved
+fraction of attainable) and per-key `*_hbm_bytes` / `*_hbm_peak_bytes`
+watermarks from `device.memory_stats()`. Compare two bench JSONs
+spread-aware with `python -m sparse_coding__tpu.perfdiff OLD.json NEW.json`.
 """
 
 import json
@@ -179,6 +186,10 @@ def prep_fista(stack, tol: float = 0.0, structured: bool = False):
         jax.device_get(ahat).sum()
         return BATCH / (time.perf_counter() - t0)
 
+    # no cost/roofline handle here: the solve's 500 FISTA iterations live
+    # inside a compiled loop (or a Pallas custom call), and XLA's cost
+    # analysis counts loop bodies once / custom calls not at all — any
+    # roofline number derived from it would be off by the iteration count
     return measure
 
 
@@ -248,6 +259,11 @@ def prep_topk(stack):
         jax.device_get(losses["loss"])
         return S / (time.perf_counter() - t0)
 
+    # XLA cost analysis counts the scan body once (profiling._lowered_cost_
+    # fields unit caveat): the cost block covers ONE step, and this key's
+    # rate is steps/sec — so one cost unit corresponds to 1 rate unit
+    measure.cost = ens.compiled_cost(batches)
+    measure.units_per_cost = 1
     return measure
 
 
@@ -349,7 +365,15 @@ def prep_control(stack):
     8192^3 bf16 matmul, TFLOP/s. Isolates chip weather from code
     regressions (VERDICT r4 weak #1/#7): a key that moves AGAINST the
     control across sessions moved because the code did."""
-    return make_control()
+    measure = make_control()
+    # analytic roofline handle: the chained matmul's intensity sits far above
+    # any chip's ridge, so its attainable is always the MXU peak
+    measure.cost = {
+        "flops": measure.flops_per_call,
+        "bytes_accessed": measure.bytes_per_call,
+        "analytic": True,
+    }
+    return measure
 
 
 def prep_bigbatch(stack):
@@ -387,6 +411,10 @@ def prep_bigbatch(stack):
         jax.device_get(losses["loss"])
         return k * B / (time.perf_counter() - t0)
 
+    # cost block covers ONE scan step = B activation rows (XLA counts loop
+    # bodies once — profiling._lowered_cost_fields unit caveat)
+    measure.cost = ens.compiled_cost(batches)
+    measure.units_per_cost = B
     return measure
 
 
@@ -456,6 +484,9 @@ def main(argv=None):
     # completion barrier, so we device_get the (tiny) loss vector.
     losses = ens.step_scan(batches)
     jax.device_get(losses["loss"])
+    # roofline inputs for the headline key: the compiled scan's analytic
+    # FLOPs/HBM bytes (best-effort; None on backends without cost analysis)
+    headline_cost = ens.compiled_cost(batches)
 
     # ~0.9 s per headline window (3 x 128 fused steps); ROUNDS interleaved
     # windows replace round-3's single 2.5 s window
@@ -490,10 +521,28 @@ def main(argv=None):
             "bigbatch16k_acts_per_sec": prep_bigbatch(stack),
         }
         samples = {k: [] for k in ["headline", *benches]}
+        # per-key HBM watermark samples (satellite: BENCH_r*.json must track
+        # memory, not just throughput). Sampled AFTER each key's timed
+        # window — memory_stats is a host-side query, it cannot pollute the
+        # timing; None (CPU) → the fields are simply absent.
+        from sparse_coding__tpu.telemetry.profiling import (
+            device_memory_stats,
+            record_hbm_watermarks,
+        )
+
+        hbm_samples = {k: [] for k in samples}
+
+        def hbm_sample(key):
+            stats = device_memory_stats(jax.devices()[0])
+            if stats:
+                hbm_samples[key].append(stats)
+
         for _ in range(max(2, args.rounds)):
             samples["headline"].append(measure_headline())
+            hbm_sample("headline")
             for k, m in benches.items():
                 samples[k].append(m())
+                hbm_sample(k)
 
     acts_per_sec, acts_spread = median_spread(samples["headline"])
     # true matmul work of the tied-SAE step: 5 passes (fwd c, fwd x_hat;
@@ -512,8 +561,10 @@ def main(argv=None):
         "rounds": max(2, args.rounds),
         "value_spread": [round(v, 1) for v in acts_spread],
     }
+    medians = {}  # unrounded, for the roofline time math below
     for k in benches:
         med, spread = median_spread(samples[k])
+        medians[k] = med
         out[k] = round(med, 1)
         out[f"{k}_spread"] = [round(v, 1) for v in spread]
     # derived: big-batch MFU and the control's fraction of peak (chip-weather
@@ -523,6 +574,64 @@ def main(argv=None):
         out["bigbatch16k_acts_per_sec"] * flops_per_act / (peak * 1e12), 3
     )
     out["control_fraction_of_peak"] = round(out["control_matmul_tflops"] / peak, 3)
+    # per-key HBM watermarks (median in-use / max peak observed right after
+    # that key's windows; absent on backends without memory_stats). peak is
+    # a process-global high-water mark, so with interleaved rounds a key's
+    # peak attributes "max over keys run so far" — read deltas across the
+    # round-1 key order for per-key attribution.
+    for k, stats in hbm_samples.items():
+        if not stats:
+            continue
+        out_key = "value" if k == "headline" else k
+        in_use = sorted(s.get("bytes_in_use", 0) for s in stats)
+        out[f"{out_key}_hbm_bytes"] = int(in_use[len(in_use) // 2])
+        peaks = [s["peak_bytes_in_use"] for s in stats if "peak_bytes_in_use" in s]
+        if peaks:
+            out[f"{out_key}_hbm_peak_bytes"] = int(max(peaks))
+
+    # roofline attribution (telemetry.profiling.roofline_summary): classify
+    # each entry point with captured XLA cost compute- vs bandwidth-bound
+    # against this chip's peaks, with achieved-vs-attainable from the
+    # measured median — so a future perf PR can prove WHICH bound it moved.
+    # NB the cost block of a scan program covers ONE fused step (XLA counts
+    # loop bodies once), so the measured time is scaled to the same unit
+    # via each key's `units_per_cost` (rate units per cost block).
+    from sparse_coding__tpu.telemetry.profiling import roofline_summary
+
+    device_kind = jax.devices()[0].device_kind
+    roofline = {}
+
+    def add_roofline(name, cost, cost_seconds):
+        if not cost or not cost.get("flops") or not cost.get("bytes_accessed"):
+            return
+        rl = roofline_summary(
+            cost["flops"], cost["bytes_accessed"], device_kind,
+            seconds=cost_seconds,
+        )
+        if cost.get("analytic"):
+            rl["analytic"] = True
+        roofline[name] = rl
+
+    if acts_per_sec > 0:
+        # headline cost block = one scan step = BATCH activation rows
+        add_roofline("headline", headline_cost, BATCH / acts_per_sec)
+    for k, m in benches.items():
+        cost = getattr(m, "cost", None)
+        units = getattr(m, "units_per_cost", None)
+        if k == "control_matmul_tflops" and cost and medians.get(k):
+            # control rate IS TFLOP/s: invert for the cost block's seconds
+            add_roofline(k, cost, cost["flops"] / (medians[k] * 1e12))
+        elif cost and units and medians.get(k):
+            add_roofline(k, cost, units / medians[k])
+    if roofline:
+        out["roofline"] = roofline
+
+    # flush-boundary HBM gauges into the event log (report renders them as
+    # the watermark table + OOM headroom)
+    marks = record_hbm_watermarks(telemetry)
+    if marks:
+        out["hbm"] = marks
+
     # compile activity observed by the jax.monitoring bridge during setup —
     # the sessions-differ-by-compile-state confound, now in the artifact
     counters = telemetry.counters

@@ -812,6 +812,24 @@ class Ensemble:
         self.state, loss_dicts = fn(self.state, batches)
         return loss_dicts
 
+    def compiled_cost(
+        self, batches: jax.Array, per_model: bool = False, memory: bool = False
+    ):
+        """XLA cost analysis of the `step_scan` program at this batch shape:
+        analytic FLOPs + HBM bytes from the re-lowered HLO — nothing is
+        executed and no backend compile happens (`telemetry.profiling.
+        jit_cost_fields`; note XLA counts scan bodies ONCE, so the numbers
+        describe one fused step). ``memory=True`` adds the argument/output/
+        temp/peak footprints from ``memory_analysis()`` at the price of one
+        throwaway backend compile (masked from the monitoring counters) —
+        expensive for big programs, so it is off by default. None when the
+        backend exposes no analysis. `bench.py` feeds this into its roofline
+        block; a setup-time call, not a hot-loop one."""
+        from sparse_coding__tpu.telemetry.profiling import jit_cost_fields
+
+        fn = self._multi_pm if per_model else self._multi
+        return jit_cost_fields(fn, (self.state, batches), memory=memory)
+
     def step_scan_idx(self, dataset: jax.Array, idxs) -> Dict[str, jax.Array]:
         """K fused updates in ONE dispatch, gathering each step's batch from
         the resident `dataset` INSIDE the compiled scan (`idxs`: [K, batch]

@@ -1,16 +1,21 @@
 """Run telemetry & training-health observability.
 
-Four pieces (docs/observability.md):
-  - `events`  — `RunTelemetry` structured event log (events.jsonl), counters/
-                gauges, `jax.monitoring` compile bridge, `tracked_jit`
-  - `health`  — jit-fused per-model health pack (grad/dict norms, NaN flags,
-                dead-feature fraction from a firing-frequency EMA)
-  - `anomaly` — `AnomalyGuard` flush-boundary detection (NaN/Inf, loss
-                spikes, dead-fraction jumps) with warn/mask/abort policies
-                and diagnostic bundles
-  - `audit`   — `transfer_audit()` makes "zero host transfers in the hot
-                loop" an enforced, testable property
-  - `report`  — `python -m sparse_coding__tpu.report <run_dir>` run summaries
+Six pieces (docs/observability.md):
+  - `events`    — `RunTelemetry` structured event log (events.jsonl),
+                  counters/gauges, `jax.monitoring` compile bridge,
+                  `tracked_jit`
+  - `health`    — jit-fused per-model health pack (grad/dict norms, NaN
+                  flags, dead-feature fraction from a firing-frequency EMA)
+  - `anomaly`   — `AnomalyGuard` flush-boundary detection (NaN/Inf, loss
+                  spikes, dead-fraction jumps) with warn/mask/abort policies
+                  and diagnostic bundles
+  - `audit`     — `transfer_audit()` makes "zero host transfers in the hot
+                  loop" an enforced, testable property
+  - `profiling` — performance attribution: XLA cost/roofline capture, HBM
+                  watermarks, anomaly/step-window `TraceTrigger`
+  - `report`    — `python -m sparse_coding__tpu.report <run_dir>` summaries
+                  (and `python -m sparse_coding__tpu.perfdiff OLD NEW` for
+                  bench-to-bench regression gating)
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy
@@ -22,6 +27,14 @@ from sparse_coding__tpu.telemetry.events import (
     tracked_jit,
 )
 from sparse_coding__tpu.telemetry.health import FIRE_EMA_KEY, HealthConfig
+from sparse_coding__tpu.telemetry.profiling import (
+    TraceTrigger,
+    compiled_cost_fields,
+    hbm_watermarks,
+    jit_cost_fields,
+    record_hbm_watermarks,
+    roofline_summary,
+)
 
 __all__ = [
     "AnomalyAbort",
@@ -30,9 +43,15 @@ __all__ = [
     "FIRE_EMA_KEY",
     "HealthConfig",
     "RunTelemetry",
+    "TraceTrigger",
     "TransferViolation",
     "allowed_transfer",
+    "compiled_cost_fields",
+    "hbm_watermarks",
+    "jit_cost_fields",
     "read_events",
+    "record_hbm_watermarks",
+    "roofline_summary",
     "run_fingerprint",
     "tracked_jit",
     "transfer_audit",

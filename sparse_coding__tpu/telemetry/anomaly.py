@@ -79,6 +79,10 @@ class AnomalyGuard:
     bookkeeping-only (the indices are still excluded from detection and
     reported). `checkpoint_fn(bundle_dir) -> path` (optional) is invoked once
     per bundle to dump whatever checkpoint the caller wants alongside.
+    `trace_trigger` (optional, a `telemetry.profiling.TraceTrigger`) is fired
+    on the first anomaly: a profiler trace of the steps right after the
+    blowup starts immediately, and its directory is recorded in both the
+    anomaly event and the diagnostic bundle.
     """
 
     def __init__(
@@ -89,12 +93,14 @@ class AnomalyGuard:
         ensemble=None,
         model_names: Optional[Sequence[str]] = None,
         checkpoint_fn: Optional[Callable[[Path], Any]] = None,
+        trace_trigger=None,
     ):
         self.telemetry = telemetry
         self.policy = policy or AnomalyPolicy()
         self.ensemble = ensemble
         self.model_names = list(model_names) if model_names else None
         self.checkpoint_fn = checkpoint_fn
+        self.trace_trigger = trace_trigger
         self.out_dir = Path(out_dir) if out_dir is not None else None
         self.masked: set = set()
         self.anomalies: List[Dict[str, Any]] = []
@@ -174,7 +180,15 @@ class AnomalyGuard:
         models = sorted({f["model"] for f in found})
         kinds = sorted({f["kind"] for f in found})
         step = max(f["step"] for f in found)
-        bundle_path = self._dump_bundle(step, kinds, found)
+        trace_dir = None
+        if self.trace_trigger is not None:
+            try:  # a refused capture (profiler busy, …) must not mask detection
+                trace_dir = self.trace_trigger.fire(
+                    reason=",".join(kinds), step=step
+                )
+            except Exception:
+                trace_dir = None
+        bundle_path = self._dump_bundle(step, kinds, found, trace_dir=trace_dir)
         if self.telemetry is not None:
             for kind in kinds:
                 ks = [f for f in found if f["kind"] == kind]
@@ -187,6 +201,7 @@ class AnomalyGuard:
                     detections=ks[:8],
                     bundle=str(bundle_path) if bundle_path else None,
                     action=p.action,
+                    trace_dir=trace_dir,
                 )
         desc = (
             f"anomaly at step {step}: {', '.join(kinds)} on "
@@ -206,7 +221,9 @@ class AnomalyGuard:
         else:
             warnings.warn(desc, RuntimeWarning)
 
-    def _dump_bundle(self, step: int, kinds: List[str], found) -> Optional[Path]:
+    def _dump_bundle(
+        self, step: int, kinds: List[str], found, trace_dir: Optional[str] = None
+    ) -> Optional[Path]:
         if self.out_dir is None or self._bundles >= self.policy.max_bundles:
             return None
         self._bundles += 1
@@ -222,6 +239,7 @@ class AnomalyGuard:
             "model_names": self.model_names,
             "policy": dataclasses.asdict(self.policy),
             "metric_window": list(self._window),
+            "trace_dir": trace_dir,
         }
         if self.checkpoint_fn is not None:
             try:

@@ -66,8 +66,20 @@ def _install_jax_listeners() -> None:
     try:
         import jax.monitoring as mon
 
+        def _suppressed() -> bool:
+            # profiling.jit_cost_fields(memory=True) compiles a throwaway
+            # executable for its memory_analysis — that compile must not
+            # count as run compile activity (it would corrupt the
+            # compile-state confound signal bench.py reports)
+            try:
+                from sparse_coding__tpu.telemetry.profiling import monitoring_suppressed
+
+                return monitoring_suppressed()
+            except Exception:  # pragma: no cover - import cycle during teardown
+                return False
+
         def on_duration(event: str, duration: float, **kw):
-            if event.endswith("backend_compile_duration"):
+            if event.endswith("backend_compile_duration") and not _suppressed():
                 for t in list(_ACTIVE):
                     t.counter_inc("compile.backend.count")
                     t.counter_add_float("compile.backend.seconds", duration)
@@ -76,7 +88,7 @@ def _install_jax_listeners() -> None:
             # '/jax/compilation_cache/cache_hits', '.../cache_misses',
             # '.../compile_requests_use_cache', ... — the persistent
             # compile-cache traffic enable_persistent_compile_cache turns on
-            if event.startswith("/jax/compilation_cache/"):
+            if event.startswith("/jax/compilation_cache/") and not _suppressed():
                 for t in list(_ACTIVE):
                     t.counter_inc(f"compile_cache.{event.rsplit('/', 1)[-1]}")
 
@@ -192,14 +204,25 @@ class RunTelemetry:
             fingerprint=run_fingerprint(mesh=mesh),
         )
 
-    def compile(self, name: str, seconds: float, cache_hit: Optional[bool] = None):
+    def compile(
+        self,
+        name: str,
+        seconds: float,
+        cache_hit: Optional[bool] = None,
+        cost: Optional[Dict[str, Any]] = None,
+    ):
         """One jit compilation of entry point `name` (wall-clock seconds —
-        trace + compile + the triggering dispatch)."""
+        trace + compile + the triggering dispatch). ``cost`` (optional) is a
+        `telemetry.profiling.compiled_cost_fields` dict — analytic FLOPs /
+        HBM bytes / memory footprints of the compiled executable; it rides
+        the event under a ``cost`` key for the report's perf attribution."""
         self.counter_inc(f"compile.{name}.count")
         self.counter_add_float(f"compile.{name}.seconds", seconds)
-        fields = {"name": name, "seconds": round(seconds, 4)}
+        fields: Dict[str, Any] = {"name": name, "seconds": round(seconds, 4)}
         if cache_hit is not None:
             fields["cache_hit"] = bool(cache_hit)
+        if cost:
+            fields["cost"] = cost
         return self.event("compile", **fields)
 
     def chunk_start(self, chunk: int, **fields):
@@ -301,10 +324,15 @@ class _TrackedJit:
     On each call (only while some RunTelemetry is live — otherwise a single
     list check and straight through): reads the function's executable-cache
     size before/after, and when it grew, publishes a named ``compile`` event
-    with the call's wall time to every live telemetry. Also bumps a
-    ``dispatch.<name>`` counter — the per-entry-point step totals `run_end`
-    reports. Attribute access (``.lower``, …) passes through to the jit
-    object, so AOT-lowering tests keep working on wrapped steps.
+    with the call's wall time to every live telemetry — plus the program's
+    analytic cost (`telemetry.profiling.jit_cost_fields`: FLOPs and HBM
+    bytes from the re-lowered HLO's cost analysis; no second backend
+    compile — memory footprints are the opt-in ``SC_COST_CAPTURE=full``
+    depth), so the perf-attribution report can put every entry point on
+    the roofline. Also bumps a ``dispatch.<name>`` counter —
+    the per-entry-point step totals `run_end` reports. Attribute access
+    (``.lower``, …) passes through to the jit object, so AOT-lowering tests
+    keep working on wrapped steps.
     """
 
     __slots__ = ("_fn", "_name")
@@ -324,8 +352,18 @@ class _TrackedJit:
         for t in list(_ACTIVE):
             t.counter_inc(f"dispatch.{self._name}")
         if size is not None and size() > before:
+            # once per compile, never per dispatch: re-lower through jax's
+            # lowering cache and read the HLO cost analysis (best-effort —
+            # None on backends/signatures that refuse; no backend compile
+            # at the default capture depth)
+            try:
+                from sparse_coding__tpu.telemetry.profiling import jit_cost_fields
+
+                cost = jit_cost_fields(self._fn, args, kwargs)
+            except Exception:
+                cost = None
             for t in list(_ACTIVE):
-                t.compile(self._name, dt)
+                t.compile(self._name, dt, cost=cost)
         return out
 
     def __getattr__(self, attr):

@@ -1,0 +1,492 @@
+"""Performance attribution: XLA cost/roofline capture, HBM watermarks,
+triggered trace windows.
+
+PR 2's telemetry says *what happened* in a run; this module says *where the
+time and memory go*, against hardware peaks — the roofline discipline every
+perf PR needs to prove which entry point it moved:
+
+  - **Cost capture** (`jit_cost_fields` / `compiled_cost_fields`): analytic
+    FLOPs + HBM bytes from XLA's ``cost_analysis()`` and argument/output/
+    temp footprints from ``memory_analysis()``. `tracked_jit` calls
+    `jit_cost_fields` on every compile it detects, so named ``compile``
+    events in events.jsonl carry a ``cost`` block for free. The default
+    capture re-lowers through jax's lowering cache and reads the HLO cost
+    analysis WITHOUT a backend compile (~tens of ms); the memory footprints
+    require compiling a second executable, so they are captured only on
+    demand (``memory=True`` — `Ensemble.compiled_cost`, bench setup) or
+    with ``SC_COST_CAPTURE=full``, and that extra compile is masked from
+    the `jax.monitoring` compile counters so it cannot pollute the
+    compile-state signal bench.py reports. Everything here is
+    backend-best-effort: any field XLA does not expose is simply absent,
+    and a failed capture never fails the run.
+  - **Roofline attribution** (`roofline_summary`): combines captured
+    FLOPs/bytes with `utils.bench_common`'s per-chip peaks
+    (``peak_tflops`` / ``hbm_gbps``) to classify an entry point compute- vs
+    bandwidth-bound and, given a measured wall time, report the
+    achieved-vs-attainable fraction.
+  - **HBM watermarks** (`record_hbm_watermarks` / `hbm_watermarks`): samples
+    ``device.memory_stats()`` (bytes_in_use / peak_bytes_in_use /
+    bytes_limit) into RunTelemetry gauges. A host-side C call — no device
+    computation is fenced and no jax.Array is materialized, so sampling at
+    flush boundaries preserves the zero-per-step-host-transfer invariant
+    `transfer_audit()` enforces. CPU returns None; gauges are then absent
+    (deterministically — tests rely on it).
+  - **Triggered traces** (`TraceTrigger`): arms `utils.trace`'s profiler
+    window programmatically — by step window (env ``SC_TRACE_WINDOW=N:M`` +
+    ``SC_TRACE_DIR``, or constructor args), or by the `AnomalyGuard` on
+    first anomaly — and writes the trace dir path into the event log and
+    the diagnostic bundle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "compiled_cost_fields",
+    "jit_cost_fields",
+    "monitoring_suppressed",
+    "roofline_summary",
+    "device_memory_stats",
+    "hbm_watermarks",
+    "record_hbm_watermarks",
+    "TraceTrigger",
+]
+
+# capture depth for the per-compile cost capture: "0"/"false"/"no" disables
+# it entirely, "full" additionally compiles a throwaway executable for the
+# memory_analysis footprints (masked from the monitoring counters), anything
+# else (the default) reads the HLO cost analysis only — no backend compile
+COST_CAPTURE_ENV = "SC_COST_CAPTURE"
+
+
+def _capture_mode() -> str:
+    v = os.environ.get(COST_CAPTURE_ENV, "1").lower()
+    if v in ("0", "false", "no", "off"):
+        return "off"
+    if v in ("full", "2", "memory"):
+        return "full"
+    return "cost"
+
+
+# while a cost capture compiles its throwaway executable, the jax.monitoring
+# bridge (events._install_jax_listeners) must not count it — the
+# compile.backend.* counters exist to expose the RUN's compile state, and
+# profiling overhead polluting them would corrupt bench.py's
+# sessions-differ-by-compile-state signal
+_SUPPRESS = threading.local()
+
+
+def monitoring_suppressed() -> bool:
+    return getattr(_SUPPRESS, "depth", 0) > 0
+
+
+# -- XLA cost / memory capture ------------------------------------------------
+
+def compiled_cost_fields(compiled) -> Optional[Dict[str, Any]]:
+    """Extract analytic cost + memory fields from a `jax.stages.Compiled`.
+
+    Returns a flat dict (all best-effort; absent keys mean the backend does
+    not report them):
+
+      ``flops``            analytic FLOPs of one dispatch
+      ``bytes_accessed``   HBM bytes touched per dispatch (XLA's estimate)
+      ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+      ``alias_bytes`` / ``generated_code_bytes``   memory_analysis footprints
+      ``peak_bytes``       backend peak when exposed, else the
+                           argument+output+temp sum (an upper-ish proxy,
+                           flagged by ``peak_bytes_estimated``)
+
+    None when neither analysis yields anything (e.g. a backend that returns
+    empty cost analyses).
+    """
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        # jax returns a dict on some versions, a one-element list of dicts on
+        # others (one per device program)
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+                v = ca.get(src)
+                if v is not None and float(v) >= 0:
+                    out[dst] = float(v)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            for src, dst in (
+                ("argument_size_in_bytes", "argument_bytes"),
+                ("output_size_in_bytes", "output_bytes"),
+                ("temp_size_in_bytes", "temp_bytes"),
+                ("alias_size_in_bytes", "alias_bytes"),
+                ("generated_code_size_in_bytes", "generated_code_bytes"),
+            ):
+                v = getattr(ma, src, None)
+                if v is not None:
+                    out[dst] = int(v)
+            peak = getattr(ma, "peak_memory_in_bytes", None)
+            if peak is not None:
+                out["peak_bytes"] = int(peak)
+            elif {"argument_bytes", "output_bytes", "temp_bytes"} <= out.keys():
+                out["peak_bytes"] = (
+                    out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+                )
+                out["peak_bytes_estimated"] = True
+    except Exception:
+        pass
+    return out or None
+
+
+def _lowered_cost_fields(lowered) -> Dict[str, Any]:
+    """flops / bytes_accessed from a `jax.stages.Lowered`'s HLO cost
+    analysis — no backend compile happens (verified: zero
+    ``backend_compile_duration`` monitoring events), and the numbers match
+    the compiled executable's analysis.
+
+    UNIT CAVEAT (applies to XLA's cost analysis in both forms): while/scan
+    loop bodies are counted ONCE — trip counts are not folded in. For a
+    ``step_scan``-style program the cost block therefore describes ONE
+    fused step, not the whole K-step dispatch (verified: the bench scan-128
+    program reports exactly the analytic single-step FLOPs). Arithmetic
+    intensity and the roofline bound are unaffected (flops and bytes share
+    the unit); anything comparing against wall time must scale the time to
+    the same unit — see bench.py's ``units_per_cost``."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+                v = ca.get(src)
+                if v is not None and float(v) >= 0:
+                    out[dst] = float(v)
+    except Exception:
+        pass
+    return out
+
+
+def jit_cost_fields(fn, args=(), kwargs=None, memory: Optional[bool] = None) -> Optional[Dict[str, Any]]:
+    """Cost fields for a jitted callable at a concrete call signature.
+
+    ``fn.lower(*args, **kwargs)`` immediately after the real call hits jax's
+    lowering caches (donated buffers are fine — lowering only needs avals),
+    and the Lowered's HLO ``cost_analysis()`` yields flops/bytes WITHOUT a
+    backend compile. ``memory=True`` (or ``SC_COST_CAPTURE=full``)
+    additionally compiles a throwaway executable for the
+    ``memory_analysis()`` footprints — a real second XLA compile, so it is
+    reserved for setup-time callers (`Ensemble.compiled_cost`, bench preps)
+    and masked from the `jax.monitoring` compile counters while it runs.
+    Returns None (never raises) when the callable has no ``lower``, the
+    signature cannot be re-lowered, or capture is disabled via
+    ``SC_COST_CAPTURE=0``.
+    """
+    mode = _capture_mode()
+    if mode == "off" or not hasattr(fn, "lower"):
+        return None
+    if memory is None:
+        memory = mode == "full"
+    try:
+        lowered = fn.lower(*args, **(kwargs or {}))
+        out = _lowered_cost_fields(lowered)
+        if memory:
+            _SUPPRESS.depth = getattr(_SUPPRESS, "depth", 0) + 1
+            try:
+                full = compiled_cost_fields(lowered.compile())
+            finally:
+                _SUPPRESS.depth -= 1
+            if full:
+                out.update(full)  # post-optimization analyses win
+        return out or None
+    except Exception:
+        return None
+
+
+# -- roofline attribution -----------------------------------------------------
+
+def roofline_summary(
+    flops: float,
+    bytes_accessed: float,
+    device_kind: str,
+    seconds: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Classify one program against its chip's roofline.
+
+    ``flops`` / ``bytes_accessed`` are per dispatch (XLA cost analysis or
+    analytic); ``device_kind`` selects the peak table
+    (`utils.bench_common.peak_tflops` / `hbm_gbps`); ``seconds`` (optional)
+    is the measured wall time of one dispatch.
+
+    Returns::
+
+        {"arithmetic_intensity": flops/byte,
+         "ridge_intensity":      peak_flops / peak_bw (the roofline knee),
+         "bound":                "compute" | "bandwidth",
+         "peak_tflops": ..., "hbm_gbps": ...,
+         "attainable_tflops":    min(peak, intensity * bw),
+         # with `seconds`:
+         "achieved_tflops":      flops / seconds / 1e12,
+         "achieved_fraction":    achieved / attainable,
+         "achieved_gbps":        bytes / seconds / 1e9}
+    """
+    from sparse_coding__tpu.utils.bench_common import hbm_gbps, peak_tflops
+
+    peak = peak_tflops(device_kind)
+    bw = hbm_gbps(device_kind)
+    intensity = flops / bytes_accessed if bytes_accessed > 0 else float("inf")
+    ridge = peak * 1e12 / (bw * 1e9)  # FLOPs per byte at the knee
+    attainable = min(peak, intensity * bw * 1e9 / 1e12)
+    out: Dict[str, Any] = {
+        "flops": float(flops),
+        "bytes_accessed": float(bytes_accessed),
+        "arithmetic_intensity": round(intensity, 3),
+        "ridge_intensity": round(ridge, 3),
+        "bound": "compute" if intensity >= ridge else "bandwidth",
+        "peak_tflops": peak,
+        "hbm_gbps": bw,
+        "attainable_tflops": round(attainable, 3),
+    }
+    if seconds is not None and seconds > 0:
+        achieved = flops / seconds / 1e12
+        out["achieved_tflops"] = round(achieved, 4)
+        out["achieved_fraction"] = round(achieved / attainable, 4) if attainable > 0 else None
+        out["achieved_gbps"] = round(bytes_accessed / seconds / 1e9, 2)
+    return out
+
+
+# -- HBM watermarks -----------------------------------------------------------
+
+_WATERMARK_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats(device) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` filtered to the watermark fields; None when
+    the backend does not report (CPU) or the call fails."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(stats[k]) for k in _WATERMARK_KEYS if k in stats}
+
+
+def hbm_watermarks(devices=None) -> Dict[str, Dict[str, int]]:
+    """Per-device watermark dict ``{"d0": {"bytes_in_use": ..., ...}, ...}``
+    for every local device that reports memory stats (possibly empty)."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for i, d in enumerate(devices):
+        stats = device_memory_stats(d)
+        if stats:
+            out[f"d{i}"] = stats
+    return out
+
+
+def record_hbm_watermarks(telemetry, devices=None) -> Dict[str, Dict[str, int]]:
+    """Sample HBM watermarks into `telemetry` gauges (``hbm.d<i>.<field>``).
+
+    A flush-boundary act: reading memory_stats is a host-side query — it
+    fences nothing and materializes no jax.Array, so it is legal inside
+    `transfer_audit` regions and adds zero per-step host transfers. Gauges
+    reach events.jsonl via the next ``snapshot`` (run_end emits one).
+    Returns the sample (empty on backends without memory stats)."""
+    marks = hbm_watermarks(devices)
+    if telemetry is not None:
+        for dev, stats in marks.items():
+            for field, v in stats.items():
+                telemetry.gauge_set(f"hbm.{dev}.{field}", float(v))
+    return marks
+
+
+# -- triggered trace capture --------------------------------------------------
+
+class TraceTrigger:
+    """Programmatic arming of `utils.trace` profiler windows.
+
+    Two arming paths, both driving the same reentrancy-safe
+    `start_trace_safe` / `stop_trace_safe` pair (a trigger firing inside a
+    manual ``trace(...)`` block degrades to a warning, never an exception):
+
+      - **step window**: ``TraceTrigger(..., start_step=N, stop_step=M)`` —
+        drivers call ``on_step(global_step)`` at flush/chunk boundaries; the
+        capture starts at the first boundary at or past N and stops at the
+        first boundary at or past M (when one boundary jump crosses the
+        whole window — chunk-granularity drivers — one boundary-to-boundary
+        window is captured rather than nothing). Written into
+        ``<out_dir>/trace_step<N>``. ``TraceTrigger.from_env(...)`` reads
+        ``SC_TRACE_WINDOW="N:M"`` (and optional ``SC_TRACE_DIR``) so any
+        driver run can be traced without a code change.
+      - **anomaly**: `AnomalyGuard` calls ``fire(reason=...)`` on first
+        anomaly; the trigger starts a trace immediately and stops it after
+        ``anomaly_windows`` further ``on_step`` calls — capturing the steps
+        right after the blowup. One anomaly capture per run (the first).
+
+    Every capture emits a ``trace`` event (``{"dir", "reason",
+    "start_step", "stop_step"}``) to the telemetry, and `last_trace_dir`
+    exposes the most recent dir for diagnostic bundles.
+    """
+
+    def __init__(
+        self,
+        telemetry=None,
+        out_dir: Optional[str] = None,
+        start_step: Optional[int] = None,
+        stop_step: Optional[int] = None,
+        on_anomaly: bool = True,
+        anomaly_windows: int = 1,
+        trace_dir: Optional[str] = None,
+    ):
+        self.telemetry = telemetry
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.on_anomaly = bool(on_anomaly)
+        self.anomaly_windows = max(1, int(anomaly_windows))
+        self._trace_dir_override = trace_dir
+        self._active: Optional[str] = None       # dir of the window WE started
+        self._active_reason: Optional[str] = None
+        self._active_start_step: Optional[int] = None
+        self._window_done = False                # step window fires once
+        self._anomaly_fired = False              # first anomaly only
+        self._stop_after: Optional[int] = None   # countdown of on_step calls
+        self.last_trace_dir: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, telemetry=None, out_dir: Optional[str] = None, env=None, **kw):
+        """Build from ``SC_TRACE_WINDOW="N:M"`` / ``SC_TRACE_DIR`` (anomaly
+        arming stays on by default). Malformed values warn and are ignored."""
+        env = os.environ if env is None else env
+        window = env.get("SC_TRACE_WINDOW")
+        start = stop = None
+        if window:
+            try:
+                lo, _, hi = window.partition(":")
+                start, stop = int(lo), int(hi)
+            except ValueError:
+                warnings.warn(
+                    f"ignoring malformed SC_TRACE_WINDOW={window!r} "
+                    "(expected 'start:stop' in steps)",
+                    RuntimeWarning,
+                )
+                start = stop = None
+        return cls(
+            telemetry=telemetry,
+            out_dir=out_dir,
+            start_step=start,
+            stop_step=stop,
+            trace_dir=env.get("SC_TRACE_DIR"),
+            **kw,
+        )
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _dir_for(self, tag: str) -> str:
+        if self._trace_dir_override:
+            return self._trace_dir_override
+        base = self.out_dir if self.out_dir is not None else Path("/tmp/jax-trace")
+        return str(base / f"trace_{tag}")
+
+    def _start(self, log_dir: str, reason: str, step: Optional[int]) -> Optional[str]:
+        from sparse_coding__tpu.utils.trace import start_trace_safe
+
+        if not start_trace_safe(log_dir):
+            return None
+        self._active = log_dir
+        self._active_reason = reason
+        self._active_start_step = step
+        return log_dir
+
+    def _stop(self, step: Optional[int] = None):
+        from sparse_coding__tpu.utils.trace import stop_trace_safe
+
+        if self._active is None:
+            return
+        stop_trace_safe()
+        self.last_trace_dir = self._active
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "trace",
+                dir=self._active,
+                reason=self._active_reason,
+                start_step=self._active_start_step,
+                stop_step=step,
+            )
+            self.telemetry.counter_inc("trace.captures")
+        self._active = None
+        self._active_reason = None
+        self._stop_after = None
+
+    # -- public surface ------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._active is not None
+
+    def on_step(self, step: int):
+        """Drive the trigger from a flush/chunk boundary: `step` is the
+        cumulative train-step count. Host-side integer compares only."""
+        step = int(step)
+        if self._active is not None:
+            if self._stop_after is not None:
+                self._stop_after -= 1
+                if self._stop_after <= 0:
+                    self._stop(step)
+            elif self.stop_step is not None and step >= self.stop_step:
+                self._stop(step)
+            return
+        if (
+            not self._window_done
+            and self.start_step is not None
+            and self.stop_step is not None
+            and step >= self.start_step
+        ):
+            self._window_done = True
+            started = self._start(self._dir_for(f"step{step}"), "step_window", step)
+            if started is not None and step >= self.stop_step:
+                # the caller steps the trigger at boundaries coarser than
+                # the requested window (chunk-granularity drivers): capture
+                # ONE boundary-to-boundary window starting here instead of
+                # silently skipping the request
+                self._stop_after = 1
+
+    def fire(self, reason: str = "anomaly", step: Optional[int] = None) -> Optional[str]:
+        """Anomaly-path arming (AnomalyGuard): start a capture NOW, stopping
+        after `anomaly_windows` further `on_step` calls. Returns the trace
+        dir when a capture started (first anomaly, profiler free), else
+        None."""
+        if not self.on_anomaly or self._anomaly_fired or self._active is not None:
+            return None
+        tag = f"anomaly_step{step}" if step is not None else "anomaly"
+        started = self._start(self._dir_for(tag), reason, step)
+        if started is not None:
+            # consume the run's single anomaly capture only on an actual
+            # start — a foreign trace refusing the profiler must leave the
+            # attempt available for the next anomaly
+            self._anomaly_fired = True
+            self._stop_after = self.anomaly_windows
+        return started
+
+    def close(self, step: Optional[int] = None):
+        """Stop any in-flight capture (drivers call this in their finally)."""
+        self._stop(step)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -151,6 +151,125 @@ def _compile_section(run, lines: List[str]):
     lines.append("")
 
 
+def _bytes(v) -> str:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.2f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    return "-"  # pragma: no cover
+
+
+def _perf_section(run, lines: List[str]):
+    """Performance attribution: per-entry-point XLA cost + roofline class,
+    HBM watermarks (+ OOM headroom), captured trace windows."""
+    lines.append("## Performance attribution")
+    lines.append("")
+    wrote = False
+
+    # device kind (for the peak tables) from the run fingerprint
+    device_kind = None
+    for s in _events_of(run, "run_start"):
+        device_kind = (s.get("fingerprint") or {}).get("device_kind") or device_kind
+
+    # latest captured cost per entry point (re-compiles overwrite: the last
+    # executable is the one the run kept dispatching)
+    costs: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for c in _events_of(run, "compile"):
+        if isinstance(c.get("cost"), dict):
+            costs[c.get("name", "?")] = c["cost"]
+    if costs:
+        lines.append(
+            "| entry point | GFLOP | HBM MiB | FLOPs/byte "
+            "| bound | attainable TFLOP/s | temp footprint |"
+        )
+        lines.append("|---|---:|---:|---:|---|---:|---:|")
+        for name, cost in costs.items():
+            flops = cost.get("flops")
+            byts = cost.get("bytes_accessed")
+            rl = None
+            if flops and byts and device_kind:
+                from sparse_coding__tpu.telemetry.profiling import roofline_summary
+
+                rl = roofline_summary(flops, byts, device_kind)
+            lines.append(
+                f"| {name} "
+                f"| {_fmt(flops / 1e9 if flops else None)} "
+                f"| {_fmt(byts / 2**20 if byts else None)} "
+                f"| {_fmt(rl['arithmetic_intensity'] if rl else None)} "
+                f"| {rl['bound'] if rl else '-'} "
+                f"| {_fmt(rl['attainable_tflops'] if rl else None)} "
+                f"| {_bytes(cost.get('temp_bytes'))} |"
+            )
+        lines.append("")
+        lines.append(
+            "_XLA cost analysis counts while/scan loop bodies once: for a "
+            "`step_scan` program the row describes one fused step, not the "
+            "whole dispatch (intensity and bound are unit-safe)._"
+        )
+        lines.append("")
+        if device_kind and any(
+            c.get("flops") and c.get("bytes_accessed") for c in costs.values()
+        ):
+            from sparse_coding__tpu.utils.bench_common import hbm_gbps, peak_tflops
+
+            lines.append(
+                f"Roofline peaks for **{device_kind}**: "
+                f"{peak_tflops(device_kind):.0f} TFLOP/s bf16, "
+                f"{hbm_gbps(device_kind):.0f} GB/s HBM (ridge at "
+                f"{peak_tflops(device_kind) * 1e3 / hbm_gbps(device_kind):.0f} "
+                "FLOPs/byte)."
+            )
+            lines.append("")
+        wrote = True
+
+    # HBM watermarks from the last snapshot's gauges
+    snaps = _events_of(run, "snapshot")
+    gauges = snaps[-1].get("gauges", {}) if snaps else {}
+    marks: Dict[str, Dict[str, float]] = {}
+    for k, v in gauges.items():
+        if k.startswith("hbm."):
+            _, dev, field = k.split(".", 2)
+            marks.setdefault(dev, {})[field] = v
+    if marks:
+        lines.append("| device | HBM in use | peak in use | limit | OOM headroom |")
+        lines.append("|---|---:|---:|---:|---:|")
+        for dev in sorted(marks):
+            m = marks[dev]
+            peak, limit = m.get("peak_bytes_in_use"), m.get("bytes_limit")
+            headroom = (
+                f"{_bytes(limit - peak)} ({100 * (limit - peak) / limit:.1f}%)"
+                if peak is not None and limit
+                else "-"
+            )
+            lines.append(
+                f"| {dev} | {_bytes(m.get('bytes_in_use'))} "
+                f"| {_bytes(peak)} | {_bytes(limit)} | {headroom} |"
+            )
+        lines.append("")
+        wrote = True
+
+    traces = _events_of(run, "trace")
+    if traces:
+        for t in traces:
+            lines.append(
+                f"- trace captured (`{t.get('reason', '?')}`, steps "
+                f"{_fmt(t.get('start_step'))}→{_fmt(t.get('stop_step'))}): "
+                f"`{t.get('dir')}`"
+            )
+        lines.append("")
+        wrote = True
+
+    if not wrote:
+        lines.append(
+            "_(no cost-annotated compile events, HBM gauges, or traces)_"
+        )
+        lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -169,8 +288,9 @@ def _throughput_section(run, lines: List[str]):
         if timer:
             bits.append(
                 f"StepTimer: {timer.get('steps')} ticks, "
-                f"{_fmt(timer.get('steps_per_sec'))} steps/s, "
-                f"{_fmt(timer.get('mean_step_ms'))} ms/step"
+                f"{_fmt(timer.get('steps_per_sec'))} steps/s fenced "
+                f"({_fmt(timer.get('mean_step_ms'))} ms/step), "
+                f"{_fmt(timer.get('dispatch_steps_per_sec'))} steps/s dispatch"
             )
         lines.append("- " + ", ".join(bits))
         wrote = True
@@ -255,6 +375,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     lines.append("")
     _fingerprint_section(run, lines)
     _compile_section(run, lines)
+    _perf_section(run, lines)
     _throughput_section(run, lines)
     _health_section(run, lines)
     _anomaly_section(run, lines)

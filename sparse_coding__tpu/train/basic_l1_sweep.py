@@ -21,7 +21,13 @@ import numpy as np
 from sparse_coding__tpu.data.chunks import ChunkStore
 from sparse_coding__tpu.ensemble import build_ensemble
 from sparse_coding__tpu.models import FunctionalFista
-from sparse_coding__tpu.telemetry import AnomalyGuard, AnomalyPolicy, RunTelemetry
+from sparse_coding__tpu.telemetry import (
+    AnomalyGuard,
+    AnomalyPolicy,
+    RunTelemetry,
+    TraceTrigger,
+    record_hbm_watermarks,
+)
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
 from sparse_coding__tpu.train.loop import ensemble_train_loop
 from sparse_coding__tpu.utils.logging import MetricLogger
@@ -90,9 +96,14 @@ def basic_l1_sweep(
         ),
     )
     telemetry.run_start()
+    # triggered trace capture: SC_TRACE_WINDOW="N:M" (steps) arms a profiler
+    # window; the guard's first anomaly arms one automatically — the trace
+    # dir lands in the event log and the diagnostic bundle
+    trigger = TraceTrigger.from_env(telemetry=telemetry, out_dir=output_folder)
     guard = AnomalyGuard(
         telemetry=telemetry, out_dir=output_folder,
         policy=anomaly_policy, ensemble=ens, model_names=model_names,
+        trace_trigger=trigger,
     )
     logger = MetricLogger(
         out_dir=output_folder, run_name="basic_l1_sweep",
@@ -137,6 +148,11 @@ def basic_l1_sweep(
                     int(chunk_idx), epoch=epoch, position=pos,
                     steps=chunk.shape[0] // batch_size,
                 )
+                # flush-boundary perf attribution: HBM watermark gauges
+                # (host-side query, zero device syncs) + trace-window arming
+                # on the cumulative step count
+                record_hbm_watermarks(telemetry)
+                trigger.on_step(int(telemetry.counters.get("train.steps", 0)))
                 if save_after_every:
                     learned_dicts = export()
                     # named by training-sequence position (like the reference's
@@ -165,6 +181,7 @@ def basic_l1_sweep(
             close_exc = e
             if status == "ok":
                 status = f"error: {type(e).__name__}: {e}"
+        trigger.close()  # stop any in-flight trace window before run_end
         telemetry.run_end(
             status=status,
             timer_stats=timer.report(

@@ -186,6 +186,7 @@ def train_big_batch(
     encoder_norm_ratio: float = 0.2,
     l1_warmup_steps: int = 0,
     telemetry=None,
+    trace_trigger=None,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
     resurrection. Returns (final state, sig) for `to_learned_dict` export.
@@ -201,7 +202,11 @@ def train_big_batch(
     ``telemetry`` (a `telemetry.events.RunTelemetry`) additionally records
     each resurrection as a structured event plus step/resurrection counters
     — the artifact-side trail the RESURRECT_r04 studies had to reconstruct
-    from stdout.
+    from stdout. ``trace_trigger`` (a `telemetry.profiling.TraceTrigger`)
+    is stepped once per train step (host-side integer compares only), so
+    env-armed `SC_TRACE_WINDOW` profiler windows resolve at true step
+    granularity here; HBM watermark gauges are sampled at each resurrection
+    boundary and at the end of training.
     """
     from sparse_coding__tpu.utils import precision as px
 
@@ -209,15 +214,22 @@ def train_big_batch(
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
             learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-            encoder_norm_ratio, l1_warmup_steps, telemetry,
+            encoder_norm_ratio, l1_warmup_steps, telemetry, trace_trigger,
         )
 
 
 def _train_big_batch(
     sig, init_hparams, dataset, batch_size, n_steps, key,
     learning_rate, mesh, reinit_every, worst_k, resurrection_log,
-    encoder_norm_ratio, l1_warmup_steps, telemetry=None,
+    encoder_norm_ratio, l1_warmup_steps, telemetry=None, trace_trigger=None,
 ) -> Tuple[BigBatchState, Any]:
+    if trace_trigger is None:
+        # existing callers (resurrect/batch-scaling studies) pass no trigger:
+        # honor the documented SC_TRACE_WINDOW env workflow for them too —
+        # an unarmed trigger costs one int compare per step
+        from sparse_coding__tpu.telemetry.profiling import TraceTrigger
+
+        trace_trigger = TraceTrigger.from_env(telemetry=telemetry)
     k_init, key = jax.random.split(key)
     params, buffers = sig.init(k_init, **init_hparams)
     tx = optax.adam(learning_rate)
@@ -245,38 +257,55 @@ def _train_big_batch(
 
     worst = WorstExamples(worst_k)
     n = dataset.shape[0]
-    for i in range(n_steps):
-        key, k = jax.random.split(key)
-        idxs = np.asarray(jax.random.randint(k, (batch_size,), 0, n))
-        batch = dataset[idxs]
-        if mesh is not None:
-            batch = jax.device_put(batch, sharding)
-        state, loss_dict, c = step_fn(state, batch)
-        if reinit_every:
-            # worst-example tracking (host sync) only if resurrection is on;
-            # decodes the codes the step already produced
-            mses = np.asarray(jax.device_get(mse_fn(state.params, state.buffers, batch, c)))
-            worst.update(idxs, mses)
-
-        if reinit_every and (i + 1) % reinit_every == 0:
-            worst_idx = worst.get_worst(n_feats)
-            reps = dataset[np.resize(worst_idx, n_feats)]
-            state, n_dead = resurrect_dead_features(
-                state, jnp.asarray(reps),
-                encoder_norm_ratio=encoder_norm_ratio,
-            )
-            worst = WorstExamples(worst_k)
-            if resurrection_log is not None:
-                resurrection_log.append((i + 1, n_dead))
-            if telemetry is not None:
-                telemetry.event(
-                    "resurrection", step=i + 1, n_dead=int(n_dead),
-                    n_feats=int(n_feats),
+    try:
+        for i in range(n_steps):
+            key, k = jax.random.split(key)
+            idxs = np.asarray(jax.random.randint(k, (batch_size,), 0, n))
+            batch = dataset[idxs]
+            if mesh is not None:
+                batch = jax.device_put(batch, sharding)
+            state, loss_dict, c = step_fn(state, batch)
+            if reinit_every:
+                # worst-example tracking (host sync) only if resurrection is
+                # on; decodes the codes the step already produced
+                mses = np.asarray(
+                    jax.device_get(mse_fn(state.params, state.buffers, batch, c))
                 )
-                telemetry.counter_inc("resurrections")
-                telemetry.counter_inc("resurrected_features", int(n_dead))
-            if n_dead:
-                print(f"step {i+1}: resurrected {n_dead} dead features")
+                worst.update(idxs, mses)
+
+            if reinit_every and (i + 1) % reinit_every == 0:
+                worst_idx = worst.get_worst(n_feats)
+                reps = dataset[np.resize(worst_idx, n_feats)]
+                state, n_dead = resurrect_dead_features(
+                    state, jnp.asarray(reps),
+                    encoder_norm_ratio=encoder_norm_ratio,
+                )
+                worst = WorstExamples(worst_k)
+                if resurrection_log is not None:
+                    resurrection_log.append((i + 1, n_dead))
+                if telemetry is not None:
+                    telemetry.event(
+                        "resurrection", step=i + 1, n_dead=int(n_dead),
+                        n_feats=int(n_feats),
+                    )
+                    telemetry.counter_inc("resurrections")
+                    telemetry.counter_inc("resurrected_features", int(n_dead))
+                    # resurrection is already a host-sync boundary: cheap
+                    # spot for an HBM watermark sample
+                    from sparse_coding__tpu.telemetry.profiling import record_hbm_watermarks
+
+                    record_hbm_watermarks(telemetry)
+                if n_dead:
+                    print(f"step {i+1}: resurrected {n_dead} dead features")
+            if telemetry is not None:
+                telemetry.counter_inc("train.steps")
+            trace_trigger.on_step(i + 1)  # host-side int compares only
         if telemetry is not None:
-            telemetry.counter_inc("train.steps")
+            from sparse_coding__tpu.telemetry.profiling import record_hbm_watermarks
+
+            record_hbm_watermarks(telemetry)
+    finally:
+        # an exception mid-run must still finalize any in-flight profiler
+        # window — a leaked trace blocks every later capture in the process
+        trace_trigger.close(n_steps)
     return state, sig

@@ -33,7 +33,13 @@ from sparse_coding__tpu import metrics as sm
 from sparse_coding__tpu.data.chunks import ChunkStore, generate_synthetic_chunks
 from sparse_coding__tpu.data.synthetic import SparseMixDataset
 from sparse_coding__tpu.ensemble import Ensemble
-from sparse_coding__tpu.telemetry import AnomalyGuard, AnomalyPolicy, RunTelemetry
+from sparse_coding__tpu.telemetry import (
+    AnomalyGuard,
+    AnomalyPolicy,
+    RunTelemetry,
+    TraceTrigger,
+    record_hbm_watermarks,
+)
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.loop import ensemble_train_loop
 from sparse_coding__tpu.utils.logging import (
@@ -321,6 +327,9 @@ def sweep(
     )
     print("Ensembles initialised.")
 
+    # triggered trace capture: env-armed step window (SC_TRACE_WINDOW) or
+    # first anomaly; trace dirs land in events.jsonl + diagnostic bundles
+    trigger = TraceTrigger.from_env(telemetry=telemetry, out_dir=cfg.output_folder)
     # one logger is shared by every ensemble, so the guard's loss-spike
     # trailing windows would mix members of different ensembles — spikes off,
     # NaN/Inf + dead-fraction-jump detection on (cfg.anomaly_policy overrides)
@@ -328,6 +337,7 @@ def sweep(
         telemetry=telemetry,
         out_dir=cfg.output_folder,
         policy=getattr(cfg, "anomaly_policy", None) or AnomalyPolicy(spikes=False),
+        trace_trigger=trigger,
     )
     logger = MetricLogger(
         out_dir=cfg.output_folder,
@@ -454,6 +464,10 @@ def sweep(
                     Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
                 )
             telemetry.chunk_end(i, saved=bool(want_save))
+            # flush-boundary perf attribution: HBM watermark gauges (host
+            # query, no device sync) + trace-window arming on train steps
+            record_hbm_watermarks(telemetry)
+            trigger.on_step(int(telemetry.counters.get("train.steps", 0)))
 
         if not learned_dicts:
             # resumed past the last chunk: export straight from the restored
@@ -478,6 +492,7 @@ def sweep(
             close_exc = e
             if status == "ok":
                 status = f"error: {type(e).__name__}: {e}"
+        trigger.close()  # stop any in-flight trace window before run_end
         telemetry.run_end(status=status, masked_models=sorted(guard.masked))
         telemetry.close()
         if close_exc is not None and sys.exc_info()[0] is None:

@@ -10,4 +10,12 @@ from sparse_coding__tpu.utils.config import (
     ToyArgs,
     TrainArgs,
 )
-from sparse_coding__tpu.utils.trace import Progress, StepTimer, annotate, trace
+from sparse_coding__tpu.utils.trace import (
+    Progress,
+    StepTimer,
+    annotate,
+    start_trace_safe,
+    stop_trace_safe,
+    trace,
+    trace_active,
+)

@@ -19,6 +19,18 @@ TPU_PEAK_TFLOPS = {
 }
 DEFAULT_PEAK_TFLOPS = 197.0
 
+# chip peak HBM bandwidth (GB/s) by jax device_kind — the other roofline
+# axis (telemetry.profiling.roofline_summary): a kernel whose arithmetic
+# intensity sits below peak_flops/peak_bw is bandwidth-bound and its
+# attainable TFLOP/s is intensity * bandwidth, not the MXU peak
+TPU_HBM_GBPS = {
+    "TPU v5 lite": 819.0,
+    "TPU v4": 1228.0,
+    "TPU v5": 2765.0,
+    "TPU v6 lite": 1640.0,
+}
+DEFAULT_HBM_GBPS = 819.0
+
 # analytic A100 estimate of the flagship workload (bench.py module doc):
 # 8 members x 5-matmul-pass tied-SAE step at generous 50% A100-bf16 MXU util
 A100_BASELINE_ACTS_PER_SEC = 0.78e6
@@ -26,6 +38,10 @@ A100_BASELINE_ACTS_PER_SEC = 0.78e6
 
 def peak_tflops(device_kind: str) -> float:
     return TPU_PEAK_TFLOPS.get(device_kind, DEFAULT_PEAK_TFLOPS)
+
+
+def hbm_gbps(device_kind: str) -> float:
+    return TPU_HBM_GBPS.get(device_kind, DEFAULT_HBM_GBPS)
 
 
 def tied_sae_flops_per_act(n_models: int, d_act: int, n_dict: int) -> int:
@@ -75,4 +91,10 @@ def make_control(side: int = 8192, reps: int = 8):
         jax.device_get(out)
         return flop / (time.perf_counter() - t0) / 1e12
 
+    # roofline attribution handles (telemetry.profiling / bench.py): the
+    # control's analytic work and its HBM traffic (two operands + the chain's
+    # working tile; bf16). Its intensity is far above any chip's ridge — a
+    # control reading below expectation is chip weather, not bandwidth.
+    measure.flops_per_call = float(flop)
+    measure.bytes_per_call = float((2 + reps) * side * side * 2)
     return measure

@@ -17,22 +17,92 @@ counters polled by `progressbar` (`cluster_runs.py:132-154`). Here:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
+import warnings
 from pathlib import Path
 from typing import Dict, List, Optional
 
 import jax
 
+# the jax profiler is process-global and start_trace raises on a second
+# start — every start/stop in this repo goes through the two helpers below
+# so a nested or concurrent trace degrades to a warning instead of killing
+# the outer trace (and `telemetry.profiling.TraceTrigger` can share the
+# same interlock with the `trace()` context manager)
+_TRACE_LOCK = threading.Lock()
+_TRACE_DIR: Optional[str] = None
+
+
+def trace_active() -> Optional[str]:
+    """The log dir of the currently active profiler trace, or None."""
+    return _TRACE_DIR
+
+
+def start_trace_safe(log_dir: str, create_perfetto_link: bool = False) -> bool:
+    """Start a profiler trace unless one is already active. Returns True when
+    THIS call started the trace (the caller then owns the matching stop);
+    False → a trace was already running (warned) or the profiler refused."""
+    global _TRACE_DIR
+    with _TRACE_LOCK:
+        if _TRACE_DIR is not None:
+            warnings.warn(
+                f"trace requested for {log_dir!r} while a trace into "
+                f"{_TRACE_DIR!r} is already active — jax.profiler supports "
+                "one trace per process; ignoring the nested request",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return False
+        Path(log_dir).mkdir(parents=True, exist_ok=True)
+        try:
+            jax.profiler.start_trace(
+                log_dir, create_perfetto_link=create_perfetto_link
+            )
+        except Exception as e:  # an already-armed profiler outside our lock
+            warnings.warn(
+                f"jax.profiler.start_trace({log_dir!r}) failed: {e!r} — "
+                "continuing untraced",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return False
+        _TRACE_DIR = log_dir
+        return True
+
+
+def stop_trace_safe() -> Optional[str]:
+    """Stop the active trace (no-op when none); never raises. Returns the
+    stopped trace's log dir, or None."""
+    global _TRACE_DIR
+    with _TRACE_LOCK:
+        stopped, _TRACE_DIR = _TRACE_DIR, None
+        if stopped is None:
+            return None
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - backend-dependent
+            warnings.warn(
+                f"jax.profiler.stop_trace() failed: {e!r}", RuntimeWarning
+            )
+        return stopped
+
 
 @contextlib.contextmanager
 def trace(log_dir: str = "/tmp/jax-trace", create_perfetto_link: bool = False):
-    """Profile the enclosed block; view with TensorBoard or ui.perfetto.dev."""
-    Path(log_dir).mkdir(parents=True, exist_ok=True)
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    """Profile the enclosed block; view with TensorBoard or ui.perfetto.dev.
+
+    Reentrancy-safe: when a trace is already active (a nested `trace(...)`
+    block, or a `TraceTrigger` window in flight) the block runs untraced
+    with a RuntimeWarning instead of raising from `jax.profiler.start_trace`
+    and killing the outer trace. Only the start that actually armed the
+    profiler stops it."""
+    started = start_trace_safe(log_dir, create_perfetto_link=create_perfetto_link)
     try:
         yield log_dir
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            stop_trace_safe()
 
 
 def annotate(name: str):
@@ -63,6 +133,17 @@ class StepTimer:
     steps/sec statistics. Note: on the tunneled TPU backend
     `block_until_ready` is a no-op — fetching a value is the only reliable
     fence, hence the fence-array argument.
+
+    `report` distinguishes two rates, because async dispatch makes them
+    genuinely different quantities:
+
+      - ``dispatch_steps_per_sec`` / ``dispatch_mean_step_ms`` — host-side,
+        first tick window to the LAST tick: how fast the host enqueues work.
+      - ``steps_per_sec`` / ``mean_step_ms`` — fenced: the window extended to
+        the fence fetch, i.e. including the device queue draining. This is
+        the honest throughput number, but it silently includes queue-drain
+        time — quoting it as "per-step latency" conflates the two, so both
+        now ship in every report.
     """
 
     def __init__(self):
@@ -78,6 +159,7 @@ class StepTimer:
     def report(self, fence=None) -> Dict[str, float]:
         n_steps = len(self._times) - 1  # ticks only; the fence is not a step
         end = self._times[-1]
+        dispatch_total = end - self._times[0]  # host-side, up to the last tick
         if fence is not None:
             # a sanctioned sync point: report() is a flush-boundary act, so
             # it stays legal inside telemetry.audit.transfer_audit
@@ -87,13 +169,21 @@ class StepTimer:
                 jax.device_get(fence)
             end = time.perf_counter()  # extends total time, not the step count
         if n_steps <= 0:
-            return {"steps": 0, "total_s": 0.0, "steps_per_sec": 0.0, "mean_step_ms": 0.0}
+            return {
+                "steps": 0, "total_s": 0.0, "steps_per_sec": 0.0,
+                "mean_step_ms": 0.0, "dispatch_steps_per_sec": 0.0,
+                "dispatch_mean_step_ms": 0.0,
+            }
         total = end - self._times[0]
         return {
             "steps": n_steps,
             "total_s": total,
             "steps_per_sec": n_steps / total if total > 0 else 0.0,
             "mean_step_ms": 1000.0 * total / n_steps,
+            "dispatch_steps_per_sec": (
+                n_steps / dispatch_total if dispatch_total > 0 else 0.0
+            ),
+            "dispatch_mean_step_ms": 1000.0 * dispatch_total / n_steps,
         }
 
 

@@ -1,0 +1,140 @@
+"""`python -m sparse_coding__tpu.perfdiff`: spread-aware bench regression
+gate (docs/observability.md §5; ISSUE 3 satellite: the comparator itself is
+tier-1-smoked against a checked-in fixture so it cannot silently rot)."""
+
+import copy
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.perfdiff import compare, load_bench, main, render_table
+
+FIXTURE = Path(__file__).parent / "golden" / "bench_fixture.json"
+
+
+@pytest.fixture()
+def bench():
+    return load_bench(FIXTURE)
+
+
+def _write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+# -- comparison semantics -----------------------------------------------------
+
+def test_self_compare_is_clean(bench):
+    result = compare(bench, bench)
+    assert result["control_ratio"] == 1.0
+    assert result["regressions"] == [] and result["improvements"] == []
+    statuses = {r["key"]: r["status"] for r in result["rows"]}
+    assert statuses["control_matmul_tflops"] == "control"
+    assert all(
+        s in ("ok", "control") for s in statuses.values()
+    ), statuses
+    # only measured keys (median + spread) participate — derived scalars and
+    # metadata must not produce rows
+    assert "mfu" not in statuses and "metric" not in statuses
+    assert "control_fraction_of_peak" not in statuses
+
+
+def test_injected_regression_detected(bench):
+    new = copy.deepcopy(bench)
+    new["stream_rows_per_sec"] = bench["stream_rows_per_sec"] * 0.8  # -20%
+    result = compare(bench, new)
+    assert result["regressions"] == ["stream_rows_per_sec"]
+    row = next(r for r in result["rows"] if r["key"] == "stream_rows_per_sec")
+    assert row["status"] == "regressed"
+    assert row["delta"] == pytest.approx(-0.2, abs=1e-6)
+    table = render_table(result)
+    assert "REGRESSED" in table and "stream_rows_per_sec" in table
+
+
+def test_within_old_spread_is_noise(bench):
+    # fista's old spread is wide ([1704, 2141] around 2058): a new median at
+    # the bottom of the old spread is chip noise, not a regression
+    new = copy.deepcopy(bench)
+    new["fista500_codes_per_sec"] = bench["fista500_codes_per_sec_spread"][0]
+    result = compare(bench, new)
+    assert result["regressions"] == []
+
+
+def test_chip_weather_is_scaled_out(bench):
+    """The whole chip running 20% slow (control AND keys down 20%) is
+    weather, not a code regression; a key down 20% while the control is
+    steady IS one. Same raw delta, opposite verdicts — the control makes
+    the difference."""
+    slow_chip = copy.deepcopy(bench)
+    for k in list(slow_chip):
+        if f"{k}_spread" in slow_chip:
+            slow_chip[k] = slow_chip[k] * 0.8
+    result = compare(bench, slow_chip)
+    assert result["control_ratio"] == pytest.approx(0.8, abs=1e-3)
+    assert result["regressions"] == []
+    # and a key moving AGAINST a slow control trips even when its raw value
+    # only fell 20% (expectation was scaled down by the same 20% already)
+    slow_chip["topk_steps_per_sec"] = bench["topk_steps_per_sec"] * 0.6
+    result = compare(bench, slow_chip)
+    assert result["regressions"] == ["topk_steps_per_sec"]
+
+
+def test_improvement_flagged_not_failing(bench):
+    new = copy.deepcopy(bench)
+    new["topk_steps_per_sec"] = bench["topk_steps_per_sec"] * 1.5
+    result = compare(bench, new)
+    assert result["regressions"] == []
+    assert result["improvements"] == ["topk_steps_per_sec"]
+
+
+def test_missing_key_reported_but_not_regression(bench):
+    new = copy.deepcopy(bench)
+    del new["topk_steps_per_sec"]
+    result = compare(bench, new)
+    row = next(r for r in result["rows"] if r["key"] == "topk_steps_per_sec")
+    assert row["status"] == "missing"
+    assert result["regressions"] == []
+
+
+# -- envelope / CLI -----------------------------------------------------------
+
+def test_load_bench_unwraps_round_driver_envelope(tmp_path, bench):
+    wrapped = _write(
+        tmp_path, "BENCH_rXX.json",
+        {"n": 5, "cmd": "python bench.py", "rc": 0, "parsed": bench},
+    )
+    assert load_bench(wrapped) == bench
+
+
+def test_cli_self_compare_exits_zero(capsys):
+    assert main([str(FIXTURE), str(FIXTURE)]) == 0
+    out = capsys.readouterr().out
+    assert "No regressions" in out
+    assert "| value |" in out  # markdown table rendered
+
+
+def test_cli_regression_exits_nonzero(tmp_path, bench, capsys):
+    new = copy.deepcopy(bench)
+    new["value"] = bench["value"] * 0.8
+    mutated = _write(tmp_path, "new.json", new)
+    assert main([str(FIXTURE), mutated]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "1 regression(s):** value" in out
+
+
+def test_module_entry_point(tmp_path):
+    """`python -m sparse_coding__tpu.perfdiff` — the documented invocation —
+    must exist and exit 0 on self-compare (acceptance drill)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.perfdiff",
+         str(FIXTURE), str(FIXTURE)],
+        capture_output=True, text=True, timeout=120,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": str(Path(__file__).parents[1])},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "No regressions" in proc.stdout

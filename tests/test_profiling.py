@@ -1,0 +1,400 @@
+"""Performance-attribution layer (`telemetry.profiling`; ISSUE 3): XLA
+cost/roofline capture on compile events, HBM watermark gauges, and the
+TraceTrigger arming logic.
+
+TraceTrigger tests stub `utils.trace.start_trace_safe`/`stop_trace_safe`:
+`jax.profiler.start_trace` costs ~30 s of profiler-server setup on this
+image, and the real start/stop pair (plus its reentrancy interlock) is
+already exercised by `test_train_loop.test_step_timer_and_trace`.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.telemetry import (
+    AnomalyGuard,
+    AnomalyPolicy,
+    RunTelemetry,
+    TraceTrigger,
+    read_events,
+    record_hbm_watermarks,
+    roofline_summary,
+    tracked_jit,
+)
+from sparse_coding__tpu.telemetry.profiling import (
+    compiled_cost_fields,
+    hbm_watermarks,
+    jit_cost_fields,
+)
+
+
+# -- cost capture -------------------------------------------------------------
+
+def test_compile_events_carry_cost_fields(tmp_path):
+    """On the CPU backend XLA's cost analysis is available, so every tracked
+    compile event deterministically carries a `cost` block — and the schema
+    round-trips through events.jsonl. The default capture depth reads the
+    re-lowered HLO only: flops/bytes present, NO memory footprints (those
+    would cost a second backend compile — the opt-in `memory=True` /
+    SC_COST_CAPTURE=full depth)."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="cost")
+    fn = tracked_jit("unit.matmul", jax.jit(lambda a, b: a @ b))
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 32), jnp.float32)
+    fn(a, b)
+    fn(a, b)  # cached: no second compile event
+    tel.close()
+
+    compiles = [
+        e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "compile"
+    ]
+    assert len(compiles) == 1
+    cost = compiles[0]["cost"]
+    # 2*M*N*K FLOPs for one matmul — XLA's analytic count, exactly
+    assert cost["flops"] == pytest.approx(2 * 64 * 128 * 32)
+    assert cost["bytes_accessed"] > 0
+    assert "argument_bytes" not in cost  # default depth: no throwaway compile
+
+
+def test_full_capture_has_memory_footprints_and_masks_counters(tmp_path):
+    """`memory=True` adds the memory_analysis footprints — and its throwaway
+    backend compile must NOT leak into the compile.backend.* counters the
+    monitoring bridge keeps (bench.py reports them as the compile-state
+    confound signal)."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="full")
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 32), jnp.float32)
+    f(a, b)
+    before = tel.counters.get("compile.backend.count", 0)
+    cost = jit_cost_fields(f, (a, b), memory=True)
+    assert cost["flops"] == pytest.approx(2 * 64 * 128 * 32)
+    # memory_analysis footprints: two f32 args, one f32 out
+    assert cost["argument_bytes"] == (64 * 128 + 128 * 32) * 4
+    assert cost["output_bytes"] == 64 * 32 * 4
+    assert "peak_bytes" in cost
+    assert tel.counters.get("compile.backend.count", 0) == before, (
+        "cost capture's throwaway compile leaked into the backend-compile "
+        "counters"
+    )
+    tel.close()
+
+
+def test_jit_cost_fields_survives_donated_args():
+    """Entry points with donated state (the ensemble steps) must still be
+    cost-capturable right after the call consumed (donated) their buffers —
+    `lower` only needs avals."""
+    f = jax.jit(lambda s, x: s + x.sum(), donate_argnums=(0,))
+    s = jnp.ones((256,))
+    x = jnp.ones((8, 256))
+    f(s, x)
+    assert s.is_deleted()
+    cost = jit_cost_fields(f, (s, x))
+    assert cost is not None and cost["flops"] > 0
+
+
+def test_jit_cost_fields_refuses_gracefully():
+    assert jit_cost_fields(object()) is None  # no .lower
+    assert jit_cost_fields(jax.jit(lambda x: x), args=("not-an-array",)) is None
+
+
+def test_cost_capture_kill_switch(monkeypatch):
+    monkeypatch.setenv("SC_COST_CAPTURE", "0")
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((4,)))
+    assert jit_cost_fields(f, (jnp.ones((4,)),)) is None
+
+
+def test_ensemble_compiled_cost_at_scan_shape():
+    from sparse_coding__tpu.ensemble import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    ens = build_ensemble(
+        FunctionalTiedSAE, jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=16, n_dict_components=32,
+    )
+    batches = jnp.ones((2, 8, 16))
+    ens.step_scan(batches)  # compile
+    cost = ens.compiled_cost(batches)
+    assert cost is not None
+    assert cost["flops"] > 2 * 2 * 2 * 8 * 16 * 32  # > one fwd matmul pass
+    assert cost["bytes_accessed"] > 0
+    # default depth: no throwaway compile, so no memory footprints...
+    assert "argument_bytes" not in cost
+    # ...which are the opt-in memory=True depth
+    full = ens.compiled_cost(batches, memory=True)
+    assert full["argument_bytes"] > 0 and "temp_bytes" in full
+
+
+def test_scan_cost_block_covers_one_iteration():
+    """XLA's cost analysis counts loop bodies ONCE (the documented unit
+    caveat bench.py's roofline scaling depends on): a K-step scan program
+    must report ~single-step FLOPs, not K times that."""
+    K, M = 16, 64
+
+    def body(c, x):
+        return c + x @ x, None
+
+    f = jax.jit(lambda c, xs: jax.lax.scan(body, c, xs)[0])
+    c = jnp.ones((M, M))
+    xs = jnp.ones((K, M, M))
+    f(c, xs)
+    cost = jit_cost_fields(f, (c, xs))
+    one_step = 2 * M**3  # one M^3 matmul
+    assert cost["flops"] == pytest.approx(one_step, rel=0.5), (
+        "scan cost no longer reports one loop body — bench.py's "
+        "units_per_cost scaling (and the docs' unit caveat) must be revisited"
+    )
+
+
+# -- roofline -----------------------------------------------------------------
+
+def test_roofline_classification_both_sides_of_ridge():
+    # v5e ridge: 197e12 / 819e9 ≈ 240.5 FLOPs/byte
+    hi = roofline_summary(1e12, 1e9, "TPU v5 lite")  # intensity 1000
+    assert hi["bound"] == "compute"
+    assert hi["attainable_tflops"] == pytest.approx(197.0)
+    lo = roofline_summary(1e10, 1e9, "TPU v5 lite")  # intensity 10
+    assert lo["bound"] == "bandwidth"
+    # bandwidth-bound attainable = intensity * bw = 10 * 819 GB/s = 8.19 TF/s
+    assert lo["attainable_tflops"] == pytest.approx(8.19, abs=0.01)
+
+
+def test_roofline_achieved_fraction():
+    rl = roofline_summary(1e12, 1e9, "TPU v5 lite", seconds=1 / 100.0)
+    assert rl["achieved_tflops"] == pytest.approx(100.0)
+    assert rl["achieved_fraction"] == pytest.approx(100.0 / 197.0, abs=1e-3)
+    assert rl["achieved_gbps"] == pytest.approx(100.0)
+
+
+def test_roofline_unknown_device_uses_defaults():
+    rl = roofline_summary(1e12, 1e9, "cpu")
+    assert rl["peak_tflops"] == 197.0 and rl["hbm_gbps"] == 819.0
+
+
+# -- HBM watermarks -----------------------------------------------------------
+
+def test_watermarks_absent_on_cpu_deterministically(tmp_path):
+    """CPU devices report no memory_stats: the gauges must be absent (not
+    zero, not garbage) — the report and bench rely on present-or-absent
+    being deterministic per backend."""
+    assert hbm_watermarks() == {}
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="wm")
+    assert record_hbm_watermarks(tel) == {}
+    tel.run_end()
+    tel.close()
+    snap = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "snapshot"]
+    assert all(not k.startswith("hbm.") for k in snap[-1]["gauges"])
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_watermark_gauges_flow_to_snapshot_and_report(tmp_path, capsys):
+    """With a stats-reporting device (stubbed — the TPU shape of
+    memory_stats), watermarks ride gauges into the run_end snapshot and the
+    report renders the watermark table + OOM headroom."""
+    GiB = 1024**3
+    dev = _FakeDevice(
+        {"bytes_in_use": 2 * GiB, "peak_bytes_in_use": 3 * GiB,
+         "bytes_limit": 16 * GiB, "largest_free_block_bytes": GiB}
+    )
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="wm")
+    tel.run_start()
+    marks = record_hbm_watermarks(tel, devices=[dev])
+    assert marks == {
+        "d0": {"bytes_in_use": 2 * GiB, "peak_bytes_in_use": 3 * GiB,
+               "bytes_limit": 16 * GiB}
+    }
+    tel.run_end()
+    tel.close()
+    snap = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "snapshot"][-1]
+    assert snap["gauges"]["hbm.d0.peak_bytes_in_use"] == float(3 * GiB)
+
+    from sparse_coding__tpu.report import main
+
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "Performance attribution" in out
+    assert "3.00 GiB" in out          # peak in use
+    assert "13.00 GiB (81.2%)" in out  # OOM headroom = limit - peak
+
+
+# -- report perf section ------------------------------------------------------
+
+def test_report_renders_cost_and_roofline(tmp_path, capsys):
+    """The acceptance drill: a run dir whose compile events carry cost
+    renders a perf section with per-entry-point FLOPs/bytes and a roofline
+    classification."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="perf")
+    tel.run_start()
+    fn = tracked_jit("ensemble.step_scan", jax.jit(lambda a, b: a @ b))
+    fn(jnp.ones((256, 512)), jnp.ones((512, 128)))
+    tel.run_end()
+    tel.close()
+
+    from sparse_coding__tpu.report import main
+
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "Performance attribution" in out
+    assert "ensemble.step_scan" in out
+    assert "| bound " in out or "| compute " in out or "| bandwidth " in out
+    # cpu fingerprint → default peak table note
+    assert "Roofline peaks" in out
+
+
+# -- TraceTrigger -------------------------------------------------------------
+
+@pytest.fixture()
+def fake_profiler(monkeypatch):
+    """Stub the safe start/stop pair (real pair covered in test_train_loop);
+    records calls and honors the one-trace-at-a-time contract."""
+    calls = {"started": [], "stopped": 0, "active": None}
+
+    def start(log_dir, create_perfetto_link=False):
+        if calls["active"] is not None:
+            return False
+        calls["active"] = log_dir
+        calls["started"].append(log_dir)
+        return True
+
+    def stop():
+        d, calls["active"] = calls["active"], None
+        if d is not None:
+            calls["stopped"] += 1
+        return d
+
+    import importlib
+
+    # `sparse_coding__tpu.utils.trace` the ATTRIBUTE is the trace() function
+    # (utils/__init__ re-exports it over the submodule name) — resolve the
+    # module itself
+    trace_mod = importlib.import_module("sparse_coding__tpu.utils.trace")
+
+    monkeypatch.setattr(trace_mod, "start_trace_safe", start)
+    monkeypatch.setattr(trace_mod, "stop_trace_safe", stop)
+    return calls
+
+
+def test_trace_trigger_step_window(tmp_path, fake_profiler):
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="tt")
+    tt = TraceTrigger(telemetry=tel, out_dir=str(tmp_path), start_step=10, stop_step=20)
+    for step in (0, 5):
+        tt.on_step(step)
+    assert not tt.active
+    tt.on_step(12)  # inside [10, 20): arm
+    assert tt.active
+    tt.on_step(18)  # still inside
+    assert tt.active
+    tt.on_step(25)  # past stop: capture ends
+    assert not tt.active
+    tt.on_step(12)  # the window fires ONCE per run
+    assert not tt.active
+    tel.close()
+    assert fake_profiler["started"] == [str(tmp_path / "trace_step12")]
+    traces = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "trace"]
+    assert len(traces) == 1
+    assert traces[0]["reason"] == "step_window"
+    assert traces[0]["start_step"] == 12 and traces[0]["stop_step"] == 25
+    assert tt.last_trace_dir == str(tmp_path / "trace_step12")
+
+
+def test_trace_trigger_window_coarser_than_boundaries(tmp_path, fake_profiler):
+    """Chunk-granularity drivers may jump clean across the requested window
+    (on_step(4), on_step(8) with window 2:4): one boundary-to-boundary
+    window must be captured instead of silently nothing — found by the
+    verify drive of SC_TRACE_WINDOW through basic_l1_sweep."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="coarse")
+    tt = TraceTrigger(telemetry=tel, out_dir=str(tmp_path), start_step=2, stop_step=4)
+    tt.on_step(4)   # first boundary already past stop: arm anyway
+    assert tt.active
+    tt.on_step(8)   # next boundary: capture ends
+    assert not tt.active
+    tel.close()
+    traces = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "trace"]
+    assert len(traces) == 1
+    assert traces[0]["start_step"] == 4 and traces[0]["stop_step"] == 8
+
+
+def test_trace_trigger_from_env(tmp_path, fake_profiler):
+    env = {"SC_TRACE_WINDOW": "3:5", "SC_TRACE_DIR": str(tmp_path / "custom")}
+    tt = TraceTrigger.from_env(out_dir=str(tmp_path), env=env)
+    assert (tt.start_step, tt.stop_step) == (3, 5)
+    tt.on_step(4)
+    assert fake_profiler["started"] == [str(tmp_path / "custom")]
+    tt.close()
+
+    with pytest.warns(RuntimeWarning, match="SC_TRACE_WINDOW"):
+        tt2 = TraceTrigger.from_env(env={"SC_TRACE_WINDOW": "garbage"})
+    assert tt2.start_step is None  # malformed → inert, run continues
+
+
+def test_anomaly_fires_trace_trigger_once(tmp_path, fake_profiler):
+    """First anomaly arms a capture; its dir lands in the anomaly event AND
+    the diagnostic bundle; later anomalies do not re-arm."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="anom")
+    tt = TraceTrigger(telemetry=tel, out_dir=str(tmp_path), anomaly_windows=1)
+    guard = AnomalyGuard(
+        telemetry=tel, out_dir=str(tmp_path),
+        policy=AnomalyPolicy(action="warn"), trace_trigger=tt,
+    )
+    with pytest.warns(RuntimeWarning):
+        guard.observe([3], [{"loss": np.asarray([np.nan, 1.0])}])
+    assert tt.active, "anomaly must start a capture immediately"
+    expect_dir = str(tmp_path / "trace_anomaly_step3")
+    tt.on_step(4)  # one window later: capture ends
+    assert not tt.active
+    with pytest.warns(RuntimeWarning):
+        guard.observe([5], [{"loss": np.asarray([1.0, np.nan])}])
+    assert not tt.active, "only the FIRST anomaly arms a capture"
+    tel.close()
+
+    events = read_events(tmp_path / "events.jsonl")
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    assert anomalies[0]["trace_dir"] == expect_dir
+    bundle = json.load(open(anomalies[0]["bundle"]))
+    assert bundle["trace_dir"] == expect_dir
+    traces = [e for e in events if e["event"] == "trace"]
+    assert len(traces) == 1 and traces[0]["dir"] == expect_dir
+    assert fake_profiler["started"] == [expect_dir]
+
+
+def test_trigger_yields_when_profiler_busy(fake_profiler):
+    """A trigger firing while another trace is active must refuse quietly
+    (start_trace_safe returns False) — never kill the outer trace — and a
+    refused anomaly fire must NOT consume the run's single anomaly capture."""
+    fake_profiler["active"] = "/somewhere/else"  # foreign trace in flight
+    tt = TraceTrigger(start_step=1, stop_step=2)
+    tt.on_step(1)
+    assert not tt.active
+    assert tt.fire("anomaly") is None
+    assert fake_profiler["started"] == []
+    fake_profiler["active"] = None  # foreign trace ended
+    assert tt.fire("anomaly") is not None, (
+        "refused fire consumed the anomaly capture"
+    )
+    assert tt.active
+
+
+def test_trigger_close_stops_inflight_capture(tmp_path, fake_profiler):
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="close")
+    with TraceTrigger(telemetry=tel, out_dir=str(tmp_path), start_step=0,
+                      stop_step=100) as tt:
+        tt.on_step(1)
+        assert tt.active
+    assert not tt.active and fake_profiler["stopped"] == 1
+    tel.close()
+    traces = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "trace"]
+    assert len(traces) == 1  # close() emitted the trace event

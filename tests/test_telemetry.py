@@ -99,6 +99,10 @@ def test_tracked_jit_attributes_compiles(tmp_path):
     compiles = [e for e in read_events(tmp_path / "events.jsonl") if e["event"] == "compile"]
     assert [c["name"] for c in compiles] == ["unit.square", "unit.square"]
     assert tel.counters["dispatch.unit.square"] == 3
+    # on the CPU backend XLA exposes cost analysis, so every compile event
+    # deterministically carries the perf-attribution cost block (ISSUE 3)
+    assert all(c["cost"]["flops"] > 0 for c in compiles)
+    assert all(c["cost"]["bytes_accessed"] > 0 for c in compiles)
 
 
 # -- on-device health pack ----------------------------------------------------

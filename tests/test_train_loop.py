@@ -90,8 +90,12 @@ def test_make_hyperparam_name():
 
 
 def test_step_timer_and_trace(tmp_path):
+    import warnings
+
     from sparse_coding__tpu.utils import StepTimer, trace, annotate
+    from sparse_coding__tpu.utils.trace import trace_active
     import jax.numpy as jnp
+    import pytest
 
     t = StepTimer()
     x = jnp.zeros((4,))
@@ -101,11 +105,30 @@ def test_step_timer_and_trace(tmp_path):
     rep = t.report(fence=x)
     # ticks count as steps; the fence only extends total time (trace.py:60-65)
     assert rep["steps"] == 3 and rep["total_s"] >= 0
+    # dispatch stats are host-side (up to the last tick): the fence can only
+    # extend the fenced window, so dispatch rate >= fenced rate
+    assert rep["dispatch_steps_per_sec"] >= rep["steps_per_sec"] > 0
+    assert rep["dispatch_mean_step_ms"] <= rep["mean_step_ms"]
 
     with trace(str(tmp_path / "trace")):
+        assert trace_active() == str(tmp_path / "trace")
         with annotate("toy"):
             jax.device_get(jnp.ones((8,)) * 2)
+        # reentrancy: a nested trace must degrade to a warning, not raise
+        # from jax.profiler.start_trace and kill the outer trace
+        with pytest.warns(RuntimeWarning, match="already active"):
+            with trace(str(tmp_path / "nested")) as d:
+                jax.device_get(jnp.ones((4,)) + 1)
+        assert trace_active() == str(tmp_path / "trace"), "outer trace died"
+    assert trace_active() is None
     assert any((tmp_path / "trace").rglob("*")), "no trace files written"
+    # the nested block must not have stopped the profiler for the outer one
+    # (stop after the outer exit is a safe no-op)
+    from sparse_coding__tpu.utils.trace import stop_trace_safe
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert stop_trace_safe() is None
 
 
 def test_log_image_wandb_path(tmp_path, monkeypatch):
