@@ -121,7 +121,89 @@ def fixture_metrics(dicts, eval_batch, ground_truth):
     ]
 
 
+POD_RUN_DIR = REPO / "tests" / "golden" / "pod_run"
+POD_BASE_TS = 1_754_200_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_pod_run_fixture():
+    """Deterministic two-process pod run directory (ISSUE 4 satellite).
+
+    Hand-stamped event logs — NOT a real training run: real runs stamp wall
+    clocks, and a golden fixture must be byte-stable. The shape mirrors what
+    `telemetry.multihost`-wired drivers write on a two-host pod: per-process
+    `events.p<i>.jsonl`, every record tagged `process_index`, heartbeats
+    with allgathered window times + clock offsets, `skew.flush.*` gauges,
+    `hbm.p<i>.d<j>.*` watermarks, and a straggling host (p1 is ~1 s slower
+    on chunk 1). `tests/test_monitor.py` runs `monitor --once` and the
+    report against this directory in tier-1.
+    """
+    POD_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    chunk_secs = {0: (1.00, 1.05), 1: (1.10, 2.15), 2: (1.02, 1.08)}
+    for p in (0, 1):
+        fp = {
+            "python": "3.11.8", "jax": "0.6.0", "jaxlib": "0.6.0",
+            "backend": "cpu", "device_kind": "golden-cpu", "device_count": 8,
+            "process_index": p, "process_count": 2, "git_sha": "g0lden",
+        }
+        seq = 0
+        t = POD_BASE_TS
+
+        def rec(event, dt=1.0, **fields):
+            nonlocal seq, t
+            seq += 1
+            t += dt
+            return {"seq": seq, "ts": round(t, 3), "event": event,
+                    "process_index": p, **fields}
+
+        events = [
+            rec("run_start", run_name="pod_golden",
+                config={"batch": 4096, "l1_values": [1e-4, 1e-3]},
+                fingerprint=fp),
+            rec("compile", name="ensemble.step_scan", seconds=2.5 + 0.1 * p),
+        ]
+        steps = 0
+        for chunk in range(3):
+            mine, theirs = chunk_secs[chunk][p], chunk_secs[chunk][1 - p]
+            steps += 64
+            events.append(rec("chunk_start", chunk=chunk))
+            events.append(rec("chunk_end", dt=mine, chunk=chunk,
+                              seconds=mine, steps=64))
+            events.append(rec(
+                "heartbeat", dt=0.01, step=steps, steps=steps,
+                window_seconds=mine,
+                window_seconds_by_process=[chunk_secs[chunk][0], chunk_secs[chunk][1]],
+                skew_seconds=round(abs(mine - theirs), 4),
+                clock_offset_seconds=0.012 * p,
+                clock_uncertainty_seconds=0.004,
+            ))
+        events.append(rec(
+            "snapshot",
+            counters={"chunks": 3, "chunk.seconds": round(sum(chunk_secs[c][p] for c in range(3)), 3),
+                      "compile.backend.count": 3,
+                      "compile.backend.seconds": 2.9,
+                      "heartbeats": 3, "train.steps": steps},
+            gauges={f"hbm.p{p}.d{4 * p + j}.bytes_in_use": float(2**28 + j)
+                    for j in range(2)}
+            | {f"hbm.p{p}.d{4 * p + j}.peak_bytes_in_use": float(2**29 + j)
+               for j in range(2)}
+            | {f"hbm.p{p}.d{4 * p + j}.bytes_limit": float(2**31)
+               for j in range(2)}
+            | {"skew.flush.max_seconds": 1.08, "skew.flush.min_seconds": 1.02,
+               "skew.flush.spread_seconds": 0.06},
+        ))
+        events.append(rec("run_end", status="ok", steps=steps,
+                          steps_per_sec=round(steps / (6.0 + p), 3),
+                          wall_seconds=6.0 + p))
+        with open(POD_RUN_DIR / f"events.p{p}.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    print(f"Wrote {POD_RUN_DIR}/events.p0.jsonl + events.p1.jsonl")
+
+
 def main():
+    if "--pod-run" in sys.argv:
+        make_pod_run_fixture()
+        return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")
     import jax

@@ -1,0 +1,14 @@
+"""CLI shim: ``python -m sparse_coding__tpu.monitor <run_dir> [--once]``.
+
+Tails a run directory's event logs (`events.jsonl` / per-process
+`events.p<i>.jsonl`) and renders live throughput / health / straggler-skew
+lines; ``--once`` prints one snapshot and exits nonzero on malformed event
+lines. Implementation: `sparse_coding__tpu.telemetry.monitor`.
+"""
+
+from sparse_coding__tpu.telemetry.monitor import EventTail, RunMonitor, main, render
+
+__all__ = ["EventTail", "RunMonitor", "main", "render"]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

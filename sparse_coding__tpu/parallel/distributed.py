@@ -18,6 +18,20 @@ from typing import Optional
 import jax
 
 
+def _already_initialized() -> bool:
+    """`jax.distributed.is_initialized` only exists on newer jax; on this
+    jaxlib the liveness signal is the distributed client in global state."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        try:
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client is not None
+        except Exception:
+            return False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -33,7 +47,7 @@ def initialize_distributed(
     in_tpu_pod = "TPU_WORKER_HOSTNAMES" in os.environ or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
     if not configured and not in_tpu_pod:
         return False
-    if jax.distributed.is_initialized():
+    if _already_initialized():
         return jax.process_count() > 1
     # a genuine init failure (unreachable coordinator, timeout) must propagate:
     # swallowing it would silently split-brain the pod into independent
@@ -43,6 +57,16 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+    # pod observability (docs/observability.md §5): measure the coordinator
+    # clock offset once, here, while every process is provably at the same
+    # point — run_start fingerprints and heartbeats carry it so merged
+    # per-process timelines align. Best-effort: never fails the init.
+    try:
+        from sparse_coding__tpu.telemetry.multihost import estimate_clock_offset
+
+        estimate_clock_offset()
+    except Exception:
+        pass
     return True
 
 

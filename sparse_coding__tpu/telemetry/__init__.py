@@ -1,9 +1,9 @@
 """Run telemetry & training-health observability.
 
-Six pieces (docs/observability.md):
-  - `events`    — `RunTelemetry` structured event log (events.jsonl),
-                  counters/gauges, `jax.monitoring` compile bridge,
-                  `tracked_jit`
+Eight pieces (docs/observability.md):
+  - `events`    — `RunTelemetry` structured event log (events.jsonl;
+                  events.p<i>.jsonl on pods), counters/gauges,
+                  `jax.monitoring` compile bridge, `tracked_jit`
   - `health`    — jit-fused per-model health pack (grad/dict norms, NaN
                   flags, dead-feature fraction from a firing-frequency EMA)
   - `anomaly`   — `AnomalyGuard` flush-boundary detection (NaN/Inf, loss
@@ -13,9 +13,15 @@ Six pieces (docs/observability.md):
                   loop" an enforced, testable property
   - `profiling` — performance attribution: XLA cost/roofline capture, HBM
                   watermarks, anomaly/step-window `TraceTrigger`
-  - `report`    — `python -m sparse_coding__tpu.report <run_dir>` summaries
-                  (and `python -m sparse_coding__tpu.perfdiff OLD NEW` for
-                  bench-to-bench regression gating)
+  - `multihost` — pod layer: per-process log layout, flush-boundary
+                  heartbeats + straggler-skew gauges, coordinator clock
+                  offsets, cross-host `desync` detection
+  - `monitor`   — `python -m sparse_coding__tpu.monitor <run_dir>` live
+                  tail of the event logs (`--once` snapshot mode)
+  - `report`    — `python -m sparse_coding__tpu.report <run_dir>` summaries,
+                  merging per-process pod logs (and `python -m
+                  sparse_coding__tpu.perfdiff OLD NEW` for bench-to-bench
+                  regression gating)
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy
@@ -27,6 +33,15 @@ from sparse_coding__tpu.telemetry.events import (
     tracked_jit,
 )
 from sparse_coding__tpu.telemetry.health import FIRE_EMA_KEY, HealthConfig
+from sparse_coding__tpu.telemetry.multihost import (
+    check_desync,
+    chunk_skew_windows,
+    clock_state,
+    estimate_clock_offset,
+    fingerprint_diff,
+    heartbeat,
+    process_info,
+)
 from sparse_coding__tpu.telemetry.profiling import (
     TraceTrigger,
     compiled_cost_fields,
@@ -46,9 +61,16 @@ __all__ = [
     "TraceTrigger",
     "TransferViolation",
     "allowed_transfer",
+    "check_desync",
+    "chunk_skew_windows",
+    "clock_state",
     "compiled_cost_fields",
+    "estimate_clock_offset",
+    "fingerprint_diff",
     "hbm_watermarks",
+    "heartbeat",
     "jit_cost_fields",
+    "process_info",
     "read_events",
     "record_hbm_watermarks",
     "roofline_summary",

@@ -229,10 +229,17 @@ class AnomalyGuard:
         self._bundles += 1
         d = self.out_dir / "diagnostics"
         d.mkdir(parents=True, exist_ok=True)
-        path = d / f"anomaly_step{step}_{'_'.join(kinds)}.json"
+        # multi-host: two hosts tripping at the same step must not overwrite
+        # each other's bundle on a shared run dir
+        from sparse_coding__tpu.telemetry.multihost import process_info
+
+        idx, count = process_info()
+        prefix = f"p{idx}_" if count > 1 else ""
+        path = d / f"{prefix}anomaly_step{step}_{'_'.join(kinds)}.json"
         bundle = {
             "ts": time.time(),
             "step": step,
+            "process_index": idx if count > 1 else None,
             "kinds": kinds,
             "detections": found,
             "masked_before": sorted(self.masked),

@@ -101,22 +101,49 @@ def _install_jax_listeners() -> None:
 def run_fingerprint(mesh=None) -> Dict[str, Any]:
     """Environment fingerprint for `run_start`: enough to re-identify how a
     run was produced from its artifacts alone (the ISSUE-2 requirement), all
-    best-effort — a fingerprint must never fail a training run."""
+    best-effort — a fingerprint must never fail a training run. A field
+    group that fails to resolve lands in ``fingerprint_error`` instead of
+    silently vanishing (a fingerprint whose backend/process keys are simply
+    absent is indistinguishable from an old-schema log; the error string is
+    not). Excepts are narrow per group so one failure cannot drop the
+    others."""
     fp: Dict[str, Any] = {"python": sys.version.split()[0]}
+    errors: List[str] = []
+    jax = None
     try:
         import jax
         import jaxlib
 
         fp["jax"] = jax.__version__
         fp["jaxlib"] = jaxlib.__version__
-        devs = jax.devices()
-        fp["backend"] = devs[0].platform
-        fp["device_kind"] = devs[0].device_kind
-        fp["device_count"] = len(devs)
-        fp["process_index"] = jax.process_index()
-        fp["process_count"] = jax.process_count()
-    except Exception:
+    except (ImportError, AttributeError) as e:
+        errors.append(f"jax_version: {e!r}")
+    if jax is not None:
+        try:
+            devs = jax.devices()
+            fp["backend"] = devs[0].platform
+            fp["device_kind"] = devs[0].device_kind
+            fp["device_count"] = len(devs)
+        except (RuntimeError, IndexError, AttributeError) as e:
+            errors.append(f"devices: {e!r}")
+        try:
+            fp["process_index"] = int(jax.process_index())
+            fp["process_count"] = int(jax.process_count())
+        except (RuntimeError, AttributeError) as e:
+            errors.append(f"process: {e!r}")
+    try:
+        from sparse_coding__tpu.telemetry.multihost import clock_state
+
+        clock = clock_state()
+        if clock:
+            # pod runs: the coordinator clock offset that aligns this host's
+            # timestamps with the merged timeline
+            fp["clock_offset_seconds"] = clock.get("offset_seconds")
+            fp["clock_uncertainty_seconds"] = clock.get("uncertainty_seconds")
+    except Exception:  # pragma: no cover - import cycle during teardown
         pass
+    if errors:
+        fp["fingerprint_error"] = "; ".join(errors)
     try:
         repo = Path(__file__).resolve().parents[2]
         sha = subprocess.run(
@@ -149,6 +176,12 @@ class RunTelemetry:
     The instance is also a context manager: ``__exit__`` writes ``run_end``
     (status "ok", or "error: <exc>" when exiting on an exception) unless one
     was already written, then closes the file.
+
+    Multi-host runs (``jax.process_count() > 1``, see
+    `telemetry.multihost` / docs/observability.md §5): the file becomes
+    ``events.p<i>.jsonl`` and every record is tagged ``process_index`` so
+    merged timelines and anomalies know their originating host. Single-host
+    layout (``events.jsonl``, untagged) is a stability contract.
     """
 
     def __init__(
@@ -169,10 +202,14 @@ class RunTelemetry:
         self._run_end_written = False
         self._fh = None
         self.path: Optional[Path] = None
+        from sparse_coding__tpu.telemetry import multihost as _mh
+
+        idx, count = _mh.process_info()
+        self.process_index: Optional[int] = idx if count > 1 else None
         if out_dir is not None:
             d = Path(out_dir)
             d.mkdir(parents=True, exist_ok=True)
-            self.path = d / file_name
+            self.path = d / _mh.per_process_file_name(file_name, idx, count)
             self._fh = open(self.path, "a")
         if install_jax_listeners:
             _install_jax_listeners()
@@ -187,6 +224,8 @@ class RunTelemetry:
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "ts": time.time(), "event": etype, **fields}
+            if self.process_index is not None:
+                rec["process_index"] = self.process_index
             if self._fh is not None:
                 self._fh.write(json.dumps(rec, default=str) + "\n")
                 self._fh.flush()

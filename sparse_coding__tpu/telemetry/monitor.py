@@ -1,0 +1,360 @@
+"""Live run monitor: tail a run directory's event logs, render health lines.
+
+``python -m sparse_coding__tpu.monitor <run_dir>`` follows every
+``events.jsonl`` / ``events.p<i>.jsonl`` / ``*_events.jsonl`` under the run
+directory (new files are picked up as hosts come online) and periodically
+renders a compact status block:
+
+    run my_sweep — 2 process(es), 3 event file(s), 14:02:11
+      p0  steps 12800  412.3 steps/s  chunks 25  status running  last event 1.2s ago
+      p1  steps 12800  411.9 steps/s  chunks 25  status running  last event 1.3s ago
+      skew: flush spread 0.42 s (gauge) | worst chunk window 0.51 s
+      clock offsets: p1 +0.003 s (±0.001)
+      anomalies: 1 — nonfinite@p1 step 640 | desync: none
+
+Throughput is read from consecutive ``heartbeat`` events per host (pod
+runs); single-host runs fall back to chunk cadence. ``--once`` renders a
+single snapshot and exits — nonzero when any event line is malformed
+(instead of crashing mid-parse), which makes it the tier-1 smoke and a
+cheap CI gate over archived run dirs.
+
+Follow mode exits 0 once every discovered process has written ``run_end``.
+Torn trailing lines (a writer mid-append) are NOT malformed: the tail
+buffers them until the newline arrives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from sparse_coding__tpu.telemetry.multihost import (
+    PROC_FILE_RE as _PROC_FILE_RE,
+    format_bytes as _bytes,
+)
+
+__all__ = ["EventTail", "RunMonitor", "render", "main"]
+
+_EVENT_GLOBS = (
+    "events.jsonl",
+    "events.p*.jsonl",
+    "*_events.jsonl",
+    "*_events.p*.jsonl",  # per-process form of custom file_name= logs
+)
+
+
+def discover_event_files(run_dir: Path) -> List[Path]:
+    found = set()
+    for pat in _EVENT_GLOBS:
+        found.update(run_dir.rglob(pat))
+    return sorted(found)
+
+
+class EventTail:
+    """Incremental reader of one JSONL event file.
+
+    `poll()` returns ``(records, malformed)`` for everything appended since
+    the last call. A trailing line without its newline is buffered (the
+    writer is mid-append), never reported malformed; a complete line that
+    fails to parse is returned in ``malformed`` and skipped — a torn write
+    must not kill the monitor mid-parse.
+    """
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._pos = 0
+        self._partial = ""
+        m = _PROC_FILE_RE.search(self.path.name)
+        self.process_index: Optional[int] = int(m.group(1)) if m else None
+
+    def poll(self) -> Tuple[List[Dict[str, Any]], List[str]]:
+        try:
+            with open(self.path, "r") as f:
+                f.seek(self._pos)
+                data = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return [], []
+        if not data:
+            return [], []
+        buf = self._partial + data
+        lines = buf.split("\n")
+        self._partial = lines.pop()  # torn tail ('' when data ends in \n)
+        records, malformed = [], []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                malformed.append(f"{self.path.name}: {line[:120]}")
+                continue
+            if not isinstance(rec, dict):
+                malformed.append(f"{self.path.name}: {line[:120]}")
+                continue
+            if "process_index" not in rec and self.process_index is not None:
+                rec["process_index"] = self.process_index
+            records.append(rec)
+        return records, malformed
+
+
+class _ProcState:
+    __slots__ = (
+        "steps", "chunks", "last_ts", "status", "beats", "hbm_peak",
+        "clock_offset", "clock_uncertainty", "steps_per_sec",
+    )
+
+    def __init__(self):
+        self.steps: Optional[int] = None
+        self.chunks = 0
+        self.last_ts: Optional[float] = None
+        self.status = "running"
+        self.beats: List[Tuple[float, int]] = []  # (ts, steps), last 2 kept
+        self.hbm_peak: Optional[float] = None
+        self.clock_offset: Optional[float] = None
+        self.clock_uncertainty: Optional[float] = None
+        self.steps_per_sec: Optional[float] = None
+
+
+class RunMonitor:
+    """Aggregates tailed events into per-process + run-level live state."""
+
+    def __init__(self, run_dir):
+        self.run_dir = Path(run_dir)
+        if not self.run_dir.is_dir():
+            raise FileNotFoundError(f"run dir {self.run_dir} does not exist")
+        self._tails: Dict[Path, EventTail] = {}
+        self.procs: Dict[int, _ProcState] = {}
+        self.run_name: Optional[str] = None
+        self.anomalies: List[Dict[str, Any]] = []
+        self.malformed: List[str] = []
+        self.skew_gauge: Optional[float] = None
+        self.chunk_ends: List[Dict[str, Any]] = []
+        self.events_seen = 0
+
+    # -- ingestion ------------------------------------------------------------
+
+    def poll(self) -> int:
+        """Pick up new files + new records; returns the record count."""
+        for path in discover_event_files(self.run_dir):
+            if path not in self._tails:
+                self._tails[path] = EventTail(path)
+        n = 0
+        for tail in self._tails.values():
+            records, malformed = tail.poll()
+            self.malformed.extend(malformed)
+            for rec in records:
+                try:
+                    self._ingest(rec)
+                except Exception:
+                    # valid JSON, impossible fields (ts: null, non-int steps,
+                    # …): a bad record must degrade to 'malformed', never
+                    # kill the monitor mid-parse
+                    self.malformed.append(
+                        f"{tail.path.name}: unusable event {str(rec)[:120]}"
+                    )
+                n += 1
+        return n
+
+    @property
+    def n_files(self) -> int:
+        return len(self._tails)
+
+    def _proc(self, rec) -> _ProcState:
+        idx = int(rec.get("process_index", 0))
+        if idx not in self.procs:
+            self.procs[idx] = _ProcState()
+        return self.procs[idx]
+
+    def _ingest(self, rec: Dict[str, Any]):
+        self.events_seen += 1
+        p = self._proc(rec)
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            p.last_ts = max(p.last_ts or 0.0, float(ts))
+        kind = rec.get("event")
+        if kind == "run_start":
+            self.run_name = rec.get("run_name", self.run_name)
+        elif kind == "heartbeat":
+            if rec.get("steps") is not None:
+                p.steps = int(rec["steps"])
+                p.beats = (p.beats + [(float(rec["ts"]), int(rec["steps"]))])[-2:]
+                if len(p.beats) == 2 and p.beats[1][0] > p.beats[0][0]:
+                    p.steps_per_sec = (p.beats[1][1] - p.beats[0][1]) / (
+                        p.beats[1][0] - p.beats[0][0]
+                    )
+            if rec.get("skew_seconds") is not None:
+                self.skew_gauge = float(rec["skew_seconds"])
+            if rec.get("clock_offset_seconds") is not None:
+                p.clock_offset = float(rec["clock_offset_seconds"])
+                p.clock_uncertainty = rec.get("clock_uncertainty_seconds")
+        elif kind == "chunk_end":
+            p.chunks += 1
+            self.chunk_ends.append(rec)
+        elif kind == "anomaly":
+            self.anomalies.append(rec)
+        elif kind == "snapshot":
+            counters = rec.get("counters") or {}
+            if "train.steps" in counters:
+                p.steps = int(counters["train.steps"])
+            gauges = rec.get("gauges") or {}
+            if "skew.flush.spread_seconds" in gauges:
+                self.skew_gauge = float(gauges["skew.flush.spread_seconds"])
+            peaks = [
+                v for k, v in gauges.items()
+                if k.startswith("hbm.") and k.endswith(".peak_bytes_in_use")
+            ]
+            if peaks:
+                p.hbm_peak = max(peaks)
+        elif kind == "run_end":
+            p.status = str(rec.get("status", "?"))
+            if rec.get("steps") is not None:
+                p.steps = int(rec["steps"])
+            if rec.get("steps_per_sec") is not None:
+                p.steps_per_sec = float(rec["steps_per_sec"])
+
+    # -- derived --------------------------------------------------------------
+
+    @property
+    def finished(self) -> bool:
+        return bool(self.procs) and all(
+            p.status != "running" for p in self.procs.values()
+        )
+
+    def worst_chunk_skew(self) -> Optional[Dict[str, Any]]:
+        from sparse_coding__tpu.telemetry.multihost import chunk_skew_windows
+
+        windows = chunk_skew_windows(self.chunk_ends)
+        if not windows:
+            return None
+        return max(windows, key=lambda w: w["spread"])
+
+
+def _age(now: float, ts: Optional[float]) -> str:
+    if ts is None:
+        return "-"
+    dt = now - ts
+    if dt < 0:
+        return "0s"
+    if dt < 120:
+        return f"{dt:.1f}s"
+    if dt < 7200:
+        return f"{dt / 60:.0f}m"
+    return f"{dt / 3600:.1f}h"
+
+
+def render(mon: RunMonitor, now: Optional[float] = None) -> str:
+    """One status block (plain text, terminal-friendly, no cursor games)."""
+    now = time.time() if now is None else now
+    lines = [
+        f"run {mon.run_name or mon.run_dir} — {len(mon.procs)} process(es), "
+        f"{mon.n_files} event file(s), {time.strftime('%H:%M:%S', time.localtime(now))}"
+    ]
+    if not mon.procs:
+        lines.append("  (no events yet)")
+        return "\n".join(lines)
+    for idx in sorted(mon.procs):
+        p = mon.procs[idx]
+        # `is not None`: a genuine 0.0 steps/s IS the stalled-host signal
+        rate = (
+            f"{p.steps_per_sec:.1f} steps/s" if p.steps_per_sec is not None else "-"
+        )
+        steps = p.steps if p.steps is not None else "-"
+        hbm = f"  hbm peak {_bytes(p.hbm_peak)}" if p.hbm_peak is not None else ""
+        lines.append(
+            f"  p{idx}  steps {steps}  {rate}  chunks {p.chunks}  "
+            f"status {p.status}  last event {_age(now, p.last_ts)} ago{hbm}"
+        )
+    skew_bits = []
+    if mon.skew_gauge is not None:
+        skew_bits.append(f"flush spread {mon.skew_gauge:.3f} s (gauge)")
+    worst = mon.worst_chunk_skew()
+    if worst is not None:
+        skew_bits.append(f"worst chunk window {worst['spread']:.3f} s")
+    if skew_bits:
+        lines.append("  skew: " + " | ".join(skew_bits))
+    offsets = [
+        f"p{idx} {p.clock_offset:+.3f} s"
+        + (f" (±{p.clock_uncertainty:.3f})" if p.clock_uncertainty is not None else "")
+        for idx, p in sorted(mon.procs.items())
+        if p.clock_offset is not None
+    ]
+    if offsets:
+        lines.append("  clock offsets: " + ", ".join(offsets))
+    desync = [a for a in mon.anomalies if a.get("kind") == "desync"]
+    if mon.anomalies:
+        recent = mon.anomalies[-3:]
+        described = ", ".join(
+            f"{a.get('kind', '?')}@p{a.get('process_index', 0)}"
+            + (f" step {a['step']}" if a.get("step") is not None else "")
+            for a in recent
+        )
+        lines.append(
+            f"  anomalies: {len(mon.anomalies)} — {described}"
+            f" | desync: {'YES' if desync else 'none'}"
+        )
+    else:
+        lines.append("  anomalies: none | desync: none")
+    if mon.malformed:
+        lines.append(
+            f"  MALFORMED event lines: {len(mon.malformed)} "
+            f"(first: {mon.malformed[0]})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.monitor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("run_dir", help="directory holding events JSONL file(s)")
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render one snapshot and exit (nonzero on malformed event lines)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=5.0,
+        help="refresh period in seconds (follow mode; default 5)",
+    )
+    ap.add_argument(
+        "--refreshes", type=int, default=0,
+        help="stop after N refreshes (0 = until every process writes run_end)",
+    )
+    args = ap.parse_args(argv)
+    mon = RunMonitor(args.run_dir)
+
+    if args.once:
+        mon.poll()
+        print(render(mon))
+        if mon.malformed:
+            import sys
+
+            for line in mon.malformed:
+                print(f"malformed event line: {line}", file=sys.stderr)
+            return 1
+        return 0
+
+    refreshes = 0
+    try:
+        while True:
+            mon.poll()
+            print(render(mon))
+            print()
+            refreshes += 1
+            if mon.finished:
+                print("all processes wrote run_end — done")
+                return 0
+            if args.refreshes and refreshes >= args.refreshes:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

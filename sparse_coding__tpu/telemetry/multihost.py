@@ -1,0 +1,471 @@
+"""Pod-scale telemetry: per-process event logs that merge into one story.
+
+PR 2/3 built single-process observability (`events.RunTelemetry`,
+`profiling`, the report CLI). On a multi-host run every host is its own
+Python process with its own clock and its own disk writes, so this module
+adds the pod layer (docs/observability.md §5):
+
+  - **Per-process log layout.** `RunTelemetry` consults `process_info()` at
+    construction: in a multi-host run (`jax.process_count() > 1`) the event
+    file becomes ``events.p<i>.jsonl`` and every record is tagged
+    ``process_index`` — so merged timelines, anomalies, and compile events
+    all know their originating host. Single-host runs keep today's layout
+    (``events.jsonl``, untagged) bit-for-bit.
+  - **Clock alignment** (`estimate_clock_offset` / `clock_state`). Host
+    wall clocks disagree; merged timelines need a common axis. At
+    `parallel.distributed.initialize_distributed()` (and periodically at
+    flush boundaries — see `heartbeat`) every host publishes its
+    ``time.time()`` and records ``offset = local_receive −
+    coordinator_send`` with the local round-trip as the uncertainty. A
+    cheap estimate — good to exchange-latency resolution, which is
+    exactly the resolution merged flush-boundary events need.
+  - **Heartbeats + straggler skew** (`heartbeat`). At each flush boundary
+    the drivers call `heartbeat(telemetry, step=..., window_seconds=...)`:
+    one small all-host exchange of the per-host window wall time yields
+    the flush-window skew (max−min across hosts), emitted as
+    ``skew.flush.*`` gauges and a ``heartbeat`` event per host. Exchanges
+    run ONLY at flush boundaries (never in the hot loop) and only when
+    ``process_count > 1``; the SPMD drivers hit boundaries in lockstep, so
+    the exchange rounds always match up.
+  - **Desync detection** (`check_desync`). A pod where hosts disagree on
+    code version, jax version, backend, or run config is silently broken
+    long before it crashes. At run start the drivers digest a comparable
+    fingerprint subset + the run config, exchange the digests, and any
+    mismatch against the coordinator becomes a hard ``desync`` anomaly
+    event (plus `AnomalyAbort` under ``action="abort"``). The merged
+    report diffs the actual fingerprint fields offline.
+
+**Transport.** All cross-host exchanges ride jax's distributed
+coordination service (the KV store every `jax.distributed.initialize`
+process already holds) — pure host-side string puts/gets, NO device
+computation and no XLA collective. That keeps telemetry off the ICI/DCN
+data path entirely, makes "zero extra device syncs" literal, and works on
+backends (like the simulated-pod CPU+gloo harness) where cross-process
+XLA computations are unavailable. Exchange rounds are matched by a
+per-tag call counter, so every host must reach the same call sites in the
+same order — true for the SPMD drivers, whose flush boundaries are
+already pod-wide sync points.
+
+Offline halves (`chunk_skew_windows`, `fingerprint_diff`) are pure
+functions over parsed event records — `telemetry.report` and
+`telemetry.monitor` share them, and they need no jax at all.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "process_info",
+    "per_process_file_name",
+    "estimate_clock_offset",
+    "clock_state",
+    "heartbeat",
+    "check_desync",
+    "comparable_fingerprint",
+    "chunk_skew_windows",
+    "fingerprint_diff",
+    "format_bytes",
+    "PROC_FILE_RE",
+]
+
+# the per-process log-name suffix (`per_process_file_name`); report and
+# monitor share this to recover a record's host from its filename when the
+# record itself is untagged (older telemetry versions)
+PROC_FILE_RE = re.compile(r"\.p(\d+)\.jsonl$")
+
+
+def format_bytes(v) -> str:
+    """Human bytes for report/monitor tables; '-' for None/non-numeric."""
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.2f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    return "-"  # pragma: no cover
+
+# fingerprint keys that must agree across a pod; everything else
+# (process_index, compile-cache entry counts, clock fields) is legitimately
+# per-host
+COMPARABLE_FINGERPRINT_KEYS = (
+    "python", "jax", "jaxlib", "backend", "device_kind", "device_count",
+    "process_count", "git_sha", "mesh",
+)
+
+# re-estimate the clock offset every Nth heartbeat (count-based, NOT
+# time-based: hosts must decide identically or the exchange rounds skew)
+CLOCK_RESYNC_EVERY_ENV = "SC_CLOCK_RESYNC_EVERY"
+_CLOCK_RESYNC_DEFAULT = 16
+
+# how long one host waits for the others' KV payloads before giving up on
+# that exchange round (a missed heartbeat, not a crash)
+TIMEOUT_MS_ENV = "SC_MH_TIMEOUT_MS"
+_TIMEOUT_MS_DEFAULT = 60_000
+
+# module state: the most recent clock-offset estimate for this process
+_CLOCK: Dict[str, float] = {}
+
+# per-tag exchange round counters (matched across hosts by SPMD lockstep)
+_ROUNDS: Dict[str, int] = {}
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count), best-effort: (0, 1) whenever jax is
+    unavailable or the backend refuses — telemetry must never fail a run."""
+    try:
+        import jax
+
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
+
+
+def per_process_file_name(base: str, index: int, count: int) -> str:
+    """``events.jsonl`` -> ``events.p<i>.jsonl`` in a pod; unchanged
+    single-host (the acceptance contract: single-host layout is stable)."""
+    if count <= 1:
+        return base
+    stem, dot, ext = base.rpartition(".")
+    if not dot:
+        return f"{base}.p{index}"
+    return f"{stem}.p{index}.{ext}"
+
+
+# -- KV-store exchange primitive ----------------------------------------------
+
+def _coord_client():
+    """jax's distributed-coordination client (present on every process after
+    `jax.distributed.initialize`), or None outside a pod. Private jax
+    surface, so access is defensive — telemetry degrades, runs never
+    fail."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def _timeout_ms() -> int:
+    try:
+        return int(os.environ.get(TIMEOUT_MS_ENV, _TIMEOUT_MS_DEFAULT))
+    except ValueError:
+        return _TIMEOUT_MS_DEFAULT
+
+
+def _kv_allgather(tag: str, payload: str) -> Optional[List[str]]:
+    """All-host exchange of one small string per host, through the
+    coordination-service KV store: host i sets ``sc_mh/<tag>/<round>/<i>``
+    then blocking-gets every host's key. Pure host-side I/O — no device,
+    no XLA. Rounds are numbered per tag so repeated exchanges at the same
+    call site pair up across hosts (requires SPMD-lockstep call order —
+    the flush-boundary contract). Returns the per-process payload list, or
+    None single-host / when the exchange is unavailable or times out."""
+    idx, count = process_info()
+    if count <= 1:
+        return None
+    client = _coord_client()
+    if client is None:
+        return None
+    n = _ROUNDS.get(tag, 0)
+    _ROUNDS[tag] = n + 1
+    timeout = _timeout_ms()
+    try:
+        client.key_value_set(f"sc_mh/{tag}/{n}/{idx}", payload)
+        return [
+            client.blocking_key_value_get(f"sc_mh/{tag}/{n}/{p}", timeout)
+            for p in range(count)
+        ]
+    except Exception:
+        return None
+
+
+# -- clock alignment ----------------------------------------------------------
+
+def estimate_clock_offset() -> Optional[Dict[str, float]]:
+    """One clock probe; returns (and stashes in `clock_state`)
+
+        {"offset_seconds":      local clock minus coordinator clock,
+         "uncertainty_seconds": how long this host blocked for the value,
+         "measured_at":         local time.time() of the measurement}
+
+    Asymmetric by construction: the coordinator publishes its
+    ``time.time()`` to the KV store and is pinned to offset **0.0** (it IS
+    the reference clock); every other host times the blocking fetch of that
+    key and records ``offset = fetch_return − coordinator_send``. A host
+    that arrives *before* the coordinator blocks until the key lands, so
+    its estimate is tight to KV transit; a host arriving *after* absorbs
+    the arrival skew into the offset — ``uncertainty_seconds`` (the wall
+    spent blocked) disambiguates: a long block means a tight estimate.
+    Good to call-site-skew resolution, which is all a merged
+    flush-boundary timeline needs. None (and no state update) single-host
+    or on any failure. Matched probe: call it only where every process
+    calls it too (init, count-based heartbeat resync) — never in the hot
+    loop.
+    """
+    idx, count = process_info()
+    if count <= 1:
+        return None
+    client = _coord_client()
+    if client is None:
+        return None
+    n = _ROUNDS.get("clock", 0)
+    _ROUNDS["clock"] = n + 1
+    key = f"sc_mh/clock/{n}/0"
+    try:
+        if idx == 0:
+            now = time.time()
+            client.key_value_set(key, repr(now))
+            est = {
+                "offset_seconds": 0.0,
+                "uncertainty_seconds": 0.0,
+                "measured_at": now,
+            }
+        else:
+            t_before = time.time()
+            coord_sent = float(client.blocking_key_value_get(key, _timeout_ms()))
+            t_after = time.time()
+            est = {
+                "offset_seconds": round(t_after - coord_sent, 6),
+                "uncertainty_seconds": round(t_after - t_before, 6),
+                "measured_at": t_after,
+            }
+    except Exception:
+        return None
+    _CLOCK.clear()
+    _CLOCK.update(est)
+    return est
+
+
+def clock_state() -> Optional[Dict[str, float]]:
+    """The most recent `estimate_clock_offset` result for this process, or
+    None when never measured (single-host runs)."""
+    return dict(_CLOCK) if _CLOCK else None
+
+
+# -- heartbeats + straggler skew ----------------------------------------------
+
+def heartbeat(
+    telemetry,
+    step: Optional[int] = None,
+    window_seconds: Optional[float] = None,
+) -> Optional[Dict[str, Any]]:
+    """Flush-boundary host heartbeat. No-op single-host (layout stability).
+
+    In a pod: exchanges the per-host flush-window wall time (one tiny
+    KV-store round — the boundary is already a sync point for SPMD
+    drivers, and no device is touched), sets the straggler gauges
+
+        skew.flush.max_seconds / min_seconds / spread_seconds
+
+    (identical on every host, post-exchange), and emits a ``heartbeat``
+    event carrying the local cumulative step counter, the per-host window
+    times, and the current clock-offset estimate — the monitor's liveness
+    and live-throughput signal. Every `SC_CLOCK_RESYNC_EVERY` (default 16)
+    calls the clock offset is re-estimated (count-based so all hosts
+    re-enter the exchange together).
+
+    ``window_seconds`` is the host-local wall time of the window just
+    closed (e.g. `chunk_end`'s seconds); when omitted it is measured as
+    time since this telemetry's previous heartbeat. Returns the event
+    record, or None single-host / on exchange failure.
+    """
+    idx, count = process_info()
+    if count <= 1 or telemetry is None:
+        return None
+    now = time.time()
+    last = getattr(telemetry, "_mh_last_heartbeat_t", None)
+    if window_seconds is None:
+        window_seconds = (now - last) if last is not None else 0.0
+    telemetry._mh_last_heartbeat_t = now
+    n_beats = getattr(telemetry, "_mh_heartbeats", 0) + 1
+    telemetry._mh_heartbeats = n_beats
+
+    resync_every = _CLOCK_RESYNC_DEFAULT
+    try:
+        resync_every = int(os.environ.get(CLOCK_RESYNC_EVERY_ENV, resync_every))
+    except ValueError:
+        pass
+    if resync_every > 0 and n_beats % resync_every == 0:
+        estimate_clock_offset()
+
+    raw = _kv_allgather("heartbeat", repr(float(window_seconds)))
+    if raw is None:
+        return None
+    try:
+        windows = [float(v) for v in raw]
+    except ValueError:
+        return None
+    w_max, w_min = max(windows), min(windows)
+    telemetry.gauge_set("skew.flush.max_seconds", round(w_max, 4))
+    telemetry.gauge_set("skew.flush.min_seconds", round(w_min, 4))
+    telemetry.gauge_set("skew.flush.spread_seconds", round(w_max - w_min, 4))
+    telemetry.counter_inc("heartbeats")
+    clock = clock_state() or {}
+    return telemetry.event(
+        "heartbeat",
+        step=int(step) if step is not None else None,
+        steps=int(telemetry.counters.get("train.steps", 0)),
+        window_seconds=round(float(window_seconds), 4),
+        window_seconds_by_process=[round(float(w), 4) for w in windows],
+        skew_seconds=round(w_max - w_min, 4),
+        clock_offset_seconds=clock.get("offset_seconds"),
+        clock_uncertainty_seconds=clock.get("uncertainty_seconds"),
+    )
+
+
+# -- desync detection ---------------------------------------------------------
+
+def comparable_fingerprint(config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The fingerprint subset every pod host must agree on, plus the run
+    config — the digest input for `check_desync` and the diff basis for the
+    merged report."""
+    from sparse_coding__tpu.telemetry.events import run_fingerprint
+
+    fp = run_fingerprint()
+    out = {k: fp[k] for k in COMPARABLE_FINGERPRINT_KEYS if k in fp}
+    if config is not None:
+        out["config"] = config
+    return out
+
+
+def _digest(payload: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
+def check_desync(
+    telemetry=None,
+    config: Optional[Dict[str, Any]] = None,
+    action: str = "warn",
+) -> Optional[List[int]]:
+    """Cross-host config/environment agreement check (run-start boundary).
+
+    Digests `comparable_fingerprint(config)`, exchanges the digests through
+    the KV store, and compares every host against the coordinator (process
+    0). On mismatch: a hard ``desync`` anomaly event (tagged with this
+    process via the record-level ``process_index``), a `RuntimeWarning`,
+    and — under ``action="abort"`` — an `AnomalyAbort` so the driver can
+    stop before wasting pod hours on a split-brained run.
+
+    Returns the sorted list of mismatching process indices ([] = healthy),
+    or None single-host / when the exchange is unavailable. Matched
+    exchange: call at identical points on every host (the drivers call it
+    right after `run_start`).
+    """
+    if action not in ("warn", "abort"):
+        raise ValueError(f"unknown desync action {action!r}")
+    idx, count = process_info()
+    if count <= 1:
+        return None
+    local = _digest(comparable_fingerprint(config))
+    digests = _kv_allgather("desync", local)
+    if digests is None:
+        return None
+    reference = digests[0]
+    mismatched = sorted(p for p in range(count) if digests[p] != reference)
+    if not mismatched:
+        return []
+    desc = (
+        f"desync: processes {mismatched} disagree with the coordinator's "
+        f"config/environment fingerprint (local p{idx} "
+        f"{'matches' if idx not in mismatched else 'MISMATCHES'})"
+    )
+    if telemetry is not None:
+        telemetry.anomaly(
+            "desync",
+            processes=mismatched,
+            local_digest=local,
+            reference_digest=reference,
+            local_match=idx not in mismatched,
+            action=action,
+        )
+    warnings.warn(desc, RuntimeWarning)
+    if action == "abort":
+        from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort
+
+        raise AnomalyAbort(desc)
+    return mismatched
+
+
+# -- offline halves (no jax): shared by report + monitor ----------------------
+
+def chunk_skew_windows(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-window cross-host chunk-time skew from merged `chunk_end` events.
+
+    Windows are keyed by ``(epoch, chunk, position)`` (absent fields are
+    None — the drivers' chunk ids line up across hosts because the chunk
+    schedule is seed-derived and identical pod-wide). Only windows covered
+    by ≥2 distinct processes produce a row::
+
+        {"key": (...), "seconds": {proc: s, ...}, "max": s, "min": s,
+         "spread": s}
+
+    sorted in first-seen order. Re-emitted windows (restarts) keep the last
+    observation per process.
+    """
+    windows: Dict[tuple, Dict[int, float]] = {}
+    order: List[tuple] = []
+    for e in events:
+        if e.get("event") != "chunk_end" or "seconds" not in e:
+            continue
+        key = (e.get("epoch"), e.get("chunk"), e.get("position"))
+        proc = int(e.get("process_index", 0))
+        if key not in windows:
+            windows[key] = {}
+            order.append(key)
+        windows[key][proc] = float(e["seconds"])
+    out = []
+    for key in order:
+        secs = windows[key]
+        if len(secs) < 2:
+            continue
+        vals = list(secs.values())
+        out.append(
+            {
+                "key": key,
+                "seconds": secs,
+                "max": max(vals),
+                "min": min(vals),
+                "spread": max(vals) - min(vals),
+            }
+        )
+    return out
+
+
+def fingerprint_diff(
+    run_starts: Sequence[Dict[str, Any]],
+) -> Dict[str, Dict[int, Any]]:
+    """Offline desync attribution: given merged ``run_start`` events, return
+    ``{field: {process: value}}`` for every comparable fingerprint field (or
+    config) on which the hosts disagree — the human-readable counterpart of
+    `check_desync`'s digest mismatch. Empty dict = all hosts agree."""
+    per_proc: Dict[int, Dict[str, Any]] = {}
+    for s in run_starts:
+        proc = int(s.get("process_index", 0))
+        fp = s.get("fingerprint") or {}
+        row = {k: fp.get(k) for k in COMPARABLE_FINGERPRINT_KEYS}
+        row["config"] = s.get("config")
+        per_proc[proc] = row
+    if len(per_proc) < 2:
+        return {}
+    diff: Dict[str, Dict[int, Any]] = {}
+    fields = set()
+    for row in per_proc.values():
+        fields.update(row)
+    for f in sorted(fields):
+        vals = {p: per_proc[p].get(f) for p in sorted(per_proc)}
+        canon = {p: json.dumps(v, sort_keys=True, default=str) for p, v in vals.items()}
+        if len(set(canon.values())) > 1:
+            diff[f] = vals
+    return diff

@@ -280,7 +280,12 @@ def device_memory_stats(device) -> Optional[Dict[str, int]]:
 
 def hbm_watermarks(devices=None) -> Dict[str, Dict[str, int]]:
     """Per-device watermark dict ``{"d0": {"bytes_in_use": ..., ...}, ...}``
-    for every local device that reports memory stats (possibly empty)."""
+    for every local device that reports memory stats (possibly empty).
+
+    Multi-host runs key by ``p<proc>.d<global_id>`` instead of the local
+    enumeration index: per-process event logs merge into one report, and
+    two hosts' local ``d0`` gauges must not collide there (ISSUE 4).
+    Single-host keys stay ``d<i>`` — layout stability."""
     if devices is None:
         try:
             import jax
@@ -288,16 +293,21 @@ def hbm_watermarks(devices=None) -> Dict[str, Dict[str, int]]:
             devices = jax.local_devices()
         except Exception:
             return {}
+    from sparse_coding__tpu.telemetry.multihost import process_info
+
+    pidx, pcount = process_info()
     out: Dict[str, Dict[str, int]] = {}
     for i, d in enumerate(devices):
         stats = device_memory_stats(d)
         if stats:
-            out[f"d{i}"] = stats
+            key = f"p{pidx}.d{getattr(d, 'id', i)}" if pcount > 1 else f"d{i}"
+            out[key] = stats
     return out
 
 
 def record_hbm_watermarks(telemetry, devices=None) -> Dict[str, Dict[str, int]]:
-    """Sample HBM watermarks into `telemetry` gauges (``hbm.d<i>.<field>``).
+    """Sample HBM watermarks into `telemetry` gauges (``hbm.d<i>.<field>``;
+    ``hbm.p<i>.d<j>.<field>`` on multi-host runs — merge-safe).
 
     A flush-boundary act: reading memory_stats is a host-side query — it
     fences nothing and materializes no jax.Array, so it is legal inside

@@ -1,12 +1,19 @@
 """Run report: render a run directory's JSONL artifacts into one summary.
 
 ``python -m sparse_coding__tpu.report <run_dir>`` reads every
-``events.jsonl`` / ``*_events.jsonl`` and ``metrics.jsonl`` /
-``*_metrics.jsonl`` under the run directory and prints a markdown summary:
-run fingerprint, compile and throughput stats, a per-model table of final
-metric values (loss family, FVU/L0 when logged, the ``health_*`` pack), and
-the anomaly timeline. Every bench/parity/sweep artifact becomes
-self-describing — no re-running studies to learn what a run did.
+``events.jsonl`` / ``events.p<i>.jsonl`` / ``*_events.jsonl`` and
+``metrics.jsonl`` / ``*_metrics.jsonl`` under the run directory and prints
+a markdown summary: run fingerprint, compile and throughput stats, a
+per-model table of final metric values (loss family, FVU/L0 when logged,
+the ``health_*`` pack), and the anomaly timeline. Every bench/parity/sweep
+artifact becomes self-describing — no re-running studies to learn what a
+run did.
+
+Multi-host run dirs (per-process ``events.p<i>.jsonl``, every record
+tagged ``process_index`` — `telemetry.multihost`) merge into ONE summary
+with an extra **Pod / multi-host** section: per-host throughput/compile/
+HBM rows, flush-window straggler skew, clock offsets, and an offline
+fingerprint diff when hosts disagree. Single-host output is unchanged.
 
 Use ``--out report.md`` to also write the summary next to the artifacts.
 """
@@ -18,6 +25,11 @@ import json
 from collections import OrderedDict
 from pathlib import Path
 from typing import Any, Dict, List, Optional
+
+from sparse_coding__tpu.telemetry.multihost import (
+    PROC_FILE_RE as _PROC_FILE_RE,
+    format_bytes as _bytes,
+)
 
 __all__ = ["load_run", "render_markdown", "main"]
 
@@ -49,14 +61,28 @@ def load_run(run_dir) -> Dict[str, Any]:
     if not d.is_dir():
         raise FileNotFoundError(f"run dir {d} does not exist")
     event_files = sorted(
-        {p for p in list(d.rglob("events.jsonl")) + list(d.rglob("*_events.jsonl"))}
+        {
+            p
+            for p in list(d.rglob("events.jsonl"))
+            + list(d.rglob("events.p*.jsonl"))
+            + list(d.rglob("*_events.jsonl"))
+            # per-process form of custom file_name= logs (bench_events.p0.jsonl)
+            + list(d.rglob("*_events.p*.jsonl"))
+        }
     )
     metric_files = sorted(
         {p for p in list(d.rglob("metrics.jsonl")) + list(d.rglob("*_metrics.jsonl"))}
     )
     events: List[Dict[str, Any]] = []
     for p in event_files:
-        events.extend(_read_jsonl(p))
+        recs = _read_jsonl(p)
+        # records normally carry their own process_index tag; the filename
+        # backstops logs written by older telemetry versions
+        m = _PROC_FILE_RE.search(p.name)
+        if m is not None:
+            for r in recs:
+                r.setdefault("process_index", int(m.group(1)))
+        events.extend(recs)
     metrics: List[Dict[str, Any]] = []
     for p in metric_files:
         metrics.extend(_read_jsonl(p))
@@ -83,6 +109,44 @@ def _events_of(run, kind: str) -> List[Dict[str, Any]]:
     return [e for e in run["events"] if e.get("event") == kind]
 
 
+def _processes(run) -> List[Any]:
+    """Distinct process indices present (``[None]`` for single-host logs)."""
+    seen: List[Any] = []
+    for e in run["events"]:
+        p = e.get("process_index")
+        if p not in seen:
+            seen.append(p)
+    return sorted(seen, key=lambda p: (-1 if p is None else int(p)))
+
+
+def _last_snapshots(run) -> List[Dict[str, Any]]:
+    """The final snapshot of each process (one element single-host)."""
+    last: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
+    for s in _events_of(run, "snapshot"):
+        last[s.get("process_index")] = s
+    return list(last.values())
+
+
+def _merged_counters(run) -> Dict[str, float]:
+    """Counters summed over each process's last snapshot — single-host this
+    is exactly the old snaps[-1] behavior."""
+    out: Dict[str, float] = {}
+    for s in _last_snapshots(run):
+        for k, v in (s.get("counters") or {}).items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _merged_gauges(run) -> Dict[str, float]:
+    """Union of each process's last-snapshot gauges. Pod gauges either carry
+    a ``p<i>.`` namespace (HBM) or are allgather-identical across hosts
+    (``skew.flush.*``), so the union is collision-free."""
+    out: Dict[str, float] = {}
+    for s in _last_snapshots(run):
+        out.update(s.get("gauges") or {})
+    return out
+
+
 def _fingerprint_section(run, lines: List[str]):
     starts = _events_of(run, "run_start")
     lines.append("## Run fingerprint")
@@ -91,6 +155,16 @@ def _fingerprint_section(run, lines: List[str]):
         lines.append("_(no run_start event)_")
         lines.append("")
         return
+    procs = {s.get("process_index") for s in starts}
+    if len(procs) > 1:
+        # merged pod logs: one fingerprint per host is noise — show the
+        # coordinator's and let the Pod section diff any disagreement
+        coord = [s for s in starts if s.get("process_index") in (0, None)]
+        starts = coord[:1] or starts[:1]
+        lines.append(
+            f"_Merged pod run: {len(procs)} processes; coordinator "
+            "fingerprint below, cross-host diffs in the Pod section._"
+        )
     for s in starts:
         fp = s.get("fingerprint") or {}
         lines.append(f"- **run**: {s.get('run_name', '?')}")
@@ -116,8 +190,7 @@ def _compile_section(run, lines: List[str]):
     lines.append("## Compile activity")
     lines.append("")
     compiles = _events_of(run, "compile")
-    snaps = _events_of(run, "snapshot")
-    counters = snaps[-1].get("counters", {}) if snaps else {}
+    counters = _merged_counters(run)
     by_name: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
     for c in compiles:
         d = by_name.setdefault(c.get("name", "?"), {"count": 0, "seconds": 0.0})
@@ -149,18 +222,6 @@ def _compile_section(run, lines: List[str]):
     if not by_name and total_n is None and not cache:
         lines.append("_(no compile events recorded)_")
     lines.append("")
-
-
-def _bytes(v) -> str:
-    try:
-        v = float(v)
-    except (TypeError, ValueError):
-        return "-"
-    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
-        if abs(v) < 1024 or unit == "TiB":
-            return f"{v:.2f} {unit}" if unit != "B" else f"{int(v)} B"
-        v /= 1024
-    return "-"  # pragma: no cover
 
 
 def _perf_section(run, lines: List[str]):
@@ -226,13 +287,13 @@ def _perf_section(run, lines: List[str]):
             lines.append("")
         wrote = True
 
-    # HBM watermarks from the last snapshot's gauges
-    snaps = _events_of(run, "snapshot")
-    gauges = snaps[-1].get("gauges", {}) if snaps else {}
+    # HBM watermarks from the last snapshot's gauges (per process, merged);
+    # keys are `hbm.d<i>.<field>` single-host, `hbm.p<i>.d<j>.<field>` pods
+    gauges = _merged_gauges(run)
     marks: Dict[str, Dict[str, float]] = {}
     for k, v in gauges.items():
         if k.startswith("hbm."):
-            _, dev, field = k.split(".", 2)
+            dev, field = k[len("hbm."):].rsplit(".", 1)
             marks.setdefault(dev, {})[field] = v
     if marks:
         lines.append("| device | HBM in use | peak in use | limit | OOM headroom |")
@@ -270,6 +331,141 @@ def _perf_section(run, lines: List[str]):
         lines.append("")
 
 
+def _pod_section(run, lines: List[str]):
+    """Merged multi-host view: per-host rows, straggler skew, clock offsets,
+    desync attribution. Emitted ONLY when ≥2 processes appear in the logs —
+    single-host report output is a stability contract."""
+    procs = [p for p in _processes(run) if p is not None]
+    if len(procs) < 2:
+        return
+    from sparse_coding__tpu.telemetry.multihost import (
+        chunk_skew_windows,
+        fingerprint_diff,
+    )
+
+    lines.append("## Pod / multi-host")
+    lines.append("")
+
+    per_snap = {s.get("process_index"): s for s in _last_snapshots(run)}
+    ends = {e.get("process_index"): e for e in _events_of(run, "run_end")}
+    chunk_ends = _events_of(run, "chunk_end")
+    lines.append(
+        "| host | steps | steps/s | wall s | chunks | mean chunk s "
+        "| backend compiles | compile s | HBM peak | status |"
+    )
+    lines.append("|---|---:|---:|---:|---:|---:|---:|---:|---:|---|")
+    for p in procs:
+        end = ends.get(p, {})
+        counters = (per_snap.get(p) or {}).get("counters", {})
+        gauges = (per_snap.get(p) or {}).get("gauges", {})
+        secs = [
+            float(c.get("seconds", 0.0))
+            for c in chunk_ends
+            if c.get("process_index") == p and "seconds" in c
+        ]
+        peaks = [
+            v for k, v in gauges.items()
+            if k.startswith("hbm.") and k.endswith(".peak_bytes_in_use")
+        ]
+        steps = end.get("steps", counters.get("train.steps"))
+        lines.append(
+            f"| p{p} "
+            f"| {_fmt(int(steps) if steps is not None else None)} "
+            f"| {_fmt(end.get('steps_per_sec'))} "
+            f"| {_fmt(end.get('wall_seconds'))} "
+            f"| {len(secs)} "
+            f"| {_fmt(sum(secs) / len(secs) if secs else None)} "
+            f"| {_fmt(counters.get('compile.backend.count'))} "
+            f"| {_fmt(counters.get('compile.backend.seconds'))} "
+            f"| {_bytes(max(peaks)) if peaks else '-'} "
+            f"| {end.get('status', 'running')} |"
+        )
+    lines.append("")
+
+    lines.append("### Straggler skew")
+    lines.append("")
+    wrote = False
+    gauges = _merged_gauges(run)
+    if "skew.flush.spread_seconds" in gauges:
+        lines.append(
+            f"- last flush window: spread **{_fmt(gauges['skew.flush.spread_seconds'])} s** "
+            f"(max {_fmt(gauges.get('skew.flush.max_seconds'))} s, "
+            f"min {_fmt(gauges.get('skew.flush.min_seconds'))} s across hosts)"
+        )
+        wrote = True
+    windows = chunk_skew_windows(run["events"])
+    if windows:
+        spreads = [w["spread"] for w in windows]
+        worst = max(windows, key=lambda w: w["spread"])
+        by_host = ", ".join(
+            f"p{p}={worst['seconds'][p]:.3g}s" for p in sorted(worst["seconds"])
+        )
+        epoch, chunk, _pos = worst["key"]
+        where = f"chunk {chunk}" + ("" if epoch is None else f" (epoch {epoch})")
+        lines.append(
+            f"- {len(windows)} chunk windows with ≥2 hosts: mean skew "
+            f"{sum(spreads) / len(spreads):.3g} s, worst "
+            f"**{worst['spread']:.3g} s** at {where} ({by_host})"
+        )
+        wrote = True
+    if not wrote:
+        lines.append("_(no skew gauges or multi-host chunk windows recorded)_")
+    lines.append("")
+
+    beats: Dict[Any, Dict[str, Any]] = {}
+    for h in _events_of(run, "heartbeat"):
+        if h.get("clock_offset_seconds") is not None:
+            beats[h.get("process_index")] = h
+    if beats:
+        lines.append(
+            "Clock offsets vs coordinator: "
+            + ", ".join(
+                f"p{p} {beats[p]['clock_offset_seconds']:+.3f} s"
+                + (
+                    f" (±{beats[p]['clock_uncertainty_seconds']:.3f})"
+                    if beats[p].get("clock_uncertainty_seconds") is not None
+                    else ""
+                )
+                for p in sorted(beats)
+            )
+            + "."
+        )
+        lines.append("")
+
+    desync_events = [
+        a for a in _events_of(run, "anomaly") if a.get("kind") == "desync"
+    ]
+    diff = fingerprint_diff(_events_of(run, "run_start"))
+    if desync_events or diff:
+        lines.append(
+            f"### ⚠ Desync ({len(desync_events)} event(s) recorded)"
+        )
+        lines.append("")
+        if diff:
+            lines.append("Hosts disagree on:")
+            lines.append("")
+            lines.append("| field | " + " | ".join(f"p{p}" for p in sorted(diff[next(iter(diff))])) + " |")
+            lines.append("|---|" + "---|" * len(diff[next(iter(diff))]))
+            for field, vals in diff.items():
+                lines.append(
+                    f"| {field} | "
+                    + " | ".join(
+                        f"`{json.dumps(vals[p], default=str)[:60]}`"
+                        for p in sorted(vals)
+                    )
+                    + " |"
+                )
+        else:
+            lines.append(
+                "_Digest mismatch detected live, but merged run_start "
+                "fingerprints agree on the comparable fields — check configs._"
+            )
+        lines.append("")
+    else:
+        lines.append("Desync: none — all hosts agree on config/environment.")
+        lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -278,6 +474,8 @@ def _throughput_section(run, lines: List[str]):
     wrote = False
     for e in ends:
         bits = [f"status **{e.get('status', '?')}**"]
+        if e.get("process_index") is not None:
+            bits.insert(0, f"**p{e['process_index']}**")
         if "steps" in e:
             bits.append(f"{e['steps']} steps")
         if e.get("steps_per_sec") is not None:
@@ -354,11 +552,16 @@ def _anomaly_section(run, lines: List[str]):
         lines.append("_No anomalies recorded._")
         lines.append("")
         return
-    lines.append("| step | kind | models | action | bundle |")
-    lines.append("|---:|---|---|---|---|")
+    tagged = any(a.get("process_index") is not None for a in anomalies)
+    proc_col = "| proc " if tagged else ""
+    lines.append(f"{proc_col}| step | kind | models | action | bundle |")
+    lines.append(("|---" if tagged else "") + "|---:|---|---|---|---|")
     for a in anomalies:
+        proc = (
+            f"| p{a.get('process_index', '?')} " if tagged else ""
+        )
         lines.append(
-            f"| {_fmt(a.get('step'))} | {a.get('kind', '?')} "
+            f"{proc}| {_fmt(a.get('step'))} | {a.get('kind', '?')} "
             f"| {_fmt(a.get('model_names') or a.get('models'))} "
             f"| {_fmt(a.get('action'))} | {_fmt(a.get('bundle'))} |"
         )
@@ -374,6 +577,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     )
     lines.append("")
     _fingerprint_section(run, lines)
+    _pod_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)
     _throughput_section(run, lines)

@@ -26,6 +26,8 @@ from sparse_coding__tpu.telemetry import (
     AnomalyPolicy,
     RunTelemetry,
     TraceTrigger,
+    check_desync,
+    heartbeat,
     record_hbm_watermarks,
 )
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
@@ -86,16 +88,19 @@ def basic_l1_sweep(
         health=health,
     )
     model_names = [f"l1_{float(a):.2e}" for a in l1_values]
+    run_config = dict(
+        dataset_folder=str(dataset_folder), activation_width=activation_width,
+        l1_values=[float(a) for a in l1_values], dict_ratio=dict_ratio,
+        dict_size=dict_size, batch_size=batch_size, n_epochs=n_epochs,
+        lr=lr, fista_iters=fista_iters, fista_tol=fista_tol, seed=seed,
+    )
     telemetry = RunTelemetry(
-        out_dir=output_folder, run_name="basic_l1_sweep",
-        config=dict(
-            dataset_folder=str(dataset_folder), activation_width=activation_width,
-            l1_values=[float(a) for a in l1_values], dict_ratio=dict_ratio,
-            dict_size=dict_size, batch_size=batch_size, n_epochs=n_epochs,
-            lr=lr, fista_iters=fista_iters, fista_tol=fista_tol, seed=seed,
-        ),
+        out_dir=output_folder, run_name="basic_l1_sweep", config=run_config,
     )
     telemetry.run_start()
+    # pod runs: hosts disagreeing on config/environment is a hard anomaly,
+    # caught before any training is wasted (no-op single-host)
+    check_desync(telemetry, config=run_config)
     # triggered trace capture: SC_TRACE_WINDOW="N:M" (steps) arms a profiler
     # window; the guard's first anomaly arms one automatically — the trace
     # dir lands in the event log and the diagnostic bundle
@@ -144,7 +149,7 @@ def basic_l1_sweep(
                     telemetry=telemetry,
                 )
                 timer.tick()  # one tick per chunk pass; fenced at run_end
-                telemetry.chunk_end(
+                end_rec = telemetry.chunk_end(
                     int(chunk_idx), epoch=epoch, position=pos,
                     steps=chunk.shape[0] // batch_size,
                 )
@@ -152,7 +157,13 @@ def basic_l1_sweep(
                 # (host-side query, zero device syncs) + trace-window arming
                 # on the cumulative step count
                 record_hbm_watermarks(telemetry)
-                trigger.on_step(int(telemetry.counters.get("train.steps", 0)))
+                cum_steps = int(telemetry.counters.get("train.steps", 0))
+                trigger.on_step(cum_steps)
+                # pod heartbeat + straggler-skew gauges (no-op single-host;
+                # one tiny allgather at a boundary that is already a pod
+                # sync point — the hot loop stays collective-free)
+                heartbeat(telemetry, step=cum_steps,
+                          window_seconds=end_rec.get("seconds"))
                 if save_after_every:
                     learned_dicts = export()
                     # named by training-sequence position (like the reference's

@@ -291,19 +291,25 @@ def _train_big_batch(
                     telemetry.counter_inc("resurrections")
                     telemetry.counter_inc("resurrected_features", int(n_dead))
                     # resurrection is already a host-sync boundary: cheap
-                    # spot for an HBM watermark sample
+                    # spot for an HBM watermark sample + pod heartbeat
+                    # (skew window = wall since the previous heartbeat;
+                    # no-op single-host)
+                    from sparse_coding__tpu.telemetry.multihost import heartbeat
                     from sparse_coding__tpu.telemetry.profiling import record_hbm_watermarks
 
                     record_hbm_watermarks(telemetry)
+                    heartbeat(telemetry, step=i + 1)
                 if n_dead:
                     print(f"step {i+1}: resurrected {n_dead} dead features")
             if telemetry is not None:
                 telemetry.counter_inc("train.steps")
             trace_trigger.on_step(i + 1)  # host-side int compares only
         if telemetry is not None:
+            from sparse_coding__tpu.telemetry.multihost import heartbeat
             from sparse_coding__tpu.telemetry.profiling import record_hbm_watermarks
 
             record_hbm_watermarks(telemetry)
+            heartbeat(telemetry, step=n_steps)
     finally:
         # an exception mid-run must still finalize any in-flight profiler
         # window — a leaked trace blocks every later capture in the process

@@ -38,6 +38,8 @@ from sparse_coding__tpu.telemetry import (
     AnomalyPolicy,
     RunTelemetry,
     TraceTrigger,
+    check_desync,
+    heartbeat,
     record_hbm_watermarks,
 )
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
@@ -303,16 +305,20 @@ def sweep(
     # run telemetry: events.jsonl beside the metrics JSONL makes every sweep
     # self-describing (fingerprint, compile + chunk events, anomalies,
     # run_end) — `python -m sparse_coding__tpu.report <output_folder>`
+    run_config = {
+        k: v
+        for k, v in sorted(getattr(cfg, "__dict__", {}).items())
+        if isinstance(v, (int, float, str, bool, type(None), list, tuple))
+    }
     telemetry = RunTelemetry(
         out_dir=cfg.output_folder,
         run_name=f"sweep_{Path(cfg.output_folder).name}",
-        config={
-            k: v
-            for k, v in sorted(getattr(cfg, "__dict__", {}).items())
-            if isinstance(v, (int, float, str, bool, type(None), list, tuple))
-        },
+        config=run_config,
     )
     telemetry.run_start()
+    # pod runs: a cross-host config/environment mismatch is a hard `desync`
+    # anomaly before any pod hours burn (no-op single-host)
+    check_desync(telemetry, config=run_config)
 
     with timed(telemetry, "dataset_init"):
         store = (
@@ -463,11 +469,15 @@ def sweep(
                 ckpt_lib.save_ensemble_checkpoint(
                     Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
                 )
-            telemetry.chunk_end(i, saved=bool(want_save))
+            end_rec = telemetry.chunk_end(i, saved=bool(want_save))
             # flush-boundary perf attribution: HBM watermark gauges (host
             # query, no device sync) + trace-window arming on train steps
             record_hbm_watermarks(telemetry)
-            trigger.on_step(int(telemetry.counters.get("train.steps", 0)))
+            cum_steps = int(telemetry.counters.get("train.steps", 0))
+            trigger.on_step(cum_steps)
+            # pod heartbeat + straggler-skew gauges (no-op single-host)
+            heartbeat(telemetry, step=cum_steps,
+                      window_seconds=end_rec.get("seconds"))
 
         if not learned_dicts:
             # resumed past the last chunk: export straight from the restored

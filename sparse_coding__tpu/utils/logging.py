@@ -77,7 +77,15 @@ class MetricLogger:
         if self._wandb is None and out_dir is not None:
             path = Path(out_dir)
             path.mkdir(parents=True, exist_ok=True)
-            self._jsonl = open(path / f"{run_name}_metrics.jsonl", "a")
+            # multi-host runs write per-process files on the shared run dir
+            # (interleaved appends from N hosts tear JSONL lines); the
+            # `_p<i>_metrics.jsonl` form still matches the report's
+            # `*_metrics.jsonl` glob. Single-host name unchanged.
+            from sparse_coding__tpu.telemetry.multihost import process_info
+
+            idx, count = process_info()
+            stem = f"{run_name}_p{idx}" if count > 1 else run_name
+            self._jsonl = open(path / f"{stem}_metrics.jsonl", "a")
 
     def log_image(self, step: int, name: str, fig) -> Optional[Path]:
         """Log a matplotlib figure: a wandb image when wandb is live, a PNG

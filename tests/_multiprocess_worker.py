@@ -29,6 +29,17 @@ def main():
     #   sharded over a dict=4 axis that stays WITHIN each host, data=2 axis
     #   crossing the host (DCN) boundary: the real pod layout for dictpar
     #   (VERDICT r4 next #6).
+    # "telemetry": ISSUE-4 pod observability over the same gloo coordination
+    #   layer (per-process events.p<i>.jsonl into the shared run dir
+    #   argv[5], desync check, per-chunk heartbeats + skew, clock offset).
+    #   Training is host-local in this mode: the telemetry exchanges ride
+    #   jax's distributed KV store, which works on CPU+gloo, while
+    #   cross-process XLA computations do not on this jaxlib ("Multiprocess
+    #   computations aren't implemented on the CPU backend") — exactly the
+    #   situation the KV transport exists for. Knobs via env:
+    #   SC_TEST_CHUNK_SLEEP=<s> makes THIS host a straggler (sleeps inside
+    #   each chunk), SC_TEST_DESYNC=1 poisons the run config with the
+    #   process id so hosts deliberately disagree.
     mode = sys.argv[4] if len(sys.argv) > 4 else "default"
     dpp = 8 // n_proc  # devices per simulated host
     os.environ["XLA_FLAGS"] = (
@@ -56,6 +67,10 @@ def main():
     from sparse_coding__tpu.models import FunctionalTiedSAE
     from sparse_coding__tpu.parallel import make_mesh
     from sparse_coding__tpu.parallel.mesh import batch_sharding
+
+    if mode == "telemetry":
+        telemetry_main(proc_id)
+        return
 
     d_act, n_dict, batch, mesh_shape = worker_config(mode)
     ens = build_ensemble(
@@ -89,6 +104,53 @@ def main():
 
     losses = multihost_utils.process_allgather(loss_dict["loss"], tiled=True)
     print("LOSSES=" + ",".join(f"{v:.8f}" for v in np.asarray(losses).reshape(-1)))
+
+
+def telemetry_main(proc_id: int):
+    """ISSUE-4 pod-telemetry drill: host-local training, REAL cross-process
+    telemetry (KV-store clock offset / desync digests / heartbeat skew),
+    per-process logs into the shared run dir."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.telemetry import RunTelemetry, check_desync, heartbeat
+
+    run_dir = sys.argv[5]
+    sleep_s = float(os.environ.get("SC_TEST_CHUNK_SLEEP", "0") or 0.0)
+    d_act, batch = 16, 64
+    cfg = {"mode": "telemetry", "batch": batch, "d_act": d_act}
+    if os.environ.get("SC_TEST_DESYNC"):
+        cfg["poison"] = proc_id  # hosts now deliberately disagree
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": a} for a in (1e-4, 1e-3)],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=d_act,
+        n_dict_components=4 * d_act,
+    )
+    telemetry = RunTelemetry(out_dir=run_dir, run_name="podtest", config=cfg)
+    telemetry.run_start()
+    check_desync(telemetry, config=cfg)  # warn-only: the run continues
+    for step in range(3):
+        telemetry.chunk_start(step)
+        if sleep_s:
+            time.sleep(sleep_s)  # injected straggler
+        batch_arr = jax.random.normal(
+            jax.random.PRNGKey(100 + step), (batch, d_act)
+        )
+        loss_dict, _ = ens.step_batch(batch_arr)
+        jax.block_until_ready(loss_dict["loss"])
+        telemetry.counter_inc("train.steps")
+        end_rec = telemetry.chunk_end(step)
+        heartbeat(telemetry, step=step + 1, window_seconds=end_rec.get("seconds"))
+    telemetry.run_end(status="ok")
+    telemetry.close()
+    print("TELEMETRY_OK")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,200 @@
+"""Monitor CLI + golden pod run_dir smoke (ISSUE 4 satellite).
+
+`tests/golden/pod_run/` is a checked-in two-process event-log fixture
+(regenerate ONLY via `python scripts/make_golden_fixture.py --pod-run`);
+tier-1 runs `monitor --once` and the report against it, so the merge/render
+path cannot silently rot, and a malformed event line must exit nonzero
+instead of crashing mid-parse.
+"""
+
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.monitor import EventTail, RunMonitor, main, render
+
+GOLDEN = Path(__file__).parent / "golden" / "pod_run"
+
+
+def test_golden_fixture_exists():
+    assert (GOLDEN / "events.p0.jsonl").exists()
+    assert (GOLDEN / "events.p1.jsonl").exists()
+
+
+def test_monitor_once_on_golden_fixture(capsys):
+    assert main([str(GOLDEN), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "p0" in out and "p1" in out, "one status line per host"
+    assert "steps" in out and "steps/s" in out
+    assert "skew" in out
+    assert "clock offsets" in out
+    assert "MALFORMED" not in out
+
+
+def test_monitor_once_exits_nonzero_on_malformed_line(tmp_path, capsys):
+    for p in GOLDEN.glob("events.p*.jsonl"):
+        shutil.copy(p, tmp_path / p.name)
+    with open(tmp_path / "events.p0.jsonl", "a") as f:
+        f.write('{"seq": 999, "event": "torn-mid-wri\n')  # complete, unparseable
+    rc = main([str(tmp_path), "--once"])
+    captured = capsys.readouterr()
+    assert rc == 1, "malformed complete line must exit nonzero, not crash"
+    assert "malformed" in captured.err.lower()
+    assert "p1" in captured.out, "the rest of the run must still render"
+
+
+def test_report_on_golden_pod_fixture(capsys):
+    from sparse_coding__tpu.report import main as report_main
+
+    assert report_main([str(GOLDEN)]) == 0
+    out = capsys.readouterr().out
+    assert "Pod / multi-host" in out
+    assert "| p0 |" in out and "| p1 |" in out
+    assert "Straggler skew" in out
+
+
+def test_monitor_missing_dir_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        RunMonitor(tmp_path / "nope")
+
+
+def test_event_tail_buffers_torn_tail(tmp_path):
+    path = tmp_path / "events.p1.jsonl"
+    with open(path, "w") as f:
+        f.write('{"seq": 1, "event": "run_start"}\n{"seq": 2, "ev')
+    tail = EventTail(path)
+    records, malformed = tail.poll()
+    assert len(records) == 1 and not malformed, "torn tail is not malformed"
+    assert records[0]["process_index"] == 1, "filename supplies missing tag"
+    with open(path, "a") as f:
+        f.write('ent": "chunk_end", "chunk": 0, "seconds": 1.0}\n')
+    records, malformed = tail.poll()
+    assert len(records) == 1 and not malformed
+    assert records[0]["event"] == "chunk_end"
+
+
+def test_run_monitor_incremental_follow_state(tmp_path):
+    mon = RunMonitor(tmp_path)
+    mon.poll()
+    assert not mon.procs and not mon.finished
+    for p in (0, 1):
+        with open(tmp_path / f"events.p{p}.jsonl", "w") as f:
+            f.write(json.dumps(
+                {"seq": 1, "ts": 1.0, "event": "run_start", "run_name": "live",
+                 "process_index": p}) + "\n")
+            f.write(json.dumps(
+                {"seq": 2, "ts": 2.0, "event": "heartbeat", "steps": 100,
+                 "process_index": p, "skew_seconds": 0.1}) + "\n")
+    mon.poll()  # discovers both new files mid-flight
+    assert sorted(mon.procs) == [0, 1]
+    assert mon.procs[0].steps == 100 and not mon.finished
+    with open(tmp_path / "events.p0.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"seq": 3, "ts": 4.0, "event": "heartbeat", "steps": 300,
+             "process_index": 0}) + "\n")
+        f.write(json.dumps(
+            {"seq": 4, "ts": 5.0, "event": "run_end", "status": "ok",
+             "steps": 300, "process_index": 0}) + "\n")
+    mon.poll()
+    assert mon.procs[0].steps_per_sec == pytest.approx(100.0)  # (300-100)/(4-2)
+    assert not mon.finished, "p1 has not ended yet"
+    with open(tmp_path / "events.p1.jsonl", "a") as f:
+        f.write(json.dumps(
+            {"seq": 3, "ts": 5.0, "event": "run_end", "status": "ok",
+             "steps": 300, "process_index": 1}) + "\n")
+    mon.poll()
+    assert mon.finished
+    out = render(mon, now=6.0)
+    assert "status ok" in out
+
+
+def test_monitor_renders_single_host_run(tmp_path, capsys):
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    with RunTelemetry(out_dir=str(tmp_path), run_name="solo") as tel:
+        tel.run_start()
+        tel.chunk_start(0)
+        tel.chunk_end(0)
+        tel.counter_inc("train.steps", 8)
+    assert main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "solo" in out and "chunks 1" in out and "steps 8" in out
+
+
+def test_monitor_once_flags_unusable_event_fields(tmp_path, capsys):
+    """Valid JSON with impossible fields (heartbeat without ts) must degrade
+    to a malformed count and exit 1, never a traceback."""
+    with open(tmp_path / "events.p0.jsonl", "w") as f:
+        f.write('{"event": "heartbeat", "steps": 5}\n')
+        f.write(json.dumps(
+            {"seq": 2, "ts": 2.0, "event": "run_end", "status": "ok"}) + "\n")
+    rc = main([str(tmp_path), "--once"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "unusable event" in captured.err
+    assert "status ok" in captured.out, "good records still render"
+
+
+def test_custom_named_pod_logs_are_discovered(tmp_path):
+    """per_process_file_name('bench_events.jsonl', 1, 2) ->
+    bench_events.p1.jsonl must be found by BOTH the report and the
+    monitor."""
+    with open(tmp_path / "bench_events.p1.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"seq": 1, "ts": 1.0, "event": "run_start", "run_name": "b"}) + "\n")
+    from sparse_coding__tpu.telemetry.report import load_run
+
+    run = load_run(tmp_path)
+    assert len(run["event_files"]) == 1
+    assert run["events"][0]["process_index"] == 1, "filename supplies the tag"
+    mon = RunMonitor(tmp_path)
+    mon.poll()
+    assert sorted(mon.procs) == [1]
+
+
+def test_monitor_renders_true_zero_steps_per_sec(tmp_path):
+    """0.0 steps/s is the stalled-host signal — it must render as a rate,
+    not as '-' (unknown)."""
+    with open(tmp_path / "events.p0.jsonl", "w") as f:
+        for seq, ts in ((1, 1.0), (2, 5.0)):
+            f.write(json.dumps(
+                {"seq": seq, "ts": ts, "event": "heartbeat", "steps": 100,
+                 "process_index": 0}) + "\n")
+    mon = RunMonitor(tmp_path)
+    mon.poll()
+    assert mon.procs[0].steps_per_sec == 0.0
+    assert "0.0 steps/s" in render(mon, now=6.0)
+
+
+def test_monitor_anomaly_and_desync_lines(tmp_path, capsys):
+    with open(tmp_path / "events.p0.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"seq": 1, "ts": 1.0, "event": "run_start", "run_name": "sick",
+             "process_index": 0}) + "\n")
+        f.write(json.dumps(
+            {"seq": 2, "ts": 2.0, "event": "anomaly", "kind": "desync",
+             "processes": [1], "process_index": 0}) + "\n")
+    assert main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "desync: YES" in out
+    assert "anomalies: 1" in out
+
+
+@pytest.mark.slow
+def test_monitor_module_entrypoint_subprocess():
+    """`python -m sparse_coding__tpu.monitor --once` end to end (slow: one
+    full interpreter + jax import)."""
+    import subprocess
+    import sys
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.monitor", str(GOLDEN), "--once"],
+        capture_output=True, text=True, cwd=repo, timeout=240,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin",
+             "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "p0" in proc.stdout and "p1" in proc.stdout

@@ -1,0 +1,363 @@
+"""Pod-scale telemetry units (ISSUE 4, docs/observability.md §5).
+
+Single-process CPU tests: the cross-host collectives are monkeypatched so
+the per-process log layout, heartbeat/skew gauges, desync detection, and
+the merged report are all exercised in tier-1. The true two-process gloo
+integration (real allgathers, injected slow host) lives in
+tests/test_multiprocess.py (slow tier).
+"""
+
+import json
+import time
+
+import pytest
+
+from sparse_coding__tpu.telemetry import RunTelemetry, read_events
+from sparse_coding__tpu.telemetry import multihost as mh
+from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort
+from sparse_coding__tpu.telemetry.events import run_fingerprint
+from sparse_coding__tpu.telemetry.profiling import hbm_watermarks
+from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+
+@pytest.fixture(autouse=True)
+def _fresh_multihost_state(monkeypatch):
+    monkeypatch.setattr(mh, "_CLOCK", {})
+    monkeypatch.setattr(mh, "_ROUNDS", {})
+
+
+def _fake_pod(monkeypatch, index=0, count=2):
+    monkeypatch.setattr(mh, "process_info", lambda: (index, count))
+
+
+# -- per-process log layout ---------------------------------------------------
+
+def test_per_process_file_name():
+    assert mh.per_process_file_name("events.jsonl", 0, 1) == "events.jsonl"
+    assert mh.per_process_file_name("events.jsonl", 0, 2) == "events.p0.jsonl"
+    assert mh.per_process_file_name("events.jsonl", 3, 4) == "events.p3.jsonl"
+    assert mh.per_process_file_name("bench_events.jsonl", 1, 2) == "bench_events.p1.jsonl"
+
+
+def test_single_host_layout_unchanged(tmp_path):
+    with RunTelemetry(out_dir=str(tmp_path), run_name="solo") as tel:
+        tel.run_start()
+        tel.chunk_start(0)
+        tel.chunk_end(0)
+    assert (tmp_path / "events.jsonl").exists()
+    events = read_events(tmp_path / "events.jsonl")
+    assert all("process_index" not in e for e in events), (
+        "single-host records must stay untagged (layout stability contract)"
+    )
+
+
+def test_pod_layout_per_process_file_and_tags(tmp_path, monkeypatch):
+    _fake_pod(monkeypatch, index=1, count=2)
+    with RunTelemetry(out_dir=str(tmp_path), run_name="pod") as tel:
+        tel.run_start()
+        tel.anomaly("nonfinite", step=3, models=[0])
+    assert tel.path.name == "events.p1.jsonl"
+    events = read_events(tmp_path / "events.p1.jsonl")
+    assert events, "no events written"
+    assert all(e["process_index"] == 1 for e in events), (
+        "every record (anomalies included) must carry its originating process"
+    )
+
+
+def test_metric_logger_pod_file_suffix(tmp_path, monkeypatch):
+    _fake_pod(monkeypatch, index=1, count=2)
+    from sparse_coding__tpu.utils.logging import MetricLogger
+
+    logger = MetricLogger(out_dir=str(tmp_path), run_name="pod")
+    logger.close()
+    assert (tmp_path / "pod_p1_metrics.jsonl").exists(), (
+        "per-process metrics file must not collide on a shared run dir"
+    )
+
+
+# -- clock offset -------------------------------------------------------------
+
+class _FakeKV:
+    """In-memory stand-in for jax's DistributedRuntimeClient KV store."""
+
+    def __init__(self, store=None):
+        self.store = dict(store or {})
+
+    def key_value_set(self, k, v):
+        self.store[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        if k not in self.store:
+            raise TimeoutError(k)
+        return self.store[k]
+
+
+def test_estimate_clock_offset_single_host_is_none():
+    assert mh.estimate_clock_offset() is None
+    assert mh.clock_state() is None
+
+
+def test_estimate_clock_offset_follower(monkeypatch):
+    _fake_pod(monkeypatch, index=1, count=2)
+    kv = _FakeKV({"sc_mh/clock/0/0": repr(time.time() - 0.25)})
+    monkeypatch.setattr(mh, "_coord_client", lambda: kv)
+    est = mh.estimate_clock_offset()
+    assert est is not None
+    assert est["offset_seconds"] == pytest.approx(0.25, abs=0.05)
+    assert est["uncertainty_seconds"] >= 0
+    assert mh.clock_state()["offset_seconds"] == est["offset_seconds"]
+
+
+def test_estimate_clock_offset_coordinator_pinned_to_zero(monkeypatch):
+    _fake_pod(monkeypatch, index=0, count=2)
+    kv = _FakeKV()
+    monkeypatch.setattr(mh, "_coord_client", lambda: kv)
+    est = mh.estimate_clock_offset()
+    assert est["offset_seconds"] == 0.0, "the coordinator IS the reference"
+    assert "sc_mh/clock/0/0" in kv.store, "followers must find the probe key"
+
+
+# -- heartbeat + straggler skew -----------------------------------------------
+
+def test_heartbeat_single_host_noop(tmp_path):
+    with RunTelemetry(out_dir=str(tmp_path)) as tel:
+        assert mh.heartbeat(tel, step=10, window_seconds=1.0) is None
+    events = read_events(tmp_path / "events.jsonl")
+    assert all(e["event"] != "heartbeat" for e in events)
+
+
+def test_heartbeat_emits_skew_gauges_and_event(tmp_path, monkeypatch):
+    _fake_pod(monkeypatch, index=0, count=2)
+    monkeypatch.setattr(
+        mh, "_kv_allgather", lambda tag, payload: [payload, "2.0"],
+    )
+    with RunTelemetry(out_dir=str(tmp_path)) as tel:
+        tel.counter_inc("train.steps", 128)
+        rec = mh.heartbeat(tel, step=128, window_seconds=0.5)
+        assert rec is not None
+        assert rec["steps"] == 128
+        assert rec["window_seconds"] == 0.5
+        assert rec["window_seconds_by_process"] == [0.5, 2.0]
+        assert rec["skew_seconds"] == pytest.approx(1.5)
+        snap = tel.snapshot()
+    assert snap["gauges"]["skew.flush.spread_seconds"] == pytest.approx(1.5)
+    assert snap["gauges"]["skew.flush.max_seconds"] == pytest.approx(2.0)
+    assert snap["gauges"]["skew.flush.min_seconds"] == pytest.approx(0.5)
+    assert snap["counters"]["heartbeats"] == 1
+
+
+def test_heartbeat_resyncs_clock_on_count(monkeypatch, tmp_path):
+    _fake_pod(monkeypatch, index=0, count=2)
+    monkeypatch.setenv(mh.CLOCK_RESYNC_EVERY_ENV, "2")
+    resyncs = []
+    monkeypatch.setattr(mh, "estimate_clock_offset", lambda: resyncs.append(1))
+    monkeypatch.setattr(mh, "_kv_allgather", lambda tag, payload: [payload, payload])
+    with RunTelemetry(out_dir=str(tmp_path)) as tel:
+        for i in range(4):
+            mh.heartbeat(tel, step=i, window_seconds=0.1)
+    assert len(resyncs) == 2, "count-based resync: every 2nd heartbeat"
+
+
+# -- desync detection ---------------------------------------------------------
+
+def test_check_desync_single_host_is_none():
+    assert mh.check_desync() is None
+
+
+def test_check_desync_agreement(monkeypatch, tmp_path):
+    _fake_pod(monkeypatch, index=0, count=2)
+    monkeypatch.setattr(
+        mh, "_kv_allgather", lambda tag, payload: [payload, payload],
+    )
+    with RunTelemetry(out_dir=str(tmp_path)) as tel:
+        assert mh.check_desync(tel, config={"lr": 1e-3}) == []
+    events = read_events(tel.path)
+    assert all(e["event"] != "anomaly" for e in events)
+
+
+def test_check_desync_mismatch_emits_hard_anomaly(monkeypatch, tmp_path):
+    _fake_pod(monkeypatch, index=1, count=2)
+    monkeypatch.setattr(
+        mh, "_kv_allgather",
+        lambda tag, payload: ["someone-elses-digest", payload],
+    )
+    with RunTelemetry(out_dir=str(tmp_path)) as tel:
+        with pytest.warns(RuntimeWarning, match="desync"):
+            mismatched = mh.check_desync(tel, config={"lr": 1e-3})
+    assert mismatched == [1]
+    anomalies = [
+        e for e in read_events(tel.path) if e["event"] == "anomaly"
+    ]
+    assert anomalies and anomalies[0]["kind"] == "desync"
+    assert anomalies[0]["processes"] == [1]
+    assert anomalies[0]["local_match"] is False
+
+
+def test_check_desync_abort_action(monkeypatch):
+    _fake_pod(monkeypatch, index=0, count=2)
+    monkeypatch.setattr(
+        mh, "_kv_allgather",
+        lambda tag, payload: [payload, "someone-elses-digest"],
+    )
+    with pytest.warns(RuntimeWarning, match="desync"):
+        with pytest.raises(AnomalyAbort):
+            mh.check_desync(None, action="abort")
+
+
+def test_comparable_fingerprint_drops_per_host_fields():
+    cmp = mh.comparable_fingerprint(config={"x": 1})
+    assert "process_index" not in cmp
+    assert "compile_cache" not in cmp
+    assert cmp["config"] == {"x": 1}
+    assert cmp["jax"] == run_fingerprint()["jax"]
+
+
+# -- fingerprint robustness (satellite: narrow except + fingerprint_error) ----
+
+def test_fingerprint_records_error_instead_of_omitting(monkeypatch):
+    def boom():
+        raise RuntimeError("backend exploded")
+
+    monkeypatch.setattr("jax.devices", boom)
+    fp = run_fingerprint()
+    assert "fingerprint_error" in fp and "backend exploded" in fp["fingerprint_error"]
+    # the failure is isolated: version + process fields still present
+    assert "jax" in fp and "jaxlib" in fp
+    assert "process_count" in fp
+
+
+# -- HBM gauge namespacing (satellite) ----------------------------------------
+
+class _FakeDev:
+    def __init__(self, did, stats):
+        self.id = did
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_hbm_watermarks_single_host_keys_unchanged(monkeypatch):
+    devs = [_FakeDev(0, {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100})]
+    marks = hbm_watermarks(devs)
+    assert list(marks) == ["d0"]
+
+
+def test_hbm_watermarks_pod_keys_use_global_device_id(monkeypatch):
+    _fake_pod(monkeypatch, index=1, count=2)
+    devs = [
+        _FakeDev(4, {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100}),
+        _FakeDev(5, {"bytes_in_use": 11, "peak_bytes_in_use": 21, "bytes_limit": 100}),
+    ]
+    marks = hbm_watermarks(devs)
+    assert sorted(marks) == ["p1.d4", "p1.d5"], (
+        "pod gauges must not collide across hosts after the merge"
+    )
+
+
+# -- offline halves -----------------------------------------------------------
+
+def test_chunk_skew_windows():
+    events = [
+        {"event": "chunk_end", "chunk": 0, "seconds": 1.0, "process_index": 0},
+        {"event": "chunk_end", "chunk": 0, "seconds": 1.4, "process_index": 1},
+        {"event": "chunk_end", "chunk": 1, "seconds": 2.0, "process_index": 0},
+        {"event": "chunk_end", "chunk": 1, "seconds": 2.1, "process_index": 1},
+        {"event": "chunk_end", "chunk": 2, "seconds": 9.0, "process_index": 0},
+        {"event": "other"},
+    ]
+    windows = mh.chunk_skew_windows(events)
+    assert len(windows) == 2, "single-host windows (chunk 2) are skipped"
+    assert windows[0]["spread"] == pytest.approx(0.4)
+    assert windows[1]["seconds"] == {0: 2.0, 1: 2.1}
+
+
+def test_fingerprint_diff_flags_disagreeing_fields():
+    starts = [
+        {"process_index": 0, "fingerprint": {"git_sha": "aaa", "jax": "1"},
+         "config": {"lr": 1e-3}},
+        {"process_index": 1, "fingerprint": {"git_sha": "bbb", "jax": "1"},
+         "config": {"lr": 1e-3}},
+    ]
+    diff = mh.fingerprint_diff(starts)
+    assert set(diff) == {"git_sha"}
+    assert diff["git_sha"] == {0: "aaa", 1: "bbb"}
+    assert mh.fingerprint_diff(starts[:1]) == {}
+
+
+# -- merged report ------------------------------------------------------------
+
+def _write_pod_run(d, desync=False):
+    """Handcraft a two-process run dir (the merge contract, not the gloo
+    transport — tests/test_multiprocess.py covers the real thing)."""
+    base = 1_700_000_000.0
+    for p in (0, 1):
+        fp = {
+            "python": "3.11.0", "jax": "0.9", "jaxlib": "0.9", "backend": "cpu",
+            "device_kind": "cpu", "device_count": 8, "process_count": 2,
+            "process_index": p,
+            "git_sha": "feedbeef" if (p == 0 or not desync) else "deadbeef",
+        }
+        seq = 0
+
+        def rec(event, **fields):
+            nonlocal seq
+            seq += 1
+            return {"seq": seq, "ts": base + seq, "event": event,
+                    "process_index": p, **fields}
+
+        events = [
+            rec("run_start", run_name="podtest", config={"batch": 64}, fingerprint=fp),
+            rec("compile", name="ensemble.step", seconds=1.0 + p),
+            rec("chunk_start", chunk=0),
+            rec("chunk_end", chunk=0, seconds=1.0 + 0.6 * p),
+            rec("heartbeat", step=4, steps=4, window_seconds=1.0 + 0.6 * p,
+                window_seconds_by_process=[1.0, 1.6], skew_seconds=0.6,
+                clock_offset_seconds=0.012 * p, clock_uncertainty_seconds=0.004),
+            rec("snapshot",
+                counters={"train.steps": 4, "chunks": 1,
+                          "compile.backend.count": 2 + p,
+                          "compile.backend.seconds": 3.0},
+                gauges={f"hbm.p{p}.d{4 * p}.bytes_in_use": 1000.0 + p,
+                        f"hbm.p{p}.d{4 * p}.peak_bytes_in_use": 2000.0 + p,
+                        f"hbm.p{p}.d{4 * p}.bytes_limit": 4000.0,
+                        "skew.flush.spread_seconds": 0.6,
+                        "skew.flush.max_seconds": 1.6,
+                        "skew.flush.min_seconds": 1.0}),
+            rec("run_end", status="ok", steps=4, steps_per_sec=2.0 - 0.5 * p,
+                wall_seconds=2.0 + p),
+        ]
+        with open(d / f"events.p{p}.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+
+def test_report_merges_per_process_logs(tmp_path):
+    _write_pod_run(tmp_path)
+    run = load_run(tmp_path)
+    assert len(run["event_files"]) == 2, "events.p<i>.jsonl must be discovered"
+    md = render_markdown(run)
+    assert "Pod / multi-host" in md
+    assert "| p0 |" in md and "| p1 |" in md, "one row per host"
+    assert "Straggler skew" in md
+    assert "0.6" in md  # the injected skew shows up
+    # per-process HBM gauges survive the merge without collision
+    assert "p0.d0" in md and "p1.d4" in md
+    # clock offsets rendered
+    assert "clock" in md.lower()
+
+
+def test_report_surfaces_desync_fingerprint_diff(tmp_path):
+    _write_pod_run(tmp_path, desync=True)
+    md = render_markdown(load_run(tmp_path))
+    assert "git_sha" in md and "deadbeef" in md and "feedbeef" in md
+    assert "desync" in md.lower()
+
+
+def test_single_host_report_has_no_pod_section(tmp_path):
+    with RunTelemetry(out_dir=str(tmp_path), run_name="solo") as tel:
+        tel.run_start(config={"b": 1})
+        tel.chunk_start(0)
+        tel.chunk_end(0)
+    md = render_markdown(load_run(tmp_path))
+    assert "Pod / multi-host" not in md, "single-host report output is frozen"

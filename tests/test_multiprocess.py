@@ -13,6 +13,7 @@ testing: none"); its nearest analogue is the untested gloo DDP experiment
 (`experiments/huge_batch_size.py:337-345`).
 """
 
+import os
 import socket
 import subprocess
 import sys
@@ -93,3 +94,86 @@ def test_n_process_sharded_step_matches_single_process(devices, n_proc, mode):
         loss_dict, _ = ens.step_batch(full)
     ref = np.asarray(jax.device_get(loss_dict["loss"]))
     np.testing.assert_allclose(losses[0], ref, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_telemetry_merges_and_detects_straggler(tmp_path, devices):
+    """ISSUE 4 acceptance: a real two-process gloo run writes per-process
+    event logs; the merged report carries one row per host and a straggler
+    section; an injected slow host (p1 sleeps 0.25 s per chunk) trips the
+    `skew.flush.*` gauges; a deliberately disagreeing config surfaces as a
+    hard `desync` anomaly; the monitor renders the run dir."""
+    port = _free_port()
+    run_dir = tmp_path / "pod_run"
+    sleep_s = 0.25
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["SC_TEST_DESYNC"] = "1"  # config poisoned with the process id
+        if pid == 1:
+            env["SC_TEST_CHUNK_SLEEP"] = str(sleep_s)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    str(REPO / "tests" / "_multiprocess_worker.py"),
+                    str(pid), "2", f"127.0.0.1:{port}", "telemetry",
+                    str(run_dir),
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            )
+        )
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+
+    from sparse_coding__tpu.telemetry import read_events
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    # per-process logs, every record tagged with its originating host
+    events = {}
+    for pid in range(2):
+        path = run_dir / f"events.p{pid}.jsonl"
+        assert path.exists(), f"missing per-process log {path}"
+        events[pid] = read_events(path)
+        assert all(e["process_index"] == pid for e in events[pid])
+        kinds = [e["event"] for e in events[pid]]
+        assert kinds.count("heartbeat") == 3
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+
+    # clock offset measured at initialize_distributed rides the fingerprint
+    fp = events[1][0]["fingerprint"]
+    assert "clock_offset_seconds" in fp
+
+    # the injected straggler trips the skew gauges (last snapshot)
+    snaps = [e for e in events[0] if e["event"] == "snapshot"]
+    gauges = snaps[-1]["gauges"]
+    assert gauges["skew.flush.spread_seconds"] >= 0.6 * sleep_s, gauges
+    # and both hosts agree on the allgathered skew
+    snaps1 = [e for e in events[1] if e["event"] == "snapshot"]
+    assert (
+        snaps1[-1]["gauges"]["skew.flush.spread_seconds"]
+        == gauges["skew.flush.spread_seconds"]
+    )
+
+    # the poisoned config is a hard desync anomaly on both hosts
+    for pid in range(2):
+        desync = [
+            e for e in events[pid]
+            if e["event"] == "anomaly" and e["kind"] == "desync"
+        ]
+        assert desync and desync[0]["processes"] == [1]
+
+    # merged report: one row per host + straggler section + desync diff
+    md = render_markdown(load_run(run_dir))
+    assert "Pod / multi-host" in md
+    assert "| p0 |" in md and "| p1 |" in md
+    assert "Straggler skew" in md
+    assert "desync" in md.lower()
+    assert "config" in md  # the disagreeing field is named
+
+    # the monitor renders the same dir (exit 0 = no malformed lines)
+    from sparse_coding__tpu.monitor import main as monitor_main
+
+    assert monitor_main([str(run_dir), "--once"]) == 0
