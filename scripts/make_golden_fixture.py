@@ -200,9 +200,102 @@ def make_pod_run_fixture():
     print(f"Wrote {POD_RUN_DIR}/events.p0.jsonl + events.p1.jsonl")
 
 
+RESUMED_RUN_DIR = REPO / "tests" / "golden" / "resumed_run"
+RESUMED_BASE_TS = 1_754_300_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_resumed_run_fixture():
+    """Deterministic preempted-and-resumed run directory (ISSUE 5 satellite).
+
+    Hand-stamped event logs — NOT a real training run (real runs stamp wall
+    clocks; a golden fixture must be byte-stable). The shape mirrors what a
+    supervised `basic_l1_sweep` writes across one preemption: generation 1
+    trains chunks 0–1, records a ``preempt`` + ``checkpoint`` event and a
+    ``run_end`` with status "preempted"; the supervisor logs the ``restart``
+    into ``supervisor_events.jsonl``; generation 2 appends to the SAME
+    ``events.jsonl`` with a ``resume`` event and finishes chunk 2.
+    `tests/test_monitor.py` renders `monitor --once` and the report's
+    "Recovery" section from this directory in tier-1.
+    """
+    RESUMED_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    seq = 0
+    t = RESUMED_BASE_TS
+
+    def rec(event, dt=1.0, **fields):
+        nonlocal seq, t
+        seq += 1
+        t += dt
+        return {"seq": seq, "ts": round(t, 3), "event": event, **fields}
+
+    ckpt = "out/resumed_golden/ckpt_1"
+    cursor = {"chunk": 1, "epoch": 0, "position": 1, "key": [1234, 5678]}
+    gen1 = [
+        rec("run_start", run_name="resumed_golden",
+            config={"batch": 512, "l1_values": [1e-4, 1e-3]},
+            fingerprint={"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+                         "device_kind": "golden-cpu", "device_count": 1,
+                         "git_sha": "g0lden"}),
+        rec("compile", name="ensemble.step_batch", seconds=2.1),
+        rec("chunk_start", chunk=0, epoch=0, position=0),
+        rec("chunk_end", dt=1.4, chunk=0, epoch=0, position=0, seconds=1.4,
+            steps=12),
+        rec("chunk_start", chunk=2, epoch=0, position=1),
+        rec("chunk_end", dt=1.4, chunk=2, epoch=0, position=1, seconds=1.4,
+            steps=12),
+        rec("checkpoint", path=ckpt, cursor=1, reason="preempt"),
+        rec("preempt", signum=15, checkpoint=ckpt, cursor=1),
+        rec("snapshot",
+            counters={"chunks": 2, "train.steps": 24, "checkpoints": 1},
+            gauges={}),
+        rec("run_end", status="preempted", steps=24, wall_seconds=8.1),
+    ]
+    # generation 2 APPENDS to the same events.jsonl (seq restarts — each
+    # process writes its own monotonic seq, exactly like a real rerun)
+    seq = 0
+    gen2 = [
+        rec("run_start", run_name="resumed_golden",
+            config={"batch": 512, "l1_values": [1e-4, 1e-3]},
+            fingerprint={"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+                         "device_kind": "golden-cpu", "device_count": 1,
+                         "git_sha": "g0lden"}),
+        rec("resume", checkpoint=ckpt, cursor=cursor),
+        rec("compile", name="ensemble.step_batch", seconds=2.2),
+        rec("chunk_start", chunk=1, epoch=0, position=2),
+        rec("chunk_end", dt=1.4, chunk=1, epoch=0, position=2, seconds=1.4,
+            steps=12),
+        rec("snapshot",
+            counters={"chunks": 1, "train.steps": 12, "resumes": 1},
+            gauges={}),
+        rec("run_end", status="ok", steps=12, wall_seconds=6.2),
+    ]
+    with open(RESUMED_RUN_DIR / "events.jsonl", "w") as f:
+        for e in gen1 + gen2:
+            f.write(json.dumps(e) + "\n")
+    seq = 0
+    t = RESUMED_BASE_TS
+    sup = [
+        rec("run_start", run_name="supervisor",
+            config={"cmd": ["python", "-m", "driver"], "max_restarts": 8,
+                    "restart_on": "preempt"}),
+        rec("spawn", attempt=0, cmd=["python", "-m", "driver"], resume=False),
+        rec("restart", dt=9.0, attempt=1, exit_code=75,
+            classification="preempt", backoff_seconds=1.0,
+            downtime_seconds=1.1),
+        rec("spawn", attempt=1, cmd=["python", "-m", "driver"], resume=True),
+        rec("run_end", dt=7.0, status="ok", wall_seconds=17.3),
+    ]
+    with open(RESUMED_RUN_DIR / "supervisor_events.jsonl", "w") as f:
+        for e in sup:
+            f.write(json.dumps(e) + "\n")
+    print(f"Wrote {RESUMED_RUN_DIR}/events.jsonl + supervisor_events.jsonl")
+
+
 def main():
     if "--pod-run" in sys.argv:
         make_pod_run_fixture()
+        return
+    if "--resumed-run" in sys.argv:
+        make_resumed_run_fixture()
         return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")

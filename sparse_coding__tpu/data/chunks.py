@@ -191,10 +191,40 @@ class ChunkStore:
         int8 chunks (written by ``save_chunk(..., dtype=np.int8)``) move as
         int8 — half the fp16 transfer bytes — and dequantize on device to
         fp16 before any requested upcast; ``dtype=None`` therefore yields
-        fp16 for both store formats (the store's logical dtype)."""
-        arr = np.load(chunk_path(self.folder, i))
-        sp = scale_path(self.folder, i)
-        if arr.dtype in (np.int8, np.uint8) and sp.exists():
+        fp16 for both store formats (the store's logical dtype).
+
+        Transient read errors (network filesystems under pod churn) are
+        retried with the shared `utils.sync.retry_with_backoff` schedule
+        (`SC_SYNC_RETRIES`/`SC_SYNC_BACKOFF`); each retry bumps the
+        telemetry ``io.retry`` counter. The ``chunk_read`` fault site
+        (`utils.faults`) lets tests inject the failures deterministically."""
+        from sparse_coding__tpu.telemetry.events import counter_inc_active
+        from sparse_coding__tpu.utils.faults import fault_point
+        from sparse_coding__tpu.utils.sync import retry_with_backoff
+
+        def _read(attempt: int):
+            fault_point("chunk_read", chunk=int(i), attempt=attempt)
+            a = np.load(chunk_path(self.folder, i))
+            sp_ = scale_path(self.folder, i)
+            s = (
+                np.load(sp_)
+                if a.dtype in (np.int8, np.uint8) and sp_.exists()
+                else None
+            )
+            return a, s
+
+        arr, scales = retry_with_backoff(
+            _read,
+            retry_on=(OSError,),
+            # permanent errors (a chunk index that simply doesn't exist)
+            # must fail fast, not burn the backoff schedule
+            give_up_on=(
+                FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                PermissionError,
+            ),
+            on_retry=lambda attempt, exc: counter_inc_active("io.retry"),
+        )
+        if scales is not None:
             # int8 = signed bytes; uint8 = nibble-packed int4 (save_chunk's
             # two quantized tiers)
             int4 = arr.dtype == np.uint8
@@ -202,7 +232,6 @@ class ChunkStore:
                 (_dequant_int4, _dequant_int4_to) if int4
                 else (_dequant_int8, _dequant_int8_to)
             )
-            scales = np.load(sp)
             q = jnp.asarray(arr)
             s = jnp.asarray(scales)
             if sharding is not None:

@@ -1,0 +1,298 @@
+"""Auto-resume supervisor: keep a training driver alive across preemptions.
+
+``python -m sparse_coding__tpu.supervise [options] -- <command...>`` runs the
+driver command as a subprocess and restarts it when it exits with the
+*resumable* code **75** (`train.preemption.RESUMABLE_EXIT_CODE` — what every
+driver emits after committing its preemption checkpoint). Restarted children
+get ``SC_RESUME=1`` in their environment, which the drivers' default
+``resume=None`` consults — so the SAME command line resumes from the latest
+committed checkpoint with no per-driver flag plumbing::
+
+    python -m sparse_coding__tpu.supervise --run-dir out/sweep1 -- \
+        python -m my_driver out/sweep1 ...
+
+Exit classification (``classify_exit``):
+
+  - ``preempt``        exit code 75 — restart (the default policy)
+  - ``anomaly-abort``  a nonzero exit whose run dir recorded an ``anomaly``
+                       event with ``action="abort"`` after the child started
+                       — deterministic, NOT restarted (a NaN storm does not
+                       get better by retrying)
+  - ``killed``         died on a signal (SIGKILL, OOM) — a hard crash
+  - ``crash``          any other nonzero exit
+
+``--restart-on any`` also restarts killed/crash exits (anomaly-abort never
+restarts). Restarts draw from a bounded budget (``--max-restarts``) and are
+spaced by exponential backoff with jitter (``--backoff-base``,
+``--backoff-max``, ``--jitter``) so a crash-looping fleet does not
+stampede its storage/coordinator. An exhausted budget exits with the
+child's last (nonzero) code.
+
+Every restart is recorded as a ``restart`` event in
+``supervisor_events.jsonl`` under ``--run-dir`` (the report CLI's
+``*_events.jsonl`` glob picks it up), and the run report renders a
+**Recovery** section from it: restart lineage, checkpoints used, wall time
+lost to recovery.
+
+The supervisor forwards SIGTERM/SIGINT to the child, waits for it to
+checkpoint, and then exits with the child's code WITHOUT restarting — an
+outer scheduler (k8s, a parent supervisor) sees 75 and reschedules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from sparse_coding__tpu.train.preemption import RESUME_ENV, RESUMABLE_EXIT_CODE
+
+__all__ = ["classify_exit", "compute_backoff", "run_supervised", "main"]
+
+
+def compute_backoff(
+    attempt: int,
+    base: float = 1.0,
+    cap: float = 60.0,
+    jitter: float = 0.25,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Exponential backoff with multiplicative jitter: the k-th restart waits
+    `min(base * 2**k, cap) * (1 + jitter * U[0,1))` seconds. The capped
+    schedule is the shared `utils.sync.backoff_delays` one; jitter is the
+    supervisor's own (a restarting fleet must not stampede the coordinator).
+    """
+    from sparse_coding__tpu.utils.sync import backoff_delays
+
+    delay = backoff_delays(max(0, attempt) + 2, base, max_delay=cap)[-1]
+    if jitter > 0:
+        delay *= 1.0 + jitter * (rng or random).random()
+    return delay
+
+
+def _recent_abort(run_dir: Optional[str], since_ts: float) -> bool:
+    """Did the run dir record an abort-action anomaly after `since_ts`?"""
+    if run_dir is None:
+        return False
+    root = Path(run_dir)
+    if not root.is_dir():
+        return False
+    import json
+
+    for path in root.rglob("*events*.jsonl"):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail — not this function's problem
+                    if (
+                        rec.get("event") == "anomaly"
+                        and rec.get("action") == "abort"
+                        and float(rec.get("ts", 0)) >= since_ts
+                    ):
+                        return True
+        except OSError:
+            continue
+    return False
+
+
+def classify_exit(
+    returncode: int, run_dir: Optional[str] = None, since_ts: float = 0.0
+) -> str:
+    """Classify a child exit: ok | preempt | anomaly-abort | killed | crash."""
+    if returncode == 0:
+        return "ok"
+    if returncode == RESUMABLE_EXIT_CODE:
+        return "preempt"
+    if returncode < 0:
+        return "killed"  # subprocess convention: -signum
+    if _recent_abort(run_dir, since_ts):
+        return "anomaly-abort"
+    return "crash"
+
+
+def run_supervised(
+    cmd: List[str],
+    run_dir: Optional[str] = None,
+    max_restarts: int = 8,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    jitter: float = 0.25,
+    restart_on: str = "preempt",
+    telemetry=None,
+) -> int:
+    """Supervise `cmd`; returns the exit code the supervisor should exit
+    with. `telemetry` (a RunTelemetry) is owned by the caller; pass None for
+    silent operation (unit tests)."""
+    if restart_on not in ("preempt", "any"):
+        raise ValueError(f"unknown restart_on {restart_on!r}")
+    signaled = {"got": None}
+    child: dict = {"proc": None}
+
+    def forward(signum, frame):
+        signaled["got"] = signum
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)  # graceful: the driver checkpoints
+
+    prev_handlers = {}
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[s] = signal.signal(s, forward)
+        except (ValueError, OSError):  # non-main thread (tests)
+            pass
+
+    attempt = 0
+    try:
+        while True:
+            env = dict(os.environ)
+            if attempt > 0:
+                env[RESUME_ENV] = "1"
+            started = time.time()
+            if telemetry is not None:
+                telemetry.event(
+                    "spawn", attempt=attempt, cmd=cmd,
+                    resume=attempt > 0 or env.get(RESUME_ENV) == "1",
+                )
+            proc = subprocess.Popen(cmd, env=env)
+            child["proc"] = proc
+            rc = proc.wait()
+            child["proc"] = None
+            exited = time.time()
+            cls = classify_exit(rc, run_dir=run_dir, since_ts=started)
+            if cls == "ok":
+                return 0
+            if signaled["got"] is not None:
+                # the SUPERVISOR is being preempted: stop restarting, hand
+                # the resumable code up to whatever supervises us
+                if telemetry is not None:
+                    telemetry.event(
+                        "supervisor_preempted", signum=signaled["got"],
+                        child_exit=rc,
+                    )
+                return rc if rc > 0 else RESUMABLE_EXIT_CODE
+            restartable = cls == "preempt" or (
+                restart_on == "any" and cls in ("killed", "crash")
+            )
+            rc_out = rc if rc > 0 else 128 + abs(rc)
+            if not restartable:
+                if telemetry is not None:
+                    telemetry.event("give_up", reason=cls, exit_code=rc)
+                return rc_out
+            if attempt >= max_restarts:
+                if telemetry is not None:
+                    telemetry.event(
+                        "budget_exhausted", restarts=attempt, exit_code=rc
+                    )
+                return rc_out
+            delay = compute_backoff(attempt, backoff_base, backoff_max, jitter)
+            time.sleep(delay)
+            if signaled["got"] is not None:
+                # preempted DURING the backoff sleep (no child to forward
+                # to): spawning another generation would blow the outer
+                # scheduler's grace period — hand the resumable code up now
+                if telemetry is not None:
+                    telemetry.event(
+                        "supervisor_preempted", signum=signaled["got"],
+                        child_exit=rc,
+                    )
+                return rc if rc > 0 else RESUMABLE_EXIT_CODE
+            attempt += 1
+            if telemetry is not None:
+                telemetry.event(
+                    "restart",
+                    attempt=attempt,
+                    exit_code=rc,
+                    classification=cls,
+                    backoff_seconds=round(delay, 3),
+                    downtime_seconds=round(time.time() - exited, 3),
+                )
+                telemetry.counter_inc("restarts")
+                telemetry.counter_inc(f"restarts.{cls}")
+    finally:
+        for s, h in prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.supervise",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--run-dir", default=None,
+        help="the driver's output dir: supervisor events land here and exit "
+        "classification reads its anomaly events",
+    )
+    ap.add_argument("--max-restarts", type=int, default=8,
+                    help="restart budget (default 8)")
+    ap.add_argument("--backoff-base", type=float, default=1.0,
+                    help="first-restart delay seconds (default 1.0)")
+    ap.add_argument("--backoff-max", type=float, default=60.0,
+                    help="backoff cap seconds (default 60)")
+    ap.add_argument("--jitter", type=float, default=0.25,
+                    help="multiplicative jitter fraction (default 0.25)")
+    ap.add_argument(
+        "--restart-on", choices=("preempt", "any"), default="preempt",
+        help="restart only on resumable exits (default) or also on crashes",
+    )
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="driver command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no driver command given (append: -- <command...>)")
+
+    telemetry = None
+    if args.run_dir is not None:
+        from sparse_coding__tpu.telemetry import RunTelemetry
+
+        telemetry = RunTelemetry(
+            out_dir=args.run_dir,
+            run_name="supervisor",
+            config={
+                "cmd": cmd, "max_restarts": args.max_restarts,
+                "backoff_base": args.backoff_base,
+                "backoff_max": args.backoff_max,
+                "restart_on": args.restart_on,
+            },
+            file_name="supervisor_events.jsonl",
+        )
+        telemetry.run_start()
+    rc = 1
+    try:
+        rc = run_supervised(
+            cmd,
+            run_dir=args.run_dir,
+            max_restarts=args.max_restarts,
+            backoff_base=args.backoff_base,
+            backoff_max=args.backoff_max,
+            jitter=args.jitter,
+            restart_on=args.restart_on,
+            telemetry=telemetry,
+        )
+        return rc
+    finally:
+        if telemetry is not None:
+            telemetry.close(status="ok" if rc == 0 else f"exit {rc}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

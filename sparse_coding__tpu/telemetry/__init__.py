@@ -28,6 +28,7 @@ from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, Ano
 from sparse_coding__tpu.telemetry.audit import TransferViolation, allowed_transfer, transfer_audit
 from sparse_coding__tpu.telemetry.events import (
     RunTelemetry,
+    counter_inc_active,
     read_events,
     run_fingerprint,
     tracked_jit,
@@ -65,6 +66,7 @@ __all__ = [
     "chunk_skew_windows",
     "clock_state",
     "compiled_cost_fields",
+    "counter_inc_active",
     "estimate_clock_offset",
     "fingerprint_diff",
     "hbm_watermarks",

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "RunTelemetry",
+    "counter_inc_active",
     "run_fingerprint",
     "tracked_jit",
     "read_events",
@@ -96,6 +97,14 @@ def _install_jax_listeners() -> None:
         mon.register_event_listener(on_event)
     except Exception:  # pragma: no cover - jax without monitoring
         pass
+
+
+def counter_inc_active(name: str, n: int = 1) -> None:
+    """Bump a counter on EVERY live RunTelemetry — the hook for layers that
+    hold no telemetry handle (e.g. `data.chunks` transient-read retries
+    feeding the `io.retry` counter). No live telemetry → no-op."""
+    for t in list(_ACTIVE):
+        t.counter_inc(name, n)
 
 
 def run_fingerprint(mesh=None) -> Dict[str, Any]:

@@ -135,6 +135,11 @@ class RunMonitor:
         self.skew_gauge: Optional[float] = None
         self.chunk_ends: List[Dict[str, Any]] = []
         self.events_seen = 0
+        # recovery activity (docs/RECOVERY.md): driver preempt/resume events
+        # + supervisor restarts
+        self.preempts: List[Dict[str, Any]] = []
+        self.resumes: List[Dict[str, Any]] = []
+        self.restarts: List[Dict[str, Any]] = []
 
     # -- ingestion ------------------------------------------------------------
 
@@ -178,7 +183,16 @@ class RunMonitor:
             p.last_ts = max(p.last_ts or 0.0, float(ts))
         kind = rec.get("event")
         if kind == "run_start":
-            self.run_name = rec.get("run_name", self.run_name)
+            # the supervisor's own log rides in the same dir: its run_start
+            # must not rename the header away from the DRIVER's run name
+            name = rec.get("run_name")
+            if name and (self.run_name in (None, "supervisor") or name != "supervisor"):
+                self.run_name = name
+            # a NEW generation appending to the same log (supervised
+            # restart after preemption): the process is alive again —
+            # without this reset, follow mode would exit at the first
+            # generation's run_end and leave the restarted run unwatched
+            p.status = "running"
         elif kind == "heartbeat":
             if rec.get("steps") is not None:
                 p.steps = int(rec["steps"])
@@ -197,6 +211,12 @@ class RunMonitor:
             self.chunk_ends.append(rec)
         elif kind == "anomaly":
             self.anomalies.append(rec)
+        elif kind == "preempt":
+            self.preempts.append(rec)
+        elif kind == "resume":
+            self.resumes.append(rec)
+        elif kind == "restart":
+            self.restarts.append(rec)
         elif kind == "snapshot":
             counters = rec.get("counters") or {}
             if "train.steps" in counters:
@@ -285,6 +305,19 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
     ]
     if offsets:
         lines.append("  clock offsets: " + ", ".join(offsets))
+    if mon.preempts or mon.resumes or mon.restarts:
+        bits = []
+        if mon.preempts:
+            last = mon.preempts[-1]
+            bits.append(
+                f"{len(mon.preempts)} preempt(s) (last cursor "
+                f"{last.get('cursor', '?')})"
+            )
+        if mon.restarts:
+            bits.append(f"{len(mon.restarts)} restart(s)")
+        if mon.resumes:
+            bits.append(f"{len(mon.resumes)} resume(s)")
+        lines.append("  recovery: " + ", ".join(bits))
     desync = [a for a in mon.anomalies if a.get("kind") == "desync"]
     if mon.anomalies:
         recent = mon.anomalies[-3:]

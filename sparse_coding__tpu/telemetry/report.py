@@ -466,6 +466,82 @@ def _pod_section(run, lines: List[str]):
         lines.append("")
 
 
+def _recovery_section(run, lines: List[str]):
+    """Restart lineage, checkpoints used, and wall time lost to recovery —
+    rendered from driver ``preempt``/``resume`` events plus the
+    supervisor's ``restart``/``spawn`` log (docs/RECOVERY.md). Omitted
+    entirely for runs that never preempted, resumed, or restarted —
+    routine scheduled ``checkpoint`` events alone do NOT trigger it, so
+    ordinary single-generation report output is unchanged."""
+    preempts = _events_of(run, "preempt")
+    resumes = _events_of(run, "resume")
+    restarts = _events_of(run, "restart")
+    checkpoints = _events_of(run, "checkpoint")
+    exhausted = _events_of(run, "budget_exhausted")
+    if not (preempts or resumes or restarts or exhausted):
+        return
+    lines.append("## Recovery")
+    lines.append("")
+    gens = [
+        s for s in _events_of(run, "run_start")
+        if s.get("run_name") != "supervisor"
+    ]
+    bits = [f"{len(gens)} driver generation(s)"]
+    if preempts:
+        bits.append(f"{len(preempts)} preemption(s)")
+    if restarts:
+        bits.append(f"{len(restarts)} supervisor restart(s)")
+    if checkpoints:
+        bits.append(f"{len(checkpoints)} checkpoint(s) written")
+    lines.append("- " + ", ".join(bits))
+    downtime = sum(
+        float(r["downtime_seconds"])
+        for r in restarts
+        if r.get("downtime_seconds") is not None
+    )
+    if restarts:
+        lines.append(
+            f"- wall time lost to recovery (exit → respawn, incl. backoff): "
+            f"**{downtime:.1f} s**"
+        )
+    if exhausted:
+        e = exhausted[-1]
+        lines.append(
+            f"- ⚠ restart budget exhausted after {_fmt(e.get('restarts'))} "
+            f"restart(s) (last exit code {_fmt(e.get('exit_code'))})"
+        )
+    lines.append("")
+    if preempts:
+        for p in preempts:
+            sig = p.get("signum")
+            lines.append(
+                f"- preempt at cursor {_fmt(p.get('cursor'))}"
+                + (f" (signal {sig})" if sig is not None else "")
+                + f" → checkpoint `{p.get('checkpoint', '?')}`"
+            )
+        lines.append("")
+    if resumes:
+        lines.append("Checkpoints used to resume:")
+        lines.append("")
+        for r in resumes:
+            lines.append(
+                f"- `{r.get('checkpoint', '?')}` (cursor "
+                f"{json.dumps(r.get('cursor'), default=str)[:80]})"
+            )
+        lines.append("")
+    if restarts:
+        lines.append("| restart | exit code | class | backoff s | downtime s |")
+        lines.append("|---:|---:|---|---:|---:|")
+        for r in restarts:
+            lines.append(
+                f"| {_fmt(r.get('attempt'))} | {_fmt(r.get('exit_code'))} "
+                f"| {r.get('classification', '?')} "
+                f"| {_fmt(r.get('backoff_seconds'))} "
+                f"| {_fmt(r.get('downtime_seconds'))} |"
+            )
+        lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -578,6 +654,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     lines.append("")
     _fingerprint_section(run, lines)
     _pod_section(run, lines)
+    _recovery_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)
     _throughput_section(run, lines)

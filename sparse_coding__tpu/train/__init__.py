@@ -1,4 +1,17 @@
-from sparse_coding__tpu.train.loop import ensemble_train_loop, make_fista_decoder_update
+from sparse_coding__tpu.train.loop import (
+    DriverCheckpointer,
+    ensemble_train_loop,
+    make_fista_decoder_update,
+)
+from sparse_coding__tpu.train.preemption import (
+    RESUMABLE_EXIT_CODE,
+    Preempted,
+    install_signal_handlers,
+    pod_agree_preempt,
+    preemption_requested,
+    request_preemption,
+    resume_requested,
+)
 from sparse_coding__tpu.train.sweep import (
     filter_learned_dicts,
     format_hyperparam_val,
@@ -9,11 +22,14 @@ from sparse_coding__tpu.train.sweep import (
     unstacked_to_learned_dicts,
 )
 from sparse_coding__tpu.train.checkpoint import (
+    gc_checkpoints,
     latest_checkpoint,
     load_learned_dicts,
     restore_ensemble_checkpoint,
+    save_checkpoint_tree,
     save_ensemble_checkpoint,
     save_learned_dicts,
+    verify_checkpoint,
 )
 from sparse_coding__tpu.train.baselines import (
     load_baseline,

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding__tpu.data.chunks import ChunkStore
-from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.ensemble import Ensemble, build_ensemble
 from sparse_coding__tpu.models import FunctionalFista
 from sparse_coding__tpu.telemetry import (
     AnomalyGuard,
@@ -30,8 +30,11 @@ from sparse_coding__tpu.telemetry import (
     heartbeat,
     record_hbm_watermarks,
 )
+from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
-from sparse_coding__tpu.train.loop import ensemble_train_loop
+from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
+from sparse_coding__tpu.train.preemption import Preempted, resume_requested
+from sparse_coding__tpu.utils.faults import fault_point
 from sparse_coding__tpu.utils.logging import MetricLogger
 from sparse_coding__tpu.utils.trace import StepTimer
 
@@ -53,6 +56,9 @@ def basic_l1_sweep(
     hbm_cache: bool = False,
     health: bool = True,
     anomaly_policy: Optional[AnomalyPolicy] = None,
+    resume: Optional[bool] = None,
+    checkpoint_every: Optional[int] = None,
+    checkpoint_keep: int = 3,
 ) -> List[Tuple[object, dict]]:
     """Train a FISTA ensemble over `l1_values` on every chunk in
     `dataset_folder`; save learned dicts per epoch (reference
@@ -69,7 +75,18 @@ def basic_l1_sweep(
     JSONL; ``health=True`` (default) fuses the per-model health pack into
     the train step; ``anomaly_policy`` governs the flush-boundary
     `AnomalyGuard` (default: warn + diagnostic bundle). Render the artifacts
-    with ``python -m sparse_coding__tpu.report <output_folder>``."""
+    with ``python -m sparse_coding__tpu.report <output_folder>``.
+
+    Preemption safety (docs/RECOVERY.md): a SIGTERM/SIGINT sets a flag the
+    driver checks at every chunk boundary; it then commits a
+    crash-consistent checkpoint under `output_folder` and raises
+    `train.preemption.Preempted` (process exit code 75 — resumable).
+    ``resume=True`` (or ``SC_RESUME=1``, set by the supervisor) restores
+    the latest committed checkpoint — torn/corrupt directories are skipped
+    — and replays bit-identically to the uninterrupted run (the cursor
+    carries epoch, chunk position, and the RNG key). ``checkpoint_every=N``
+    additionally checkpoints every N chunks; the newest
+    ``checkpoint_keep`` checkpoints are retained."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
@@ -101,6 +118,36 @@ def basic_l1_sweep(
     # pod runs: hosts disagreeing on config/environment is a hard anomaly,
     # caught before any training is wasted (no-op single-host)
     check_desync(telemetry, config=run_config)
+    # preemption + checkpoint/resume glue (docs/RECOVERY.md): signal
+    # handlers install here; boundaries below poll them
+    ckpt = DriverCheckpointer(
+        output_folder, telemetry=telemetry, keep=checkpoint_keep,
+        every=checkpoint_every,
+    )
+    # (epoch, position) of the last COMPLETED chunk before this process
+    # started; (-1, -1) = fresh run. The restored key replays the exact
+    # per-chunk split sequence of the uninterrupted run.
+    start_epoch, start_pos = -1, -1
+    restored_key = None
+    if resume_requested(resume):
+        template = {
+            "cursor": {
+                "chunk": 0, "epoch": 0, "position": 0,
+                "key": np.zeros((2,), np.uint32),
+            },
+            "ensembles": {"ensemble": ens.state_template()},
+            "args": {"ensemble": {}},
+        }
+        tree = ckpt.restore(template)
+        if tree is not None:
+            ens = Ensemble.from_state(tree["ensembles"]["ensemble"], sig=ens.sig)
+            start_epoch = int(tree["cursor"]["epoch"])
+            start_pos = int(tree["cursor"]["position"])
+            restored_key = np.asarray(tree["cursor"]["key"])
+            print(
+                f"Resumed {output_folder} at epoch {start_epoch} "
+                f"chunk position {start_pos}"
+            )
     # triggered trace capture: SC_TRACE_WINDOW="N:M" (steps) arms a profiler
     # window; the guard's first anomaly arms one automatically — the trace
     # dir lands in the event log and the diagnostic bundle
@@ -116,7 +163,11 @@ def basic_l1_sweep(
     )
     timer = StepTimer()
 
-    key = jax.random.PRNGKey(seed + 1)
+    key = (
+        jnp.asarray(restored_key)
+        if restored_key is not None
+        else jax.random.PRNGKey(seed + 1)
+    )
     order_rng = np.random.default_rng(seed)
     learned_dicts: List[Tuple[object, dict]] = []
     cache: dict = {}
@@ -135,6 +186,14 @@ def basic_l1_sweep(
                 order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
             )
             for pos, chunk_idx in enumerate(chunk_order):
+                if epoch < start_epoch or (
+                    epoch == start_epoch and pos <= start_pos
+                ):
+                    # completed before the resume; the restored key already
+                    # accounts for these chunks' splits, so skip WITHOUT
+                    # splitting/loading — replay stays bit-identical
+                    continue
+                fault_point("chunk_loop", chunk=pos, epoch=epoch)
                 if hbm_cache:
                     if int(chunk_idx) not in cache:
                         cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
@@ -173,11 +232,32 @@ def basic_l1_sweep(
                         out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
                         learned_dicts,
                     )
-            if not save_after_every:
+
+                # preemption/periodic checkpoint boundary: cursor = last
+                # COMPLETED (epoch, position) + the post-split key, so a
+                # resumed run replays the remaining chunks bit-identically
+                def _save_ckpt(path, _epoch=epoch, _pos=pos):
+                    ckpt_lib.save_ensemble_checkpoint(
+                        path, [(ens, {}, "ensemble")],
+                        chunk_cursor=_epoch * len(store) + _pos,
+                        extra={
+                            "epoch": _epoch, "position": _pos,
+                            "key": np.asarray(jax.device_get(key)),
+                        },
+                    )
+
+                ckpt.boundary(epoch * len(store) + pos, _save_ckpt)
+            # epochs fully completed BEFORE the resume already have their
+            # export on disk — re-exporting would overwrite it with the
+            # restored (later-epoch) state
+            if not save_after_every and epoch >= start_epoch:
                 learned_dicts = export()
                 save_learned_dicts(
                     out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
                 )
+    except Preempted:
+        status = "preempted"
+        raise
     except BaseException as e:
         status = f"error: {type(e).__name__}: {e}"
         raise
@@ -193,6 +273,7 @@ def basic_l1_sweep(
             if status == "ok":
                 status = f"error: {type(e).__name__}: {e}"
         trigger.close()  # stop any in-flight trace window before run_end
+        ckpt.close()  # no longer polling: later signals terminate normally
         telemetry.run_end(
             status=status,
             timer_stats=timer.report(

@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from sparse_coding__tpu.parallel.mesh import DATA_AXIS, batch_sharding
+from sparse_coding__tpu.utils.faults import fault_point
 
 Pytree = Any
 
@@ -187,6 +188,11 @@ def train_big_batch(
     l1_warmup_steps: int = 0,
     telemetry=None,
     trace_trigger=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: Optional[bool] = None,
+    checkpoint_every: Optional[int] = None,
+    checkpoint_keep: int = 3,
+    preempt_sync_every: int = 16,
 ) -> Tuple[BigBatchState, Any]:
     """Train one SAE with huge data-parallel batches + periodic dead-feature
     resurrection. Returns (final state, sig) for `to_learned_dict` export.
@@ -207,6 +213,18 @@ def train_big_batch(
     env-armed `SC_TRACE_WINDOW` profiler windows resolve at true step
     granularity here; HBM watermark gauges are sampled at each resurrection
     boundary and at the end of training.
+
+    Preemption safety (docs/RECOVERY.md): when ``checkpoint_dir`` is set the
+    run survives being killed at any instant — SIGTERM/SIGINT triggers a
+    crash-consistent checkpoint (full `BigBatchState` + step cursor + RNG
+    key) at the next step boundary and a resumable exit (code 75);
+    ``checkpoint_every=N`` additionally checkpoints every N steps, keeping
+    the newest ``checkpoint_keep``. ``resume=True`` (or ``SC_RESUME=1``)
+    restores the latest committed checkpoint and replays the remaining
+    steps with the original key chain. The host-side worst-example ring
+    restarts empty on resume (its ~`reinit_every`-step window refills
+    before the next resurrection); on pods the preemption agreement
+    exchange runs every ``preempt_sync_every`` step boundaries.
     """
     from sparse_coding__tpu.utils import precision as px
 
@@ -215,6 +233,8 @@ def train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
             learning_rate, mesh, reinit_every, worst_k, resurrection_log,
             encoder_norm_ratio, l1_warmup_steps, telemetry, trace_trigger,
+            checkpoint_dir, resume, checkpoint_every, checkpoint_keep,
+            preempt_sync_every,
         )
 
 
@@ -222,6 +242,8 @@ def _train_big_batch(
     sig, init_hparams, dataset, batch_size, n_steps, key,
     learning_rate, mesh, reinit_every, worst_k, resurrection_log,
     encoder_norm_ratio, l1_warmup_steps, telemetry=None, trace_trigger=None,
+    checkpoint_dir=None, resume=None, checkpoint_every=None,
+    checkpoint_keep=3, preempt_sync_every=16,
 ) -> Tuple[BigBatchState, Any]:
     if trace_trigger is None:
         # existing callers (resurrect/batch-scaling studies) pass no trigger:
@@ -241,6 +263,30 @@ def _train_big_batch(
         c_totals=jnp.zeros((n_feats,)),
         step=jnp.zeros((), jnp.int32),
     )
+
+    # checkpoint/resume/preemption glue (docs/RECOVERY.md): shared with the
+    # sweep drivers via train.loop.DriverCheckpointer
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from sparse_coding__tpu.train.loop import DriverCheckpointer
+        from sparse_coding__tpu.train.preemption import resume_requested
+
+        ckpt = DriverCheckpointer(
+            checkpoint_dir, telemetry=telemetry, keep=checkpoint_keep,
+            every=checkpoint_every, sync_every=preempt_sync_every,
+        )
+        if resume_requested(resume):
+            template = {
+                "cursor": {"step": 0, "key": np.zeros((2,), np.uint32)},
+                "state": state,
+            }
+            tree = ckpt.restore(template)
+            if tree is not None:
+                state = tree["state"]
+                start_step = int(tree["cursor"]["step"])
+                key = jnp.asarray(np.asarray(tree["cursor"]["key"]))
+                print(f"Resumed {checkpoint_dir} at step {start_step}")
     if mesh is not None:
         sharding = batch_sharding(mesh)
         # mesh-dependent loss specialization (e.g. the tied-SAE DP backward
@@ -258,7 +304,8 @@ def _train_big_batch(
     worst = WorstExamples(worst_k)
     n = dataset.shape[0]
     try:
-        for i in range(n_steps):
+        for i in range(start_step, n_steps):
+            fault_point("step_loop", step=i)
             key, k = jax.random.split(key)
             idxs = np.asarray(jax.random.randint(k, (batch_size,), 0, n))
             batch = dataset[idxs]
@@ -304,6 +351,22 @@ def _train_big_batch(
             if telemetry is not None:
                 telemetry.counter_inc("train.steps")
             trace_trigger.on_step(i + 1)  # host-side int compares only
+            if ckpt is not None:
+                # step-window boundary: cursor = completed steps + the
+                # post-split key (a resumed run replays the same batches).
+                # Unflagged single-host cost: one bool read.
+                def _save_ckpt(path, _done=i + 1):
+                    from sparse_coding__tpu.train.checkpoint import save_checkpoint_tree
+
+                    save_checkpoint_tree(path, {
+                        "cursor": {
+                            "step": _done,
+                            "key": np.asarray(jax.device_get(key)),
+                        },
+                        "state": state,
+                    })
+
+                ckpt.boundary(i + 1, _save_ckpt)
         if telemetry is not None:
             from sparse_coding__tpu.telemetry.multihost import heartbeat
             from sparse_coding__tpu.telemetry.profiling import record_hbm_watermarks
@@ -314,4 +377,6 @@ def _train_big_batch(
         # an exception mid-run must still finalize any in-flight profiler
         # window — a leaked trace blocks every later capture in the process
         trace_trigger.close(n_steps)
+        if ckpt is not None:
+            ckpt.close()  # no longer polling: signals terminate normally
     return state, sig

@@ -1,4 +1,4 @@
-"""Checkpointing: full training-state save/resume + learned-dict exports.
+"""Checkpointing: crash-consistent full-state save/resume + learned-dict exports.
 
 The reference only ever saves *outputs* — `(LearnedDict, hyperparams)` lists at
 exponential chunk counts (`big_sweep.py:421-427`) — and has no way to resume
@@ -14,16 +14,56 @@ training (SURVEY.md §5 "checkpoint/resume: save-only"). Here:
     with numpy leaves (portable, no framework pinning). All analysis tooling
     consumes this format, exactly as everything in the reference consumes
     `learned_dicts.pt`.
+
+**Crash consistency (PR 5).** A kill mid-write must never produce a
+checkpoint that resume will trust. Every full-state save follows an atomic
+commit protocol (`save_checkpoint_tree`):
+
+  1. orbax writes into a dot-prefixed staging dir (`.staging_ckpt_<i>`) that
+     no discovery glob matches;
+  2. a manifest (`sc_manifest.json`, per-file byte sizes + sha256 digests)
+     is written inside the staging dir;
+  3. the staging dir is renamed onto the final `ckpt_<i>` name —
+     `os.replace`, the one atomic commit point. A committed directory
+     therefore ALWAYS carries its manifest; a torn save only ever leaves a
+     staging dir behind.
+
+`latest_checkpoint` walks candidates newest-first and returns the first one
+that *verifies* (manifest present, file sizes and — by default — digests
+match; `SC_CKPT_VERIFY=size|digest|off` tunes the depth), falling back to
+the previous good checkpoint past any torn or corrupt directory.
+`gc_checkpoints` keeps the newest K committed checkpoints and sweeps torn
+leftovers. Fault sites `checkpoint_commit` / `checkpoint_committed`
+(`utils.faults`) let the chaos tests kill or corrupt a save at exactly the
+wrong moment and prove all of the above.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import pickle
+import shutil
+import time
+import warnings
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from sparse_coding__tpu.utils.faults import fault_point
+
+MANIFEST_NAME = "sc_manifest.json"
+
+# verification depth for latest_checkpoint / verify_checkpoint:
+#   digest (default) — sizes + sha256 of every file (resume is rare; reading
+#                      the checkpoint once more is cheap insurance)
+#   size             — existence + byte sizes only (pod-scale states where a
+#                      full re-read is material)
+#   off              — manifest presence only
+VERIFY_ENV = "SC_CKPT_VERIFY"
 
 
 # -- learned-dict export (the reference's learned_dicts.pt) -------------------
@@ -36,6 +76,11 @@ def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
     shifts (corrupting loads) if a class's pytree registration changes between
     save and load. Non-registered values (e.g. nested pytrees inside a field)
     are handled by `jax.tree.map` over the field value.
+
+    The write is atomic: the pickle lands in a same-directory temp file and
+    is `os.replace`d onto `path`, so a kill mid-export leaves either the
+    previous complete file or nothing — never a truncated pickle for
+    `load_learned_dicts` to explode on.
     """
     from sparse_coding__tpu.models.learned_dict import LEARNED_DICT_REGISTRY
 
@@ -60,10 +105,29 @@ def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
                 "hyperparams": hyperparams,
             }
         )
+    fault_point("export", path=str(path))
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(records, f)
+    # same directory, so the final os.replace is within one filesystem; pid
+    # suffix keeps concurrent writers apart — and means a SIGKILLed export
+    # leaves a tmp a LATER process can't reuse, so sweep stale ones here,
+    # but ONLY those whose writer is dead (a live pid may be mid-dump)
+    for stale in path.parent.glob(f".{path.name}.tmp*"):
+        try:
+            os.kill(int(stale.name.rsplit("tmp", 1)[-1]), 0)
+        except (ValueError, ProcessLookupError):
+            stale.unlink(missing_ok=True)  # dead or unparseable writer
+        except PermissionError:
+            pass  # alive under another uid: leave it
+    tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(records, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 def load_learned_dicts(path) -> List[Tuple[Any, Dict[str, Any]]]:
@@ -94,6 +158,165 @@ def load_learned_dicts(path) -> List[Tuple[Any, Dict[str, Any]]]:
     return out
 
 
+# -- atomic commit protocol ----------------------------------------------------
+
+def _staging_dir(final: Path) -> Path:
+    """Dot-prefixed sibling: invisible to the `ckpt_*` discovery glob, so a
+    torn write can never be mistaken for a checkpoint."""
+    return final.parent / f".staging_{final.name}"
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _write_manifest(ckpt_dir: Path, extra: Optional[Dict[str, Any]] = None) -> None:
+    # digests double the checkpoint's write-side I/O (a full re-read of the
+    # state just written); SC_CKPT_VERIFY=size skips them HERE too — the
+    # knob exists exactly for pod-scale states where the re-read is
+    # material, and it is paid per save, not per (rare) resume
+    digest = os.environ.get(VERIFY_ENV, "digest").lower() == "digest"
+    files = {}
+    for p in sorted(ckpt_dir.rglob("*")):
+        if p.is_file() and p.name != MANIFEST_NAME:
+            rel = str(p.relative_to(ckpt_dir))
+            files[rel] = {"bytes": p.stat().st_size}
+            if digest:
+                files[rel]["sha256"] = _sha256(p)
+    manifest = {"format": 1, "created_at": time.time(), "files": files, **(extra or {})}
+    with open(ckpt_dir / MANIFEST_NAME, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def checkpoint_manifest(ckpt_dir) -> Optional[Dict[str, Any]]:
+    """The directory's commit manifest, or None when uncommitted/unreadable."""
+    path = Path(ckpt_dir) / MANIFEST_NAME
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_checkpoint(ckpt_dir, depth: Optional[str] = None) -> Tuple[bool, str]:
+    """Is `ckpt_dir` a committed, intact checkpoint? Returns (ok, reason).
+
+    `depth` overrides `SC_CKPT_VERIFY` (digest | size | off). A directory
+    without a manifest is uncommitted by definition — the commit rename is
+    the only way a manifest-bearing dir gets its final name."""
+    ckpt_dir = Path(ckpt_dir)
+    if not ckpt_dir.is_dir():
+        return False, "not a directory"
+    manifest = checkpoint_manifest(ckpt_dir)
+    if manifest is None:
+        return False, "uncommitted (no manifest)"
+    depth = (depth or os.environ.get(VERIFY_ENV, "digest")).lower()
+    if depth == "off":
+        return True, "ok (manifest only)"
+    for rel, meta in manifest.get("files", {}).items():
+        p = ckpt_dir / rel
+        if not p.is_file():
+            return False, f"missing file {rel}"
+        if p.stat().st_size != meta.get("bytes"):
+            return False, f"size mismatch on {rel}"
+        # digest-check only entries that carry one (manifests written under
+        # SC_CKPT_VERIFY=size store sizes only)
+        if depth == "digest" and "sha256" in meta and _sha256(p) != meta["sha256"]:
+            return False, f"digest mismatch on {rel}"
+    return True, "ok"
+
+
+def _pod_barrier(tag: str) -> None:
+    """All-host rendezvous through the coordination KV store (no-op
+    single-host): checkpoint commits must not rename a directory other
+    hosts are still writing into."""
+    from sparse_coding__tpu.telemetry.multihost import _kv_allgather, process_info
+
+    _, count = process_info()
+    if count > 1:
+        _kv_allgather(tag, "done")
+
+
+def save_checkpoint_tree(ckpt_dir, tree: Dict[str, Any], extra_manifest: Optional[Dict[str, Any]] = None) -> Path:
+    """Atomically save an orbax pytree checkpoint to `ckpt_dir`.
+
+    Data lands in a staging dir, the manifest is written beside it, and the
+    staging dir is renamed onto the final name — the atomic commit point. A
+    kill anywhere in between leaves only a staging dir that
+    `latest_checkpoint` never considers and `gc_checkpoints` sweeps.
+    Multi-host: every process writes its shards into the shared staging dir,
+    a KV barrier waits for all writers, then process 0 alone commits.
+    """
+    final = Path(ckpt_dir).absolute()
+    final.parent.mkdir(parents=True, exist_ok=True)
+    staging = _staging_dir(final)
+    from sparse_coding__tpu.telemetry.multihost import process_info
+
+    idx, count = process_info()
+    if idx == 0 and staging.exists():
+        shutil.rmtree(staging)
+    if count > 1:
+        # pods: nobody may write shards into the staging dir until the
+        # coordinator has finished sweeping a stale one (crashed prior save)
+        _pod_barrier("ckpt_staged")
+    _checkpointer().save(staging, tree, force=True)
+    # chaos site: dying HERE (data written, not committed) is the torn-write
+    # case the whole protocol exists for
+    fault_point("checkpoint_commit", path=str(final))
+    _pod_barrier("ckpt_written")
+    if idx == 0:
+        _write_manifest(staging, extra=extra_manifest)
+        if final.exists():
+            shutil.rmtree(final)
+        os.replace(staging, final)
+    if count > 1:
+        _pod_barrier("ckpt_committed")
+    fault_point("checkpoint_committed", path=str(final))
+    return final
+
+
+def gc_checkpoints(output_folder, keep: int = 3) -> List[Path]:
+    """Retention GC: keep the newest `keep` committed `ckpt_*` dirs, delete
+    older committed ones plus stale staging leftovers. Returns the removed
+    paths.
+
+    Manifest-less `ckpt_*` dirs are NEVER deleted: the atomic protocol can
+    only leave a torn save under a `.staging_*` name (the rename is the
+    commit), so a final-named dir without a manifest is a LEGACY checkpoint
+    from the pre-manifest format — hours of training state, not garbage.
+
+    Single-writer discipline: call it from the process/host that writes the
+    checkpoints (the drivers call it right after each successful commit).
+    """
+    root = Path(output_folder)
+    if not root.exists() or keep < 1:
+        return []
+    removed: List[Path] = []
+    indexed = [
+        (idx, p) for p in root.glob("ckpt_*")
+        if p.is_dir() and (idx := _ckpt_index(p)) is not None
+    ]
+    committed = sorted(
+        (i, p) for i, p in indexed if checkpoint_manifest(p) is not None
+    )
+    for i, p in committed[:-keep] if len(committed) > keep else []:
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    for p in root.glob(".staging_ckpt_*"):
+        # stale staging from a previous crash — the current save's staging
+        # was renamed away before GC runs
+        if p.is_dir():
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+    return removed
+
+
 # -- full training-state checkpoints (orbax) ----------------------------------
 
 def _checkpointer():
@@ -117,8 +340,10 @@ def save_ensemble_checkpoint(
     global array would raise on non-addressable shards, and even
     single-host it would needlessly round-trip the whole state through host
     RAM). Pairs with the sharded restore in `restore_ensemble_checkpoint`.
+
+    Commits atomically via `save_checkpoint_tree` (staging dir + manifest +
+    rename), so a kill mid-save can never leave a directory resume trusts.
     """
-    ckpt_dir = Path(ckpt_dir).absolute()
     tree = {
         "cursor": {"chunk": chunk_cursor, **(extra or {})},
         "ensembles": {
@@ -126,7 +351,7 @@ def save_ensemble_checkpoint(
         },
         "args": {name: _args for _ens, _args, name in ensembles},
     }
-    _checkpointer().save(ckpt_dir, tree, force=True)
+    return save_checkpoint_tree(ckpt_dir, tree)
 
 
 def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = None):
@@ -160,10 +385,50 @@ def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = N
     return ckpt.restore(ckpt_dir)
 
 
-def latest_checkpoint(output_folder) -> Optional[Path]:
-    """Most recent `ckpt_*` dir under the sweep output folder."""
+def _ckpt_index(p: Path) -> Optional[int]:
+    try:
+        return int(p.name.split("_", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def latest_checkpoint(output_folder, depth: Optional[str] = None) -> Optional[Path]:
+    """Most recent COMMITTED, intact `ckpt_*` dir under the sweep output
+    folder — corrupt (size/digest-mismatched) directories are skipped with
+    a warning, falling back to the previous good checkpoint. `depth` tunes
+    verification (see `verify_checkpoint`).
+
+    Legacy checkpoints (pre-manifest format — the atomic protocol never
+    leaves a manifest-less dir under a final name) are used only when NO
+    manifest-bearing checkpoint verifies, newest first, with a warning:
+    resume from unverifiable prior state beats silently restarting a run
+    from scratch.
+    """
     root = Path(output_folder)
     if not root.exists():
         return None
-    ckpts = sorted(root.glob("ckpt_*"), key=lambda p: int(p.name.split("_")[1]))
-    return ckpts[-1] if ckpts else None
+    ckpts = sorted(
+        (p for p in root.glob("ckpt_*") if p.is_dir() and _ckpt_index(p) is not None),
+        key=_ckpt_index,
+    )
+    legacy: List[Path] = []
+    for p in reversed(ckpts):
+        if checkpoint_manifest(p) is None:
+            legacy.append(p)
+            continue
+        ok, reason = verify_checkpoint(p, depth=depth)
+        if ok:
+            return p
+        warnings.warn(
+            f"skipping checkpoint {p.name}: {reason} (falling back to the "
+            "previous good checkpoint)",
+            RuntimeWarning,
+        )
+    if legacy:
+        warnings.warn(
+            f"no committed checkpoint verifies under {root}; using legacy "
+            f"(pre-manifest, unverifiable) {legacy[0].name}",
+            RuntimeWarning,
+        )
+        return legacy[0]
+    return None

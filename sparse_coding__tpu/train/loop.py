@@ -26,6 +26,7 @@ buffers — the behavior the EMA code plainly intends.
 from __future__ import annotations
 
 from functools import lru_cache, partial
+from pathlib import Path
 from typing import Callable, Dict, Optional
 
 import jax
@@ -38,6 +39,142 @@ from sparse_coding__tpu.models.learned_dict import _norm_rows
 from sparse_coding__tpu.telemetry.audit import allowed_transfer
 from sparse_coding__tpu.telemetry.events import tracked_jit
 from sparse_coding__tpu.utils.logging import MetricLogger
+
+
+class DriverCheckpointer:
+    """Shared driver-side checkpoint/resume/preemption glue (docs/RECOVERY.md).
+
+    Every training driver (`basic_l1_sweep`, `sweep`, `train_big_batch`)
+    holds one of these and calls `boundary(cursor_id, save_fn)` at each
+    chunk (or step-window) boundary. The boundary:
+
+      - asks `preemption.pod_agree_preempt` whether the run is being
+        reclaimed (host-local flag single-host; a KV-store allgather on
+        pods — any flagged host preempts the whole pod). If so it writes a
+        crash-consistent checkpoint via `save_fn`, records a ``preempt``
+        telemetry event, and raises `Preempted` (exit code 75 — the
+        supervisor's restart signal);
+      - otherwise saves on the periodic ``every``-boundaries cadence.
+
+    `save_fn(path)` is driver-owned: it must write the checkpoint with the
+    atomic `train.checkpoint` protocol (`save_ensemble_checkpoint` /
+    `save_checkpoint_tree`) so a kill mid-save is recoverable. Every save
+    is followed by retention GC (keep the newest ``keep``).
+
+    ``sync_every`` bounds pod KV-exchange frequency for drivers whose
+    boundaries are per-step rather than per-chunk (`train_big_batch`):
+    multi-host agreement runs only every Nth boundary (still lockstep —
+    every host counts boundaries identically); single-host the flag check
+    is a plain bool read, so every boundary checks.
+    """
+
+    def __init__(
+        self,
+        output_folder,
+        telemetry=None,
+        keep: int = 3,
+        every: Optional[int] = None,
+        sync_every: int = 1,
+    ):
+        from sparse_coding__tpu.train.preemption import (
+            install_signal_handlers,
+            poller_started,
+        )
+
+        self.out = Path(output_folder)
+        self.telemetry = telemetry
+        self.keep = keep
+        self.every = every
+        self._sync_every = max(1, int(sync_every))
+        self._n_boundaries = 0
+        self._closed = False
+        self.handlers_active = install_signal_handlers()
+        poller_started()
+
+    def close(self) -> None:
+        """The driver's run is over: stop counting as a live boundary poller
+        so a later signal terminates normally instead of setting a flag
+        nothing reads. Idempotent; drivers call it in their `finally`."""
+        from sparse_coding__tpu.train.preemption import poller_stopped
+
+        if not self._closed:
+            self._closed = True
+            poller_stopped()
+
+    def restore(self, template) -> Optional[Dict]:
+        """Latest committed+intact checkpoint tree (torn/corrupt dirs are
+        skipped by `latest_checkpoint`), or None. Emits a ``resume`` event."""
+        from sparse_coding__tpu.train import checkpoint as ckpt_lib
+
+        latest = ckpt_lib.latest_checkpoint(self.out)
+        if latest is None:
+            return None
+        tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
+        if self.telemetry is not None:
+            cursor = {
+                k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in (tree.get("cursor") or {}).items()
+            }
+            self.telemetry.event("resume", checkpoint=str(latest), cursor=cursor)
+            self.telemetry.counter_inc("resumes")
+        return tree
+
+    def save(self, cursor_id: int, save_fn: Callable[[Path], None], reason: str = "periodic") -> Path:
+        from sparse_coding__tpu.train import checkpoint as ckpt_lib
+
+        path = self.out / f"ckpt_{int(cursor_id)}"
+        save_fn(path)
+        ckpt_lib.gc_checkpoints(self.out, keep=self.keep)
+        if self.telemetry is not None:
+            self.telemetry.event("checkpoint", path=str(path), cursor=int(cursor_id), reason=reason)
+            self.telemetry.counter_inc("checkpoints")
+        return path
+
+    def boundary(
+        self,
+        cursor_id: int,
+        save_fn: Callable[[Path], None],
+        already_saved: bool = False,
+    ) -> None:
+        """Chunk/step-window boundary hook; raises `Preempted` after the
+        preemption checkpoint commits. ``already_saved=True`` when the
+        driver just checkpointed this cursor on its own schedule (the
+        preemption path then reuses it instead of re-saving)."""
+        from sparse_coding__tpu.telemetry.multihost import process_info
+        from sparse_coding__tpu.train.preemption import (
+            Preempted,
+            pod_agree_preempt,
+            preemption_signal,
+        )
+
+        self._n_boundaries += 1
+        _, count = process_info()
+        if count > 1 and self._n_boundaries % self._sync_every != 0:
+            preempt = False
+        else:
+            preempt = pod_agree_preempt(self.telemetry)
+        if preempt:
+            path = (
+                self.out / f"ckpt_{int(cursor_id)}"
+                if already_saved
+                else self.save(cursor_id, save_fn, reason="preempt")
+            )
+            if self.telemetry is not None:
+                self.telemetry.event(
+                    "preempt",
+                    signum=preemption_signal(),
+                    checkpoint=str(path),
+                    cursor=int(cursor_id),
+                )
+            raise Preempted(
+                f"preempted: checkpoint committed at {path}; exiting resumable"
+            )
+        if (
+            self.every
+            and not already_saved
+            and self._n_boundaries % self.every == 0
+        ):
+            self.save(cursor_id, save_fn, reason="periodic")
 
 
 @lru_cache(maxsize=32)

@@ -1,0 +1,203 @@
+"""Graceful preemption: signal → flag → checkpoint at a boundary → exit 75.
+
+TPU pods are reclaimed mid-run as a matter of course; the contract here
+(docs/RECOVERY.md) is that a SIGTERM costs at most one chunk of progress,
+never the run:
+
+  1. `install_signal_handlers()` (called by every driver through
+     `train.loop.DriverCheckpointer`) converts SIGTERM/SIGINT into a
+     host-side flag. Nothing is interrupted mid-step — jitted dispatches
+     complete, device state stays consistent.
+  2. Drivers poll the flag at chunk (or step-window) boundaries via
+     `pod_agree_preempt`. On multi-host runs the poll is a tiny allgather
+     over the same distributed-coordination KV store `telemetry.multihost`
+     rides (pure host-side, zero device syncs): if ANY host saw a signal,
+     EVERY host agrees to checkpoint — a pod must act as one, because a
+     checkpoint only some hosts wrote is no checkpoint at all. The exchange
+     runs at boundaries that are already pod-lockstep (the heartbeat
+     contract), so rounds always pair up.
+  3. The driver writes a crash-consistent checkpoint
+     (`train.checkpoint.save_checkpoint_tree`) and raises `Preempted` — a
+     `SystemExit` carrying exit code **75** (`EX_TEMPFAIL`: "transient,
+     try again"), the code the auto-resume supervisor
+     (`python -m sparse_coding__tpu.supervise`) treats as "restart me".
+
+A second SIGINT while the flag is set raises `KeyboardInterrupt` — Ctrl-C
+twice still means "stop NOW". `SC_PREEMPT=0` disables handler
+installation entirely (the flag then simply never sets). Handlers can only
+be installed from the main thread (a CPython `signal` restriction);
+elsewhere installation is skipped and reported via the return value.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Optional, Tuple
+
+__all__ = [
+    "RESUMABLE_EXIT_CODE",
+    "Preempted",
+    "install_signal_handlers",
+    "pod_agree_preempt",
+    "preemption_requested",
+    "preemption_signal",
+    "request_preemption",
+    "reset",
+    "resume_requested",
+]
+
+# EX_TEMPFAIL from sysexits.h: a temporary failure, the caller should retry.
+# The supervisor restarts ONLY on this code by default; anything else is a
+# real failure that deserves eyes.
+RESUMABLE_EXIT_CODE = 75
+
+# set by the supervisor on restarted children; drivers with resume=None
+# (the default) consult it so `supervise` needs no per-driver flag plumbing
+RESUME_ENV = "SC_RESUME"
+
+# SC_PREEMPT=0 opts out of signal-handler installation (e.g. a harness that
+# owns its own signal semantics)
+DISABLE_ENV = "SC_PREEMPT"
+
+
+class Preempted(SystemExit):
+    """Raised by a driver after its preemption checkpoint is committed.
+
+    A `SystemExit` subclass carrying `RESUMABLE_EXIT_CODE`, so an unhandled
+    unwind exits the process with code 75 — no CLI glue needed — while
+    library callers can still catch it (drivers' `finally` blocks run on the
+    way out, so telemetry `run_end` records land)."""
+
+    def __init__(self, message: str = "preempted"):
+        super().__init__(RESUMABLE_EXIT_CODE)
+        self.message = message
+
+    def __str__(self) -> str:  # SystemExit.__str__ would print "75"
+        return self.message
+
+
+_STATE = {
+    "installed": False,
+    "requested": False,
+    "signum": None,  # type: Optional[int]
+    # count of live DriverCheckpointers actually polling the flag; when it
+    # is zero (e.g. a script doing post-processing after its training run)
+    # the handler reverts to normal semantics instead of setting a flag
+    # nothing will ever read
+    "pollers": 0,
+}
+
+
+def _handler(signum, frame):
+    if _STATE["requested"] and signum == signal.SIGINT:
+        # second Ctrl-C: the user wants out NOW, not a checkpoint
+        raise KeyboardInterrupt
+    if _STATE["pollers"] <= 0:
+        # no driver is polling: behave like the default disposition
+        if signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        raise SystemExit(128 + signum)
+    _STATE["requested"] = True
+    _STATE["signum"] = signum
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:  # pragma: no cover - unknown signum
+        name = str(signum)
+    sys.stderr.write(
+        f"[preemption] {name} received — will checkpoint at the next "
+        "boundary and exit 75 (signal again with SIGINT to abort now)\n"
+    )
+
+
+def install_signal_handlers(
+    signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+) -> bool:
+    """Install the preemption handlers (idempotent). Returns True when the
+    handlers are active; False when skipped (SC_PREEMPT=0, non-main thread,
+    or an environment that refuses signal.signal)."""
+    if os.environ.get(DISABLE_ENV, "1").lower() in ("0", "false", "off"):
+        return False
+    if _STATE["installed"]:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        for s in signals:
+            signal.signal(s, _handler)
+    except (ValueError, OSError):  # pragma: no cover - exotic embeddings
+        return False
+    _STATE["installed"] = True
+    return True
+
+
+def preemption_requested() -> bool:
+    """Host-local flag: has a preemption signal arrived in THIS process?"""
+    return bool(_STATE["requested"])
+
+
+def preemption_signal() -> Optional[int]:
+    """The signum that set the flag (None when not preempted)."""
+    return _STATE["signum"]
+
+
+def request_preemption(signum: Optional[int] = None) -> None:
+    """Set the flag programmatically — for tests and for cluster-notice
+    pollers (e.g. a thread watching the GCE preemption metadata endpoint)
+    that learn about reclamation without a signal."""
+    _STATE["requested"] = True
+    _STATE["signum"] = signum
+
+
+def poller_started() -> None:
+    """A boundary poller (DriverCheckpointer) is live: preemption signals
+    set the flag instead of terminating."""
+    _STATE["pollers"] += 1
+
+
+def poller_stopped() -> None:
+    _STATE["pollers"] = max(0, _STATE["pollers"] - 1)
+
+
+def reset() -> None:
+    """Clear the flag and forget installation (tests only — the process-wide
+    signal disposition is NOT restored)."""
+    _STATE["requested"] = False
+    _STATE["signum"] = None
+    _STATE["installed"] = False
+    _STATE["pollers"] = 0
+
+
+def pod_agree_preempt(telemetry=None) -> bool:
+    """Pod-wide "checkpoint now?" agreement, called at lockstep boundaries.
+
+    Single-host: returns the local flag (no I/O). Multi-host: one KV-store
+    allgather of the per-host flag; ANY host flagged → True on EVERY host,
+    so the whole pod checkpoints the same cursor and exits 75 together. On
+    exchange failure (coordinator gone — often preemption itself) falls
+    back to the local flag: better one host checkpointing than none.
+    """
+    from sparse_coding__tpu.telemetry.multihost import _kv_allgather, process_info
+
+    local = preemption_requested()
+    _, count = process_info()
+    if count <= 1:
+        return local
+    raw = _kv_allgather("preempt", "1" if local else "0")
+    if raw is None:
+        return local
+    agreed = any(v == "1" for v in raw)
+    if agreed and not local and telemetry is not None:
+        telemetry.event("preempt_peer", flagged=[i for i, v in enumerate(raw) if v == "1"])
+    return agreed
+
+
+def resume_requested(explicit: Optional[bool]) -> bool:
+    """Resolve a driver's `resume` argument: an explicit True/False wins;
+    None (the default) defers to `SC_RESUME` — which the supervisor sets on
+    every restarted child, making auto-resume zero-config."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(RESUME_ENV, "").lower() not in ("", "0", "false", "off")

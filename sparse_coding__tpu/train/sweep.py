@@ -43,7 +43,9 @@ from sparse_coding__tpu.telemetry import (
     record_hbm_watermarks,
 )
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
-from sparse_coding__tpu.train.loop import ensemble_train_loop
+from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
+from sparse_coding__tpu.train.preemption import Preempted, resume_requested
+from sparse_coding__tpu.utils.faults import fault_point
 from sparse_coding__tpu.utils.logging import (
     MetricLogger,
     format_hyperparam_val,
@@ -290,13 +292,22 @@ def init_model_dataset(cfg) -> ChunkStore:
 def sweep(
     ensemble_init_func: Callable,
     cfg,
-    resume: bool = False,
+    resume: Optional[bool] = None,
 ) -> List[Tuple[Any, Dict[str, Any]]]:
     """Run the full sweep; returns the final `(LearnedDict, hyperparams)` list.
 
     `ensemble_init_func(cfg) -> (ensembles, ensemble_hyperparams,
     buffer_hyperparams, hyperparam_ranges)` with `ensembles` a list of
     `(Ensemble, args, name)` — the reference contract (`big_sweep.py:374-379`).
+
+    Preemption safety (docs/RECOVERY.md): SIGTERM/SIGINT → crash-consistent
+    checkpoint at the next chunk boundary → exit code 75 (resumable).
+    ``resume=True`` — or the default ``resume=None`` with ``SC_RESUME=1``
+    (the supervisor's restart signal); an explicit ``False`` never resumes —
+    restores the latest COMMITTED checkpoint — torn/corrupt directories are
+    skipped — and fast-forwards the per-chunk RNG chain, so a resumed sweep
+    trains the remaining chunks with the same keys as an uninterrupted one.
+    The newest ``cfg.checkpoint_keep`` (default 3) checkpoints are retained.
     """
     np.random.seed(cfg.seed)
     os.makedirs(cfg.dataset_folder, exist_ok=True)
@@ -359,18 +370,23 @@ def sweep(
     reps = cfg.n_repetitions if getattr(cfg, "n_repetitions", None) else cfg.n_epochs
     chunk_order = np.tile(chunk_order, max(1, reps))
 
+    # preemption + checkpoint glue: signal handlers install here, the chunk
+    # boundary below polls them (docs/RECOVERY.md)
+    ckpt = DriverCheckpointer(
+        cfg.output_folder, telemetry=telemetry,
+        keep=getattr(cfg, "checkpoint_keep", 3),
+    )
     start_chunk = 0
-    if resume:
-        latest = ckpt_lib.latest_checkpoint(cfg.output_folder)
-        if latest is not None:
-            # live-state templates: sharded ensembles restore shard-by-shard
-            # onto their devices (never materialized whole on device 0)
-            template = {
-                "cursor": {"chunk": 0},
-                "ensembles": {name: ens.state_template() for ens, _a, name in ensembles},
-                "args": {name: _a for _e, _a, name in ensembles},
-            }
-            tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
+    if resume_requested(resume):
+        # live-state templates: sharded ensembles restore shard-by-shard
+        # onto their devices (never materialized whole on device 0)
+        template = {
+            "cursor": {"chunk": 0},
+            "ensembles": {name: ens.state_template() for ens, _a, name in ensembles},
+            "args": {name: _a for _e, _a, name in ensembles},
+        }
+        tree = ckpt.restore(template)
+        if tree is not None:
             start_chunk = int(tree["cursor"]["chunk"]) + 1
             restored = []
             for ens, args, name in ensembles:
@@ -385,7 +401,7 @@ def sweep(
                     )
                 restored.append((new_ens, args, name))
             ensembles = restored
-            print(f"Resumed from {latest} at chunk {start_chunk}")
+            print(f"Resumed {cfg.output_folder} at chunk {start_chunk}")
 
     means: Optional[jax.Array] = None
     means_path = Path(cfg.output_folder) / "means.npy"
@@ -394,6 +410,11 @@ def sweep(
 
     learned_dicts: List[Tuple[Any, Dict[str, Any]]] = []
     rng_key = jax.random.PRNGKey(cfg.seed)
+    # resumed runs fast-forward the split chain so the remaining chunks see
+    # the SAME keys the uninterrupted run would have used (one split per
+    # ensemble per completed chunk — exactly the consumption below)
+    for _ in range(start_chunk * len(ensembles)):
+        rng_key, _unused = jax.random.split(rng_key)
     remaining_order = [int(c) for c in chunk_order[start_chunk:]]
     if getattr(cfg, "hbm_cache_chunks", False):
         # multi-epoch sweeps whose dataset fits HBM: upload each unique chunk
@@ -422,6 +443,7 @@ def sweep(
     try:
         for i, chunk in zip(range(start_chunk, len(chunk_order)), chunk_iter):
             print(f"Chunk {i+1}/{len(chunk_order)} (file {int(chunk_order[i])})")
+            fault_point("chunk_loop", chunk=i)
             telemetry.chunk_start(i, file=int(chunk_order[i]))
             if getattr(cfg, "center_activations", False):
                 if means is None:
@@ -460,15 +482,17 @@ def sweep(
                     learned_dicts, chunk, i, hyperparam_ranges, logger, cfg.output_folder
                 )
 
+            def _save_ckpt(path, _i=i):
+                ckpt_lib.save_ensemble_checkpoint(path, ensembles, chunk_cursor=_i)
+
             if want_save:
                 iter_folder = Path(cfg.output_folder) / f"_{i}"
                 iter_folder.mkdir(parents=True, exist_ok=True)
                 ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
                 if hasattr(cfg, "save_yaml"):
                     cfg.save_yaml(iter_folder / "config.yaml")
-                ckpt_lib.save_ensemble_checkpoint(
-                    Path(cfg.output_folder) / f"ckpt_{i}", ensembles, chunk_cursor=i
-                )
+                # atomic commit + retention GC + telemetry `checkpoint` event
+                ckpt.save(i, _save_ckpt, reason="schedule")
             end_rec = telemetry.chunk_end(i, saved=bool(want_save))
             # flush-boundary perf attribution: HBM watermark gauges (host
             # query, no device sync) + trace-window arming on train steps
@@ -478,6 +502,9 @@ def sweep(
             # pod heartbeat + straggler-skew gauges (no-op single-host)
             heartbeat(telemetry, step=cum_steps,
                       window_seconds=end_rec.get("seconds"))
+            # preemption boundary: a signaled (pod-agreed) run checkpoints
+            # here and exits 75; a save-point checkpoint is reused as-is
+            ckpt.boundary(i, _save_ckpt, already_saved=want_save)
 
         if not learned_dicts:
             # resumed past the last chunk: export straight from the restored
@@ -488,6 +515,9 @@ def sweep(
                         ensemble, args, ensemble_hyperparams, buffer_hyperparams
                     )
                 )
+    except Preempted:
+        status = "preempted"
+        raise
     except BaseException as e:
         status = f"error: {type(e).__name__}: {e}"
         raise
@@ -503,6 +533,7 @@ def sweep(
             if status == "ok":
                 status = f"error: {type(e).__name__}: {e}"
         trigger.close()  # stop any in-flight trace window before run_end
+        ckpt.close()  # no longer polling: later signals terminate normally
         telemetry.run_end(status=status, masked_models=sorted(guard.masked))
         telemetry.close()
         if close_exc is not None and sys.exc_info()[0] is None:

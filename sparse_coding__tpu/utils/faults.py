@@ -1,0 +1,234 @@
+"""Deterministic fault injection (`SC_FAULT`) for robustness testing.
+
+Preemption-safety claims are only claims until a test kills a run and proves
+recovery; this module is how the chaos tests do it deterministically. Named
+*sites* are planted at the few places a real failure bites — checkpoint
+commit, chunk reads, the chunk/step loops — and the `SC_FAULT` env var
+selects which site fires, when, and how. Because selection is positional
+(chunk index, hit count) rather than time-based, an injected failure is
+reproducible run-to-run, which is what lets the kill-and-resume equivalence
+test assert bit-level recovery instead of "it didn't crash".
+
+Grammar (full reference: docs/RECOVERY.md)::
+
+    SC_FAULT = spec[;spec...]
+    spec     = action[:site][:key=value ...]
+
+Actions
+    kill                SIGKILL this process at the site (hard crash — no
+                        handlers, no cleanup; the torn-state generator)
+    sigterm / sigint    deliver the signal to this process (graceful
+                        preemption path: the handler sets the flag, the
+                        driver checkpoints at the next boundary, exit 75)
+    io_error            raise OSError at the site (retried by callers that
+                        retry; fires on attempt 0 only, so backoff succeeds)
+    exc                 raise InjectedFault (un-retried, unwinds the caller)
+    torn_checkpoint     InjectedFault at `checkpoint_commit` — the save dies
+                        after the data write, before the commit rename: a
+                        staging dir is left behind, never a committed one
+    corrupt_checkpoint  at `checkpoint_committed`: flip one byte of a data
+                        file inside the just-committed directory (the
+                        bit-rot / partial-overwrite case digest verification
+                        must catch)
+
+Sites (ctx fields in parentheses)
+    chunk_loop            top of each driver chunk iteration (chunk, epoch)
+    step_loop             top of each big-batch train step (step)
+    chunk_read            inside `ChunkStore.load`'s host read (chunk, attempt)
+    checkpoint_commit     after checkpoint data is on disk, before commit
+    checkpoint_committed  right after a successful commit (path)
+    export                top of `save_learned_dicts` (path)
+
+Selectors (all optional; every given selector must match)
+    chunk=N / step=N / epoch=N   fire only when the ctx field equals N
+    every=N                      fire on every Nth matching hit (1-based)
+    times=N                      stop after N fires (default: unlimited,
+                                 except torn/corrupt which default to 1)
+
+`kill:chunk=3` defaults its site to `chunk_loop`; `io_error` defaults to
+`chunk_read`. Unset `SC_FAULT` costs one dict lookup per site — the sites
+are free in production.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FAULT_ENV",
+    "InjectedFault",
+    "fault_point",
+    "parse_faults",
+    "reset",
+]
+
+FAULT_ENV = "SC_FAULT"
+
+_ACTIONS = (
+    "kill", "sigterm", "sigint", "io_error", "exc",
+    "torn_checkpoint", "corrupt_checkpoint",
+)
+
+# site aliases accepted in specs → canonical site names
+_SITE_ALIASES = {
+    "chunks": "chunk_read",
+    "chunk": "chunk_loop",
+    "checkpoint": "checkpoint_commit",
+    "export": "export",
+}
+
+# default site per action when the spec names none
+_DEFAULT_SITE = {
+    "io_error": "chunk_read",
+    "torn_checkpoint": "checkpoint_commit",
+    "corrupt_checkpoint": "checkpoint_committed",
+}
+
+
+class InjectedFault(RuntimeError):
+    """An intentionally planted failure (`SC_FAULT` exc/torn_checkpoint)."""
+
+
+class _Spec:
+    __slots__ = ("action", "site", "params", "hits", "fires", "max_fires")
+
+    def __init__(self, action: str, site: Optional[str], params: Dict[str, Any]):
+        self.action = action
+        self.site = site
+        self.params = params
+        self.hits = 0
+        self.fires = 0
+        default_times = 1 if action in ("torn_checkpoint", "corrupt_checkpoint") else None
+        self.max_fires = params.get("times", default_times)
+
+
+def parse_faults(text: str) -> List[_Spec]:
+    """Parse an `SC_FAULT` value; raises ValueError on an unknown action so a
+    typo'd chaos run fails loudly instead of injecting nothing."""
+    specs: List[_Spec] = []
+    for raw in text.replace(",", ";").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        fields = raw.split(":")
+        action = fields[0].strip()
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"unknown {FAULT_ENV} action {action!r} in {raw!r} "
+                f"(known: {', '.join(_ACTIONS)})"
+            )
+        site: Optional[str] = None
+        params: Dict[str, Any] = {}
+        for field in fields[1:]:
+            field = field.strip()
+            if not field:
+                continue
+            if "=" in field:
+                k, _, v = field.partition("=")
+                try:
+                    params[k.strip()] = int(v)
+                except ValueError:
+                    params[k.strip()] = v.strip()
+            else:
+                site = _SITE_ALIASES.get(field, field)
+        if site is None:
+            site = _DEFAULT_SITE.get(action)
+            if site is None and any(k in params for k in ("chunk", "epoch")):
+                site = "chunk_loop"
+            elif site is None and "step" in params:
+                site = "step_loop"
+        if site is None:
+            raise ValueError(
+                f"{FAULT_ENV} spec {raw!r} names no site and none can be "
+                "inferred from its action/selectors"
+            )
+        specs.append(_Spec(action, site, params))
+    return specs
+
+
+# parsed-spec cache keyed by the env string; counters live on the spec
+# objects, so changing SC_FAULT mid-process resets them (tests rely on this)
+_CACHE: Dict[str, Any] = {"env": None, "specs": []}
+
+
+def reset() -> None:
+    """Drop parsed specs + fire counters (tests; env changes do this too)."""
+    _CACHE["env"] = None
+    _CACHE["specs"] = []
+
+
+def _corrupt_committed_dir(path: str) -> None:
+    """Flip the first byte of the largest data file under `path` — a
+    deterministic stand-in for bit rot / a partial overwrite after commit."""
+    from pathlib import Path
+
+    files = sorted(
+        (p for p in Path(path).rglob("*") if p.is_file() and p.name != "sc_manifest.json"),
+        key=lambda p: (-p.stat().st_size, str(p)),
+    )
+    if not files:
+        return
+    target = files[0]
+    data = bytearray(target.read_bytes())
+    if not data:
+        return
+    data[0] ^= 0xFF
+    target.write_bytes(bytes(data))
+
+
+def _fire(spec: _Spec, site: str, ctx: Dict[str, Any]) -> None:
+    spec.fires += 1
+    desc = f"SC_FAULT {spec.action} at {site} {ctx or ''}".strip()
+    if spec.action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif spec.action == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+    elif spec.action == "sigint":
+        os.kill(os.getpid(), signal.SIGINT)
+    elif spec.action == "io_error":
+        raise OSError(desc)
+    elif spec.action == "corrupt_checkpoint":
+        if "path" in ctx:
+            _corrupt_committed_dir(str(ctx["path"]))
+    else:  # exc / torn_checkpoint
+        raise InjectedFault(desc)
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Declare a named fault site; no-op unless `SC_FAULT` selects it.
+
+    Raises (io_error/exc/torn_checkpoint), signals the process
+    (kill/sigterm/sigint), or mutates on-disk state (corrupt_checkpoint)
+    when a spec matches. Call it at the top of the loop/operation the site
+    names, passing positional context (chunk=, step=, attempt=, path=).
+    """
+    env = os.environ.get(FAULT_ENV)
+    if not env:
+        return
+    if env != _CACHE["env"]:
+        _CACHE["env"] = env
+        _CACHE["specs"] = parse_faults(env)
+    for spec in _CACHE["specs"]:
+        if spec.site != site:
+            continue
+        if spec.max_fires is not None and spec.fires >= spec.max_fires:
+            continue
+        # positional selectors must all match the ctx
+        matched = True
+        for key in ("chunk", "step", "epoch"):
+            if key in spec.params and ctx.get(key) != spec.params[key]:
+                matched = False
+                break
+        if not matched:
+            continue
+        # retried sites: fire on the first attempt only, so the caller's
+        # backoff path is exercised AND succeeds (the transient-error case)
+        if ctx.get("attempt", 0) != 0:
+            continue
+        spec.hits += 1
+        every = spec.params.get("every")
+        if every and spec.hits % int(every) != 0:
+            continue
+        _fire(spec, site, ctx)

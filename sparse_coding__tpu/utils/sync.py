@@ -25,9 +25,91 @@ import os
 import subprocess
 import time
 from pathlib import Path
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple, Type
 
 Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
+
+# env knobs for the shared retry engine (both sync and chunk reads ride it):
+# total attempts and the base delay of the exponential backoff
+RETRIES_ENV = "SC_SYNC_RETRIES"
+BACKOFF_ENV = "SC_SYNC_BACKOFF"
+_DEFAULT_RETRIES = 3
+_DEFAULT_BACKOFF = 1.0
+_MAX_DELAY = 8.0
+
+
+def default_retries() -> int:
+    """Total attempts (not re-tries) per operation: `SC_SYNC_RETRIES`, else 3."""
+    try:
+        return max(1, int(os.environ.get(RETRIES_ENV, _DEFAULT_RETRIES)))
+    except ValueError:
+        return _DEFAULT_RETRIES
+
+
+def default_backoff() -> float:
+    """Base delay (seconds) of the exponential backoff: `SC_SYNC_BACKOFF`,
+    else 1.0. The k-th failure sleeps `min(base * 2**k, 8.0)`."""
+    try:
+        return max(0.0, float(os.environ.get(BACKOFF_ENV, _DEFAULT_BACKOFF)))
+    except ValueError:
+        return _DEFAULT_BACKOFF
+
+
+def backoff_delays(
+    attempts: int, base_delay: float, max_delay: float = _MAX_DELAY
+) -> List[float]:
+    """The sleep schedule between attempts: `attempts - 1` exponentially
+    growing delays capped at `max_delay` (the last attempt never sleeps)."""
+    return [min(base_delay * (2 ** k), max_delay) for k in range(max(0, attempts - 1))]
+
+
+def retry_with_backoff(
+    fn: Callable[[int], object],
+    *,
+    attempts: Optional[int] = None,
+    base_delay: Optional[float] = None,
+    max_delay: float = _MAX_DELAY,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    give_up_on: Tuple[Type[BaseException], ...] = (),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+):
+    """Call `fn(attempt)` until it returns, retrying `retry_on` exceptions
+    with exponential backoff. ONE implementation shared by the remote-sync
+    engine and `data.chunks` transient-read retries (the PR-5 satellite
+    contract: both follow the same env-configurable schedule).
+
+    `attempts`/`base_delay` default to the `SC_SYNC_RETRIES` /
+    `SC_SYNC_BACKOFF` env values. `give_up_on` carves permanent failures
+    out of a broad `retry_on` (e.g. FileNotFoundError out of OSError) —
+    those re-raise immediately. `on_retry(attempt, exc)` fires before each
+    sleep — telemetry counters hook in there. The final failure re-raises.
+    """
+    attempts = default_retries() if attempts is None else max(1, attempts)
+    base = default_backoff() if base_delay is None else base_delay
+    delays = backoff_delays(attempts, base, max_delay)
+    if sleep is None:
+        sleep = time.sleep  # bound at call time (tests monkeypatch the module)
+    for attempt in range(attempts):
+        try:
+            return fn(attempt)
+        except retry_on as e:
+            if give_up_on and isinstance(e, give_up_on):
+                raise
+            if attempt >= attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delays[attempt] > 0:
+                sleep(delays[attempt])
+
+
+class _SyncFailed(Exception):
+    """Internal: a transfer tool run returned nonzero (retried)."""
+
+    def __init__(self, result: "subprocess.CompletedProcess"):
+        super().__init__(result.stderr)
+        self.result = result
 
 
 def _default_runner(cmd: List[str]) -> "subprocess.CompletedProcess":
@@ -116,19 +198,22 @@ def sync(
     includes: Optional[Sequence[str]] = None,
     excludes: Optional[Sequence[str]] = None,
     delete: bool = False,
-    retries: int = 3,
+    retries: Optional[int] = None,
     ssh_port: int = 22,
     runner: Runner = _default_runner,
 ) -> "subprocess.CompletedProcess":
     """Sync `src` → `dst` with scheme dispatch and retry/backoff.
 
-    Raises RuntimeError with the tool's stderr after `retries` failures.
+    `retries` (total attempts) defaults to `SC_SYNC_RETRIES` (3); the
+    backoff base comes from `SC_SYNC_BACKOFF` (1.0 s, doubling per failure,
+    capped at 8 s) — the shared `retry_with_backoff` schedule. Raises
+    RuntimeError with the tool's stderr after the final failure.
     """
     cmd = _build_command(src, dst, includes, excludes, delete, ssh_port)
-    last = None
-    for attempt in range(retries):
+
+    def attempt_once(_attempt: int) -> "subprocess.CompletedProcess":
         try:
-            last = runner(cmd)
+            result = runner(cmd)
         except FileNotFoundError:
             # transfer tool not installed. Local↔local still works through a
             # pure-python fallback (minimal images — like TPU-VM containers —
@@ -140,12 +225,20 @@ def sync(
                 f"`{cmd[0]}` is not installed; install it (or use a local "
                 "destination, which falls back to a pure-python copy)"
             ) from None
-        if last.returncode == 0:
-            return last
-        time.sleep(min(2**attempt, 8))
-    raise RuntimeError(
-        f"sync failed after {retries} attempts: {' '.join(cmd)}\n{last.stderr}"
-    )
+        if result.returncode != 0:
+            raise _SyncFailed(result)
+        return result
+
+    attempts = default_retries() if retries is None else max(1, retries)
+    try:
+        return retry_with_backoff(
+            attempt_once, attempts=attempts, retry_on=(_SyncFailed,)
+        )
+    except _SyncFailed as e:
+        raise RuntimeError(
+            f"sync failed after {attempts} attempts: {' '.join(cmd)}\n"
+            f"{e.result.stderr}"
+        ) from None
 
 
 def _local_sync(src, dst, includes, excludes, delete):

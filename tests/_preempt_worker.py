@@ -1,0 +1,37 @@
+"""Subprocess worker for the kill-and-resume chaos tests (tests/test_preemption.py).
+
+Runs a smoke-scale `basic_l1_sweep` over a pre-built chunk folder. The
+parent test controls fault injection through the SC_FAULT env var (e.g.
+``sigterm:chunk=1`` self-delivers a real SIGTERM at the top of chunk 1, so
+the driver checkpoints at that chunk's boundary and exits 75) and resume
+through ``--resume`` / SC_RESUME.
+
+Usage: python tests/_preempt_worker.py <dataset_folder> <output_folder> [--resume]
+"""
+
+import sys
+
+
+def main() -> None:
+    dataset_folder, output_folder = sys.argv[1], sys.argv[2]
+    resume = "--resume" in sys.argv[3:]
+
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    basic_l1_sweep(
+        dataset_folder,
+        output_folder,
+        activation_width=16,
+        l1_values=[1e-4, 1e-3],
+        dict_ratio=2.0,
+        batch_size=128,
+        n_epochs=1,
+        lr=1e-3,
+        fista_iters=8,
+        seed=0,
+        resume=True if resume else None,
+    )
+
+
+if __name__ == "__main__":
+    main()

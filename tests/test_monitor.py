@@ -137,6 +137,36 @@ def test_monitor_once_flags_unusable_event_fields(tmp_path, capsys):
     assert "status ok" in captured.out, "good records still render"
 
 
+RESUMED = Path(__file__).parent / "golden" / "resumed_run"
+
+
+def test_report_and_monitor_on_resumed_run_fixture(capsys):
+    """`tests/golden/resumed_run/` is a checked-in preempted-and-resumed run
+    with a supervisor restart log (regenerate ONLY via
+    `python scripts/make_golden_fixture.py --resumed-run`); tier-1 renders
+    the report's Recovery section and the monitor snapshot from it, so the
+    recovery merge/render path cannot silently rot (ISSUE 5 satellite)."""
+    assert (RESUMED / "events.jsonl").exists()
+    assert (RESUMED / "supervisor_events.jsonl").exists()
+
+    from sparse_coding__tpu.report import main as report_main
+
+    assert report_main([str(RESUMED)]) == 0
+    out = capsys.readouterr().out
+    assert "## Recovery" in out
+    assert "2 driver generation(s)" in out
+    assert "1 preemption(s)" in out
+    assert "1 supervisor restart(s)" in out
+    assert "Checkpoints used to resume" in out
+    assert "| 1 | 75 | preempt |" in out, "restart lineage row"
+
+    assert main([str(RESUMED), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "recovery: 1 preempt(s)" in out
+    assert "1 restart(s)" in out and "1 resume(s)" in out
+    assert "MALFORMED" not in out
+
+
 def test_custom_named_pod_logs_are_discovered(tmp_path):
     """per_process_file_name('bench_events.jsonl', 1, 2) ->
     bench_events.p1.jsonl must be found by BOTH the report and the

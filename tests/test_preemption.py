@@ -1,0 +1,404 @@
+"""Preemption safety, crash-consistent checkpoints, and fault injection.
+
+The PR-5 robustness contract (docs/RECOVERY.md), proven with injected
+faults: `SC_FAULT` grammar, transient-read retries feeding the `io.retry`
+counter, torn/corrupt checkpoint directories skipped by `latest_checkpoint`
+with fallback to the previous good one, retention GC, the `Preempted`
+exit-75 path — and the acceptance test: a smoke-scale `basic_l1_sweep`
+subprocess SIGTERMed mid-run (a REAL signal through the OS, delivered by a
+`sigterm:chunk=1` fault), resumed, and asserted to export learned dicts
+matching an uninterrupted run.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import RandomDatasetGenerator, save_chunk
+from sparse_coding__tpu.data.chunks import ChunkStore
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.telemetry import RunTelemetry
+from sparse_coding__tpu.train import checkpoint as ckpt_lib
+from sparse_coding__tpu.train import preemption
+from sparse_coding__tpu.train.loop import DriverCheckpointer
+from sparse_coding__tpu.utils import faults
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Every test starts (and leaves) with no faults armed, no preemption
+    flag set, and no sleeping backoff."""
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.setenv("SC_SYNC_BACKOFF", "0")
+    faults.reset()
+    preemption.reset()
+    yield
+    faults.reset()
+    preemption.reset()
+
+
+# -- SC_FAULT grammar ---------------------------------------------------------
+
+def test_fault_grammar():
+    specs = faults.parse_faults("kill:chunk=3;torn_checkpoint;io_error:chunks:every=5")
+    assert [(s.action, s.site) for s in specs] == [
+        ("kill", "chunk_loop"),
+        ("torn_checkpoint", "checkpoint_commit"),
+        ("io_error", "chunk_read"),
+    ]
+    assert specs[0].params == {"chunk": 3}
+    assert specs[2].params == {"every": 5}
+    # commas work as separators too; sigterm with a chunk selector infers
+    # the chunk loop
+    (s,) = faults.parse_faults("sigterm:chunk=1")
+    assert s.site == "chunk_loop" and s.params["chunk"] == 1
+    with pytest.raises(ValueError, match="unknown SC_FAULT action"):
+        faults.parse_faults("explode:chunk=1")
+    with pytest.raises(ValueError, match="names no site"):
+        faults.parse_faults("kill")
+
+
+def test_fault_point_selectors(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "exc:chunk_loop:chunk=2")
+    faults.reset()
+    faults.fault_point("chunk_loop", chunk=0)  # selector mismatch: no fire
+    faults.fault_point("chunk_read", chunk=2)  # site mismatch: no fire
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("chunk_loop", chunk=2)
+
+
+def test_fault_every_and_times(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "exc:chunk_loop:every=2:times=1")
+    faults.reset()
+    faults.fault_point("chunk_loop", chunk=0)  # hit 1: not every 2nd
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("chunk_loop", chunk=1)  # hit 2 fires
+    # times=1: exhausted, silent forever after
+    for c in range(2, 8):
+        faults.fault_point("chunk_loop", chunk=c)
+
+
+# -- chunk-read retry (satellite) ---------------------------------------------
+
+def test_chunk_read_retries_and_counts(tmp_path, monkeypatch):
+    """An injected transient read error is retried with the shared backoff
+    helper and surfaces as a telemetry `io.retry` counter bump — the load
+    still returns correct data."""
+    data = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float16)
+    save_chunk(tmp_path, 0, data)
+    monkeypatch.setenv(faults.FAULT_ENV, "io_error:chunk_read:every=1")
+    faults.reset()
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        x = ChunkStore(tmp_path).load(0)
+        np.testing.assert_allclose(np.asarray(x), data.astype(np.float32))
+        assert telemetry.counters.get("io.retry") == 1
+    finally:
+        telemetry.close()
+
+
+def test_chunk_read_permanent_errors_fail_fast(tmp_path, monkeypatch):
+    """A chunk index that simply doesn't exist is a bug, not a transient —
+    it must raise immediately without burning the backoff schedule or
+    polluting the io.retry counter."""
+    save_chunk(tmp_path, 0, np.zeros((4, 4), np.float16))
+    monkeypatch.setenv("SC_SYNC_RETRIES", "5")
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        with pytest.raises(FileNotFoundError):
+            ChunkStore(tmp_path).load(7)
+        assert "io.retry" not in telemetry.counters
+    finally:
+        telemetry.close()
+
+
+# -- crash-consistent checkpoints ---------------------------------------------
+
+def _small_ensembles():
+    ens = build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=8,
+        n_dict_components=16,
+    )
+    return [(ens, {"dict_size": 16}, "ensemble")]
+
+
+def test_torn_and_corrupt_checkpoints_skipped(tmp_path, monkeypatch):
+    """`latest_checkpoint` never returns an uncommitted (torn) or
+    digest-mismatched directory — it falls back to the previous good one."""
+    ensembles = _small_ensembles()
+    ckpt_lib.save_ensemble_checkpoint(tmp_path / "ckpt_1", ensembles, chunk_cursor=1)
+    ok, reason = ckpt_lib.verify_checkpoint(tmp_path / "ckpt_1")
+    assert ok, reason
+    assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_1"
+
+    # torn: the save dies after the data write, before the commit rename —
+    # only a staging dir is left, which discovery never considers
+    monkeypatch.setenv(faults.FAULT_ENV, "torn_checkpoint")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        ckpt_lib.save_ensemble_checkpoint(tmp_path / "ckpt_2", ensembles, chunk_cursor=2)
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    assert not (tmp_path / "ckpt_2").exists()
+    assert (tmp_path / ".staging_ckpt_2").exists()
+    assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_1"
+
+    # corrupt-after-commit: one flipped byte must flunk digest verification
+    monkeypatch.setenv(faults.FAULT_ENV, "corrupt_checkpoint")
+    faults.reset()
+    ckpt_lib.save_ensemble_checkpoint(tmp_path / "ckpt_3", ensembles, chunk_cursor=3)
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    ok, reason = ckpt_lib.verify_checkpoint(tmp_path / "ckpt_3")
+    assert not ok and "mismatch" in reason
+    with pytest.warns(RuntimeWarning, match="skipping checkpoint ckpt_3"):
+        assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_1"
+    # restore through the fallback works
+    tree = ckpt_lib.restore_ensemble_checkpoint(ckpt_lib.latest_checkpoint(tmp_path))
+    assert int(tree["cursor"]["chunk"]) == 1
+
+
+def test_checkpoint_gc_retention(tmp_path, monkeypatch):
+    tree = {"cursor": {"chunk": 0}, "x": np.arange(4.0)}
+    for i in range(5):
+        ckpt_lib.save_checkpoint_tree(tmp_path / f"ckpt_{i}", dict(tree))
+    # plus a torn leftover below the newest committed index
+    monkeypatch.setenv(faults.FAULT_ENV, "torn_checkpoint")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        ckpt_lib.save_checkpoint_tree(tmp_path / "ckpt_2b", dict(tree))
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    ckpt_lib.gc_checkpoints(tmp_path, keep=2)
+    kept = sorted(p.name for p in tmp_path.iterdir())
+    assert kept == ["ckpt_3", "ckpt_4"], kept
+
+
+def test_legacy_checkpoints_survive_gc_and_resume(tmp_path):
+    """Pre-manifest checkpoints (written before the atomic protocol) are
+    hours of training state, not garbage: GC must never delete them, and
+    resume falls back to the newest one when no committed checkpoint
+    exists — with a warning, since they cannot be verified."""
+    tree = {"cursor": {"chunk": 0}, "x": np.arange(4.0)}
+    # a legacy dir = committed content, no manifest
+    ckpt_lib.save_checkpoint_tree(tmp_path / "ckpt_0", dict(tree))
+    (tmp_path / "ckpt_0" / ckpt_lib.MANIFEST_NAME).unlink()
+    with pytest.warns(RuntimeWarning, match="legacy"):
+        assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_0"
+    # a newer committed checkpoint wins silently
+    ckpt_lib.save_checkpoint_tree(tmp_path / "ckpt_1", dict(tree))
+    assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_1"
+    # retention GC leaves the legacy dir alone even when over budget
+    ckpt_lib.save_checkpoint_tree(tmp_path / "ckpt_2", dict(tree))
+    ckpt_lib.gc_checkpoints(tmp_path, keep=1)
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["ckpt_0", "ckpt_2"], names
+
+
+def test_save_learned_dicts_atomic(tmp_path, monkeypatch):
+    """A kill mid-export must leave the previous complete pickle, not a
+    truncated one (the write goes through a temp file + os.replace)."""
+    ens = _small_ensembles()[0][0]
+    dicts = [(ld, {"l1_alpha": 1e-3}) for ld in ens.to_learned_dicts()]
+    path = tmp_path / "learned_dicts.pkl"
+    ckpt_lib.save_learned_dicts(path, dicts)
+    before = path.read_bytes()
+
+    import pickle as _pickle
+
+    def dying_dump(obj, fh):
+        fh.write(b"partial garbage")
+        raise KeyboardInterrupt("killed mid-write")
+
+    monkeypatch.setattr(ckpt_lib.pickle, "dump", dying_dump)
+    with pytest.raises(KeyboardInterrupt):
+        ckpt_lib.save_learned_dicts(path, dicts)
+    monkeypatch.setattr(ckpt_lib.pickle, "dump", _pickle.dump)
+    assert path.read_bytes() == before, "torn export clobbered the previous file"
+    assert not list(tmp_path.glob(".*tmp*")), "temp file leaked"
+    loaded = ckpt_lib.load_learned_dicts(path)
+    assert len(loaded) == 1
+
+
+# -- preemption machinery -----------------------------------------------------
+
+def test_preempted_is_resumable_systemexit():
+    exc = preemption.Preempted("checkpointed at ckpt_3")
+    assert isinstance(exc, SystemExit)
+    assert exc.code == preemption.RESUMABLE_EXIT_CODE == 75
+    assert "ckpt_3" in str(exc)
+
+
+def test_resume_requested_env(monkeypatch):
+    monkeypatch.delenv(preemption.RESUME_ENV, raising=False)
+    assert preemption.resume_requested(None) is False
+    assert preemption.resume_requested(True) is True
+    monkeypatch.setenv(preemption.RESUME_ENV, "1")
+    assert preemption.resume_requested(None) is True
+    assert preemption.resume_requested(False) is False, "explicit beats env"
+
+
+def test_pod_agree_preempt_single_host():
+    assert preemption.pod_agree_preempt() is False
+    preemption.request_preemption(15)
+    assert preemption.pod_agree_preempt() is True
+    assert preemption.preemption_signal() == 15
+
+
+def test_driver_checkpointer_periodic_and_preempt(tmp_path):
+    telemetry = RunTelemetry(out_dir=None)
+    saves = []
+
+    def save_fn(path):
+        saves.append(Path(path).name)
+        ckpt_lib.save_checkpoint_tree(path, {"cursor": {"chunk": 0}, "x": np.arange(3.0)})
+
+    try:
+        ckpt = DriverCheckpointer(tmp_path, telemetry=telemetry, keep=2, every=2)
+        for i in range(4):
+            ckpt.boundary(i, save_fn)
+        assert saves == ["ckpt_1", "ckpt_3"], "every=2 cadence"
+        assert telemetry.counters.get("checkpoints") == 2
+
+        preemption.request_preemption(signum=15)
+        with pytest.raises(preemption.Preempted):
+            ckpt.boundary(4, save_fn)
+        assert saves[-1] == "ckpt_4"
+        # the preemption checkpoint is committed and discoverable
+        assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_4"
+    finally:
+        telemetry.close()
+
+
+def test_multi_epoch_resume_preserves_earlier_epoch_exports(tmp_path, monkeypatch):
+    """Preempt during epoch 1, resume: epoch 0's export must stay byte-equal
+    (a resumed run must not re-export skipped epochs with later-epoch
+    state), and epoch 1's final export must match an uninterrupted control."""
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    gen = RandomDatasetGenerator(
+        activation_dim=16, n_ground_truth_components=32, batch_size=256,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    for i in range(2):
+        save_chunk(tmp_path / "chunks", i, np.asarray(next(gen)))
+    kw = dict(activation_width=16, l1_values=[1e-3], dict_ratio=2.0,
+              batch_size=128, n_epochs=2, fista_iters=5, seed=0)
+    basic_l1_sweep(str(tmp_path / "chunks"), str(tmp_path / "ctl"), **kw)
+
+    monkeypatch.setenv(faults.FAULT_ENV, "sigterm:chunk=0:epoch=1")
+    faults.reset()
+    with pytest.raises(preemption.Preempted):
+        basic_l1_sweep(str(tmp_path / "chunks"), str(tmp_path / "res"), **kw)
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    preemption.reset()
+
+    ep0 = (tmp_path / "res" / "epoch_0" / "learned_dicts.pkl").read_bytes()
+    basic_l1_sweep(str(tmp_path / "chunks"), str(tmp_path / "res"), resume=True, **kw)
+    assert (tmp_path / "res" / "epoch_0" / "learned_dicts.pkl").read_bytes() == ep0, (
+        "resume overwrote the completed epoch-0 export"
+    )
+    c = np.asarray(ckpt_lib.load_learned_dicts(
+        tmp_path / "ctl" / "epoch_1" / "learned_dicts.pkl")[0][0].get_learned_dict())
+    r = np.asarray(ckpt_lib.load_learned_dicts(
+        tmp_path / "res" / "epoch_1" / "learned_dicts.pkl")[0][0].get_learned_dict())
+    np.testing.assert_allclose(c, r, atol=1e-6)
+
+
+# -- the acceptance test: kill mid-run, resume, match -------------------------
+
+def _worker_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # 1 CPU device: fastest subprocess startup
+    env.pop("SC_FAULT", None)
+    env.pop("SC_RESUME", None)
+    return env
+
+
+def _run_worker(dataset, out, *args, env=None, check=True):
+    cmd = [sys.executable, str(REPO / "tests" / "_preempt_worker.py"),
+           str(dataset), str(out), *args]
+    res = subprocess.run(
+        cmd, env=env or _worker_env(), capture_output=True, text=True,
+        timeout=300,
+    )
+    if check and res.returncode != 0:
+        raise AssertionError(
+            f"worker failed rc={res.returncode}\n{res.stdout}\n{res.stderr}"
+        )
+    return res
+
+
+def test_kill_and_resume_equivalence(tmp_path):
+    """SIGTERM a smoke-scale `basic_l1_sweep` subprocess mid-run (a REAL
+    signal, injected at the top of chunk 1 by `SC_FAULT=sigterm:chunk=1`),
+    assert it exits with the resumable code 75 leaving a committed
+    checkpoint, resume it, and assert the final learned dicts match an
+    uninterrupted run's bit-for-bit-scale tolerance."""
+    gen = RandomDatasetGenerator(
+        activation_dim=16, n_ground_truth_components=32, batch_size=384,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    dataset = tmp_path / "chunks"
+    for i in range(3):
+        save_chunk(dataset, i, np.asarray(next(gen)))
+
+    # A: uninterrupted control
+    _run_worker(dataset, tmp_path / "out_a")
+
+    # B1: killed mid-run → exit 75, committed checkpoint, preempt event
+    env = _worker_env()
+    env["SC_FAULT"] = "sigterm:chunk=1"
+    res = _run_worker(dataset, tmp_path / "out_b", env=env, check=False)
+    assert res.returncode == 75, (res.returncode, res.stdout, res.stderr)
+    latest = ckpt_lib.latest_checkpoint(tmp_path / "out_b")
+    assert latest is not None
+    ok, reason = ckpt_lib.verify_checkpoint(latest)
+    assert ok, reason
+
+    # B2: resume → completes, exports
+    _run_worker(dataset, tmp_path / "out_b", "--resume")
+
+    a = ckpt_lib.load_learned_dicts(tmp_path / "out_a" / "epoch_0" / "learned_dicts.pkl")
+    b = ckpt_lib.load_learned_dicts(tmp_path / "out_b" / "epoch_0" / "learned_dicts.pkl")
+    assert len(a) == len(b) == 2
+    for (ld_a, hp_a), (ld_b, hp_b) in zip(a, b):
+        assert hp_a == hp_b
+        np.testing.assert_allclose(
+            np.asarray(ld_a.get_learned_dict()),
+            np.asarray(ld_b.get_learned_dict()),
+            atol=1e-6,
+        )
+
+    # the run dir tells the whole recovery story
+    from sparse_coding__tpu.telemetry import read_events
+
+    events = read_events(tmp_path / "out_b" / "events.jsonl")
+    kinds = [e["event"] for e in events]
+    assert "preempt" in kinds and "resume" in kinds and "checkpoint" in kinds
+    preempt = next(e for e in events if e["event"] == "preempt")
+    assert preempt["signum"] == 15
+    ends = [e for e in events if e["event"] == "run_end"]
+    assert [e["status"] for e in ends] == ["preempted", "ok"]
+
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(tmp_path / "out_b"))
+    assert "## Recovery" in md and "Checkpoints used to resume" in md

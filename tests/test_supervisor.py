@@ -1,0 +1,167 @@
+"""Auto-resume supervisor: exit classification, backoff, restart budget,
+and the ride-through-preemption integration (ISSUE 5 tentpole piece 3).
+
+The fast tests drive `run_supervised` in-process with a trivial python
+child; the slow tier exercises the real `python -m
+sparse_coding__tpu.supervise` CLI end to end (subprocess, full package
+import) per the acceptance criteria: two injected preemptions → the run
+completes and the report shows the restart lineage; an exhausted restart
+budget → nonzero exit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu import supervise
+from sparse_coding__tpu.telemetry import RunTelemetry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_classify_exit(tmp_path):
+    assert supervise.classify_exit(0) == "ok"
+    assert supervise.classify_exit(75) == "preempt"
+    assert supervise.classify_exit(-9) == "killed"
+    assert supervise.classify_exit(1, run_dir=str(tmp_path)) == "crash"
+    # a run dir that recorded an abort-action anomaly after the child
+    # started classifies as a deterministic anomaly-abort (never restarted)
+    with open(tmp_path / "events.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"seq": 1, "ts": 100.0, "event": "anomaly", "kind": "nonfinite",
+             "action": "abort"}) + "\n")
+    assert supervise.classify_exit(1, run_dir=str(tmp_path), since_ts=50.0) == "anomaly-abort"
+    # ...but an OLD abort (before this child started) does not
+    assert supervise.classify_exit(1, run_dir=str(tmp_path), since_ts=200.0) == "crash"
+
+
+def test_compute_backoff_schedule():
+    # jitter off: pure exponential with a cap
+    delays = [supervise.compute_backoff(k, base=1.0, cap=60.0, jitter=0.0)
+              for k in range(8)]
+    assert delays == [1, 2, 4, 8, 16, 32, 60, 60]
+    # jitter on: bounded multiplicative spread
+    import random
+
+    rng = random.Random(0)
+    d = supervise.compute_backoff(2, base=1.0, cap=60.0, jitter=0.5, rng=rng)
+    assert 4.0 <= d <= 6.0
+
+
+def _child_script(tmp_path, succeed_after: int) -> list:
+    """A child that exits 75 (resumable) until its Nth generation, then 0;
+    generations are counted in a state file so restarts are observable."""
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        state = {str(tmp_path / 'state')!r}
+        n = int(open(state).read()) if os.path.exists(state) else 0
+        open(state, "w").write(str(n + 1))
+        assert (os.environ.get("SC_RESUME") == "1") == (n > 0), "resume env wiring"
+        sys.exit(75 if n < {succeed_after} else 0)
+    """))
+    return [sys.executable, str(script)]
+
+
+def test_run_supervised_rides_through_preemptions(tmp_path):
+    telemetry = RunTelemetry(out_dir=str(tmp_path / "run"), run_name="supervisor",
+                             file_name="supervisor_events.jsonl")
+    try:
+        rc = supervise.run_supervised(
+            _child_script(tmp_path, succeed_after=2),
+            run_dir=str(tmp_path / "run"),
+            backoff_base=0.01, jitter=0.0,
+            telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    assert rc == 0
+    assert (tmp_path / "state").read_text() == "3", "two restarts then success"
+    from sparse_coding__tpu.telemetry import read_events
+
+    events = read_events(tmp_path / "run" / "supervisor_events.jsonl")
+    restarts = [e for e in events if e["event"] == "restart"]
+    assert [r["attempt"] for r in restarts] == [1, 2]
+    assert all(r["classification"] == "preempt" for r in restarts)
+    assert all(r["exit_code"] == 75 for r in restarts)
+
+    # the report renders the restart lineage from the supervisor log
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(tmp_path / "run"))
+    assert "## Recovery" in md
+    assert "2 supervisor restart(s)" in md
+    assert "| 2 | 75 | preempt |" in md
+
+
+def test_run_supervised_budget_exhausted(tmp_path):
+    rc = supervise.run_supervised(
+        _child_script(tmp_path, succeed_after=99),
+        max_restarts=2, backoff_base=0.01, jitter=0.0,
+    )
+    assert rc == 75, "exhausted budget surfaces the child's resumable code"
+    assert (tmp_path / "state").read_text() == "3", "initial run + 2 restarts"
+
+
+def test_run_supervised_crash_not_restarted_by_default(tmp_path):
+    rc = supervise.run_supervised(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        backoff_base=0.01, jitter=0.0,
+    )
+    assert rc == 3
+
+
+def test_run_supervised_restart_on_any(tmp_path):
+    script = tmp_path / "crashy.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        state = {str(tmp_path / 'state')!r}
+        n = int(open(state).read()) if os.path.exists(state) else 0
+        open(state, "w").write(str(n + 1))
+        sys.exit(3 if n < 1 else 0)
+    """))
+    rc = supervise.run_supervised(
+        [sys.executable, str(script)],
+        restart_on="any", backoff_base=0.01, jitter=0.0,
+    )
+    assert rc == 0
+    assert (tmp_path / "state").read_text() == "2"
+
+
+@pytest.mark.slow
+def test_supervise_cli_end_to_end(tmp_path):
+    """The real CLI: `python -m sparse_coding__tpu.supervise` rides through
+    two injected preemptions to completion (exit 0, restart lineage in the
+    report) and exits nonzero on an exhausted budget."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable, "-m", "sparse_coding__tpu.supervise",
+        "--run-dir", str(tmp_path / "run"),
+        "--backoff-base", "0.05", "--jitter", "0",
+        "--", *(_child_script(tmp_path, succeed_after=2)),
+    ]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(tmp_path / "run"))
+    assert "2 supervisor restart(s)" in md
+
+    # exhausted budget → nonzero
+    (tmp_path / "state").unlink()
+    cmd = [
+        sys.executable, "-m", "sparse_coding__tpu.supervise",
+        "--run-dir", str(tmp_path / "run2"), "--max-restarts", "1",
+        "--backoff-base", "0.05", "--jitter", "0",
+        "--", *(_child_script(tmp_path, succeed_after=99)),
+    ]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 75, (res.stdout, res.stderr)

@@ -102,6 +102,57 @@ def test_retry_then_success_and_failure():
         S.sync("/a/", "/b", retries=2, runner=r2)
 
 
+def test_backoff_schedule_and_env_config(monkeypatch):
+    """The shared retry engine (ISSUE 5 satellite): SC_SYNC_RETRIES /
+    SC_SYNC_BACKOFF configure attempts + base delay, and the slept schedule
+    is exponential with an 8 s cap."""
+    monkeypatch.setenv(S.RETRIES_ENV, "5")
+    monkeypatch.setenv(S.BACKOFF_ENV, "0.5")
+    assert S.default_retries() == 5 and S.default_backoff() == 0.5
+    assert S.backoff_delays(5, 0.5) == [0.5, 1.0, 2.0, 4.0]
+    assert S.backoff_delays(7, 2.0) == [2.0, 4.0, 8.0, 8.0, 8.0, 8.0]
+
+    slept, attempts = [], []
+
+    def fn(attempt):
+        attempts.append(attempt)
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        S.retry_with_backoff(fn, sleep=slept.append)
+    assert attempts == [0, 1, 2, 3, 4], "env-configured attempt count"
+    assert slept == [0.5, 1.0, 2.0, 4.0], "env-configured backoff schedule"
+
+    # sync() rides the same engine: 5 env-default attempts, same sleeps
+    slept.clear()
+    monkeypatch.setattr(S.time, "sleep", slept.append)
+    r = Recorder(fail_times=99)
+    with pytest.raises(RuntimeError, match="after 5 attempts"):
+        S.sync("/a/", "/b", runner=r)
+    assert len(r.calls) == 5 and slept == [0.5, 1.0, 2.0, 4.0]
+
+    # garbage env values fall back to the defaults rather than crashing
+    monkeypatch.setenv(S.RETRIES_ENV, "many")
+    monkeypatch.setenv(S.BACKOFF_ENV, "soon")
+    assert S.default_retries() == 3 and S.default_backoff() == 1.0
+
+
+def test_retry_with_backoff_on_retry_hook():
+    seen = []
+
+    def fn(attempt):
+        if attempt < 2:
+            raise OSError("flaky")
+        return "ok"
+
+    out = S.retry_with_backoff(
+        fn, attempts=4, base_delay=0.0,
+        on_retry=lambda attempt, exc: seen.append((attempt, str(exc))),
+    )
+    assert out == "ok"
+    assert seen == [(0, "flaky"), (1, "flaky")]
+
+
 def test_task_wrappers_use_env_remote(monkeypatch, tmp_path):
     monkeypatch.setenv("SC_TPU_REMOTE", "gs://bucket/proj/")
     r = Recorder()
