@@ -290,9 +290,155 @@ def make_resumed_run_fixture():
     print(f"Wrote {RESUMED_RUN_DIR}/events.jsonl + supervisor_events.jsonl")
 
 
+FLEET_RUN_DIR = REPO / "tests" / "golden" / "fleet_run"
+FLEET_BASE_TS = 1_754_400_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_fleet_run_fixture():
+    """Deterministic finished-fleet directory (ISSUE 6 satellite).
+
+    Hand-stamped queue/event files — NOT a real fleet run: real runs stamp
+    wall clocks, and a golden fixture must be byte-stable. The shape mirrors
+    what `fleet/` leaves behind after a night of churn: two done items (four
+    members, zero lost), a reassignment lineage where w0 lost g0's lease and
+    w1 resumed it from `ckpt_1`, a repeat offender (w2, three lost leases)
+    quarantined, and the scheduler's event log. `tests/test_fleet.py` pins
+    `fleet.report` and the monitor's fleet view against this directory in
+    tier-1.
+    """
+    t = FLEET_BASE_TS
+    queue = FLEET_RUN_DIR / "queue"
+    for bucket in ("pending", "leased", "done", "failed", "leases", "workers",
+                   "seen"):
+        (queue / bucket).mkdir(parents=True, exist_ok=True)
+    for bucket in ("pending", "leased", "failed", "leases"):
+        # git drops empty dirs, but is_fleet_dir/WorkQueue need the layout
+        (queue / bucket / ".gitkeep").write_text("")
+
+    items = {
+        "g0": {
+            "item": "g0",
+            "members": ["l1_1.00e-04", "l1_3.16e-04"],
+            "payload": {"driver": "basic_l1_sweep",
+                        "kwargs": {"l1_values": [1e-4, 3.16e-4]}},
+            "attempt": 1,
+            "submitted_ts": t,
+            "lineage": [
+                {"attempt": 0, "worker": "w0", "claimed_ts": t + 1.0,
+                 "outcome": "lease_expired", "released_ts": t + 40.0,
+                 "lease_age_seconds": 31.5},
+                {"attempt": 1, "worker": "w1", "claimed_ts": t + 45.0,
+                 "outcome": "done", "resumed_from": "ckpt_1",
+                 "completed_ts": t + 90.0},
+            ],
+            "result": {"export_manifest": "export_manifest.json",
+                       "verified": True},
+        },
+        "g1": {
+            "item": "g1",
+            "members": ["l1_1.00e-03", "l1_3.16e-03"],
+            "payload": {"driver": "basic_l1_sweep",
+                        "kwargs": {"l1_values": [1e-3, 3.16e-3]}},
+            "attempt": 3,
+            "submitted_ts": t,
+            "lineage": [
+                {"attempt": k, "worker": "w2", "claimed_ts": t + 2.0 + 20 * k,
+                 "outcome": "lease_expired", "released_ts": t + 14.0 + 20 * k,
+                 "lease_age_seconds": 10.0}
+                for k in range(3)
+            ] + [
+                {"attempt": 3, "worker": "w1", "claimed_ts": t + 95.0,
+                 "outcome": "done", "resumed_from": "ckpt_0",
+                 "completed_ts": t + 130.0},
+            ],
+            "result": {"export_manifest": "export_manifest.json",
+                       "verified": True},
+        },
+    }
+    for item_id, item in items.items():
+        with open(queue / "done" / f"{item_id}.json", "w") as f:
+            json.dump(item, f)
+    # ledger (scheduler-owned: strikes/quarantine) + seen (worker-owned
+    # liveness) are separate single-writer files; per-worker done counts
+    # are derived from item lineage, never stored
+    workers = {
+        "w0": {"worker": "w0", "strikes": 1,
+               "strike_reasons": ["lease_expired:g0"], "quarantined": False},
+        "w2": {"worker": "w2", "strikes": 3,
+               "strike_reasons": ["lease_expired:g1"] * 3, "quarantined": True},
+    }
+    for wid, rec in workers.items():
+        with open(queue / "workers" / f"{wid}.json", "w") as f:
+            json.dump(rec, f)
+    for wid, seen_ts in (("w0", t + 100.0), ("w1", t + 130.0), ("w2", t + 60.0)):
+        with open(queue / "seen" / f"{wid}.json", "w") as f:
+            json.dump({"worker": wid, "last_seen_ts": seen_ts}, f)
+
+    # the scheduler's own event log (RunTelemetry record shape)
+    seq = 0
+    ts = t
+
+    def rec(event, dt=1.0, **fields):
+        nonlocal seq, ts
+        seq += 1
+        ts += dt
+        return {"seq": seq, "ts": round(ts, 3), "event": event, **fields}
+
+    sched = [
+        rec("run_start", run_name="fleet_scheduler",
+            config={"lease_seconds": 30.0, "max_attempts": 5,
+                    "quarantine_after": 3}),
+        rec("lease_expired", dt=39.0, item="g0", worker="w0", attempt=1,
+            requeued_to="pending"),
+        rec("lease_expired", dt=-26.0, item="g1", worker="w2", attempt=1,
+            requeued_to="pending"),
+        rec("lease_expired", dt=20.0, item="g1", worker="w2", attempt=2,
+            requeued_to="pending"),
+        rec("lease_expired", dt=20.0, item="g1", worker="w2", attempt=3,
+            requeued_to="pending"),
+        rec("quarantine", dt=0.1, worker="w2", strikes=3),
+        rec("fleet_done", dt=57.0,
+            items={"pending": 0, "leased": 0, "done": 2, "failed": 0},
+            members={"queued": 0, "running": 0, "orphaned": 0, "done": 4,
+                     "lost": 0}),
+        rec("run_end", dt=0.1, status="ok", wall_seconds=131.2),
+    ]
+    with open(FLEET_RUN_DIR / "scheduler_events.jsonl", "w") as f:
+        for e in sched:
+            f.write(json.dumps(e) + "\n")
+
+    # per-item run dirs: just enough events for the report's item rollup
+    for item_id, resumes, steps in (("g0", 1, 24), ("g1", 1, 24)):
+        run_dir = FLEET_RUN_DIR / "runs" / item_id
+        run_dir.mkdir(parents=True, exist_ok=True)
+        seq, ts = 0, t
+        run = [
+            rec("run_start", run_name=f"fleet_{item_id}",
+                config={"l1_values": items[item_id]["payload"]["kwargs"]["l1_values"]},
+                fingerprint={"python": "3.11.8", "jax": "0.6.0",
+                             "backend": "cpu", "device_kind": "golden-cpu",
+                             "device_count": 1, "git_sha": "g0lden"}),
+            rec("resume", checkpoint=f"ckpt_{1 if item_id == 'g0' else 0}",
+                cursor={"chunk": 1, "epoch": 0, "position": 1}),
+            rec("snapshot", dt=40.0,
+                counters={"chunks": 2, "train.steps": steps,
+                          "resumes": resumes, "checkpoints": 2},
+                gauges={}),
+            rec("run_end", dt=1.0, status="ok", steps=steps,
+                wall_seconds=43.0),
+        ]
+        with open(run_dir / "events.jsonl", "w") as f:
+            for e in run:
+                f.write(json.dumps(e) + "\n")
+    print(f"Wrote {FLEET_RUN_DIR}/queue + scheduler_events.jsonl + runs/")
+
+
 def main():
     if "--pod-run" in sys.argv:
         make_pod_run_fixture()
+        return
+    if "--fleet-run" in sys.argv:
+        make_fleet_run_fixture()
         return
     if "--resumed-run" in sys.argv:
         make_resumed_run_fixture()

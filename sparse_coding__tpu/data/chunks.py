@@ -213,17 +213,29 @@ class ChunkStore:
             )
             return a, s
 
-        arr, scales = retry_with_backoff(
-            _read,
-            retry_on=(OSError,),
-            # permanent errors (a chunk index that simply doesn't exist)
-            # must fail fast, not burn the backoff schedule
-            give_up_on=(
-                FileNotFoundError, IsADirectoryError, NotADirectoryError,
-                PermissionError,
-            ),
-            on_retry=lambda attempt, exc: counter_inc_active("io.retry"),
-        )
+        try:
+            arr, scales = retry_with_backoff(
+                _read,
+                retry_on=(OSError,),
+                # permanent errors (a chunk index that simply doesn't exist)
+                # must fail fast, not burn the backoff schedule
+                give_up_on=(
+                    FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                    PermissionError,
+                ),
+                on_retry=lambda attempt, exc: counter_inc_active("io.retry"),
+            )
+        except (
+            FileNotFoundError, IsADirectoryError, NotADirectoryError,
+            PermissionError,
+        ):
+            raise
+        except OSError:
+            # the whole retry schedule burned: count the exhaustion so the
+            # report distinguishes "retried and recovered" from "gave up" —
+            # drivers turn this into a resumable exit-75 abort
+            counter_inc_active("io.exhausted")
+            raise
         if scales is not None:
             # int8 = signed bytes; uint8 = nibble-packed int4 (save_chunk's
             # two quantized tiers)

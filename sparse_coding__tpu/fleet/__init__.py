@@ -1,0 +1,54 @@
+"""Fleet scheduler: sweep-as-a-service on preemptible worker fleets.
+
+PR 5 made any *single* run survive SIGKILL/SIGTERM with bit-exact resume
+(docs/RECOVERY.md); this package (docs/FLEET.md) is the layer above — the
+ROADMAP-4 work-queue scheduler that shards a sweep into member-group work
+items and drives them across many preemptible workers with at-least-once
+execution and exactly-once commits:
+
+  - `queue`     — filesystem work queue: atomic `os.replace` claims, lease
+                  files with heartbeat renewal, dead-lease reaping, worker
+                  quarantine, per-item reassignment lineage
+  - `worker`    — claim → (supervised) train → verify learned-dict export
+                  against a size/digest manifest → commit
+  - `scheduler` — HBM-watermark-aware member packing, expired-lease
+                  reassignment, done-export re-verification
+  - `report`    — one fleet dashboard: members done/running/orphaned/lost,
+                  per-worker health, the reassignment lineage table
+
+Chaos-tested end to end (`tests/test_fleet.py`): a sharded sweep with
+injected worker kills, a torn checkpoint, and transient read errors must
+finish with zero lost members, every member bit-exact vs an uninterrupted
+run on CPU.
+"""
+
+from sparse_coding__tpu.fleet.queue import LeaseLost, WorkQueue, is_fleet_dir
+from sparse_coding__tpu.fleet.report import load_fleet, render_fleet_markdown
+from sparse_coding__tpu.fleet.scheduler import (
+    FleetScheduler,
+    build_sweep_items,
+    member_bytes_from_run,
+    pack_members,
+)
+from sparse_coding__tpu.fleet.worker import (
+    FleetWorker,
+    run_item,
+    verify_export,
+    write_export_manifest,
+)
+
+__all__ = [
+    "FleetScheduler",
+    "FleetWorker",
+    "LeaseLost",
+    "WorkQueue",
+    "build_sweep_items",
+    "is_fleet_dir",
+    "load_fleet",
+    "member_bytes_from_run",
+    "pack_members",
+    "render_fleet_markdown",
+    "run_item",
+    "verify_export",
+    "write_export_manifest",
+]

@@ -26,7 +26,10 @@ restarts). Restarts draw from a bounded budget (``--max-restarts``) and are
 spaced by exponential backoff with jitter (``--backoff-base``,
 ``--backoff-max``, ``--jitter``) so a crash-looping fleet does not
 stampede its storage/coordinator. An exhausted budget exits with the
-child's last (nonzero) code.
+child's last (nonzero) code. ``--backoff-reset-after SECS`` replenishes
+the budget whenever a child survives SECS of healthy running — a
+weeks-long run no longer exhausts it on unrelated preemptions, while
+crash loops (rapid exits) still burn it down.
 
 Every restart is recorded as a ``restart`` event in
 ``supervisor_events.jsonl`` under ``--run-dir`` (the report CLI's
@@ -130,15 +133,45 @@ def run_supervised(
     backoff_max: float = 60.0,
     jitter: float = 0.25,
     restart_on: str = "preempt",
+    backoff_reset_after: Optional[float] = None,
     telemetry=None,
+    on_spawn=None,
+    should_continue=None,
+    outcome: Optional[dict] = None,
 ) -> int:
     """Supervise `cmd`; returns the exit code the supervisor should exit
     with. `telemetry` (a RunTelemetry) is owned by the caller; pass None for
-    silent operation (unit tests)."""
+    silent operation (unit tests).
+
+    ``backoff_reset_after=SECS`` replenishes the restart budget: a child
+    that ran healthy for at least SECS before exiting resets the attempt
+    counter (and therefore the backoff) to zero. Without it a long-lived
+    run slowly exhausts its budget on unrelated preemptions spread over
+    days; with it only a *crash loop* — rapid exits faster than the healthy
+    threshold — can exhaust the budget, which is exactly what the budget is
+    for.
+
+    ``on_spawn(proc)`` fires with each generation's `subprocess.Popen` —
+    embedders (the fleet worker) use it to signal the child themselves.
+    ``should_continue()`` is consulted before every restart: returning
+    False stops supervising and hands the child's exit code up (the fleet
+    worker stops restarting an item whose lease it no longer holds).
+
+    ``outcome``, if given, is filled with ``{"reason": ...}`` explaining
+    WHY supervision stopped — ``ok`` / ``supervisor_preempted`` /
+    ``caller_stop`` / ``budget_exhausted`` / a give-up classification —
+    because the bare exit code is ambiguous: 75 can mean "this process is
+    being preempted" (release the work, no penalty) or "the child burned
+    its restart budget" (charge the failure), and embedders like the fleet
+    worker must treat those differently."""
     if restart_on not in ("preempt", "any"):
         raise ValueError(f"unknown restart_on {restart_on!r}")
     signaled = {"got": None}
     child: dict = {"proc": None}
+
+    def stopped(reason: str) -> None:
+        if outcome is not None:
+            outcome["reason"] = reason
 
     def forward(signum, frame):
         signaled["got"] = signum
@@ -167,11 +200,14 @@ def run_supervised(
                 )
             proc = subprocess.Popen(cmd, env=env)
             child["proc"] = proc
+            if on_spawn is not None:
+                on_spawn(proc)
             rc = proc.wait()
             child["proc"] = None
             exited = time.time()
             cls = classify_exit(rc, run_dir=run_dir, since_ts=started)
             if cls == "ok":
+                stopped("ok")
                 return 0
             if signaled["got"] is not None:
                 # the SUPERVISOR is being preempted: stop restarting, hand
@@ -181,20 +217,45 @@ def run_supervised(
                         "supervisor_preempted", signum=signaled["got"],
                         child_exit=rc,
                     )
+                stopped("supervisor_preempted")
                 return rc if rc > 0 else RESUMABLE_EXIT_CODE
             restartable = cls == "preempt" or (
                 restart_on == "any" and cls in ("killed", "crash")
             )
+            healthy_seconds = exited - started
+            if (
+                backoff_reset_after is not None
+                and attempt > 0
+                and healthy_seconds >= backoff_reset_after
+            ):
+                # a long-healthy generation proves the run itself is fine —
+                # this exit is fresh churn, not a continuing crash loop
+                if telemetry is not None:
+                    telemetry.event(
+                        "backoff_reset",
+                        healthy_seconds=round(healthy_seconds, 3),
+                        attempts_cleared=attempt,
+                    )
+                attempt = 0
             rc_out = rc if rc > 0 else 128 + abs(rc)
+            if should_continue is not None and not should_continue():
+                # the embedder withdrew (e.g. the fleet worker's lease was
+                # reaped): restarting would race the item's new holder
+                if telemetry is not None:
+                    telemetry.event("give_up", reason="caller_stop", exit_code=rc)
+                stopped("caller_stop")
+                return rc_out
             if not restartable:
                 if telemetry is not None:
                     telemetry.event("give_up", reason=cls, exit_code=rc)
+                stopped(cls)
                 return rc_out
             if attempt >= max_restarts:
                 if telemetry is not None:
                     telemetry.event(
                         "budget_exhausted", restarts=attempt, exit_code=rc
                     )
+                stopped("budget_exhausted")
                 return rc_out
             delay = compute_backoff(attempt, backoff_base, backoff_max, jitter)
             time.sleep(delay)
@@ -207,6 +268,7 @@ def run_supervised(
                         "supervisor_preempted", signum=signaled["got"],
                         child_exit=rc,
                     )
+                stopped("supervisor_preempted")
                 return rc if rc > 0 else RESUMABLE_EXIT_CODE
             attempt += 1
             if telemetry is not None:
@@ -248,6 +310,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--jitter", type=float, default=0.25,
                     help="multiplicative jitter fraction (default 0.25)")
     ap.add_argument(
+        "--backoff-reset-after", type=float, default=None, metavar="SECS",
+        help="reset the restart budget after a child survives this many "
+        "seconds (long runs no longer exhaust it on unrelated preemptions; "
+        "crash loops — rapid exits — still do). Default: never reset",
+    )
+    ap.add_argument(
         "--restart-on", choices=("preempt", "any"), default="preempt",
         help="restart only on resumable exits (default) or also on crashes",
     )
@@ -271,6 +339,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "cmd": cmd, "max_restarts": args.max_restarts,
                 "backoff_base": args.backoff_base,
                 "backoff_max": args.backoff_max,
+                "backoff_reset_after": args.backoff_reset_after,
                 "restart_on": args.restart_on,
             },
             file_name="supervisor_events.jsonl",
@@ -286,6 +355,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             backoff_max=args.backoff_max,
             jitter=args.jitter,
             restart_on=args.restart_on,
+            backoff_reset_after=args.backoff_reset_after,
             telemetry=telemetry,
         )
         return rc

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional
 __all__ = [
     "RunTelemetry",
     "counter_inc_active",
+    "event_active",
     "run_fingerprint",
     "tracked_jit",
     "read_events",
@@ -105,6 +106,15 @@ def counter_inc_active(name: str, n: int = 1) -> None:
     feeding the `io.retry` counter). No live telemetry → no-op."""
     for t in list(_ACTIVE):
         t.counter_inc(name, n)
+
+
+def event_active(etype: str, **fields) -> None:
+    """Emit an event on EVERY live RunTelemetry — the event-shaped sibling
+    of `counter_inc_active`, for layers with no telemetry handle whose
+    occurrences deserve a timeline entry (e.g. `train.checkpoint`'s
+    checkpoint-fallback anomalies). No live telemetry → no-op."""
+    for t in list(_ACTIVE):
+        t.event(etype, **fields)
 
 
 def run_fingerprint(mesh=None) -> Dict[str, Any]:

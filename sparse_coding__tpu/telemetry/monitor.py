@@ -21,6 +21,14 @@ cheap CI gate over archived run dirs.
 Follow mode exits 0 once every discovered process has written ``run_end``.
 Torn trailing lines (a writer mid-append) are NOT malformed: the tail
 buffers them until the newline arrives.
+
+Fleet directories (`fleet.queue.is_fleet_dir` — a `queue/pending/` layout,
+docs/FLEET.md) get an extra **fleet view** block: per-worker liveness and
+lease ages read straight from the lease/ledger files, plus the member
+ledger (done/running/orphaned/queued/lost)::
+
+      fleet: items 3 done / 1 leased / 0 pending / 0 failed | members 6 done / 2 running / 0 orphaned / 0 queued / 0 lost
+      workers: w0 lease g3 (age 1.2s, expires in 28.8s); w1 idle 4.1s; w2 QUARANTINED (3 strikes)
 """
 
 from __future__ import annotations
@@ -36,7 +44,7 @@ from sparse_coding__tpu.telemetry.multihost import (
     format_bytes as _bytes,
 )
 
-__all__ = ["EventTail", "RunMonitor", "render", "main"]
+__all__ = ["EventTail", "RunMonitor", "fleet_lines", "render", "main"]
 
 _EVENT_GLOBS = (
     "events.jsonl",
@@ -267,6 +275,48 @@ def _age(now: float, ts: Optional[float]) -> str:
     return f"{dt / 3600:.1f}h"
 
 
+def fleet_lines(run_dir, now: float) -> List[str]:
+    """The fleet view (ISSUE 6): when the monitored directory holds a fleet
+    queue (`fleet.queue.is_fleet_dir`), render per-worker liveness, lease
+    ages, and the member ledger — done/running/orphaned/queued/**lost** —
+    from the queue files themselves (no events needed, so a fleet whose
+    scheduler died still renders). Empty list for ordinary run dirs."""
+    from sparse_coding__tpu.fleet.queue import WorkQueue, is_fleet_dir
+
+    if not is_fleet_dir(run_dir):
+        return []
+    st = WorkQueue(run_dir, create=False).state(now=now)
+    c, m = st["item_counts"], st["members"]
+    lines = [
+        f"  fleet: items {c['done']} done / {c['leased']} leased / "
+        f"{c['pending']} pending / {c['failed']} failed | members "
+        f"{m['done']} done / {m['running']} running / {m['orphaned']} orphaned"
+        f" / {m['queued']} queued / {m['lost']} lost"
+        + ("  ⚠ LOST MEMBERS" if m["lost"] else "")
+    ]
+    by_worker = {l.get("worker"): l for l in st["leases"].values()}
+    bits = []
+    for w in st["workers"]:
+        wid = w.get("worker", "?")
+        if w.get("quarantined"):
+            bits.append(f"{wid} QUARANTINED ({w.get('strikes', 0)} strikes)")
+            continue
+        lease = by_worker.get(wid)
+        if lease is not None:
+            age = now - float(lease.get("renewed_ts", now))
+            left = float(lease.get("expires_ts", now)) - now
+            state = (
+                f"lease {lease.get('item', '?')} (age {age:.1f}s, "
+                + (f"expires in {left:.1f}s)" if left > 0 else "EXPIRED)")
+            )
+            bits.append(f"{wid} {state}")
+        else:
+            bits.append(f"{wid} idle {_age(now, w.get('last_seen_ts'))}")
+    if bits:
+        lines.append("  workers: " + "; ".join(bits))
+    return lines
+
+
 def render(mon: RunMonitor, now: Optional[float] = None) -> str:
     """One status block (plain text, terminal-friendly, no cursor games)."""
     now = time.time() if now is None else now
@@ -276,6 +326,7 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
     ]
     if not mon.procs:
         lines.append("  (no events yet)")
+        lines.extend(fleet_lines(mon.run_dir, now))
         return "\n".join(lines)
     for idx in sorted(mon.procs):
         p = mon.procs[idx]
@@ -332,6 +383,7 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         )
     else:
         lines.append("  anomalies: none | desync: none")
+    lines.extend(fleet_lines(mon.run_dir, now))
     if mon.malformed:
         lines.append(
             f"  MALFORMED event lines: {len(mon.malformed)} "

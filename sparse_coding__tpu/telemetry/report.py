@@ -478,7 +478,8 @@ def _recovery_section(run, lines: List[str]):
     restarts = _events_of(run, "restart")
     checkpoints = _events_of(run, "checkpoint")
     exhausted = _events_of(run, "budget_exhausted")
-    if not (preempts or resumes or restarts or exhausted):
+    fallbacks = _merged_counters(run).get("checkpoint.fallback")
+    if not (preempts or resumes or restarts or exhausted or fallbacks):
         return
     lines.append("## Recovery")
     lines.append("")
@@ -509,6 +510,14 @@ def _recovery_section(run, lines: List[str]):
         lines.append(
             f"- ⚠ restart budget exhausted after {_fmt(e.get('restarts'))} "
             f"restart(s) (last exit code {_fmt(e.get('exit_code'))})"
+        )
+    if fallbacks:
+        # the PR-6 satellite: resume silently skipping torn/corrupt
+        # checkpoint dirs must be visible, not just a Python warning
+        lines.append(
+            f"- ⚠ {int(fallbacks)} checkpoint fallback(s): torn/corrupt "
+            "checkpoint dirs skipped during resume (details in the anomaly "
+            "timeline)"
         )
     lines.append("")
     if preempts:

@@ -33,7 +33,11 @@ from sparse_coding__tpu.telemetry import (
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
 from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
-from sparse_coding__tpu.train.preemption import Preempted, resume_requested
+from sparse_coding__tpu.train.preemption import (
+    Preempted,
+    ResumableAbort,
+    resume_requested,
+)
 from sparse_coding__tpu.utils.faults import fault_point
 from sparse_coding__tpu.utils.logging import MetricLogger
 from sparse_coding__tpu.utils.trace import StepTimer
@@ -194,12 +198,32 @@ def basic_l1_sweep(
                     # splitting/loading — replay stays bit-identical
                     continue
                 fault_point("chunk_loop", chunk=pos, epoch=epoch)
-                if hbm_cache:
-                    if int(chunk_idx) not in cache:
-                        cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
-                    chunk = cache[int(chunk_idx)].astype(jnp.float32)
-                else:
-                    chunk = store.load(int(chunk_idx))
+                try:
+                    if hbm_cache:
+                        if int(chunk_idx) not in cache:
+                            cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
+                        chunk = cache[int(chunk_idx)].astype(jnp.float32)
+                    else:
+                        chunk = store.load(int(chunk_idx))
+                except (
+                    FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                    PermissionError,
+                ):
+                    raise  # a real bug, not churn: deserves the traceback
+                except OSError as e:
+                    # the whole transient-read retry schedule burned:
+                    # storage churn, not a code bug — exit RESUMABLE (75)
+                    # so the supervisor/fleet retries from the last
+                    # committed checkpoint instead of surfacing a raw
+                    # traceback as a crash
+                    telemetry.event(
+                        "io_exhausted", chunk=int(chunk_idx), epoch=epoch,
+                        position=pos, error=str(e)[:200],
+                    )
+                    raise ResumableAbort(
+                        f"chunk {int(chunk_idx)} unreadable after retries "
+                        f"({e}); exiting resumable"
+                    ) from e
                 key, k = jax.random.split(key)
                 telemetry.chunk_start(int(chunk_idx), epoch=epoch, position=pos)
                 loss_fence = ensemble_train_loop(
@@ -255,6 +279,9 @@ def basic_l1_sweep(
                 save_learned_dicts(
                     out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
                 )
+    except ResumableAbort as e:
+        status = f"resumable-abort: {e}"
+        raise
     except Preempted:
         status = "preempted"
         raise

@@ -404,6 +404,19 @@ def latest_checkpoint(output_folder, depth: Optional[str] = None) -> Optional[Pa
     resume from unverifiable prior state beats silently restarting a run
     from scratch.
     """
+    # a resume silently skipping state must be loud in artifacts, not just
+    # on a stderr nobody kept: every skip bumps a `checkpoint.fallback`
+    # counter and lands an anomaly-style event on any live telemetry, so
+    # the report's Recovery section and anomaly timeline both show it
+    from sparse_coding__tpu.telemetry.events import counter_inc_active, event_active
+
+    def _record_fallback(name: str, reason: str) -> None:
+        counter_inc_active("checkpoint.fallback")
+        event_active(
+            "anomaly", kind="checkpoint_fallback", action="warn",
+            checkpoint=name, reason=reason,
+        )
+
     root = Path(output_folder)
     if not root.exists():
         return None
@@ -419,12 +432,14 @@ def latest_checkpoint(output_folder, depth: Optional[str] = None) -> Optional[Pa
         ok, reason = verify_checkpoint(p, depth=depth)
         if ok:
             return p
+        _record_fallback(p.name, reason)
         warnings.warn(
             f"skipping checkpoint {p.name}: {reason} (falling back to the "
             "previous good checkpoint)",
             RuntimeWarning,
         )
     if legacy:
+        _record_fallback(legacy[0].name, "legacy (pre-manifest, unverifiable)")
         warnings.warn(
             f"no committed checkpoint verifies under {root}; using legacy "
             f"(pre-manifest, unverifiable) {legacy[0].name}",

@@ -40,6 +40,8 @@ from typing import Optional, Tuple
 __all__ = [
     "RESUMABLE_EXIT_CODE",
     "Preempted",
+    "ResumableAbort",
+    "clear_preemption",
     "install_signal_handlers",
     "pod_agree_preempt",
     "preemption_requested",
@@ -77,6 +79,14 @@ class Preempted(SystemExit):
 
     def __str__(self) -> str:  # SystemExit.__str__ would print "75"
         return self.message
+
+
+class ResumableAbort(Preempted):
+    """A non-signal failure that is safe to retry from the last committed
+    checkpoint — e.g. a chunk read whose whole retry schedule burned
+    (storage churn under fleet preemption). Same exit code 75, so the
+    supervisor/fleet restarts it with backoff instead of a human reading a
+    raw OSError traceback; distinct type, so run_end status can say WHY."""
 
 
 _STATE = {
@@ -149,6 +159,17 @@ def request_preemption(signum: Optional[int] = None) -> None:
     that learn about reclamation without a signal."""
     _STATE["requested"] = True
     _STATE["signum"] = signum
+
+
+def clear_preemption() -> None:
+    """Clear a pending request WITHOUT touching handler installation — for
+    callers whose own `request_preemption` turned out to be moot (a fleet
+    worker that requested a stop on lease loss: the *item* is gone, but the
+    worker itself is healthy and moves on to the next claim). Only safe
+    when `preemption_signal()` is None — a real signal means the process
+    really is being reclaimed."""
+    _STATE["requested"] = False
+    _STATE["signum"] = None
 
 
 def poller_started() -> None:

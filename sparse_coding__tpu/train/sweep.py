@@ -44,7 +44,11 @@ from sparse_coding__tpu.telemetry import (
 )
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
-from sparse_coding__tpu.train.preemption import Preempted, resume_requested
+from sparse_coding__tpu.train.preemption import (
+    Preempted,
+    ResumableAbort,
+    resume_requested,
+)
 from sparse_coding__tpu.utils.faults import fault_point
 from sparse_coding__tpu.utils.logging import (
     MetricLogger,
@@ -441,7 +445,30 @@ def sweep(
         chunk_iter = store.iter_chunks(remaining_order, dtype=jnp.float32)
     status = "ok"
     try:
-        for i, chunk in zip(range(start_chunk, len(chunk_order)), chunk_iter):
+        for i in range(start_chunk, len(chunk_order)):
+            try:
+                chunk = next(chunk_iter)
+            except StopIteration:
+                break
+            except (
+                FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                PermissionError,
+            ):
+                raise  # a real bug, not churn: deserves the traceback
+            except OSError as e:
+                # transient-read retries exhausted (data.chunks already
+                # counted io.exhausted): storage churn under fleet
+                # preemption — exit RESUMABLE (75) so the supervisor/fleet
+                # retries from the last committed checkpoint instead of
+                # surfacing a raw traceback as a crash
+                telemetry.event(
+                    "io_exhausted", chunk=int(chunk_order[i]),
+                    error=str(e)[:200],
+                )
+                raise ResumableAbort(
+                    f"chunk {int(chunk_order[i])} unreadable after retries "
+                    f"({e}); exiting resumable"
+                ) from e
             print(f"Chunk {i+1}/{len(chunk_order)} (file {int(chunk_order[i])})")
             fault_point("chunk_loop", chunk=i)
             telemetry.chunk_start(i, file=int(chunk_order[i]))
@@ -515,6 +542,9 @@ def sweep(
                         ensemble, args, ensemble_hyperparams, buffer_hyperparams
                     )
                 )
+    except ResumableAbort as e:
+        status = f"resumable-abort: {e}"
+        raise
     except Preempted:
         status = "preempted"
         raise

@@ -44,6 +44,11 @@ Selectors (all optional; every given selector must match)
     every=N                      fire on every Nth matching hit (1-based)
     times=N                      stop after N fires (default: unlimited,
                                  except torn/corrupt which default to 1)
+    persist=1                    retried sites only: fire on every retry
+                                 attempt too (default: attempt 0 only, so
+                                 backoff succeeds) — the retries-exhausted
+                                 case, which drivers must turn into a
+                                 resumable exit-75 abort
 
 `kill:chunk=3` defaults its site to `chunk_loop`; `io_error` defaults to
 `chunk_read`. Unset `SC_FAULT` costs one dict lookup per site — the sites
@@ -224,8 +229,10 @@ def fault_point(site: str, **ctx) -> None:
         if not matched:
             continue
         # retried sites: fire on the first attempt only, so the caller's
-        # backoff path is exercised AND succeeds (the transient-error case)
-        if ctx.get("attempt", 0) != 0:
+        # backoff path is exercised AND succeeds (the transient-error case);
+        # persist=1 fires on EVERY attempt — the retries-exhausted case the
+        # fleet chaos tests drive to a resumable abort
+        if ctx.get("attempt", 0) != 0 and not spec.params.get("persist"):
             continue
         spec.hits += 1
         every = spec.params.get("every")

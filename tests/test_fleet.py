@@ -1,0 +1,756 @@
+"""Fleet scheduler: work-queue semantics, lease reassignment, chaos (ISSUE 6).
+
+Three tiers:
+
+  - **unit** — queue claim atomicity, lease renewal/expiry, quarantine,
+    HBM-aware packing, export-manifest verification;
+  - **in-process chaos** (tier-1, ``chaos`` marker) — the acceptance run:
+    an 8-member sweep over 3 workers with a simulated worker death (fault +
+    abandoned lease), a torn checkpoint, and a transient read error must
+    finish with ZERO lost members, every member's dicts matching an
+    uninterrupted control run, and the fleet report rendering the
+    reassignment lineage;
+  - **subprocess chaos** (``slow`` + ``chaos``) — the same story with real
+    worker processes and a real SC_FAULT SIGKILL storm.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import save_chunk
+from sparse_coding__tpu.fleet import (
+    FleetScheduler,
+    FleetWorker,
+    LeaseLost,
+    WorkQueue,
+    build_sweep_items,
+    load_fleet,
+    member_bytes_from_run,
+    pack_members,
+    render_fleet_markdown,
+    verify_export,
+    write_export_manifest,
+)
+from sparse_coding__tpu.telemetry import RunTelemetry
+from sparse_coding__tpu.train import checkpoint as ckpt_lib
+from sparse_coding__tpu.train import preemption
+from sparse_coding__tpu.utils import faults
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN_FLEET = Path(__file__).parent / "golden" / "fleet_run"
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.setenv("SC_SYNC_BACKOFF", "0")
+    faults.reset()
+    preemption.reset()
+    yield
+    faults.reset()
+    preemption.reset()
+
+
+# -- queue semantics ----------------------------------------------------------
+
+def _submit(q, item_id, members=("m0", "m1")):
+    return q.submit(item_id, list(members), {"driver": "noop"})
+
+
+def test_claim_is_exclusive_and_ordered(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    _submit(q, "g1")
+    a = q.claim("w0", lease_seconds=30)
+    b = q.claim("w1", lease_seconds=30)
+    assert a["item"] == "g0" and b["item"] == "g1", "sorted order, one winner each"
+    assert q.claim("w2", lease_seconds=30) is None, "queue drained"
+    assert a["lineage"][-1]["worker"] == "w0"
+    assert {l["item"] for l in q.leases()} == {"g0", "g1"}
+    assert not q.finished(), "leased items are outstanding work"
+
+
+def test_renew_extends_and_reap_reassigns(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.claim("w0", lease_seconds=10)
+    lease = q.renew("g0", "w0", lease_seconds=10)
+    assert lease["renewals"] == 1
+    assert q.renew("g0", "w0", lease_seconds=10)["renewals"] == 2
+    with pytest.raises(LeaseLost):
+        q.renew("g0", "w1", lease_seconds=10)  # not the holder
+
+    # the holder goes silent; the reaper reassigns once the lease expires
+    actions = q.reap_expired(now=time.time() + 60, quarantine_after=3)
+    assert [a["kind"] for a in actions] == ["lease_expired"]
+    assert actions[0]["worker"] == "w0" and actions[0]["requeued_to"] == "pending"
+    item = q.items("pending")[0]
+    assert item["attempt"] == 1
+    assert item["lineage"][-1]["outcome"] == "lease_expired"
+    assert q.worker_record("w0")["strikes"] == 1
+    with pytest.raises(LeaseLost):
+        q.renew("g0", "w0")  # zombie holder cannot resurrect the lease
+
+
+def test_complete_commits_exactly_once(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0", members=("a", "b", "c"))
+    q.claim("w0", lease_seconds=30)
+    done = q.complete("g0", "w0", result={"verified": True})
+    assert done["lineage"][-1]["outcome"] == "done"
+    assert q.finished() and not q.leases()
+    assert q.state()["members"]["done"] == 3
+    with pytest.raises(LeaseLost):
+        q.complete("g0", "w0")  # second commit is impossible
+
+
+def test_fail_requeues_then_exhausts_budget(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.claim("w0", lease_seconds=30)
+    assert q.fail("g0", "w0", "boom", max_attempts=2) == "pending"
+    assert q.items("pending")[0]["attempt"] == 1
+    q.claim("w1", lease_seconds=30)
+    assert q.fail("g0", "w1", "boom again", max_attempts=2) == "failed"
+    state = q.state()
+    assert state["members"]["lost"] == 2 and q.finished()
+    outcomes = [e["outcome"] for e in q.items("failed")[0]["lineage"]]
+    assert outcomes == ["failed", "failed"]
+
+
+def test_release_returns_item_without_penalty(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.claim("w0", lease_seconds=30)
+    q.release("g0", "w0", outcome="preempted")
+    item = q.items("pending")[0]
+    assert item["attempt"] == 0, "voluntary release costs no attempt"
+    assert item["lineage"][-1]["outcome"] == "preempted"
+
+
+def test_repeat_offender_quarantined(tmp_path):
+    q = WorkQueue(tmp_path)
+    for i in range(3):
+        _submit(q, f"g{i}")
+    for i in range(2):
+        assert q.claim("w0", lease_seconds=5) is not None
+        actions = q.reap_expired(now=time.time() + 60, quarantine_after=2)
+        kinds = [a["kind"] for a in actions]
+        assert "lease_expired" in kinds
+        if i == 1:
+            assert "quarantine" in kinds
+    assert q.worker_quarantined("w0")
+    assert q.claim("w0", lease_seconds=5) is None, "quarantined workers get nothing"
+    assert q.claim("w1", lease_seconds=5) is not None, "healthy workers still do"
+
+
+def test_orphaned_claim_without_lease_is_reaped(tmp_path):
+    """A worker that dies between the claim rename and the lease write
+    leaves a leased item with no lease file — requeued after the grace."""
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.claim("w0", lease_seconds=30)
+    q._lease_path("g0").unlink()
+    assert q.reap_expired(now=time.time(), grace_seconds=3600) == [], "grace holds"
+    actions = q.reap_expired(now=time.time() + 7200, grace_seconds=3600)
+    assert [a["kind"] for a in actions] == ["lease_expired"]
+    assert q.items("pending")[0]["attempt"] == 1
+
+
+def test_state_counts_orphaned_members(tmp_path):
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    _submit(q, "g1", members=("x",))
+    q.claim("w0", lease_seconds=0.0)  # expires immediately → orphaned
+    state = q.state(now=time.time() + 1)
+    assert state["members"] == {
+        "queued": 1, "running": 0, "orphaned": 2, "done": 0, "lost": 0,
+    }
+
+
+# -- packing ------------------------------------------------------------------
+
+def test_pack_members_budget_math(tmp_path):
+    members = list(range(8))
+    assert pack_members(members) == [members], "no sizing info → one item"
+    groups = pack_members(
+        members, bytes_per_member=1.0, hbm_budget_bytes=2.5,
+        reserve_fraction=0.2,
+    )
+    assert [len(g) for g in groups] == [2, 2, 2, 2], "floor(2.0/1.0) per item"
+    groups = pack_members(members, max_members_per_item=3)
+    assert [len(g) for g in groups] == [3, 3, 2]
+    assert pack_members([]) == []
+
+
+def test_pack_members_from_hbm_watermarks(tmp_path):
+    """The empirical path: per-member bytes derived from a previous run's
+    recorded `hbm.*.peak_bytes_in_use` gauges."""
+    with RunTelemetry(out_dir=str(tmp_path / "prev"), run_name="probe") as t:
+        t.run_start()
+        t.gauge_set("hbm.d0.peak_bytes_in_use", 8.0e9)
+        t.gauge_set("hbm.d0.bytes_limit", 16.0e9)
+    assert member_bytes_from_run(tmp_path / "prev", 4) == pytest.approx(2.0e9)
+    groups = pack_members(
+        list(range(8)), watermark_run_dir=tmp_path / "prev",
+        watermark_members=4, hbm_budget_bytes=16.0e9, reserve_fraction=0.25,
+    )
+    # usable 12 GB / 2 GB per member → 6 per item
+    assert [len(g) for g in groups] == [6, 2]
+    assert member_bytes_from_run(tmp_path / "prev", 0) is None
+
+
+# -- export manifests ---------------------------------------------------------
+
+def test_export_manifest_verify_and_corruption(tmp_path):
+    run = tmp_path / "run"
+    (run / "epoch_0").mkdir(parents=True)
+    (run / "epoch_0" / "learned_dicts.pkl").write_bytes(b"dict-bytes-1")
+    assert verify_export(run) == (False, "no export manifest")
+    write_export_manifest(run)
+    ok, reason = verify_export(run)
+    assert ok, reason
+    (run / "epoch_0" / "learned_dicts.pkl").write_bytes(b"dict-bytes-2")
+    ok, reason = verify_export(run)
+    assert not ok and "digest mismatch" in reason
+    (run / "epoch_0" / "learned_dicts.pkl").write_bytes(b"truncated")
+    ok, reason = verify_export(run)
+    assert not ok and "size mismatch" in reason
+
+
+def test_empty_export_never_verifies(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    write_export_manifest(run)
+    ok, reason = verify_export(run)
+    assert not ok and "no exports" in reason
+
+
+# -- real training items ------------------------------------------------------
+
+def _make_dataset(folder, n_chunks=2, rows=128, width=8):
+    rng = np.random.default_rng(0)
+    for i in range(n_chunks):
+        save_chunk(folder, i, rng.normal(size=(rows, width)).astype(np.float16))
+
+
+def _base_kwargs(dataset):
+    return dict(
+        dataset_folder=str(dataset), activation_width=8, dict_ratio=2.0,
+        batch_size=64, n_epochs=1, lr=1e-3, fista_iters=2, seed=0,
+        checkpoint_every=1,
+    )
+
+
+def test_worker_trains_verifies_and_commits(tmp_path):
+    dataset = tmp_path / "data"
+    _make_dataset(dataset)
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    build_sweep_items(q, [[1e-4, 1e-3]], _base_kwargs(dataset))
+    w = FleetWorker(fleet, "w0", lease_seconds=30)
+    assert w.claim_and_run() == "done"
+    assert w.claim_and_run() == "idle"
+    assert q.finished()
+    item = q.items("done")[0]
+    assert item["result"]["verified"] is True
+    run_dir = q.run_dir("g0")
+    assert verify_export(run_dir)[0]
+    dicts = ckpt_lib.load_learned_dicts(run_dir / "epoch_0" / "learned_dicts.pkl")
+    assert [hp["l1_alpha"] for _ld, hp in dicts] == [1e-4, 1e-3]
+
+
+def test_scheduler_requeues_corrupted_done_export(tmp_path):
+    dataset = tmp_path / "data"
+    _make_dataset(dataset)
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    build_sweep_items(q, [[1e-3]], _base_kwargs(dataset))
+    w = FleetWorker(fleet, "w0", lease_seconds=30)
+    assert w.claim_and_run() == "done"
+    sched = FleetScheduler(fleet, lease_seconds=5)
+    assert sched.tick() == [], "a verifying done item stays done"
+    # post-completion bit rot: the member is NOT done anymore
+    pkl = q.run_dir("g0") / "epoch_0" / "learned_dicts.pkl"
+    data = bytearray(pkl.read_bytes())
+    data[0] ^= 0xFF
+    pkl.write_bytes(bytes(data))
+    sched2 = FleetScheduler(fleet, lease_seconds=5)
+    actions = sched2.tick()
+    assert [a["kind"] for a in actions] == ["export_corrupt"]
+    assert [i["item"] for i in q.items("pending")] == ["g0"]
+    # a healthy worker retrains it back to done (resuming the committed
+    # checkpoint) and the export verifies again
+    assert w.claim_and_run() == "done"
+    assert verify_export(q.run_dir("g0"))[0]
+
+
+# -- the acceptance chaos run (tier-1, in-process) ----------------------------
+
+@pytest.mark.chaos
+def test_chaos_fleet_zero_lost_members(tmp_path, monkeypatch):
+    """ISSUE 6 acceptance: an 8-member sweep sharded over 3 workers rides
+    out a dead worker (fault + abandoned lease — the in-process stand-in
+    for SIGKILL), a torn checkpoint, and a transient read error with ZERO
+    lost members; every member's learned dict verifies against its
+    manifest and matches an uninterrupted run bit-exactly on CPU, and the
+    fleet report renders which worker lost which lease and where the item
+    resumed."""
+    from sparse_coding__tpu.fleet.queue import is_fleet_dir
+
+    dataset = tmp_path / "data"
+    _make_dataset(dataset)
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    members = [float(a) for a in np.logspace(-4, -2, 8)]
+    groups = pack_members(
+        members, bytes_per_member=1.0, hbm_budget_bytes=2.5,
+        reserve_fraction=0.2,
+    )
+    assert [len(g) for g in groups] == [2, 2, 2, 2]
+    base = _base_kwargs(dataset)
+    build_sweep_items(q, groups, base)
+    assert is_fleet_dir(fleet)
+
+    sched_tel = RunTelemetry(
+        out_dir=str(fleet), run_name="fleet_scheduler",
+        file_name="scheduler_events.jsonl",
+    )
+    sched_tel.run_start()
+    sched = FleetScheduler(
+        fleet, lease_seconds=5, max_attempts=5, quarantine_after=3,
+        telemetry=sched_tel,
+    )
+    workers = {}
+    for wid in ("w0", "w1", "w2"):
+        tel = RunTelemetry(
+            out_dir=str(fleet), run_name=f"fleet_worker_{wid}",
+            file_name=f"worker_{wid}_events.jsonl",
+        )
+        tel.run_start()
+        workers[wid] = FleetWorker(fleet, wid, lease_seconds=5, telemetry=tel)
+
+    try:
+        # 1. worker w0 claims g0 and dies at the top of chunk 1 — AFTER
+        #    chunk 0's checkpoint committed. fail_mode="abandon" leaves the
+        #    lease exactly as a SIGKILL would.
+        workers["w0"].fail_mode = "abandon"
+        monkeypatch.setenv(faults.FAULT_ENV, "exc:chunk_loop:chunk=1")
+        faults.reset()
+        assert workers["w0"].claim_and_run() == "abandoned"
+        monkeypatch.delenv(faults.FAULT_ENV)
+        faults.reset()
+        assert ckpt_lib.latest_checkpoint(q.run_dir("g0")) is not None, (
+            "the dead worker left a committed checkpoint to resume from"
+        )
+
+        # 2. torn checkpoint: w1's first item dies mid-commit (data written,
+        #    rename never happens) — graceful failure, immediate requeue
+        monkeypatch.setenv(faults.FAULT_ENV, "torn_checkpoint")
+        faults.reset()
+        assert workers["w1"].claim_and_run() == "failed"
+        monkeypatch.delenv(faults.FAULT_ENV)
+        faults.reset()
+        assert ckpt_lib.latest_checkpoint(q.run_dir("g1")) is None, (
+            "a torn save must never look committed"
+        )
+
+        # 3. transient read error: retried in place, the item completes
+        monkeypatch.setenv(faults.FAULT_ENV, "io_error:chunk_read:times=1")
+        faults.reset()
+        assert workers["w2"].claim_and_run() == "done"
+        monkeypatch.delenv(faults.FAULT_ENV)
+        faults.reset()
+
+        # 4. the scheduler reaps w0's now-expired lease and reassigns g0
+        actions = sched.tick(now=time.time() + 30)
+        kinds = [a["kind"] for a in actions]
+        assert "lease_expired" in kinds and "item_lost" not in kinds
+        assert q.worker_record("w0")["strikes"] == 1
+
+        # 5. the healthy workers drain the queue (g0 resumes mid-run)
+        deadline = time.time() + 300
+        while not q.finished() and time.time() < deadline:
+            sched.tick()
+            outcomes = {
+                workers["w1"].claim_and_run(), workers["w2"].claim_and_run()
+            }
+            if outcomes == {"idle"}:
+                time.sleep(0.05)
+        assert q.finished(), q.state()["item_counts"]
+    finally:
+        sched_tel.close()
+        for w in workers.values():
+            w.telemetry.close()
+
+    # ZERO lost members; all 8 done and export-verified
+    state = q.state()
+    assert state["members"]["lost"] == 0
+    assert state["members"]["done"] == 8
+    assert state["item_counts"]["failed"] == 0
+    for item in q.items("done"):
+        ok, reason = verify_export(q.run_dir(item["item"]))
+        assert ok, (item["item"], reason)
+
+    # the interrupted item resumed from the dead worker's checkpoint
+    g0 = next(i for i in q.items("done") if i["item"] == "g0")
+    outcomes = [e["outcome"] for e in g0["lineage"]]
+    assert outcomes == ["lease_expired", "done"]
+    assert g0["lineage"][0]["worker"] == "w0"
+    assert g0["lineage"][1]["resumed_from"] == "ckpt_0"
+
+    # bit-exact vs an uninterrupted control run of every member group
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    for i, group in enumerate(groups):
+        ref_dir = tmp_path / f"ref_{i}"
+        basic_l1_sweep(
+            output_folder=str(ref_dir), l1_values=list(group), **base
+        )
+        ref = ckpt_lib.load_learned_dicts(ref_dir / "epoch_0" / "learned_dicts.pkl")
+        got = ckpt_lib.load_learned_dicts(
+            q.run_dir(f"g{i}") / "epoch_0" / "learned_dicts.pkl"
+        )
+        assert [hp["l1_alpha"] for _l, hp in got] == [hp["l1_alpha"] for _l, hp in ref]
+        for (ld_r, _), (ld_g, _) in zip(ref, got):
+            assert np.array_equal(
+                np.asarray(ld_r.get_learned_dict()),
+                np.asarray(ld_g.get_learned_dict()),
+            ), f"group {i} diverged from the uninterrupted run"
+
+    # the fleet report renders the reassignment lineage
+    md = render_fleet_markdown(load_fleet(fleet))
+    assert "**8 done**" in md and "**0 lost**" in md
+    assert "lease_expired" in md and "ckpt_0" in md
+    assert "| w0 |" in md and "| w1 |" in md and "| w2 |" in md
+
+    # and the monitor's fleet view renders clean
+    from sparse_coding__tpu.monitor import main as monitor_main
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert monitor_main([str(fleet), "--once"]) == 0
+    out = buf.getvalue()
+    assert "fleet:" in out and "0 lost" in out
+
+
+# -- lease-loss / shutdown / ledger regressions -------------------------------
+
+_DRIVER = "import:tests._fleet_drivers:{}"
+
+
+def _submit_driver(q, item_id, fn, members=("m0",), **kwargs):
+    return q.submit(
+        item_id, list(members),
+        {"driver": _DRIVER.format(fn), "kwargs": kwargs},
+    )
+
+
+def test_lease_loss_recovers_worker_inprocess(tmp_path):
+    """A worker whose lease is reaped MID-RUN (stalled long enough to be
+    presumed dead) must stop at the driver's next poll boundary, clear its
+    self-inflicted preemption flag, and stay healthy for the next claim —
+    never die, never keep racing the item's new holder."""
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "slow_driver", seconds=20.0, poll=0.02)
+    w = FleetWorker(fleet, "w0", lease_seconds=1.0, heartbeat_every=0.1)
+    result = {}
+    t = threading.Thread(target=lambda: result.setdefault("out", w.claim_and_run()))
+    t.start()
+    deadline = time.time() + 30
+    while not q.leases() and time.time() < deadline:
+        time.sleep(0.02)
+    assert q.leases(), "worker never claimed"
+    # the scheduler presumes w0 dead and reassigns its item
+    actions = q.reap_expired(now=time.time() + 60, quarantine_after=5)
+    assert [a["kind"] for a in actions] == ["lease_expired"]
+    t.join(timeout=90)
+    assert not t.is_alive() and result["out"] == "lease_lost"
+    assert not preemption.preemption_requested(), (
+        "the heartbeat's stop request is cleared once the item is handed "
+        "off — the worker itself is healthy"
+    )
+    item = q.items("pending")[0]
+    assert item["lineage"][-1]["outcome"] == "lease_expired"
+    # the worker moves on: park the slow item elsewhere, then a fresh
+    # claim on quick work still commits
+    assert q.claim("other", lease_seconds=300)["item"] == "g0"
+    _submit_driver(q, "g1", "quick_driver", members=("m1",))
+    assert w.claim_and_run() == "done"
+    assert q.state()["done_by_worker"] == {"w0": 1}
+
+
+def test_preempted_worker_releases_item_and_reraises(tmp_path):
+    """A REAL preemption (signal-set flag) releases the item without an
+    attempt penalty and lets the exit-75 unwind continue — unlike a
+    heartbeat-induced stop, which is swallowed."""
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "slow_driver", seconds=20.0, poll=0.02)
+    w = FleetWorker(fleet, "w0", lease_seconds=30)
+    preemption.request_preemption(signal.SIGTERM)
+    with pytest.raises(preemption.Preempted):
+        w.claim_and_run()
+    item = q.items("pending")[0]
+    assert item["attempt"] == 0, "preemption costs no attempt"
+    assert item["lineage"][-1]["outcome"] == "preempted"
+    assert not q.leases()
+
+
+def test_worker_shutdown_releases_item_without_penalty(tmp_path):
+    """Ctrl-C in the driver is worker shutdown, not item failure: the item
+    goes back to pending at the same attempt and the interrupt unwinds."""
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "interrupt_driver")
+    w = FleetWorker(fleet, "w0", lease_seconds=30)
+    with pytest.raises(KeyboardInterrupt):
+        w.claim_and_run()
+    item = q.items("pending")[0]
+    assert item["attempt"] == 0
+    assert item["lineage"][-1]["outcome"] == "released"
+    assert not q.leases()
+
+
+def test_supervised_worker_preemption_releases_without_penalty(tmp_path, monkeypatch):
+    """`--mode supervised`: when run_supervised stops because THIS worker
+    is being preempted (reason `supervisor_preempted`), the item must be
+    released without an attempt penalty and the resumable unwind continue —
+    NOT be charged as an item failure while the worker keeps claiming."""
+    import sparse_coding__tpu.supervise as sup
+
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "quick_driver")
+
+    def fake_run_supervised(cmd, outcome=None, **kw):
+        if outcome is not None:
+            outcome["reason"] = "supervisor_preempted"
+        return 75
+
+    monkeypatch.setattr(sup, "run_supervised", fake_run_supervised)
+    w = FleetWorker(fleet, "w0", mode="supervised", lease_seconds=30)
+    with pytest.raises(preemption.Preempted):
+        w.claim_and_run()
+    item = q.items("pending")[0]
+    assert item["attempt"] == 0, "worker preemption costs the item nothing"
+    assert item["lineage"][-1]["outcome"] == "preempted"
+    assert not q.leases()
+
+
+def test_supervised_worker_budget_exhausted_charges_item(tmp_path, monkeypatch):
+    """A child that burns its restart budget IS an item failure: the item
+    pays an attempt and the worker stays alive for other work."""
+    import sparse_coding__tpu.supervise as sup
+
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "quick_driver")
+
+    def fake_run_supervised(cmd, outcome=None, **kw):
+        if outcome is not None:
+            outcome["reason"] = "budget_exhausted"
+        return 75
+
+    monkeypatch.setattr(sup, "run_supervised", fake_run_supervised)
+    w = FleetWorker(fleet, "w0", mode="supervised", lease_seconds=30)
+    assert w.claim_and_run() == "failed"
+    item = q.items("pending")[0]
+    assert item["attempt"] == 1
+    assert item["lineage"][-1]["outcome"] == "failed"
+
+
+def test_quarantine_survives_worker_liveness_stamp(tmp_path):
+    """The ledger/seen single-writer split: a worker's own liveness stamp
+    (`touch_seen`) can never erase a scheduler quarantine, and `workers()`
+    unions ledger entries with seen-only workers."""
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.strike_worker("w0", "lease_expired:g9", quarantine_after=1)
+    assert q.worker_quarantined("w0")
+    q.touch_seen("w0")  # the worker-side write path
+    rec = q.worker_record("w0")
+    assert rec["quarantined"] and rec["strikes"] == 1
+    assert "last_seen_ts" in rec, "both writers' fields merge in the record"
+    assert q.claim("w0", lease_seconds=5) is None
+    assert q.claim("w1", lease_seconds=5) is not None
+    assert [w["worker"] for w in q.workers()] == ["w0", "w1"], (
+        "struck and seen-only workers both appear"
+    )
+
+
+def test_export_corrupt_exhausts_attempt_budget(tmp_path):
+    """Post-completion rot spends the SAME attempt budget as every other
+    requeue: a disk that rots every export eventually counts the members
+    LOST instead of cycling done→pending forever."""
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    _submit_driver(q, "g0", "quick_driver")
+    w = FleetWorker(fleet, "w0", lease_seconds=30)
+    assert w.claim_and_run() == "done"
+    (q.run_dir("g0") / "epoch_0" / "learned_dicts.pkl").write_bytes(b"rot")
+    sched = FleetScheduler(fleet, max_attempts=1)
+    actions = sched.tick()
+    assert [a["kind"] for a in actions] == ["export_corrupt", "item_lost"]
+    assert actions[0]["requeued_to"] == "failed"
+    state = q.state()
+    assert state["members"]["lost"] == 1 and q.finished()
+    assert q.items("failed")[0]["lineage"][-1]["outcome"] == "export_corrupt"
+
+
+# -- subprocess chaos: real workers, real SIGKILL ----------------------------
+
+def _worker_cmd(fleet, wid, extra=()):
+    return [
+        sys.executable, "-m", "sparse_coding__tpu.fleet.worker", str(fleet),
+        "--worker-id", wid, "--lease-seconds", "6", "--poll", "0.2",
+        "--idle-exit", "60", *extra,
+    ]
+
+
+def _worker_env(**overrides):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SC_SYNC_BACKOFF"] = "0"
+    env.pop("SC_FAULT", None)
+    env.update(overrides)
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_fleet_subprocess_kill_storm(tmp_path):
+    """The full-stack version: three REAL worker processes; w0 is SIGKILLed
+    by an injected fault mid-item, w1 hits a transient read error. The
+    scheduler reassigns the dead worker's lease and the fleet finishes with
+    zero lost members."""
+    dataset = tmp_path / "data"
+    _make_dataset(dataset, n_chunks=2, rows=128, width=8)
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    members = [float(a) for a in np.logspace(-4, -2, 8)]
+    groups = pack_members(members, max_members_per_item=2)
+    build_sweep_items(q, groups, _base_kwargs(dataset))
+
+    procs = [
+        subprocess.Popen(
+            _worker_cmd(fleet, "w0"),
+            env=_worker_env(SC_FAULT="kill:chunk_loop:chunk=1:times=1"),
+        ),
+        subprocess.Popen(
+            _worker_cmd(fleet, "w1"),
+            env=_worker_env(SC_FAULT="io_error:chunk_read:times=1"),
+        ),
+        subprocess.Popen(_worker_cmd(fleet, "w2"), env=_worker_env()),
+    ]
+    sched = FleetScheduler(fleet, lease_seconds=6, max_attempts=6,
+                           quarantine_after=3)
+    try:
+        deadline = time.time() + 480
+        while not q.finished() and time.time() < deadline:
+            sched.tick()
+            time.sleep(0.5)
+        assert q.finished(), q.state()["item_counts"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    assert procs[0].returncode == -9, "w0 really was SIGKILLed by the fault"
+    state = q.state()
+    assert state["members"]["lost"] == 0 and state["members"]["done"] == 8
+
+    # somebody lost a lease (the killed worker) and the report shows it
+    md = render_fleet_markdown(load_fleet(fleet))
+    assert "**0 lost**" in md
+    assert "lease_expired" in md or "interrupted" in md
+
+
+@pytest.mark.slow
+def test_supervised_worker_mode_end_to_end(tmp_path):
+    """`--mode supervised`: the worker runs each item as a child under
+    `supervise.run_supervised`, so a mid-item preemption (exit 75) restarts
+    with SC_RESUME=1 and the item still commits exactly once."""
+    dataset = tmp_path / "data"
+    _make_dataset(dataset)
+    fleet = tmp_path / "fleet"
+    q = WorkQueue(fleet)
+    build_sweep_items(q, [[1e-4, 1e-3]], _base_kwargs(dataset))
+    env = _worker_env(SC_FAULT="sigterm:chunk=1:times=1")
+    res = subprocess.run(
+        _worker_cmd(fleet, "w0", extra=("--mode", "supervised")),
+        env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert q.finished() and q.state()["members"]["done"] == 2
+    run = q.run_dir("g0")
+    assert verify_export(run)[0]
+    from sparse_coding__tpu.telemetry import read_events
+
+    events = read_events(run / "events.jsonl")
+    kinds = [e["event"] for e in events]
+    assert "preempt" in kinds and "resume" in kinds, (
+        "the item really was preempted and resumed under supervision"
+    )
+
+
+# -- golden fleet fixture (report/monitor rendering pins) ---------------------
+
+def test_golden_fleet_fixture_exists():
+    assert (GOLDEN_FLEET / "queue" / "done" / "g0.json").exists()
+    assert (GOLDEN_FLEET / "scheduler_events.jsonl").exists()
+
+
+def test_fleet_report_on_golden_fixture(capsys):
+    from sparse_coding__tpu.fleet.report import main as report_main
+
+    assert report_main([str(GOLDEN_FLEET)]) == 0
+    out = capsys.readouterr().out
+    assert "# Fleet report" in out
+    assert "**4 done**" in out and "**0 lost**" in out  # members
+    assert "## Reassignment lineage" in out
+    assert "| g0 | 0 | w0 | lease_expired | - |" in out
+    assert "| g0 | 1 | w1 | done | ckpt_1 |" in out
+    assert "| w2 | 0 | 3 | YES |" in out, "quarantined worker row"
+
+
+def test_monitor_fleet_view_on_golden_fixture(capsys):
+    from sparse_coding__tpu.monitor import main as monitor_main
+
+    assert monitor_main([str(GOLDEN_FLEET), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: items 2 done" in out
+    assert "4 done" in out and "0 lost" in out
+    assert "w2 QUARANTINED (3 strikes)" in out
+
+
+def test_fleet_report_exit_code_gates_lost_members(tmp_path, capsys):
+    """`python -m sparse_coding__tpu.fleet.report` exits 1 when members
+    were lost — a one-line CI gate over any archived fleet dir."""
+    q = WorkQueue(tmp_path)
+    _submit(q, "g0")
+    q.claim("w0", lease_seconds=30)
+    q.fail("g0", "w0", "dead", max_attempts=1)
+    from sparse_coding__tpu.fleet.report import main as report_main
+
+    assert report_main([str(tmp_path)]) == 1
+    assert "LOST" in capsys.readouterr().out

@@ -120,6 +120,99 @@ def test_chunk_read_permanent_errors_fail_fast(tmp_path, monkeypatch):
         telemetry.close()
 
 
+# -- retries exhausted: resumable abort, not a raw traceback (ISSUE 6) --------
+
+def test_chunk_read_retries_exhausted_counts_all_attempts(tmp_path, monkeypatch):
+    """`persist=1` makes the injected read error survive every retry: the
+    whole schedule burns, `io.retry` reflects ALL retry attempts, and the
+    give-up is counted separately as `io.exhausted`."""
+    data = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float16)
+    save_chunk(tmp_path, 0, data)
+    monkeypatch.setenv("SC_SYNC_RETRIES", "4")
+    monkeypatch.setenv(faults.FAULT_ENV, "io_error:chunk_read:persist=1")
+    faults.reset()
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        with pytest.raises(OSError):
+            ChunkStore(tmp_path).load(0)
+        assert telemetry.counters.get("io.retry") == 3, "4 attempts = 3 retries"
+        assert telemetry.counters.get("io.exhausted") == 1
+    finally:
+        telemetry.close()
+
+
+@pytest.mark.chaos
+def test_driver_exhausted_reads_abort_resumable(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: when `SC_FAULT=io_error:chunk_read` outlives the
+    retry budget, the driver must NOT surface a raw OSError traceback — it
+    raises `ResumableAbort` (SystemExit 75, the supervisor/fleet restart
+    signal), records the abort in `run_end`, and the io.retry counter
+    reflects every attempt."""
+    from sparse_coding__tpu.telemetry.report import (
+        _events_of,
+        _merged_counters,
+        load_run,
+    )
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    dataset = tmp_path / "data"
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        save_chunk(dataset, i, rng.normal(size=(64, 8)).astype(np.float16))
+    out = tmp_path / "out"
+    monkeypatch.setenv("SC_SYNC_RETRIES", "3")
+    monkeypatch.setenv(faults.FAULT_ENV, "io_error:chunk_read:persist=1")
+    faults.reset()
+    with pytest.raises(preemption.ResumableAbort) as exc_info:
+        basic_l1_sweep(
+            dataset_folder=str(dataset), output_folder=str(out),
+            activation_width=8, l1_values=[1e-3], dict_ratio=2.0,
+            batch_size=32, n_epochs=1, fista_iters=2, seed=0,
+        )
+    assert exc_info.value.code == preemption.RESUMABLE_EXIT_CODE
+    run = load_run(out)
+    ends = _events_of(run, "run_end")
+    assert ends and ends[-1]["status"].startswith("resumable-abort")
+    assert _events_of(run, "io_exhausted"), "the give-up landed in the log"
+    counters = _merged_counters(run)
+    assert counters.get("io.retry") == 2, "3 attempts = 2 retries, all counted"
+    assert counters.get("io.exhausted") == 1
+
+
+def test_checkpoint_fallback_is_loud_in_telemetry(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: `latest_checkpoint` skipping a torn/corrupt dir
+    must not be just a Python warning — it bumps a `checkpoint.fallback`
+    counter and emits an anomaly-style event on any live telemetry, so the
+    report's Recovery section and anomaly timeline both show it."""
+    ensembles = _small_ensembles()
+    ckpt_lib.save_ensemble_checkpoint(tmp_path / "ckpt_1", ensembles, chunk_cursor=1)
+    monkeypatch.setenv(faults.FAULT_ENV, "corrupt_checkpoint")
+    faults.reset()
+    ckpt_lib.save_ensemble_checkpoint(tmp_path / "ckpt_2", ensembles, chunk_cursor=2)
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    out = tmp_path / "run"
+    telemetry = RunTelemetry(out_dir=str(out), run_name="fallback")
+    try:
+        telemetry.run_start()
+        with pytest.warns(RuntimeWarning, match="skipping checkpoint ckpt_2"):
+            assert ckpt_lib.latest_checkpoint(tmp_path).name == "ckpt_1"
+        assert telemetry.counters.get("checkpoint.fallback") == 1
+    finally:
+        telemetry.close()
+    from sparse_coding__tpu.telemetry import read_events
+    from sparse_coding__tpu.telemetry.report import render_markdown, load_run
+
+    events = read_events(out / "events.jsonl")
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    assert any(
+        a.get("kind") == "checkpoint_fallback" and a.get("checkpoint") == "ckpt_2"
+        for a in anomalies
+    )
+    md = render_markdown(load_run(out))
+    assert "checkpoint fallback" in md
+
+
 # -- crash-consistent checkpoints ---------------------------------------------
 
 def _small_ensembles():

@@ -100,20 +100,26 @@ def test_run_supervised_rides_through_preemptions(tmp_path):
 
 
 def test_run_supervised_budget_exhausted(tmp_path):
+    outcome = {}
     rc = supervise.run_supervised(
         _child_script(tmp_path, succeed_after=99),
-        max_restarts=2, backoff_base=0.01, jitter=0.0,
+        max_restarts=2, backoff_base=0.01, jitter=0.0, outcome=outcome,
     )
     assert rc == 75, "exhausted budget surfaces the child's resumable code"
     assert (tmp_path / "state").read_text() == "3", "initial run + 2 restarts"
+    assert outcome["reason"] == "budget_exhausted", (
+        "exit 75 alone is ambiguous — embedders need the why"
+    )
 
 
 def test_run_supervised_crash_not_restarted_by_default(tmp_path):
+    outcome = {}
     rc = supervise.run_supervised(
         [sys.executable, "-c", "import sys; sys.exit(3)"],
-        backoff_base=0.01, jitter=0.0,
+        backoff_base=0.01, jitter=0.0, outcome=outcome,
     )
     assert rc == 3
+    assert outcome["reason"] == "crash"
 
 
 def test_run_supervised_restart_on_any(tmp_path):
@@ -131,6 +137,63 @@ def test_run_supervised_restart_on_any(tmp_path):
     )
     assert rc == 0
     assert (tmp_path / "state").read_text() == "2"
+
+
+def _sleepy_child_script(tmp_path, succeed_after: int, sleep_s: float) -> list:
+    """Like `_child_script` but each generation runs 'healthy' for
+    `sleep_s` seconds before exiting 75 — the long-lived-run shape the
+    backoff-reset satellite targets."""
+    script = tmp_path / "sleepy.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        state = {str(tmp_path / 'state')!r}
+        n = int(open(state).read()) if os.path.exists(state) else 0
+        open(state, "w").write(str(n + 1))
+        time.sleep({sleep_s})
+        sys.exit(75 if n < {succeed_after} else 0)
+    """))
+    return [sys.executable, str(script)]
+
+
+def test_backoff_reset_after_healthy_stretch(tmp_path):
+    """ISSUE 6 satellite: without replenishment a restart budget of 2 dies
+    at the third preemption of a long-healthy run; with
+    `backoff_reset_after` below the generation length the counter resets
+    after every healthy stretch and the run completes."""
+    telemetry = RunTelemetry(out_dir=str(tmp_path / "run"), run_name="supervisor",
+                             file_name="supervisor_events.jsonl")
+    try:
+        rc = supervise.run_supervised(
+            _sleepy_child_script(tmp_path, succeed_after=4, sleep_s=0.3),
+            max_restarts=2, backoff_base=0.01, jitter=0.0,
+            backoff_reset_after=0.1,
+            telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    assert rc == 0
+    assert (tmp_path / "state").read_text() == "5", "4 preempts ridden through"
+    from sparse_coding__tpu.telemetry import read_events
+
+    events = read_events(tmp_path / "run" / "supervisor_events.jsonl")
+    resets = [e for e in events if e["event"] == "backoff_reset"]
+    assert resets, "healthy stretches recorded budget replenishment"
+    assert all(e["healthy_seconds"] >= 0.1 for e in resets)
+    # every restart after a reset starts the backoff schedule over
+    restarts = [e for e in events if e["event"] == "restart"]
+    assert all(r["attempt"] == 1 for r in restarts[1:])
+
+
+def test_backoff_reset_leaves_crash_loops_bounded(tmp_path):
+    """A crash loop — generations exiting faster than the healthy threshold
+    — must still exhaust the budget; the reset only rewards healthy time."""
+    rc = supervise.run_supervised(
+        _child_script(tmp_path, succeed_after=99),  # instant exit-75 loop
+        max_restarts=2, backoff_base=0.01, jitter=0.0,
+        backoff_reset_after=30.0,
+    )
+    assert rc == 75, "instant exits never reach the healthy threshold"
+    assert (tmp_path / "state").read_text() == "3", "initial run + 2 restarts"
 
 
 @pytest.mark.slow
