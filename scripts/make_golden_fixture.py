@@ -433,9 +433,63 @@ def make_fleet_run_fixture():
     print(f"Wrote {FLEET_RUN_DIR}/queue + scheduler_events.jsonl + runs/")
 
 
+CORRUPT_STORE_DIR = REPO / "tests" / "golden" / "corrupt_store"
+CORRUPT_BASE_TS = 1_754_500_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_corrupt_store_fixture():
+    """Deterministic chunk store with known-bad chunks (ISSUE 8 satellite).
+
+    A five-chunk store exercising every row of the DATAPLANE failure
+    matrix: two good chunks (fp16 + int8), a bit-flipped committed chunk
+    (sizes intact — only the digest tier catches it), a committed int8
+    chunk whose scale file was deleted (missing-file vs manifest), and a
+    LEGACY int8 chunk (no manifest) with no scale file — the pre-manifest
+    format's silent-misread case, pinned as *detected*. Chunk data is
+    seeded numpy; manifest timestamps are re-stamped to a fixed value so
+    the fixture is byte-stable. `tests/test_data_integrity.py` copies this
+    directory and pins the scrub CLI's report rendering and exit code
+    against it in tier-1."""
+    import json as _json
+
+    import numpy as np
+
+    from sparse_coding__tpu.data import integrity
+    from sparse_coding__tpu.data.chunks import chunk_path, save_chunk, scale_path
+
+    CORRUPT_STORE_DIR.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(8)
+    data = rng.standard_normal((64, 16)).astype(np.float32)
+    save_chunk(CORRUPT_STORE_DIR, 0, data)                   # good fp16
+    save_chunk(CORRUPT_STORE_DIR, 1, data * 2, dtype=np.int8)  # good int8
+    save_chunk(CORRUPT_STORE_DIR, 2, data + 1)               # to be bit-flipped
+    save_chunk(CORRUPT_STORE_DIR, 3, data - 1, dtype=np.int8)  # scale to vanish
+    # chunk 2: bit rot AFTER commit — size intact, digest wrong
+    p = chunk_path(CORRUPT_STORE_DIR, 2)
+    raw = bytearray(p.read_bytes())
+    raw[-1] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    # chunk 3: committed pair whose scale side file went missing
+    scale_path(CORRUPT_STORE_DIR, 3).unlink()
+    # chunk 4: LEGACY torn pair — int8 bytes, no scale, no manifest (the
+    # pre-manifest silent misread, now detected structurally)
+    np.save(chunk_path(CORRUPT_STORE_DIR, 4), (data * 3).astype(np.int8))
+    # byte-stability: pin every manifest's created_at
+    for i in range(4):
+        mp = integrity.chunk_manifest_path(CORRUPT_STORE_DIR, i)
+        manifest = _json.loads(mp.read_text())
+        manifest["created_at"] = CORRUPT_BASE_TS
+        mp.write_text(_json.dumps(manifest))
+    print(f"Wrote {CORRUPT_STORE_DIR} (chunks 0-1 good, 2 bit-flipped, "
+          "3 missing scale, 4 legacy torn)")
+
+
 def main():
     if "--pod-run" in sys.argv:
         make_pod_run_fixture()
+        return
+    if "--corrupt-store" in sys.argv:
+        make_corrupt_store_fixture()
         return
     if "--fleet-run" in sys.argv:
         make_fleet_run_fixture()

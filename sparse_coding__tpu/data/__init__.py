@@ -8,7 +8,17 @@ from sparse_coding__tpu.data.chunks import (
     ChunkStore,
     chunk_path,
     generate_synthetic_chunks,
+    load_store_dataset,
     save_chunk,
+)
+from sparse_coding__tpu.data.integrity import (
+    ChunkLossBudget,
+    CorruptChunk,
+    chunk_manifest_path,
+    quarantine_chunk,
+    quarantined_indices,
+    read_chunk_manifest,
+    verify_chunk,
 )
 from sparse_coding__tpu.data.activations import (
     chunk_and_tokenize_texts,

@@ -249,6 +249,116 @@ def harvest_folder_name(base_folder, layer: int, layer_loc: str) -> Path:
     return Path(f"{base_folder}_l{layer}_{layer_loc}")
 
 
+# -- harvest cursor / verified resume -----------------------------------------
+
+HARVEST_CURSOR = "sc_harvest_cursor.json"
+
+
+def _harvest_config_sha(
+    layers, layer_locs, batch_size, chunk_size_gb, store_dtype, center_dataset,
+    tokens_shape,
+) -> str:
+    """Fingerprint of everything that determines chunk CONTENT at a given
+    index — a resume against a store harvested under a different geometry
+    must fail loudly, not silently splice incompatible chunks."""
+    import hashlib
+    import json as _json
+
+    spec = {
+        "layers": [int(l) for l in layers],
+        "layer_locs": [str(l) for l in layer_locs],
+        "batch_size": int(batch_size),
+        "chunk_size_gb": float(chunk_size_gb),
+        "store_dtype": str(store_dtype),
+        "center_dataset": bool(center_dataset),
+        "tokens_shape": [int(s) for s in tokens_shape],
+    }
+    return hashlib.sha256(_json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _write_harvest_cursor(folders, next_chunk: int, batch_cursor: int, config_sha: str):
+    """Commit the harvest position into every capture-point folder (atomic
+    JSON replace) — each store is then self-describing for resume."""
+    import time as _time
+
+    from sparse_coding__tpu.data import integrity
+
+    rec = {
+        "format": 1,
+        "chunk": int(next_chunk),
+        "batch_cursor": int(batch_cursor),
+        "config_sha": config_sha,
+        "updated_at": _time.time(),
+    }
+    for folder in folders.values():
+        integrity.write_json_atomic(Path(folder) / HARVEST_CURSOR, rec)
+
+
+def read_harvest_cursor(folder) -> Optional[Dict]:
+    import json as _json
+
+    try:
+        with open(Path(folder) / HARVEST_CURSOR) as f:
+            return _json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _verified_skip_chunks(folders, requested: int, config_sha: str) -> int:
+    """How many leading chunks a resume may really skip: the longest prefix
+    `[0, k)` (k ≤ `requested`) whose chunks VERIFY against their commit
+    manifests in EVERY capture-point folder. `skip_chunks` used to trust
+    bare file existence — a torn pair or a differently-configured store
+    silently passed; now an unverifiable chunk truncates the skip (it gets
+    re-harvested) and a cursor written under a different config fingerprint
+    raises."""
+    import warnings
+
+    from sparse_coding__tpu.data import integrity
+    from sparse_coding__tpu.telemetry.events import event_active
+
+    for folder in folders.values():
+        cursor = read_harvest_cursor(folder)
+        if cursor is not None and cursor.get("config_sha") not in (None, config_sha):
+            raise ValueError(
+                f"harvest resume refused: {folder} was harvested under a "
+                f"different configuration (cursor config_sha "
+                f"{cursor.get('config_sha')!r} != {config_sha!r}); use a "
+                "fresh dataset folder or re-harvest from scratch"
+            )
+    effective = requested
+    for folder in folders.values():
+        for i in range(requested):
+            if i >= effective:
+                break
+            ok, reason = integrity.verify_chunk(folder, i)
+            if not ok:
+                effective = i
+                warnings.warn(
+                    f"harvest resume: chunk {i} in {folder} does not verify "
+                    f"({reason}) — re-harvesting from chunk {i} instead of "
+                    f"skipping {requested}",
+                    RuntimeWarning,
+                )
+                event_active(
+                    "anomaly", kind="harvest_resume_truncated", action="warn",
+                    chunk=i, reason=reason, store=str(folder),
+                )
+                break
+    return effective
+
+
+def _committed_resume_point(folders, config_sha: str) -> int:
+    """The cursor-recorded resume point, clamped to what actually verifies —
+    a harvest killed mid-chunk resumes from the last *committed* chunk."""
+    chunks = []
+    for folder in folders.values():
+        cursor = read_harvest_cursor(folder)
+        chunks.append(0 if cursor is None else int(cursor.get("chunk", 0)))
+    requested = min(chunks) if chunks else 0
+    return _verified_skip_chunks(folders, requested, config_sha)
+
+
 def make_activation_dataset(
     params,
     lm_cfg: lm_model.LMConfig,
@@ -267,6 +377,8 @@ def make_activation_dataset(
     compute_dtype=None,
     store_dtype=np.float16,
     attn: str = "dense",
+    resume: bool = False,
+    only_chunks: Optional[Sequence[int]] = None,
 ) -> Dict[Tuple[int, str], Path]:
     """Run the subject LM over `tokens` `[N, S]`, capturing every requested
     (layer, layer_loc) in one pass; write fp16 chunks per capture point.
@@ -278,6 +390,21 @@ def make_activation_dataset(
     `lm.ring_attention`); `store_dtype=np.int8` ("int4") writes quantized
     chunks at half (a quarter of) the disk/transfer bytes, dequantized
     on device (`data.chunks`).
+
+    **Resumable verified harvest** (docs/DATAPLANE.md): chunks are written
+    through `data.chunks.save_chunk`'s atomic pair-commit, and after each
+    chunk lands in every folder a harvest cursor
+    (``sc_harvest_cursor.json``: next chunk, batch cursor, config
+    fingerprint) is committed alongside. ``resume=True`` restarts from the
+    last *committed* chunk — the cursor position clamped to the longest
+    prefix that VERIFIES against its chunk manifests, so a harvest
+    SIGKILLed mid-pair re-harvests the torn chunk instead of trusting it;
+    a cursor from a differently-configured harvest raises. An explicit
+    ``skip_chunks=N`` is verified the same way (it used to trust bare file
+    existence) and is truncated, with a warning, at the first unverifiable
+    chunk. ``only_chunks=[...]`` harvests exactly those indices (the batch
+    cursor still advances deterministically through the rest), which is how
+    `data.scrub --repair` refills quarantined holes bit-exactly.
     """
     names, stop_at, batches_per_chunk = _harvest_plan(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
@@ -294,6 +421,20 @@ def make_activation_dataset(
     for f in folders.values():
         f.mkdir(parents=True, exist_ok=True)
 
+    config_sha = _harvest_config_sha(
+        layers, layer_locs, batch_size, chunk_size_gb, store_dtype,
+        center_dataset, tokens.shape,
+    )
+    if resume:
+        # resume from the last committed-and-verified chunk (cursor clamped
+        # by manifest verification); an explicit skip_chunks still wins when
+        # it asks for LESS than the cursor reached
+        committed = _committed_resume_point(folders, config_sha)
+        skip_chunks = committed if skip_chunks == 0 else min(skip_chunks, committed)
+    elif skip_chunks:
+        skip_chunks = _verified_skip_chunks(folders, skip_chunks, config_sha)
+    selected = None if only_chunks is None else {int(c) for c in only_chunks}
+
     compute_dtype = _canon_dtype(compute_dtype)
     capture = _build_capture(lm_cfg, names, stop_at, mesh, seq_attn, compute_dtype, attn)
     if compute_dtype is not None:
@@ -306,8 +447,11 @@ def make_activation_dataset(
     batch_cursor = 0
     means: Dict[Tuple[int, str], np.ndarray] = {}
     while chunk_idx < max_chunks and batch_cursor + batches_per_chunk <= n_batches_total:
-        if chunk_idx < skip_chunks:
-            # resume: skip the forward entirely, just advance the cursor
+        if chunk_idx < skip_chunks or (
+            selected is not None and chunk_idx not in selected
+        ):
+            # resume/repair: skip the forward entirely, just advance the
+            # cursor — chunk content is a pure function of the batch range
             batch_cursor += batches_per_chunk
             chunk_idx += 1
             continue
@@ -340,9 +484,24 @@ def make_activation_dataset(
                 elif key not in means:
                     means[key] = np.load(folders[key] / "mean.npy")
                 chunk = chunk - means[key]
-            save_chunk(folders[key], chunk_idx, chunk, dtype=store_dtype)
+            save_chunk(
+                folders[key], chunk_idx, chunk, dtype=store_dtype,
+                provenance={
+                    "harvest": {
+                        "config_sha": config_sha,
+                        "layer": int(key[0]), "loc": str(key[1]),
+                        "batches": [batch_cursor, batch_cursor + batches_per_chunk],
+                        "centered": bool(center_dataset),
+                    }
+                },
+            )
         batch_cursor += batches_per_chunk
         chunk_idx += 1
+        if selected is None:
+            # commit the harvest position AFTER the chunk landed in every
+            # folder — the resume contract "last committed chunk" (repair
+            # passes leave the cursor alone: they fill holes, not the tail)
+            _write_harvest_cursor(folders, chunk_idx, batch_cursor, config_sha)
 
     return folders
 
@@ -360,6 +519,7 @@ def harvest_to_device(
     seq_attn: str = "ring",
     save_folder: Optional[Union[str, Path]] = None,
     compute_dtype=None,
+    store_dtype=np.float16,
     attn: str = "dense",
 ):
     """Fused harvest→train streaming: yield HBM-resident activation chunks,
@@ -376,8 +536,13 @@ def harvest_to_device(
     written (asserted in tests).
 
     ``save_folder``: optionally ALSO persist each chunk through the normal
-    fp16 `.npy` store (pays the device→host fetch; keeps the data contract
-    when the run should be resumable/reusable).
+    `.npy` store (pays the device→host fetch; keeps the data contract when
+    the run should be resumable/reusable). ``store_dtype`` selects the
+    persisted tier exactly as in `make_activation_dataset` — fp16
+    (default), ``np.int8``, or ``"int4"`` — so fused-harvest runs can
+    persist quantized stores too (the yielded device chunks stay fp16
+    either way; quantization is a disk/transfer format, not a training
+    dtype).
     """
     names, stop_at, batches_per_chunk = _harvest_plan(
         lm_cfg, layers, layer_locs, chunk_size_gb, batch_size, tokens.shape[1]
@@ -418,7 +583,10 @@ def harvest_to_device(
         del buffers
         if folders is not None:
             for key, arr in chunk.items():
-                save_chunk(folders[key], chunk_idx, np.asarray(jax.device_get(arr)))
+                save_chunk(
+                    folders[key], chunk_idx, np.asarray(jax.device_get(arr)),
+                    dtype=store_dtype,
+                )
         yield chunk
         batch_cursor += batches_per_chunk
         chunk_idx += 1
@@ -439,6 +607,7 @@ def setup_data(
     skip_chunks: int = 0,
     compute_dtype=None,
     store_dtype="float16",
+    resume: bool = False,
 ) -> int:
     """Full pipeline: HF model + dataset → tokenize → harvest → chunk store
     (reference `setup_data`, `activation_dataset.py:400-460`). Needs the HF
@@ -465,6 +634,7 @@ def setup_data(
         batch_size=batch_size, chunk_size_gb=chunk_size_gb, n_chunks=n_chunks,
         skip_chunks=skip_chunks, center_dataset=center_dataset,
         single_folder=single,
+        resume=resume,
         compute_dtype=compute_dtype,
         # "int4" is a save_chunk format tag, not a numpy dtype
         store_dtype=store_dtype if str(store_dtype) == "int4" else np.dtype(store_dtype),
@@ -487,6 +657,9 @@ def main(argv=None):
     p.add_argument("--chunk_size_gb", type=float, default=2.0)
     p.add_argument("--center_dataset", action="store_true")
     p.add_argument("--skip_chunks", type=int, default=0)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the last committed-and-verified chunk "
+                   "(sc_harvest_cursor.json; docs/DATAPLANE.md)")
     p.add_argument("--compute_dtype", default=None,
                    help="e.g. bfloat16: run the capture forward MXU-native")
     p.add_argument("--store_dtype", default="float16",
@@ -499,7 +672,7 @@ def main(argv=None):
         layer=args.layers, layer_loc=args.layer_locs, n_chunks=args.n_chunks,
         chunk_size_gb=args.chunk_size_gb, center_dataset=args.center_dataset,
         skip_chunks=args.skip_chunks, compute_dtype=args.compute_dtype,
-        store_dtype=args.store_dtype,
+        store_dtype=args.store_dtype, resume=args.resume,
     )
     print(f"wrote {n} datapoints")
 

@@ -119,8 +119,20 @@ def _dequant_int4_to(sharding):
     return jax.jit(_dequant_int4_impl, out_shardings=sharding)
 
 
-def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
-    """Write chunk `i` as `[N, d]` .npy.
+def _save_npy_staged(final: Path, array: np.ndarray) -> Path:
+    """Write `array` to a dot-prefixed same-dir temp (invisible to every
+    chunk glob/stem check). np.save would append `.npy` to a bare temp
+    name, so write through an open handle."""
+    tmp = final.with_name(f".{final.name}.tmp{os.getpid()}")
+    with open(tmp, "wb") as f:
+        np.save(f, array)
+        f.flush()
+        os.fsync(f.fileno())
+    return tmp
+
+
+def save_chunk(folder, i: int, array, dtype=np.float16, provenance=None) -> Path:
+    """Write chunk `i` as `[N, d]` .npy, committed atomically.
 
     ``dtype=np.float16`` (default): the reference's half-precision contract
     (`activation_dataset.py:393-397`). ``dtype=np.int8``: symmetric per-row
@@ -130,23 +142,66 @@ def save_chunk(folder, i: int, array, dtype=np.float16) -> Path:
     the fp16 bytes (`quantize_rows_int4`). Built for slow links (the
     tunneled bench host moves ~20 MiB/s, VERDICT r2 weak #2 / r3 next #5);
     SAE training on quantize-roundtripped activations is asserted on-par
-    with fp16 in tests/test_chunk_quant.py for both tiers."""
+    with fp16 in tests/test_chunk_quant.py for both tiers.
+
+    **Commit protocol** (docs/DATAPLANE.md): data files are staged in
+    dot-prefixed temps and `os.replace`d into place, then the per-chunk
+    manifest ``sc_chunk.<i>.json`` (sizes + sha256 + shape/dtype/rows +
+    ``provenance``) lands with a final `os.replace` — the ONE commit point.
+    A kill anywhere in between leaves either the previous committed chunk
+    (old manifest, old bytes) or an uncommitted/mismatched pair the digest
+    tier always detects. The default ``size`` tier detects every tear that
+    changes a file's byte size (fresh writes, format/tier flips, fp16↔quant
+    overwrites, truncation); the one size-invisible case — overwriting an
+    existing quantized chunk with same-shape quantized data and dying in
+    the pair gap — needs ``SC_CHUNK_VERIFY=digest`` (in-repo repair/resume
+    flows rewrite bit-identical content, so the gap is moot there, but
+    external writers replacing chunk CONTENT in place should verify at
+    digest). Ordering matters
+    for the fp16-over-int8 overwrite: the new chunk bytes land BEFORE the
+    stale scale file is unlinked (the reverse order left old int8 bytes
+    with no scale — loaded as raw integers). Fault sites ``chunk_write``
+    (before anything lands), ``chunk_pair`` (between the pair's two file
+    operations) and ``chunk_committed`` (after the manifest commit) let the
+    chaos tests kill/corrupt a write at exactly the wrong moment."""
+    from sparse_coding__tpu.data import integrity
+    from sparse_coding__tpu.utils.faults import fault_point
+
     path = chunk_path(folder, i)
     path.parent.mkdir(parents=True, exist_ok=True)
     host = np.asarray(jax.device_get(array))
+    sp = scale_path(folder, i)
     if isinstance(dtype, str) and dtype == "int4":
-        packed, scales = quantize_rows_int4(host)
-        np.save(path, packed)
-        np.save(scale_path(folder, i), scales)
+        stored, scales = quantize_rows_int4(host)
+        tier = "int4"
     elif np.dtype(dtype) == np.int8:
-        q, scales = quantize_rows_int8(host)
-        np.save(path, q)
-        np.save(scale_path(folder, i), scales)
+        stored, scales = quantize_rows_int8(host)
+        tier = "int8"
     else:
-        sp = scale_path(folder, i)
-        if sp.exists():
-            sp.unlink()  # don't let a stale side file reinterpret fp16 bytes
-        np.save(path, host.astype(dtype))
+        stored, scales = host.astype(dtype), None
+        tier = np.dtype(dtype).name
+    tmp = _save_npy_staged(path, stored)
+    stmp = _save_npy_staged(sp, scales) if scales is not None else None
+    # nothing visible has changed yet: a kill here leaves the previous
+    # committed chunk intact (temps are swept by the scrub CLI)
+    fault_point("chunk_write", chunk=int(i))
+    os.replace(tmp, path)
+    # THE pair gap: new chunk bytes are live, the scale side file still
+    # describes the previous contents (or is missing). A kill here leaves a
+    # torn pair under the OLD manifest — detected by size/digest mismatch,
+    # never silently loaded
+    fault_point("chunk_pair", chunk=int(i))
+    files = {path.name: path}
+    if stmp is not None:
+        os.replace(stmp, sp)
+        files[sp.name] = sp
+    elif sp.exists():
+        sp.unlink()  # AFTER the new bytes land — see the docstring ordering
+    integrity.write_chunk_manifest(
+        folder, i, files, rows=host.shape[0], shape=stored.shape,
+        store_dtype=tier, provenance=provenance,
+    )
+    fault_point("chunk_committed", chunk=int(i), path=str(path))
     return path
 
 
@@ -157,29 +212,58 @@ class ChunkStore:
         self.folder = Path(folder)
         self.folder.mkdir(parents=True, exist_ok=True)
 
+    def indices(self) -> List[int]:
+        """Sorted chunk indices present on disk. NOT necessarily contiguous:
+        a quarantined chunk leaves a hole (degraded-mode drivers account the
+        hole against the loss budget; `data.scrub --repair` refills it)."""
+        return sorted(
+            int(p.stem)
+            for p in self.folder.iterdir()
+            if p.suffix == ".npy" and p.stem.isdigit()
+        )
+
     def __len__(self) -> int:
         # only numbered chunk files — the folder may also hold mean.npy etc.
-        return len(
-            [p for p in self.folder.iterdir() if p.suffix == ".npy" and p.stem.isdigit()]
-        )
+        return len(self.indices())
 
     @property
     def n_chunks(self) -> int:
         return len(self)
 
+    def slot_count(self) -> int:
+        """The chunk-index DOMAIN size: highest index present or quarantined,
+        plus one. Drivers iterate slots rather than `len` so a quarantined
+        chunk keeps its place in the epoch order — its absence surfaces as a
+        budgeted degraded-mode skip instead of silently renumbering every
+        later chunk."""
+        from sparse_coding__tpu.data import integrity
+
+        idx = self.indices() + integrity.quarantined_indices(self.folder)
+        return max(idx) + 1 if idx else 0
+
     def n_datapoints(self) -> int:
-        """Total rows across chunks — header-only reads, no data loaded
-        (the reference loads every full chunk just to count,
-        `big_sweep.py:306-309`)."""
+        """Total rows across chunks — manifest reads where chunks are
+        committed (`sc_chunk.<i>.json` records ``rows``), header-only .npy
+        reads for legacy chunks via the PUBLIC numpy format API (the
+        private `_read_array_header` broke across numpy versions). No chunk
+        data is loaded either way (the reference loads every full chunk
+        just to count, `big_sweep.py:306-309`)."""
+        from sparse_coding__tpu.data import integrity
+
         total = 0
-        for i in range(len(self)):
-            with open(chunk_path(self.folder, i), "rb") as f:
-                version = np.lib.format.read_magic(f)
-                shape, _, _ = np.lib.format._read_array_header(f, version)
+        for i in self.indices():
+            manifest = integrity.read_chunk_manifest(self.folder, i)
+            if manifest is not None and isinstance(manifest.get("rows"), int):
+                total += manifest["rows"]
+                continue
+            shape, _ = integrity.npy_header(chunk_path(self.folder, i))
             total += shape[0]
         return total
 
-    def load(self, i: int, dtype=jnp.float32, device=None, sharding=None) -> jax.Array:
+    def load(
+        self, i: int, dtype=jnp.float32, device=None, sharding=None,
+        verify: Optional[str] = None,
+    ) -> jax.Array:
         """Load chunk `i` to device (defaults to JAX's default device).
 
         The on-disk fp16 bytes are transferred as-is and upcast ON DEVICE:
@@ -193,14 +277,47 @@ class ChunkStore:
         fp16 before any requested upcast; ``dtype=None`` therefore yields
         fp16 for both store formats (the store's logical dtype).
 
+        **Integrity** (docs/DATAPLANE.md): the chunk is verified against its
+        commit manifest before its bytes are trusted — ``verify`` overrides
+        ``SC_CHUNK_VERIFY`` (``size`` default / ``digest`` / ``off``). A
+        failing chunk is quarantined (`data.integrity.quarantine_chunk`:
+        moved into ``quarantine/``, ``data.corrupt`` counter + anomaly-style
+        ``chunk_corrupt`` event) and raises `CorruptChunk`, which drivers
+        turn into a budgeted degraded-mode skip. Quantized bytes with a
+        missing scale file are detected at EVERY depth — the silent-misread
+        case (raw int8 fed to training as activations) is structurally
+        impossible. A chunk that was already quarantined raises
+        `CorruptChunk` too (never `FileNotFoundError` — a hole left by
+        quarantine is data loss, not a caller bug).
+
         Transient read errors (network filesystems under pod churn) are
         retried with the shared `utils.sync.retry_with_backoff` schedule
         (`SC_SYNC_RETRIES`/`SC_SYNC_BACKOFF`); each retry bumps the
         telemetry ``io.retry`` counter. The ``chunk_read`` fault site
         (`utils.faults`) lets tests inject the failures deterministically."""
+        from sparse_coding__tpu.data import integrity
         from sparse_coding__tpu.telemetry.events import counter_inc_active
         from sparse_coding__tpu.utils.faults import fault_point
         from sparse_coding__tpu.utils.sync import retry_with_backoff
+
+        def _corrupt(reason: str) -> "jax.Array":
+            integrity.quarantine_chunk(self.folder, i, reason)
+            raise integrity.CorruptChunk(self.folder, i, reason)
+
+        if not chunk_path(self.folder, i).exists():
+            if integrity.is_quarantined(self.folder, i):
+                raise integrity.CorruptChunk(self.folder, i, "quarantined")
+            if integrity.read_chunk_manifest(self.folder, i) is None:
+                # no file, no manifest, no quarantine record: the index was
+                # never written — a caller bug, not data loss
+                raise FileNotFoundError(chunk_path(self.folder, i))
+        depth = integrity.verify_depth(verify)
+        if depth != "off":
+            ok, reason = integrity.verify_chunk(self.folder, i, depth=depth)
+            if not ok:
+                _corrupt(reason)
+            if integrity.read_chunk_manifest(self.folder, i) is not None:
+                counter_inc_active("data.chunks_verified")
 
         def _read(attempt: int):
             fault_point("chunk_read", chunk=int(i), attempt=attempt)
@@ -226,16 +343,28 @@ class ChunkStore:
                 on_retry=lambda attempt, exc: counter_inc_active("io.retry"),
             )
         except (
-            FileNotFoundError, IsADirectoryError, NotADirectoryError,
-            PermissionError,
+            IsADirectoryError, NotADirectoryError, PermissionError,
         ):
             raise
+        except FileNotFoundError:
+            raise
+        except ValueError as e:
+            # np.load on truncated/garbled bytes: corruption, not churn
+            _corrupt(f"unreadable npy: {e}")
         except OSError:
             # the whole retry schedule burned: count the exhaustion so the
             # report distinguishes "retried and recovered" from "gave up" —
             # drivers turn this into a resumable exit-75 abort
             counter_inc_active("io.exhausted")
             raise
+        if arr.dtype in (np.int8, np.uint8) and scales is None:
+            # quantized bytes, no scale file: the pre-manifest format's one
+            # silent misread (raw integers fed to training as activations) —
+            # detected at EVERY verify depth, including off and legacy stores
+            _corrupt(
+                f"quantized ({arr.dtype.name}) chunk bytes with no scale "
+                "file — torn pair"
+            )
         if scales is not None:
             # int8 = signed bytes; uint8 = nibble-packed int4 (save_chunk's
             # two quantized tiers)
@@ -317,15 +446,79 @@ def generate_synthetic_chunks(
     chunk_size_gb: float = 2.0,
     activation_width: Optional[int] = None,
     dtype=np.float16,
+    only_chunks: Optional[Sequence[int]] = None,
 ) -> ChunkStore:
     """Materialize a generator into chunk files
-    (reference `generate_synthetic_dataset`, `big_sweep.py:272-281`)."""
+    (reference `generate_synthetic_dataset`, `big_sweep.py:272-281`).
+
+    ``only_chunks``: regenerate just those indices (the generator still
+    advances through every chunk's batches so chunk `k`'s data is identical
+    whichever subset is written — what `data.scrub --repair` leans on to
+    refill quarantined holes bit-exactly)."""
     store = ChunkStore(folder)
     width = activation_width or generator.activation_dim
     bytes_per_row = width * np.dtype(dtype).itemsize
     rows_per_chunk = int(chunk_size_gb * 1024**3 // bytes_per_row)
     batches_per_chunk = max(1, rows_per_chunk // generator.batch_size)
+    selected = None if only_chunks is None else {int(c) for c in only_chunks}
     for i in range(n_chunks):
+        if selected is not None and i not in selected:
+            for _ in range(batches_per_chunk):
+                next(generator)  # keep the stream position deterministic
+            continue
         parts = [np.asarray(jax.device_get(next(generator))) for _ in range(batches_per_chunk)]
         save_chunk(folder, i, np.concatenate(parts, axis=0), dtype=dtype)
     return store
+
+
+def load_store_dataset(
+    store,
+    dtype=jnp.float32,
+    telemetry=None,
+    budget=None,
+    budget_frac: Optional[float] = None,
+):
+    """Load a whole chunk store into one `[N, d]` device array, surviving
+    corrupt chunks in degraded mode.
+
+    The admission path for array-input trainers (`train.train_big_batch`
+    accepts a store folder through this): every chunk is loaded (and
+    verified per ``SC_CHUNK_VERIFY``); a `CorruptChunk` is quarantined by
+    the load and accounted against a `data.integrity.ChunkLossBudget` —
+    inside the budget the chunk's rows are simply absent from the returned
+    array (``data.chunks_skipped``/``data.rows_skipped`` counters record
+    the loss), past it the budget raises `ResumableAbort` (exit 75).
+    Returns ``(dataset, budget)``."""
+    from sparse_coding__tpu.data import integrity
+
+    if not isinstance(store, ChunkStore):
+        store = ChunkStore(store)
+    idx = store.indices()
+    # distinct union: a chunk both present AND in the quarantine ledger
+    # (repaired after an earlier quarantine) must not inflate the budget's
+    # denominator
+    n_total = max(
+        len(set(idx) | set(integrity.quarantined_indices(store.folder))), 1
+    )
+    if budget is None:
+        budget = integrity.ChunkLossBudget(
+            n_total, budget_frac=budget_frac, telemetry=telemetry
+        )
+    # chunks already quarantined before this run started are losses too
+    for q in integrity.quarantined_indices(store.folder):
+        if q not in idx:
+            budget.skip(q, "quarantined", rows=integrity.quarantined_rows(store.folder, q))
+    parts = []
+    for i in idx:
+        try:
+            parts.append(store.load(i, dtype=dtype))
+        except integrity.CorruptChunk as e:
+            budget.skip(i, e.reason, rows=integrity.quarantined_rows(store.folder, i))
+    if not parts:
+        from sparse_coding__tpu.train.preemption import ResumableAbort
+
+        raise ResumableAbort(
+            f"no loadable chunks in {store.folder} "
+            f"({len(budget.skipped_chunks)} quarantined); scrub/repair the store"
+        )
+    return jnp.concatenate(parts, axis=0), budget

@@ -1,0 +1,439 @@
+"""Chunk-store integrity: per-chunk commit manifests, verify tiers, quarantine,
+and the degraded-mode loss budget.
+
+The activation chunk store is the framework's only data contract
+(`data/chunks.py`, reference `activation_dataset.py:393-397`) — and until
+this layer it was trust-based: `save_chunk` wrote `.npy` files
+non-atomically, so a kill between a quantized chunk and its `{i}.scale.npy`
+side file left raw int8 bytes that `ChunkStore.load` silently fed to
+training as activations. This module gives the data plane the same
+commit-verify-recover treatment the checkpoint layer got in PR 5
+(`train.checkpoint`):
+
+**Commit.** `save_chunk` stages chunk + scale in dot-prefixed temps and
+lands them with a final `os.replace` of a per-chunk manifest
+(``sc_chunk.<i>.json``: per-file byte sizes + sha256, rows, shape, store
+dtype/quant tier, harvest provenance) — the ONE atomic commit point. A
+chunk without a matching manifest is uncommitted by definition; a torn
+pair can never be observed as data.
+
+**Verify.** `verify_chunk` checks a chunk against its manifest at a depth
+set by ``SC_CHUNK_VERIFY``:
+
+    size   (default) existence + byte sizes — catches torn pairs,
+           truncation, and format flips (int8 bytes under an fp16
+           manifest); cheap enough for every hot-loop load
+    digest sizes + sha256 of every file — catches bit rot; the scrub CLI
+           and fleet admission checks run at this depth
+    off    skip manifest verification (structural missing-scale detection
+           in `ChunkStore.load` still applies — silent misreads stay
+           impossible at every depth)
+
+Manifest-less chunks are *legacy* (pre-manifest stores): verification
+passes them except for the one structurally detectable corruption —
+quantized bytes with no scale file.
+
+**Quarantine + degraded mode.** A chunk that fails verification is moved
+into ``<store>/quarantine/`` (never deleted — an operator can inspect or
+restore it), a ``data.corrupt`` counter and an anomaly-style
+``chunk_corrupt`` event land on any live telemetry, and the load raises
+`CorruptChunk`. Drivers catch it and consult a `ChunkLossBudget`
+(``SC_CHUNK_LOSS_BUDGET``, default 5% of distinct chunks): inside the
+budget the chunk is skipped and accounted (``data.chunks_skipped`` /
+``data.rows_skipped``); past it the budget raises
+`train.preemption.ResumableAbort` — exit 75, never a raw traceback, never
+silent corruption.
+
+Repair: ``python -m sparse_coding__tpu.data.scrub <store>`` (see
+`data.scrub`) verifies a whole store, quarantines failures, and
+re-harvests missing indices. docs/DATAPLANE.md has the failure matrix.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CHUNK_VERIFY_ENV",
+    "LOSS_BUDGET_ENV",
+    "QUARANTINE_DIR",
+    "ChunkLossBudget",
+    "CorruptChunk",
+    "chunk_manifest_path",
+    "default_loss_budget",
+    "is_quarantined",
+    "npy_header",
+    "quarantine_chunk",
+    "quarantined_indices",
+    "quarantined_rows",
+    "read_chunk_manifest",
+    "verify_chunk",
+    "verify_depth",
+    "write_chunk_manifest",
+    "write_json_atomic",
+]
+
+# verification depth for chunk loads: size (default) | digest | off.
+# Unlike SC_CKPT_VERIFY (default digest — resume is rare), chunk loads are
+# the hot loop: a digest re-read of every chunk every epoch is real I/O, so
+# the default is the size tier and digest is reserved for scrub / admission.
+CHUNK_VERIFY_ENV = "SC_CHUNK_VERIFY"
+
+# degraded-mode budget: the fraction of DISTINCT chunks a run may lose to
+# quarantine before it stops trusting the dataset and exits resumable (75)
+LOSS_BUDGET_ENV = "SC_CHUNK_LOSS_BUDGET"
+DEFAULT_LOSS_BUDGET = 0.05
+
+QUARANTINE_DIR = "quarantine"
+
+_QUANT_DTYPES = ("int8", "uint8")  # on-disk dtypes that REQUIRE a scale file
+
+
+class CorruptChunk(RuntimeError):
+    """A chunk that failed integrity verification (torn pair, missing scale,
+    size/digest mismatch, unreadable bytes) — already quarantined by the
+    raiser. Drivers route this into degraded-mode skip-and-account, NEVER
+    into training data."""
+
+    def __init__(self, store, chunk: int, reason: str):
+        super().__init__(f"chunk {chunk} of {store} is corrupt: {reason}")
+        self.store = str(store)
+        self.chunk = int(chunk)
+        self.reason = reason
+
+
+def chunk_manifest_path(folder, i: int) -> Path:
+    return Path(folder) / f"sc_chunk.{int(i)}.json"
+
+
+def verify_depth(depth: Optional[str] = None) -> str:
+    """Resolve a verification depth: explicit arg > SC_CHUNK_VERIFY > size."""
+    d = (depth or os.environ.get(CHUNK_VERIFY_ENV, "size")).lower()
+    if d not in ("digest", "size", "off"):
+        raise ValueError(
+            f"unknown {CHUNK_VERIFY_ENV} depth {d!r} (digest | size | off)"
+        )
+    return d
+
+
+def default_loss_budget() -> float:
+    """The degraded-mode loss budget fraction (SC_CHUNK_LOSS_BUDGET)."""
+    raw = os.environ.get(LOSS_BUDGET_ENV)
+    if raw is None or raw == "":
+        return DEFAULT_LOSS_BUDGET
+    return float(raw)
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_json_atomic(path: Path, obj: Dict[str, Any]) -> Path:
+    """Same-dir temp + `os.replace` — the commit idiom every durable write
+    in this repo uses (a kill mid-write leaves the previous complete file or
+    nothing, never a torn one)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def write_chunk_manifest(
+    folder,
+    i: int,
+    files: Dict[str, Path],
+    rows: int,
+    shape,
+    store_dtype: str,
+    provenance: Optional[Dict[str, Any]] = None,
+) -> Path:
+    """Commit chunk `i`: hash the already-landed data files and `os.replace`
+    the manifest onto its final name — the single atomic commit point of the
+    chunk-pair write protocol (`data.chunks.save_chunk`).
+
+    Digests are ALWAYS recorded — the chunk bytes were just written, so the
+    hashing re-read is served from page cache, and a manifest without
+    digests would make the scrub/admission digest tier silently toothless
+    for the store's whole lifetime. ``SC_CHUNK_VERIFY`` tunes READ-side
+    verification only; it must never degrade what future readers can
+    check."""
+    entries: Dict[str, Dict[str, Any]] = {}
+    for name, p in files.items():
+        p = Path(p)
+        entries[name] = {
+            "bytes": p.stat().st_size,
+            "sha256": _sha256_file(p),
+        }
+    manifest = {
+        "format": 1,
+        "chunk": int(i),
+        "created_at": time.time(),
+        "rows": int(rows),
+        "shape": [int(s) for s in shape],
+        "store_dtype": str(store_dtype),
+        "files": entries,
+    }
+    if provenance:
+        manifest["provenance"] = provenance
+    return write_json_atomic(chunk_manifest_path(folder, i), manifest)
+
+
+def read_chunk_manifest(folder, i: int) -> Optional[Dict[str, Any]]:
+    """Chunk `i`'s commit manifest, or None when uncommitted/unreadable."""
+    try:
+        with open(chunk_manifest_path(folder, i)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def npy_header(path: Path):
+    """(shape, dtype) from a .npy header via the PUBLIC numpy format API —
+    the private `_read_array_header` breaks across numpy versions."""
+    with open(path, "rb") as f:
+        version = np.lib.format.read_magic(f)
+        if version == (1, 0):
+            shape, _, dtype = np.lib.format.read_array_header_1_0(f)
+        else:
+            # 2.0 and 3.0 share the header layout; 3.0 only changes the
+            # allowed field-name encoding
+            shape, _, dtype = np.lib.format.read_array_header_2_0(f)
+    return shape, dtype
+
+
+def verify_chunk(folder, i: int, depth: Optional[str] = None) -> Tuple[bool, str]:
+    """Is chunk `i` committed and intact at `depth`? Returns (ok, reason).
+
+    Manifest present → every listed file must exist with matching byte size
+    (and sha256 at the digest tier). Manifest absent → a legacy chunk:
+    passes unless it is structurally corrupt (quantized on-disk bytes with
+    no scale file — the torn pair the pre-manifest format could not
+    detect). A missing chunk file fails either way."""
+    from sparse_coding__tpu.data.chunks import chunk_path, scale_path
+
+    folder = Path(folder)
+    depth = verify_depth(depth)
+    cp = chunk_path(folder, i)
+    manifest = read_chunk_manifest(folder, i)
+    if manifest is None:
+        if not cp.is_file():
+            return False, "missing chunk file"
+        if depth == "off":
+            return True, "ok (verification off)"
+        try:
+            _, dtype = npy_header(cp)
+        except (OSError, ValueError) as e:
+            return False, f"unreadable npy header: {e}"
+        if dtype.name in _QUANT_DTYPES and not scale_path(folder, i).is_file():
+            return False, (
+                f"quantized ({dtype.name}) chunk bytes with no scale file — "
+                "torn pair (legacy, no manifest)"
+            )
+        return True, "ok (legacy, no manifest)"
+    if depth == "off":
+        return True, "ok (verification off)"
+    for rel, meta in manifest.get("files", {}).items():
+        p = folder / rel
+        if not p.is_file():
+            return False, f"missing file {rel}"
+        if p.stat().st_size != meta.get("bytes"):
+            return False, f"size mismatch on {rel}"
+        if depth == "digest" and "sha256" in meta and _sha256_file(p) != meta["sha256"]:
+            return False, f"digest mismatch on {rel}"
+    # files not in the manifest that change the load's interpretation: a
+    # stale scale file next to a committed fp16 chunk would flip the loader
+    # into dequantizing real fp16 bytes
+    sp = scale_path(folder, i)
+    if sp.is_file() and sp.name not in manifest.get("files", {}):
+        return False, f"stray scale file {sp.name} not in manifest"
+    return True, "ok"
+
+
+def _quarantine_root(folder) -> Path:
+    return Path(folder) / QUARANTINE_DIR
+
+
+def is_quarantined(folder, i: int) -> bool:
+    q = _quarantine_root(folder)
+    return (q / f"{int(i)}.npy").exists() or (q / f"sc_quarantine.{int(i)}.json").exists()
+
+
+def quarantined_indices(folder) -> List[int]:
+    q = _quarantine_root(folder)
+    if not q.is_dir():
+        return []
+    idx = set()
+    for p in q.iterdir():
+        if p.suffix == ".npy" and p.stem.isdigit():
+            idx.add(int(p.stem))
+        elif p.name.startswith("sc_quarantine.") and p.suffix == ".json":
+            mid = p.name[len("sc_quarantine."):-len(".json")]
+            if mid.isdigit():
+                idx.add(int(mid))
+    return sorted(idx)
+
+
+def quarantined_rows(folder, i: int) -> Optional[int]:
+    """Row count of a quarantined chunk (manifest first, npy header second)
+    — so degraded-mode epoch accounting knows how much data went missing.
+    None when it cannot be determined (e.g. truncated bytes)."""
+    q = _quarantine_root(folder)
+    try:
+        with open(q / f"sc_chunk.{int(i)}.json") as f:
+            manifest = json.load(f)
+        if isinstance(manifest.get("rows"), int):
+            return manifest["rows"]
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        shape, _ = npy_header(q / f"{int(i)}.npy")
+        return int(shape[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def quarantine_chunk(folder, i: int, reason: str) -> List[Path]:
+    """Move chunk `i`'s files (data, scale, manifest) into
+    ``<store>/quarantine/`` and record the reason — detection must never
+    destroy the evidence. Bumps the ``data.corrupt`` counter and emits an
+    anomaly-style ``chunk_corrupt`` event on any live telemetry. Returns the
+    moved paths. Idempotent: already-moved files are skipped."""
+    from sparse_coding__tpu.data.chunks import chunk_path, scale_path
+    from sparse_coding__tpu.telemetry.events import counter_inc_active, event_active
+
+    folder = Path(folder)
+    q = _quarantine_root(folder)
+    q.mkdir(parents=True, exist_ok=True)
+    moved: List[Path] = []
+    for p in (chunk_path(folder, i), scale_path(folder, i), chunk_manifest_path(folder, i)):
+        if p.is_file():
+            dst = q / p.name
+            os.replace(p, dst)
+            moved.append(dst)
+    write_json_atomic(
+        q / f"sc_quarantine.{int(i)}.json",
+        {"chunk": int(i), "reason": reason, "quarantined_at": time.time(),
+         "files": [p.name for p in moved]},
+    )
+    counter_inc_active("data.corrupt")
+    event_active(
+        "anomaly", kind="chunk_corrupt", action="quarantine",
+        chunk=int(i), reason=reason, store=str(folder),
+    )
+    return moved
+
+
+class ChunkLossBudget:
+    """Degraded-mode accounting: how much of the dataset a run may lose.
+
+    Drivers construct one per run and call `skip(chunk, reason, rows=...)`
+    for every `CorruptChunk` they survive. Skips are counted in DISTINCT
+    chunk indices (an epoch loop re-skipping the same quarantined chunk is
+    one loss, not n_epochs losses); rows are accumulated separately so
+    epoch accounting can correct for what training never saw. When the
+    distinct-loss fraction exceeds the budget (``SC_CHUNK_LOSS_BUDGET``,
+    default 5%), `skip` raises `train.preemption.ResumableAbort` — exit 75,
+    the same resumable contract as a preemption or an exhausted read retry,
+    so the supervisor/fleet can repair (scrub + re-harvest) and retry
+    instead of a human reading a traceback."""
+
+    def __init__(
+        self,
+        n_chunks: int,
+        budget_frac: Optional[float] = None,
+        telemetry=None,
+    ):
+        self.n_chunks = max(1, int(n_chunks))
+        self.budget_frac = (
+            default_loss_budget() if budget_frac is None else float(budget_frac)
+        )
+        self.telemetry = telemetry
+        self.skipped_chunks: set = set()
+        self.rows_skipped = 0
+        self._events = 0
+        self._gauge(self.budget_frac)
+
+    # telemetry plumbing: prefer the driver's handle; fall back to the
+    # process-global fan-out so library callers still account
+    def _counter(self, name: str, n=1):
+        from sparse_coding__tpu.telemetry.events import counter_inc_active
+
+        if self.telemetry is not None:
+            self.telemetry.counter_inc(name, n)
+        else:
+            counter_inc_active(name, n)
+
+    def _gauge(self, remaining: float):
+        from sparse_coding__tpu.telemetry.events import gauge_set_active
+
+        if self.telemetry is not None:
+            self.telemetry.gauge_set("data.budget_remaining_frac", remaining)
+        else:
+            gauge_set_active("data.budget_remaining_frac", remaining)
+
+    def _event(self, etype: str, **fields):
+        from sparse_coding__tpu.telemetry.events import event_active
+
+        if self.telemetry is not None:
+            self.telemetry.event(etype, **fields)
+        else:
+            event_active(etype, **fields)
+
+    @property
+    def loss_frac(self) -> float:
+        return len(self.skipped_chunks) / self.n_chunks
+
+    @property
+    def remaining_frac(self) -> float:
+        return max(0.0, self.budget_frac - self.loss_frac)
+
+    @property
+    def exceeded(self) -> bool:
+        return self.loss_frac > self.budget_frac
+
+    def skip(self, chunk: int, reason: str, rows: Optional[int] = None) -> None:
+        """Account one skipped chunk; raise `ResumableAbort` past budget."""
+        self.skipped_chunks.add(int(chunk))
+        self._events += 1
+        if rows:
+            self.rows_skipped += int(rows)
+            self._counter("data.rows_skipped", int(rows))
+        self._counter("data.chunks_skipped")
+        self._gauge(self.remaining_frac)
+        self._event(
+            "chunk_skipped", chunk=int(chunk), reason=reason,
+            rows=rows, loss_frac=round(self.loss_frac, 4),
+            budget_frac=self.budget_frac,
+        )
+        if self.exceeded:
+            from sparse_coding__tpu.train.preemption import ResumableAbort
+
+            self._counter("data.budget_exhausted")
+            self._event(
+                "loss_budget_exhausted",
+                chunks_lost=sorted(self.skipped_chunks),
+                loss_frac=round(self.loss_frac, 4),
+                budget_frac=self.budget_frac,
+            )
+            raise ResumableAbort(
+                f"chunk loss budget exhausted: {len(self.skipped_chunks)}/"
+                f"{self.n_chunks} chunks lost "
+                f"({self.loss_frac:.1%} > {self.budget_frac:.1%} "
+                f"{LOSS_BUDGET_ENV}); scrub/repair the store and resume"
+            )

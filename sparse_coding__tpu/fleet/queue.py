@@ -356,16 +356,20 @@ class WorkQueue:
         worker_id: str,
         error: str,
         max_attempts: Optional[int] = None,
+        outcome: str = "failed",
     ) -> str:
         """Graceful failure: the worker saw the item's run die and releases
         it for another attempt. Returns the bucket the item landed in
-        ('pending' or, budget exhausted, 'failed')."""
+        ('pending' or, budget exhausted, 'failed'). ``outcome`` names the
+        lineage entry's terminal mark — e.g. ``input_corrupt`` when the
+        admission check found the item's chunk store rotten (mirroring the
+        scheduler's post-completion ``export_corrupt`` requeues)."""
         self._owned_lease(item_id, worker_id)
         src = self._item_path("leased", item_id)
         item = _read_json(src)
         if item is None:
             raise LeaseLost(f"leased item {item_id} vanished")
-        return self._requeue(item, src, "failed", max_attempts, error=str(error)[:500])
+        return self._requeue(item, src, outcome, max_attempts, error=str(error)[:500])
 
     def release(self, item_id: str, worker_id: str, outcome: str = "released") -> None:
         """Voluntary release WITHOUT an attempt penalty (worker shutting

@@ -19,6 +19,16 @@ loops over `WorkQueue.claim` until the queue drains. Each claimed item:
      ``python -m sparse_coding__tpu.fleet.worker --run-item`` as a child
      under `supervise.run_supervised`, so exit-75 preemptions restart with
      backoff exactly like a standalone supervised run.
+  3b. **Admission check.** When the item's payload names a
+     ``dataset_folder``, the worker verifies that chunk store at the
+     digest tier BEFORE training (`data.scrub.store_loss` — the input-side
+     mirror of export verification): corruption beyond
+     ``SC_CHUNK_LOSS_BUDGET`` requeues the item with an ``input_corrupt``
+     lineage entry (attempt charged, same budget protocol as the
+     scheduler's ``export_corrupt``) so a scrub/repair pass — or a worker
+     whose replica of the store is intact — gets it instead of training
+     on bad rows; loss *within* the budget proceeds, and the driver's
+     degraded mode accounts the skips.
   4. **Verify, then commit.** The learned-dict exports are hashed into
      ``export_manifest.json`` (per-file sizes + sha256 — the same
      size/digest discipline as checkpoint manifests) and re-verified; only
@@ -195,6 +205,7 @@ class FleetWorker:
         fail_mode: str = "release",
         telemetry=None,
         supervise_kwargs: Optional[Dict[str, Any]] = None,
+        admission_check: bool = True,
     ):
         if mode not in ("inprocess", "supervised"):
             raise ValueError(f"unknown worker mode {mode!r}")
@@ -213,10 +224,71 @@ class FleetWorker:
         self.fail_mode = fail_mode
         self.telemetry = telemetry
         self.supervise_kwargs = supervise_kwargs or {}
+        self.admission_check = admission_check
+        # (folder, dir mtime) → False (admitted) | error string; see
+        # _admission_failure
+        self._admission_cache: Dict[Any, Any] = {}
 
     def _event(self, etype: str, **fields):
         if self.telemetry is not None:
             self.telemetry.event(etype, worker=self.worker_id, **fields)
+            if etype == "input_corrupt":
+                self.telemetry.counter_inc("fleet.input_corrupt")
+
+    @staticmethod
+    def _store_signature(folder: Path):
+        """Stat-level fingerprint of a chunk store: (name, size, mtime_ns)
+        of every chunk/scale/manifest file, hashed. Far cheaper than the
+        digest sweep it gates."""
+        import hashlib
+
+        h = hashlib.sha256()
+        try:
+            for p in sorted(folder.iterdir()):
+                if p.name.startswith("."):
+                    continue
+                try:
+                    st = p.stat()
+                except OSError:
+                    continue
+                h.update(f"{p.name}:{st.st_size}:{st.st_mtime_ns};".encode())
+        except OSError:
+            return None
+        return h.hexdigest()
+
+    def _admission_failure(self, item: Dict[str, Any]) -> Optional[str]:
+        """Digest-verify the item's chunk store (payload ``dataset_folder``).
+        Returns an error string when the store's loss exceeds
+        ``SC_CHUNK_LOSS_BUDGET`` (the item must not train), None when the
+        store is whole, within budget, or the payload names no store."""
+        kwargs = (item.get("payload") or {}).get("kwargs") or {}
+        folder = kwargs.get("dataset_folder")
+        if not folder or not Path(folder).is_dir():
+            return None
+        from sparse_coding__tpu.data.integrity import default_loss_budget
+        from sparse_coding__tpu.data.scrub import store_loss
+
+        # many items usually share one store: cache the digest sweep per
+        # store SIGNATURE — a cheap stat sweep (names, sizes, file mtimes)
+        # — so N claims don't re-hash a multi-GB store N times. Any write,
+        # repair, quarantine move, or in-place rewrite changes a file stat
+        # and invalidates the cache; only writeless media rot between two
+        # claims escapes, the same residual the drivers' size tier accepts.
+        key = (str(folder), self._store_signature(Path(folder)))
+        cached = self._admission_cache.get(key)
+        if cached is not None:
+            return cached or None
+        loss = store_loss(folder, depth="digest")
+        verdict: Any = False  # cache sentinel: checked and admitted
+        if loss["loss_frac"] > default_loss_budget():
+            verdict = (
+                f"input store {folder} corrupt beyond budget: "
+                f"{len(loss['bad'])}/{loss['total']} chunks unverifiable "
+                f"({loss['loss_frac']:.1%} > {default_loss_budget():.1%}); "
+                f"bad={loss['bad'][:16]}"
+            )
+        self._admission_cache[key] = verdict
+        return verdict or None
 
     def _child_cmd(self, item_id: str) -> List[str]:
         return [
@@ -253,6 +325,26 @@ class FleetWorker:
             "claim", item=item_id, attempt=item.get("attempt", 0),
             resumed_from=None if resumed_from is None else resumed_from.name,
         )
+        # input-side admission check (mirror of export verification): the
+        # member group's chunk store must be within the loss budget BEFORE
+        # chips are spent training on it (docs/DATAPLANE.md)
+        if self.admission_check:
+            bad = self._admission_failure(item)
+            if bad is not None:
+                try:
+                    bucket = self.queue.fail(
+                        item_id, self.worker_id, error=bad,
+                        max_attempts=self.max_attempts,
+                        outcome="input_corrupt",
+                    )
+                except LeaseLost:
+                    self._event("lease_lost", item=item_id)
+                    return "lease_lost"
+                self._event(
+                    "input_corrupt", item=item_id, error=bad,
+                    requeued_to=bucket,
+                )
+                return "failed"
         # supervised mode trains in a child process the parent's preemption
         # flag cannot stop: on lease loss the heartbeat SIGTERMs the child
         # (it checkpoints and exits 75) so it stops racing the new holder

@@ -45,6 +45,7 @@ __all__ = [
     "RunTelemetry",
     "counter_inc_active",
     "event_active",
+    "gauge_set_active",
     "run_fingerprint",
     "tracked_jit",
     "read_events",
@@ -106,6 +107,14 @@ def counter_inc_active(name: str, n: int = 1) -> None:
     feeding the `io.retry` counter). No live telemetry → no-op."""
     for t in list(_ACTIVE):
         t.counter_inc(name, n)
+
+
+def gauge_set_active(name: str, value: float) -> None:
+    """Set a gauge on EVERY live RunTelemetry — for handle-less layers whose
+    state is a level, not a count (e.g. `data.integrity.ChunkLossBudget`'s
+    remaining-budget fraction). No live telemetry → no-op."""
+    for t in list(_ACTIVE):
+        t.gauge_set(name, value)
 
 
 def event_active(etype: str, **fields) -> None:

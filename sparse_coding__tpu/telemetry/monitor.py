@@ -113,7 +113,7 @@ class EventTail:
 class _ProcState:
     __slots__ = (
         "steps", "chunks", "last_ts", "status", "beats", "hbm_peak",
-        "clock_offset", "clock_uncertainty", "steps_per_sec",
+        "clock_offset", "clock_uncertainty", "steps_per_sec", "data",
     )
 
     def __init__(self):
@@ -126,6 +126,7 @@ class _ProcState:
         self.clock_offset: Optional[float] = None
         self.clock_uncertainty: Optional[float] = None
         self.steps_per_sec: Optional[float] = None
+        self.data: Dict[str, float] = {}  # last-snapshot data.* counters
 
 
 class RunMonitor:
@@ -148,6 +149,11 @@ class RunMonitor:
         self.preempts: List[Dict[str, Any]] = []
         self.resumes: List[Dict[str, Any]] = []
         self.restarts: List[Dict[str, Any]] = []
+        # data-plane integrity (docs/DATAPLANE.md): live skip events + the
+        # remaining-budget gauge; quarantines ride the anomaly list
+        self.chunk_skips: List[Dict[str, Any]] = []
+        self.budget_remaining: Optional[float] = None
+        self.budget_exhausted = False
 
     # -- ingestion ------------------------------------------------------------
 
@@ -225,11 +231,20 @@ class RunMonitor:
             self.resumes.append(rec)
         elif kind == "restart":
             self.restarts.append(rec)
+        elif kind == "chunk_skipped":
+            self.chunk_skips.append(rec)
+        elif kind == "loss_budget_exhausted":
+            self.budget_exhausted = True
         elif kind == "snapshot":
             counters = rec.get("counters") or {}
             if "train.steps" in counters:
                 p.steps = int(counters["train.steps"])
+            p.data = {
+                k: float(v) for k, v in counters.items() if k.startswith("data.")
+            } or p.data
             gauges = rec.get("gauges") or {}
+            if "data.budget_remaining_frac" in gauges:
+                self.budget_remaining = float(gauges["data.budget_remaining_frac"])
             if "skew.flush.spread_seconds" in gauges:
                 self.skew_gauge = float(gauges["skew.flush.spread_seconds"])
             peaks = [
@@ -356,6 +371,35 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
     ]
     if offsets:
         lines.append("  clock offsets: " + ", ".join(offsets))
+    # data-plane integrity line (docs/DATAPLANE.md): summed last-snapshot
+    # counters, live skip events, remaining budget — only when the run has
+    # any data-integrity activity (ordinary output is a stability contract)
+    data: Dict[str, float] = {}
+    for p in mon.procs.values():
+        for k, v in p.data.items():
+            data[k] = data.get(k, 0.0) + v
+    n_skips = max(int(data.get("data.chunks_skipped", 0)), len(mon.chunk_skips))
+    n_corrupt = max(
+        int(data.get("data.corrupt", 0)),
+        sum(1 for a in mon.anomalies if a.get("kind") == "chunk_corrupt"),
+    )
+    if data or n_skips or n_corrupt or mon.budget_exhausted:
+        bits = [f"chunks {int(data.get('data.chunks_verified', 0))} verified"]
+        bits.append(f"{n_corrupt} quarantined")
+        bits.append(
+            f"{n_skips} skipped"
+            + (
+                f" ({int(data['data.rows_skipped'])} rows)"
+                if data.get("data.rows_skipped")
+                else ""
+            )
+        )
+        line = "  data: " + " / ".join(bits)
+        if mon.budget_exhausted:
+            line += " | budget EXHAUSTED (exit 75 — scrub/repair the store)"
+        elif mon.budget_remaining is not None:
+            line += f" | budget {100 * mon.budget_remaining:.1f}% remaining"
+        lines.append(line)
     if mon.preempts or mon.resumes or mon.restarts:
         bits = []
         if mon.preempts:

@@ -551,6 +551,61 @@ def _recovery_section(run, lines: List[str]):
         lines.append("")
 
 
+def _data_section(run, lines: List[str]):
+    """Data-plane integrity: chunks verified/quarantined/skipped, rows lost
+    to degraded mode, remaining loss budget (docs/DATAPLANE.md). Omitted
+    entirely for runs with no data-integrity activity at all — ordinary
+    report output is a stability contract."""
+    counters = _merged_counters(run)
+    gauges = _merged_gauges(run)
+    skips = _events_of(run, "chunk_skipped")
+    exhausted = _events_of(run, "loss_budget_exhausted")
+    verified = counters.get("data.chunks_verified")
+    corrupt = counters.get("data.corrupt")
+    skipped = counters.get("data.chunks_skipped")
+    if not (verified or corrupt or skipped or skips or exhausted):
+        return
+    lines.append("## Data integrity")
+    lines.append("")
+    bits = []
+    if verified:
+        bits.append(f"{int(verified)} chunk load(s) verified")
+    if corrupt:
+        bits.append(f"**{int(corrupt)} chunk(s) quarantined**")
+    if skipped:
+        rows = counters.get("data.rows_skipped")
+        bits.append(
+            f"{int(skipped)} degraded-mode skip(s)"
+            + (f" ({int(rows)} rows never trained)" if rows else "")
+        )
+    if bits:
+        lines.append("- " + ", ".join(bits))
+    budget = gauges.get("data.budget_remaining_frac")
+    if budget is not None:
+        lines.append(
+            f"- loss budget remaining: **{100 * budget:.1f}%** "
+            "(`SC_CHUNK_LOSS_BUDGET`)"
+        )
+    if exhausted:
+        e = exhausted[-1]
+        lines.append(
+            f"- ⚠ **loss budget EXHAUSTED**: chunks {_fmt(e.get('chunks_lost'))} "
+            f"lost ({_fmt(e.get('loss_frac'))} > {_fmt(e.get('budget_frac'))}) "
+            "— run exited resumable (75); scrub/repair the store "
+            "(`python -m sparse_coding__tpu.data.scrub`)"
+        )
+    lines.append("")
+    if skips:
+        lines.append("| chunk | reason | rows | loss so far |")
+        lines.append("|---:|---|---:|---:|")
+        for s in skips:
+            lines.append(
+                f"| {_fmt(s.get('chunk'))} | {s.get('reason', '?')} "
+                f"| {_fmt(s.get('rows'))} | {_fmt(s.get('loss_frac'))} |"
+            )
+        lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -664,6 +719,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _fingerprint_section(run, lines)
     _pod_section(run, lines)
     _recovery_section(run, lines)
+    _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)
     _throughput_section(run, lines)

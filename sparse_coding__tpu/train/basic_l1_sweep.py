@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparse_coding__tpu.data import integrity as data_integrity
 from sparse_coding__tpu.data.chunks import ChunkStore
 from sparse_coding__tpu.ensemble import Ensemble, build_ensemble
 from sparse_coding__tpu.models import FunctionalFista
@@ -90,11 +91,24 @@ def basic_l1_sweep(
     — and replays bit-identically to the uninterrupted run (the cursor
     carries epoch, chunk position, and the RNG key). ``checkpoint_every=N``
     additionally checkpoints every N chunks; the newest
-    ``checkpoint_keep`` checkpoints are retained."""
+    ``checkpoint_keep`` checkpoints are retained.
+
+    Data integrity (docs/DATAPLANE.md): chunk loads verify against their
+    commit manifests (``SC_CHUNK_VERIFY``); a corrupt chunk is quarantined
+    by the store and the driver enters *degraded mode* — the chunk is
+    skipped and accounted (``data.chunks_skipped``/``data.rows_skipped``,
+    ``chunk_skipped`` events) against ``SC_CHUNK_LOSS_BUDGET`` (default
+    5% of distinct chunks); past the budget the run raises
+    `ResumableAbort` (exit 75) so a supervisor/fleet can scrub-and-repair
+    the store and retry — never a raw traceback, never silent
+    corruption."""
     if l1_values is None:
         l1_values = list(np.logspace(-4, -2, 8))
     store = ChunkStore(dataset_folder)
-    assert len(store) > 0, f"no chunks in {dataset_folder}"
+    # slot_count, not len: a previously-quarantined chunk keeps its place in
+    # the epoch order and surfaces as a budgeted skip below
+    n_chunk_slots = store.slot_count()
+    assert n_chunk_slots > 0, f"no chunks in {dataset_folder}"
     out = Path(output_folder)
     out.mkdir(parents=True, exist_ok=True)
 
@@ -128,6 +142,9 @@ def basic_l1_sweep(
         output_folder, telemetry=telemetry, keep=checkpoint_keep,
         every=checkpoint_every,
     )
+    # degraded-mode accounting: corrupt chunks are quarantined by the store
+    # and skipped here within SC_CHUNK_LOSS_BUDGET (docs/DATAPLANE.md)
+    budget = data_integrity.ChunkLossBudget(n_chunk_slots, telemetry=telemetry)
     # (epoch, position) of the last COMPLETED chunk before this process
     # started; (-1, -1) = fresh run. The restored key replays the exact
     # per-chunk split sequence of the uninterrupted run.
@@ -187,7 +204,9 @@ def basic_l1_sweep(
     try:
         for epoch in range(n_epochs):
             chunk_order = (
-                order_rng.permutation(len(store)) if shuffle_chunks else range(len(store))
+                order_rng.permutation(n_chunk_slots)
+                if shuffle_chunks
+                else range(n_chunk_slots)
             )
             for pos, chunk_idx in enumerate(chunk_order):
                 if epoch < start_epoch or (
@@ -205,6 +224,17 @@ def basic_l1_sweep(
                         chunk = cache[int(chunk_idx)].astype(jnp.float32)
                     else:
                         chunk = store.load(int(chunk_idx))
+                except data_integrity.CorruptChunk as e:
+                    # quarantined by the store: degraded mode — skip and
+                    # account this chunk's rows against the loss budget
+                    # (past budget this raises ResumableAbort → exit 75)
+                    budget.skip(
+                        e.chunk, e.reason,
+                        rows=data_integrity.quarantined_rows(
+                            store.folder, e.chunk
+                        ),
+                    )
+                    continue
                 except (
                     FileNotFoundError, IsADirectoryError, NotADirectoryError,
                     PermissionError,
@@ -263,14 +293,14 @@ def basic_l1_sweep(
                 def _save_ckpt(path, _epoch=epoch, _pos=pos):
                     ckpt_lib.save_ensemble_checkpoint(
                         path, [(ens, {}, "ensemble")],
-                        chunk_cursor=_epoch * len(store) + _pos,
+                        chunk_cursor=_epoch * n_chunk_slots + _pos,
                         extra={
                             "epoch": _epoch, "position": _pos,
                             "key": np.asarray(jax.device_get(key)),
                         },
                     )
 
-                ckpt.boundary(epoch * len(store) + pos, _save_ckpt)
+                ckpt.boundary(epoch * n_chunk_slots + pos, _save_ckpt)
             # epochs fully completed BEFORE the resume already have their
             # export on disk — re-exporting would overwrite it with the
             # restored (later-epoch) state

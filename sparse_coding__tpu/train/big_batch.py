@@ -225,9 +225,23 @@ def train_big_batch(
     restarts empty on resume (its ~`reinit_every`-step window refills
     before the next resurrection); on pods the preemption agreement
     exchange runs every ``preempt_sync_every`` step boundaries.
+
+    ``dataset`` may also be a chunk-store folder (or `data.ChunkStore`):
+    the store is loaded through `data.chunks.load_store_dataset`, which
+    verifies every chunk against its commit manifest (``SC_CHUNK_VERIFY``),
+    quarantines corruption, and skips lost chunks in degraded mode within
+    ``SC_CHUNK_LOSS_BUDGET`` — past the budget it raises `ResumableAbort`
+    (exit 75) instead of training on bad rows (docs/DATAPLANE.md).
     """
     from sparse_coding__tpu.utils import precision as px
 
+    if not hasattr(dataset, "shape"):
+        # a chunk store (folder path or ChunkStore): degraded-mode load —
+        # the big-batch trainer samples rows, so a skipped chunk simply
+        # shrinks the pool; the budget bounds how much may go missing
+        from sparse_coding__tpu.data.chunks import load_store_dataset
+
+        dataset, _budget = load_store_dataset(dataset, telemetry=telemetry)
     with px.compute(compute_dtype):
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,

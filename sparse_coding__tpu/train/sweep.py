@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparse_coding__tpu import metrics as sm
+from sparse_coding__tpu.data import integrity as data_integrity
 from sparse_coding__tpu.data.chunks import ChunkStore, generate_synthetic_chunks
 from sparse_coding__tpu.data.synthetic import SparseMixDataset
 from sparse_coding__tpu.ensemble import Ensemble
@@ -312,6 +313,12 @@ def sweep(
     skipped — and fast-forwards the per-chunk RNG chain, so a resumed sweep
     trains the remaining chunks with the same keys as an uninterrupted one.
     The newest ``cfg.checkpoint_keep`` (default 3) checkpoints are retained.
+
+    Data integrity (docs/DATAPLANE.md): chunk loads verify against their
+    commit manifests (``SC_CHUNK_VERIFY``); a corrupt chunk is quarantined
+    and skipped in *degraded mode* within ``SC_CHUNK_LOSS_BUDGET``, past
+    which the sweep raises `ResumableAbort` (exit 75) for a
+    scrub-and-repair retry.
     """
     np.random.seed(cfg.seed)
     os.makedirs(cfg.dataset_folder, exist_ok=True)
@@ -367,7 +374,9 @@ def sweep(
         on_flush=guard.observe,
     )
 
-    n_chunks = len(store)
+    # slot_count, not len: a previously-quarantined chunk keeps its slot in
+    # the permutation and surfaces as a budgeted degraded-mode skip below
+    n_chunks = store.slot_count()
     # explicitly seeded: resume must reproduce the ORIGINAL run's permutation
     # regardless of what consumed global numpy randomness in between
     chunk_order = np.random.default_rng(cfg.seed).permutation(n_chunks)
@@ -419,30 +428,40 @@ def sweep(
     # ensemble per completed chunk — exactly the consumption below)
     for _ in range(start_chunk * len(ensembles)):
         rng_key, _unused = jax.random.split(rng_key)
-    remaining_order = [int(c) for c in chunk_order[start_chunk:]]
-    if getattr(cfg, "hbm_cache_chunks", False):
-        # multi-epoch sweeps whose dataset fits HBM: upload each unique chunk
-        # ONCE and reuse it every epoch — on slow host links re-reading per
-        # epoch dominates the sweep. The cache fills THROUGH the prefetching
-        # iterator (epoch 1 keeps its disk/train overlap) and holds the
-        # on-disk dtype (fp16 stores cache at half the fp32 footprint; the
-        # per-use upcast is lossless, so training matches the streaming path
-        # bit-for-bit — asserted in tests/test_sweep.py)
-        first_occurrence = list(dict.fromkeys(remaining_order))
-        stream = store.iter_chunks(first_occurrence, dtype=None)
-        cached: Dict[int, jax.Array] = {}
+    cached: Dict[int, jax.Array] = {}
 
-        def cached_iter():
-            for i in remaining_order:
-                if i not in cached:
-                    cached[i] = next(stream)  # uncached idxs arrive in order
-                yield cached[i].astype(jnp.float32)
+    def _build_iter(pos: int):
+        """The chunk stream from permutation position `pos` — rebuilt after
+        a degraded-mode skip (a prefetching generator dies with the error it
+        surfaced; corruption is rare, so a rebuild per skip is cheap)."""
+        rem = [int(c) for c in chunk_order[pos:]]
+        if getattr(cfg, "hbm_cache_chunks", False):
+            # multi-epoch sweeps whose dataset fits HBM: upload each unique
+            # chunk ONCE and reuse it every epoch — on slow host links
+            # re-reading per epoch dominates the sweep. The cache fills
+            # THROUGH the prefetching iterator (epoch 1 keeps its disk/train
+            # overlap) and holds the on-disk dtype (fp16 stores cache at
+            # half the fp32 footprint; the per-use upcast is lossless, so
+            # training matches the streaming path bit-for-bit — asserted in
+            # tests/test_sweep.py)
+            todo = [i for i in dict.fromkeys(rem) if i not in cached]
+            stream = store.iter_chunks(todo, dtype=None)
 
-        chunk_iter = cached_iter()
-    else:
+            def cached_iter():
+                for i in rem:
+                    if i not in cached:
+                        cached[i] = next(stream)  # uncached idxs arrive in order
+                    yield cached[i].astype(jnp.float32)
+
+            return cached_iter()
         # double-buffered prefetch: next chunk's disk read + H2D transfer
         # overlap the current chunk's training (data.chunks.iter_chunks)
-        chunk_iter = store.iter_chunks(remaining_order, dtype=jnp.float32)
+        return store.iter_chunks(rem, dtype=jnp.float32)
+
+    chunk_iter = _build_iter(start_chunk)
+    # degraded-mode accounting: corrupt chunks are quarantined by the store
+    # and skipped here within SC_CHUNK_LOSS_BUDGET (docs/DATAPLANE.md)
+    budget = data_integrity.ChunkLossBudget(n_chunks, telemetry=telemetry)
     status = "ok"
     try:
         for i in range(start_chunk, len(chunk_order)):
@@ -450,6 +469,22 @@ def sweep(
                 chunk = next(chunk_iter)
             except StopIteration:
                 break
+            except data_integrity.CorruptChunk as e:
+                # quarantined by the load: skip-and-account within the loss
+                # budget (past budget this raises ResumableAbort → exit 75),
+                # then restart the prefetch stream past the bad slot
+                budget.skip(
+                    e.chunk, e.reason,
+                    rows=data_integrity.quarantined_rows(store.folder, e.chunk),
+                )
+                # consume this position's key splits even though no training
+                # happens: the resume fast-forward above is position-based
+                # (start_chunk * len(ensembles) splits), so a skip that ate
+                # no splits would silently desync every later key on resume
+                for _ in ensembles:
+                    rng_key, _unused = jax.random.split(rng_key)
+                chunk_iter = _build_iter(i + 1)
+                continue
             except (
                 FileNotFoundError, IsADirectoryError, NotADirectoryError,
                 PermissionError,

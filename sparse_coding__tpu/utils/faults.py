@@ -30,11 +30,22 @@ Actions
                         file inside the just-committed directory (the
                         bit-rot / partial-overwrite case digest verification
                         must catch)
+    torn_chunk_pair     InjectedFault at `chunk_pair` — the chunk write dies
+                        between the pair's two file operations (new chunk
+                        bytes live, stale/missing scale, old manifest): the
+                        torn pair `ChunkStore.load` must detect, never feed
+                        to training
+    corrupt_chunk       at `chunk_committed`: flip one byte of the
+                        just-committed chunk file (bit rot the digest
+                        verify tier / scrub must catch)
 
 Sites (ctx fields in parentheses)
     chunk_loop            top of each driver chunk iteration (chunk, epoch)
     step_loop             top of each big-batch train step (step)
     chunk_read            inside `ChunkStore.load`'s host read (chunk, attempt)
+    chunk_write           inside `save_chunk`, data staged, nothing landed (chunk)
+    chunk_pair            between the chunk/scale pair's file ops (chunk)
+    chunk_committed       right after a chunk's manifest commit (chunk, path)
     checkpoint_commit     after checkpoint data is on disk, before commit
     checkpoint_committed  right after a successful commit (path)
     export                top of `save_learned_dicts` (path)
@@ -74,6 +85,7 @@ FAULT_ENV = "SC_FAULT"
 _ACTIONS = (
     "kill", "sigterm", "sigint", "io_error", "exc",
     "torn_checkpoint", "corrupt_checkpoint",
+    "torn_chunk_pair", "corrupt_chunk",
 )
 
 # site aliases accepted in specs → canonical site names
@@ -89,6 +101,8 @@ _DEFAULT_SITE = {
     "io_error": "chunk_read",
     "torn_checkpoint": "checkpoint_commit",
     "corrupt_checkpoint": "checkpoint_committed",
+    "torn_chunk_pair": "chunk_pair",
+    "corrupt_chunk": "chunk_committed",
 }
 
 
@@ -105,7 +119,14 @@ class _Spec:
         self.params = params
         self.hits = 0
         self.fires = 0
-        default_times = 1 if action in ("torn_checkpoint", "corrupt_checkpoint") else None
+        default_times = (
+            1
+            if action in (
+                "torn_checkpoint", "corrupt_checkpoint",
+                "torn_chunk_pair", "corrupt_chunk",
+            )
+            else None
+        )
         self.max_fires = params.get("times", default_times)
 
 
@@ -183,6 +204,20 @@ def _corrupt_committed_dir(path: str) -> None:
     target.write_bytes(bytes(data))
 
 
+def _corrupt_file(path: str) -> None:
+    """Flip the LAST byte of one file — bit rot on a just-committed chunk.
+    The last byte is array data, not the npy header, so the flip is the
+    digest-verification case, not an unreadable-header crash."""
+    from pathlib import Path
+
+    target = Path(path)
+    data = bytearray(target.read_bytes())
+    if not data:
+        return
+    data[-1] ^= 0xFF
+    target.write_bytes(bytes(data))
+
+
 def _fire(spec: _Spec, site: str, ctx: Dict[str, Any]) -> None:
     spec.fires += 1
     desc = f"SC_FAULT {spec.action} at {site} {ctx or ''}".strip()
@@ -197,7 +232,10 @@ def _fire(spec: _Spec, site: str, ctx: Dict[str, Any]) -> None:
     elif spec.action == "corrupt_checkpoint":
         if "path" in ctx:
             _corrupt_committed_dir(str(ctx["path"]))
-    else:  # exc / torn_checkpoint
+    elif spec.action == "corrupt_chunk":
+        if "path" in ctx:
+            _corrupt_file(str(ctx["path"]))
+    else:  # exc / torn_checkpoint / torn_chunk_pair
         raise InjectedFault(desc)
 
 

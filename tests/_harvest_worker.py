@@ -1,0 +1,68 @@
+"""Subprocess worker for the data-plane chaos tests (tests/test_data_integrity.py).
+
+Harvests a deterministic tiny-LM activation store into one folder. The
+parent test controls fault injection through SC_FAULT (e.g.
+``kill:chunk_pair:chunk=2`` SIGKILLs the process mid-chunk-pair — after the
+chunk bytes land, before the scale/manifest commit) and resumption through
+``--resume`` (verified-cursor resume, `data.activations`).
+
+The subject builder lives HERE and only here so the worker subprocess and
+the in-process control/repair passes of the test provably run the identical
+seeded forward (the chaos acceptance asserts bit-exact chunk bytes across
+kill → resume → repair).
+
+Usage: python tests/_harvest_worker.py <dataset_folder> [--resume] [--only K]
+"""
+
+import sys
+
+N_CHUNKS = 4
+BATCH = 8
+SEQ = 16
+
+
+def build_subject():
+    """The seeded tiny subject LM + tokens every pass of the chaos test
+    shares (CPU-deterministic)."""
+    import jax
+    import numpy as np
+
+    from sparse_coding__tpu.lm import LMConfig, init_params
+
+    cfg = LMConfig(
+        arch="neox", n_layers=2, d_model=16, n_heads=2, d_mlp=32,
+        vocab_size=64, n_ctx=32, rotary_pct=0.25,
+    )
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    tokens = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(8), (64, SEQ), 0, 64),
+        dtype=np.int32,
+    )
+    return cfg, params, tokens
+
+
+def harvest(dataset_folder, resume: bool = False, only_chunks=None):
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+
+    cfg, params, tokens = build_subject()
+    # chunk_size_gb sized for exactly BATCH*SEQ rows per chunk
+    chunk_gb = BATCH * SEQ * cfg.d_model * 2 / 1024**3
+    return make_activation_dataset(
+        params, cfg, tokens, dataset_folder,
+        layers=[1], layer_locs=["residual"], batch_size=BATCH,
+        chunk_size_gb=chunk_gb, n_chunks=N_CHUNKS, single_folder=True,
+        resume=resume, only_chunks=only_chunks,
+    )
+
+
+def main() -> None:
+    folder = sys.argv[1]
+    resume = "--resume" in sys.argv[2:]
+    only = None
+    if "--only" in sys.argv[2:]:
+        only = [int(sys.argv[sys.argv.index("--only") + 1])]
+    harvest(folder, resume=resume, only_chunks=only)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,771 @@
+"""Self-healing activation data plane (ISSUE 8, docs/DATAPLANE.md).
+
+Four tiers:
+
+  - **unit** — atomic chunk-pair commit + manifests, verify tiers
+    (size/digest/off), quarantine moves, the silent-misread regressions
+    (fp16-over-int8 gap, missing scale file), `n_datapoints` via manifests
+    and the public npy-header API, loss-budget accounting;
+  - **driver degraded mode** — `basic_l1_sweep`/`sweep`/`train_big_batch`
+    survive a corrupt chunk inside `SC_CHUNK_LOSS_BUDGET` (skip-and-account,
+    telemetry counters, report/monitor rendering) and exit 75 past it;
+  - **tooling** — the scrub CLI against the checked-in
+    `tests/golden/corrupt_store/` fixture (report rendering + exit codes
+    pinned) and synthetic-store repair; fleet admission-check requeue;
+  - **chaos acceptance** (tier-1, ``chaos`` marker) — harvest SIGKILLed
+    mid-chunk-pair via SC_FAULT, store bit-flipped post-hoc → scrub
+    quarantines exactly the bad chunk, resumed harvest + `only_chunks`
+    repair restore the store bit-exactly, training over it matches an
+    uncorrupted control, and a degraded-mode run over the UNREPAIRED store
+    finishes inside budget with the loss accounted.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import (
+    ChunkStore,
+    RandomDatasetGenerator,
+    save_chunk,
+)
+from sparse_coding__tpu.data import integrity
+from sparse_coding__tpu.data.chunks import chunk_path, scale_path
+from sparse_coding__tpu.data.scrub import (
+    render_scrub_markdown,
+    scrub_store,
+    store_loss,
+)
+from sparse_coding__tpu.telemetry import RunTelemetry
+from sparse_coding__tpu.train import preemption
+from sparse_coding__tpu.utils import faults
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN_STORE = Path(__file__).parent / "golden" / "corrupt_store"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_ENV, raising=False)
+    monkeypatch.delenv(integrity.CHUNK_VERIFY_ENV, raising=False)
+    monkeypatch.delenv(integrity.LOSS_BUDGET_ENV, raising=False)
+    monkeypatch.setenv("SC_SYNC_BACKOFF", "0")
+    faults.reset()
+    preemption.reset()
+    yield
+    faults.reset()
+    preemption.reset()
+
+
+def _data(rows=64, d=16, seed=0):
+    return np.random.default_rng(seed).normal(size=(rows, d)).astype(np.float32)
+
+
+def _bitflip(path: Path):
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF
+    path.write_bytes(bytes(raw))
+
+
+def _truncate(path: Path, n=32):
+    path.write_bytes(path.read_bytes()[:-n])
+
+
+# -- atomic commit + manifests ------------------------------------------------
+
+def test_commit_writes_manifest_with_digests(tmp_path):
+    a = _data()
+    save_chunk(tmp_path, 0, a)
+    save_chunk(tmp_path, 1, a, dtype=np.int8)
+    m0 = integrity.read_chunk_manifest(tmp_path, 0)
+    m1 = integrity.read_chunk_manifest(tmp_path, 1)
+    assert m0["rows"] == 64 and m0["store_dtype"] == "float16"
+    assert set(m0["files"]) == {"0.npy"}
+    assert set(m1["files"]) == {"1.npy", "1.scale.npy"}
+    assert m1["store_dtype"] == "int8"
+    for meta in m1["files"].values():
+        assert meta["bytes"] > 0 and len(meta["sha256"]) == 64
+    assert integrity.verify_chunk(tmp_path, 0, depth="digest") == (True, "ok")
+    assert integrity.verify_chunk(tmp_path, 1, depth="digest") == (True, "ok")
+    # manifest-driven row counting, no data read
+    assert ChunkStore(tmp_path).n_datapoints() == 128
+
+
+def test_n_datapoints_legacy_public_header(tmp_path):
+    """Legacy stores (no manifests) count rows through the PUBLIC numpy
+    header API — the private `_read_array_header` broke across versions."""
+    np.save(chunk_path(tmp_path, 0), _data(rows=48).astype(np.float16))
+    np.save(chunk_path(tmp_path, 1), _data(rows=16).astype(np.float16))
+    assert ChunkStore(tmp_path).n_datapoints() == 64
+
+
+def test_provenance_recorded(tmp_path):
+    save_chunk(tmp_path, 0, _data(), provenance={"harvest": {"layer": 3}})
+    m = integrity.read_chunk_manifest(tmp_path, 0)
+    assert m["provenance"]["harvest"]["layer"] == 3
+
+
+# -- verify tiers + quarantine ------------------------------------------------
+
+def test_verify_tiers_and_quarantine(tmp_path):
+    a = _data()
+    save_chunk(tmp_path, 0, a)
+    _bitflip(chunk_path(tmp_path, 0))  # size intact, digest wrong
+    assert integrity.verify_chunk(tmp_path, 0, depth="size") == (True, "ok")
+    ok, reason = integrity.verify_chunk(tmp_path, 0, depth="digest")
+    assert not ok and "digest mismatch" in reason
+
+    save_chunk(tmp_path, 1, a)
+    _truncate(chunk_path(tmp_path, 1))  # size wrong: the default tier catches
+    ok, reason = integrity.verify_chunk(tmp_path, 1)  # env default = size
+    assert not ok and "size mismatch" in reason
+
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        with pytest.raises(integrity.CorruptChunk) as e:
+            ChunkStore(tmp_path).load(1)
+        assert e.value.chunk == 1
+        # quarantined, not deleted: files moved with a reason record
+        assert not chunk_path(tmp_path, 1).exists()
+        assert (tmp_path / "quarantine" / "1.npy").exists()
+        assert integrity.quarantined_indices(tmp_path) == [1]
+        assert integrity.quarantined_rows(tmp_path, 1) == 64
+        assert telemetry.counters.get("data.corrupt") == 1
+        # a later load of the quarantined index is CorruptChunk, not
+        # FileNotFoundError — the hole is data loss, not a caller bug
+        with pytest.raises(integrity.CorruptChunk, match="quarantined"):
+            ChunkStore(tmp_path).load(1)
+    finally:
+        telemetry.close()
+    # slot_count keeps the quarantined chunk's place; len drops it
+    st = ChunkStore(tmp_path)
+    assert len(st) == 1 and st.slot_count() == 2
+
+
+def test_verified_load_counts(tmp_path):
+    save_chunk(tmp_path, 0, _data())
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        ChunkStore(tmp_path).load(0)
+        assert telemetry.counters.get("data.chunks_verified") == 1
+    finally:
+        telemetry.close()
+
+
+def test_missing_index_stays_file_not_found(tmp_path):
+    save_chunk(tmp_path, 0, _data())
+    with pytest.raises(FileNotFoundError):
+        ChunkStore(tmp_path).load(7)
+
+
+# -- the silent-misread regressions -------------------------------------------
+
+def test_missing_scale_detected_not_misread(tmp_path):
+    """The pre-fix failure: int8 chunk bytes with no scale file were loaded
+    as RAW INTEGERS and fed to training. Pinned as *detected* — CorruptChunk
+    + quarantine, at every verify depth including off, manifest or not."""
+    for depth in ("size", "digest", "off"):
+        shutil.rmtree(tmp_path / "quarantine", ignore_errors=True)
+        np.save(chunk_path(tmp_path, 0), _data().astype(np.int8))
+        with pytest.raises(integrity.CorruptChunk, match="no scale"):
+            ChunkStore(tmp_path).load(0, verify=depth)
+
+
+def test_fp16_overwrite_gap_detected(tmp_path, monkeypatch):
+    """The save_chunk ordering bug (ISSUE 8 satellite): overwriting an int8
+    chunk with fp16 used to unlink the scale file BEFORE the new bytes
+    landed — a kill in the gap left old int8 bytes with no scale, silently
+    loaded as raw integers. New ordering: the kill-in-the-gap state is new
+    fp16 bytes + stale scale + old int8 manifest — detected and
+    quarantined, never misread."""
+    a = _data()
+    save_chunk(tmp_path, 0, a, dtype=np.int8)
+    monkeypatch.setenv(faults.FAULT_ENV, "torn_chunk_pair")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        save_chunk(tmp_path, 0, a)  # dies in the pair gap
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    # stale scale file still present next to the NEW fp16 bytes, old
+    # manifest still describing the int8 pair
+    assert scale_path(tmp_path, 0).exists()
+    with pytest.raises(integrity.CorruptChunk):
+        ChunkStore(tmp_path).load(0)
+    assert integrity.quarantined_indices(tmp_path) == [0]
+    # re-committing the chunk heals the slot
+    save_chunk(tmp_path, 0, a)
+    np.testing.assert_allclose(
+        np.asarray(ChunkStore(tmp_path).load(0)), a, atol=2e-3 * np.abs(a).max()
+    )
+
+
+def test_torn_pair_never_observed_as_committed(tmp_path, monkeypatch):
+    """A write killed before the manifest commit leaves an UNCOMMITTED
+    chunk: fresh folders show the bytes but no manifest, and verification
+    at any tier... passes legacy fp16 (bytes are self-consistent) — but a
+    QUANTIZED torn pair is structurally detected. The stronger guarantee:
+    overwrites are never half-applied (previous manifest keeps describing
+    the previous bytes until the new commit)."""
+    a = _data()
+    save_chunk(tmp_path, 0, a, dtype=np.int8)
+    before = integrity.read_chunk_manifest(tmp_path, 0)
+    monkeypatch.setenv(faults.FAULT_ENV, "exc:chunk_write")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        save_chunk(tmp_path, 0, a * 2, dtype=np.int8)  # dies before anything lands
+    monkeypatch.delenv(faults.FAULT_ENV)
+    faults.reset()
+    # nothing observable changed: old pair + old manifest still verify
+    assert integrity.read_chunk_manifest(tmp_path, 0) == before
+    assert integrity.verify_chunk(tmp_path, 0, depth="digest") == (True, "ok")
+    np.testing.assert_allclose(
+        np.asarray(ChunkStore(tmp_path).load(0)), a, atol=np.abs(a).max() / 120
+    )
+
+
+def test_corrupt_chunk_fault_action(tmp_path, monkeypatch):
+    """`SC_FAULT=corrupt_chunk` flips a byte of the just-committed chunk —
+    the bit-rot drill the digest tier must catch."""
+    monkeypatch.setenv(faults.FAULT_ENV, "corrupt_chunk")
+    faults.reset()
+    save_chunk(tmp_path, 0, _data())
+    ok, reason = integrity.verify_chunk(tmp_path, 0, depth="digest")
+    assert not ok and "digest mismatch" in reason
+    # size tier can't see it — exactly why scrub runs at digest
+    assert integrity.verify_chunk(tmp_path, 0, depth="size") == (True, "ok")
+
+
+def test_fault_grammar_new_actions():
+    specs = faults.parse_faults("torn_chunk_pair;corrupt_chunk;kill:chunk_pair:chunk=2")
+    assert [(s.action, s.site) for s in specs] == [
+        ("torn_chunk_pair", "chunk_pair"),
+        ("corrupt_chunk", "chunk_committed"),
+        ("kill", "chunk_pair"),
+    ]
+    assert specs[0].max_fires == 1 and specs[1].max_fires == 1
+
+
+# -- loss budget --------------------------------------------------------------
+
+def test_loss_budget_accounting_and_exit_75(monkeypatch):
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        budget = integrity.ChunkLossBudget(10, budget_frac=0.25, telemetry=telemetry)
+        budget.skip(3, "digest mismatch", rows=100)
+        budget.skip(3, "quarantined", rows=100)  # same chunk: one distinct loss
+        budget.skip(7, "torn pair")
+        assert budget.loss_frac == 0.2 and not budget.exceeded
+        assert telemetry.counters["data.chunks_skipped"] == 3
+        assert telemetry.counters["data.rows_skipped"] == 200
+        with pytest.raises(SystemExit) as e:
+            budget.skip(9, "digest mismatch")
+        assert e.value.code == preemption.RESUMABLE_EXIT_CODE
+        assert telemetry.counters["data.budget_exhausted"] == 1
+    finally:
+        telemetry.close()
+
+
+def test_loss_budget_env_default(monkeypatch):
+    assert integrity.default_loss_budget() == integrity.DEFAULT_LOSS_BUDGET
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.5")
+    assert integrity.default_loss_budget() == 0.5
+
+
+# -- driver degraded mode -----------------------------------------------------
+
+def _synthetic_store(folder, n_chunks=3, rows=384, d=16, seed=0):
+    gen = RandomDatasetGenerator(
+        activation_dim=d, n_ground_truth_components=2 * d, batch_size=rows,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(seed),
+    )
+    for i in range(n_chunks):
+        save_chunk(folder, i, np.asarray(next(gen)))
+    return ChunkStore(folder)
+
+
+@pytest.mark.chaos
+def test_basic_l1_sweep_degraded_mode(tmp_path, monkeypatch):
+    """One truncated chunk inside the budget: the driver quarantines it,
+    skips it with rows accounted, finishes — and the report + monitor
+    render the loss."""
+    from sparse_coding__tpu.telemetry.events import read_events
+    from sparse_coding__tpu.telemetry.monitor import RunMonitor, render
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    store_dir = tmp_path / "chunks"
+    _synthetic_store(store_dir, n_chunks=3)
+    _truncate(chunk_path(store_dir, 1))
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.5")
+    out = tmp_path / "out"
+    dicts = basic_l1_sweep(
+        str(store_dir), str(out), activation_width=16,
+        l1_values=[1e-3], dict_ratio=2.0, batch_size=128, n_epochs=1,
+        fista_iters=2, seed=0,
+    )
+    assert len(dicts) == 1  # run completed despite the loss
+    assert integrity.quarantined_indices(store_dir) == [1]
+    events = read_events(out / "events.jsonl")
+    skips = [e for e in events if e.get("event") == "chunk_skipped"]
+    assert len(skips) == 1 and skips[0]["chunk"] == 1 and skips[0]["rows"] == 384
+    snap = [e for e in events if e.get("event") == "snapshot"][-1]
+    assert snap["counters"]["data.corrupt"] == 1
+    assert snap["counters"]["data.chunks_skipped"] == 1
+    assert snap["counters"]["data.rows_skipped"] == 384
+    assert snap["gauges"]["data.budget_remaining_frac"] > 0
+    # only the two surviving chunks trained
+    chunk_ends = [e for e in events if e.get("event") == "chunk_end"]
+    assert len(chunk_ends) == 2
+    md = render_markdown(load_run(out))
+    assert "## Data integrity" in md
+    assert "1 chunk(s) quarantined" in md
+    assert "384 rows never trained" in md
+    mon = RunMonitor(out)
+    mon.poll()
+    text = render(mon)
+    assert "data: " in text and "1 quarantined" in text and "1 skipped" in text
+
+
+@pytest.mark.chaos
+def test_basic_l1_sweep_budget_exhaustion_exit_75(tmp_path, monkeypatch):
+    """Past SC_CHUNK_LOSS_BUDGET the run raises ResumableAbort — SystemExit
+    code 75, run_end recorded — never a raw traceback."""
+    from sparse_coding__tpu.telemetry.events import read_events
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+
+    store_dir = tmp_path / "chunks"
+    _synthetic_store(store_dir, n_chunks=3)
+    _truncate(chunk_path(store_dir, 0))
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.1")
+    with pytest.raises(SystemExit) as e:
+        basic_l1_sweep(
+            str(store_dir), str(tmp_path / "out"), activation_width=16,
+            l1_values=[1e-3], dict_ratio=2.0, batch_size=128, n_epochs=1,
+            fista_iters=2, seed=0,
+        )
+    assert e.value.code == preemption.RESUMABLE_EXIT_CODE
+    events = read_events(tmp_path / "out" / "events.jsonl")
+    assert any(e.get("event") == "loss_budget_exhausted" for e in events)
+    ends = [e for e in events if e.get("event") == "run_end"]
+    assert ends and ends[-1]["status"].startswith("resumable-abort")
+
+
+@pytest.mark.chaos
+def test_sweep_degraded_mode(tmp_path, monkeypatch):
+    """The sweep driver's prefetching iterator survives a corrupt chunk:
+    stream rebuilt past the bad slot, loss accounted, run completes."""
+    from test_sweep import l1_ensemble_init, make_cfg
+
+    from sparse_coding__tpu.telemetry.events import read_events
+    from sparse_coding__tpu.train import sweep
+
+    cfg = make_cfg(tmp_path, n_epochs=1)
+    # materialize the synthetic store first, then corrupt one chunk
+    from sparse_coding__tpu.train.sweep import init_synthetic_dataset
+
+    os.makedirs(cfg.output_folder, exist_ok=True)
+    init_synthetic_dataset(cfg)
+    _truncate(chunk_path(cfg.dataset_folder, 1))
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.5")
+    dicts = sweep(l1_ensemble_init, cfg)
+    assert len(dicts) == 2
+    assert integrity.quarantined_indices(cfg.dataset_folder) == [1]
+    events = read_events(Path(cfg.output_folder) / "events.jsonl")
+    skips = [e for e in events if e.get("event") == "chunk_skipped"]
+    assert [s["chunk"] for s in skips] == [1]
+    assert len([e for e in events if e.get("event") == "chunk_end"]) == 2
+
+
+@pytest.mark.chaos
+def test_big_batch_store_input_degraded(tmp_path, monkeypatch):
+    """`train_big_batch(dataset=<store folder>)` admits the store through
+    the degraded-mode loader: corrupt chunk skipped within budget, training
+    proceeds on the surviving rows."""
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+    from sparse_coding__tpu.train.big_batch import train_big_batch
+
+    store_dir = tmp_path / "chunks"
+    _synthetic_store(store_dir, n_chunks=3, rows=256)
+    _truncate(chunk_path(store_dir, 2))
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.5")
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        state, sig = train_big_batch(
+            FunctionalTiedSAE,
+            {"activation_size": 16, "n_dict_components": 32, "l1_alpha": 1e-3},
+            str(store_dir), batch_size=64, n_steps=3,
+            key=jax.random.PRNGKey(0), reinit_every=None, telemetry=telemetry,
+        )
+        assert int(state.step) == 3
+        assert telemetry.counters["data.chunks_skipped"] == 1
+    finally:
+        telemetry.close()
+
+
+# -- scrub CLI + golden fixture -----------------------------------------------
+
+def _copy_golden(tmp_path) -> Path:
+    dst = tmp_path / "store"
+    shutil.copytree(GOLDEN_STORE, dst)
+    return dst
+
+
+def test_scrub_cli_on_golden_corrupt_store(tmp_path, capsys):
+    """The checked-in fixture pins the scrub CLI end to end: chunks 0-1
+    verify, 2 (bit rot) / 3 (missing scale) / 4 (legacy torn) are
+    quarantined, rendering and the exit-1 CI gate are stable."""
+    from sparse_coding__tpu.data.scrub import main as scrub_main
+
+    store = _copy_golden(tmp_path)
+    rc = scrub_main([str(store), "--out", str(tmp_path / "scrub.md")])
+    out = capsys.readouterr().out
+    assert rc == 1  # unrepaired loss → CI gate trips
+    assert integrity.quarantined_indices(store) == [2, 3, 4]
+    assert ChunkStore(store).indices() == [0, 1]
+    assert "Verified **2** chunk(s) at the `digest` tier" in out
+    assert "**3 quarantined** this pass" in out
+    assert "digest mismatch on 2.npy" in out
+    assert "missing file 3.scale.npy" in out
+    assert "no scale file" in out
+    assert "UNREPAIRED LOSS" in out and "[2, 3, 4]" in out
+    assert (tmp_path / "scrub.md").exists()
+    # second pass: nothing new to quarantine, loss still reported
+    rc2 = scrub_main([str(store)])
+    assert rc2 == 1
+    assert "**0 quarantined** this pass" in capsys.readouterr().out
+
+
+def test_scrub_clean_store_exits_zero(tmp_path, capsys):
+    save_chunk(tmp_path / "s", 0, _data())
+    save_chunk(tmp_path / "s", 1, _data(seed=1), dtype=np.int8)
+    from sparse_coding__tpu.data.scrub import main as scrub_main
+
+    rc = scrub_main([str(tmp_path / "s")])
+    assert rc == 0
+    assert "store is whole" in capsys.readouterr().out
+
+
+def test_scrub_repair_synthetic_store(tmp_path, capsys):
+    """--repair regenerates exactly the quarantined indices through the
+    seeded generator — bit-exact against an untouched control store."""
+    from sparse_coding__tpu.data.chunks import generate_synthetic_chunks
+    from sparse_coding__tpu.data.scrub import main as scrub_main
+
+    gen_kwargs = dict(
+        activation_dim=16, n_ground_truth_components=32, batch_size=256,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+    )
+    spec = dict(
+        n_chunks=3, chunk_size_gb=256 * 16 * 2 / 1024**3, activation_width=16,
+    )
+    for name in ("ctl", "vic"):
+        gen = RandomDatasetGenerator(**gen_kwargs, key=jax.random.PRNGKey(3))
+        generate_synthetic_chunks(gen, tmp_path / name, **spec)
+    _bitflip(chunk_path(tmp_path / "vic", 1))
+    config = {
+        "kind": "synthetic",
+        "generator": {**gen_kwargs, "class": "RandomDatasetGenerator", "seed": 3},
+        **spec,
+    }
+    (tmp_path / "repair.json").write_text(json.dumps(config))
+    rc = scrub_main([
+        str(tmp_path / "vic"), "--repair", str(tmp_path / "repair.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "1 repaired" in out
+    for i in range(3):
+        np.testing.assert_array_equal(
+            chunk_path(tmp_path / "vic", i).read_bytes(),
+            chunk_path(tmp_path / "ctl", i).read_bytes(),
+        )
+
+
+def test_scrub_detects_wholesale_tail_loss(tmp_path):
+    """A partial copy that drops the TAIL chunks (files + manifests) must
+    not look whole: the harvest cursor records how many chunks were
+    committed, and scrub/store_loss use it as the expected-size floor."""
+    import _harvest_worker as hw
+
+    hw.harvest(tmp_path / "s")
+    for i in (2, 3):  # the partial-rsync case: tail gone, manifests too
+        chunk_path(tmp_path / "s", i).unlink()
+        integrity.chunk_manifest_path(tmp_path / "s", i).unlink()
+    summary = scrub_store(tmp_path / "s", depth="digest")
+    assert summary["missing"] == [2, 3]
+    loss = store_loss(tmp_path / "s", depth="digest")
+    assert loss["bad"] == [2, 3] and loss["total"] == hw.N_CHUNKS
+
+
+def test_store_loss_nonmutating(tmp_path):
+    save_chunk(tmp_path, 0, _data())
+    save_chunk(tmp_path, 1, _data(seed=1))
+    _bitflip(chunk_path(tmp_path, 1))
+    loss = store_loss(tmp_path, depth="digest")
+    assert loss["bad"] == [1] and loss["total"] == 2 and loss["loss_frac"] == 0.5
+    # nothing moved
+    assert chunk_path(tmp_path, 1).exists()
+    assert integrity.quarantined_indices(tmp_path) == []
+
+
+# -- fleet admission check ----------------------------------------------------
+
+@pytest.mark.chaos
+def test_fleet_admission_requeues_input_corrupt(tmp_path, monkeypatch):
+    """A claimed item whose chunk store is rotten beyond the loss budget is
+    requeued with an `input_corrupt` lineage entry BEFORE any training —
+    the input-side mirror of the scheduler's export_corrupt requeue."""
+    from sparse_coding__tpu.fleet import FleetWorker, WorkQueue
+
+    store_dir = tmp_path / "chunks"
+    _synthetic_store(store_dir, n_chunks=2, rows=128)
+    _bitflip(chunk_path(store_dir, 0))
+    _bitflip(chunk_path(store_dir, 1))  # 100% loss ≫ any budget
+    q = WorkQueue(tmp_path / "fleet")
+    q.submit("g0", ["m0"], {
+        "driver": "basic_l1_sweep",
+        "kwargs": {"dataset_folder": str(store_dir), "activation_width": 16},
+    })
+    telemetry = RunTelemetry(out_dir=None)
+    try:
+        w = FleetWorker(tmp_path / "fleet", "w0", max_attempts=2,
+                        telemetry=telemetry)
+        assert w.claim_and_run() == "failed"
+        (item,) = q.items("pending")
+        assert item["attempt"] == 1
+        assert item["lineage"][-1]["outcome"] == "input_corrupt"
+        assert "corrupt beyond budget" in item["lineage"][-1]["error"]
+        assert telemetry.counters["fleet.input_corrupt"] == 1
+        # second claim burns the attempt budget → lost (failed bucket)
+        assert w.claim_and_run() == "failed"
+        assert [i["item"] for i in q.items("failed")] == ["g0"]
+        # admission is non-mutating: the store itself was not quarantined
+        assert integrity.quarantined_indices(store_dir) == []
+    finally:
+        telemetry.close()
+
+
+def test_fleet_admission_passes_within_budget(tmp_path, monkeypatch):
+    """Loss inside the budget admits the item — degraded-mode training is
+    the driver's job, not a reason to bounce work around the fleet."""
+    from sparse_coding__tpu.fleet import FleetWorker, WorkQueue
+
+    store_dir = tmp_path / "chunks"
+    _synthetic_store(store_dir, n_chunks=3, rows=128)
+    _bitflip(chunk_path(store_dir, 2))
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.5")
+    monkeypatch.setenv(integrity.CHUNK_VERIFY_ENV, "digest")
+    q = WorkQueue(tmp_path / "fleet")
+    q.submit("g0", ["m0"], {
+        "driver": "basic_l1_sweep",
+        "kwargs": {
+            "dataset_folder": str(store_dir), "activation_width": 16,
+            "l1_values": [1e-3], "dict_ratio": 2.0, "batch_size": 64,
+            "n_epochs": 1, "fista_iters": 2,
+        },
+    })
+    w = FleetWorker(tmp_path / "fleet", "w0")
+    assert w.claim_and_run() == "done"
+    # the driver quarantined + skipped the rotten chunk in degraded mode
+    assert integrity.quarantined_indices(store_dir) == [2]
+
+
+# -- harvest: cursor resume, verified skip, store_dtype -----------------------
+
+def test_harvest_cursor_resume_matches_full(tmp_path):
+    """A harvest stopped after 2 chunks resumes from its committed cursor
+    and produces a store byte-identical to an uninterrupted one."""
+    import _harvest_worker as hw
+
+    hw.harvest(tmp_path / "full")
+    cfg, params, tokens = hw.build_subject()
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+
+    chunk_gb = hw.BATCH * hw.SEQ * cfg.d_model * 2 / 1024**3
+    kw = dict(
+        layers=[1], layer_locs=["residual"], batch_size=hw.BATCH,
+        chunk_size_gb=chunk_gb, single_folder=True,
+    )
+    make_activation_dataset(params, cfg, tokens, tmp_path / "part",
+                            n_chunks=2, **kw)
+    cursor = json.loads((tmp_path / "part" / "sc_harvest_cursor.json").read_text())
+    assert cursor["chunk"] == 2
+    make_activation_dataset(params, cfg, tokens, tmp_path / "part",
+                            n_chunks=hw.N_CHUNKS, resume=True, **kw)
+    for i in range(hw.N_CHUNKS):
+        assert chunk_path(tmp_path / "part", i).read_bytes() == \
+            chunk_path(tmp_path / "full", i).read_bytes()
+
+
+def test_harvest_resume_reharvests_unverified(tmp_path):
+    """A torn chunk under the cursor truncates the resume point — the bad
+    chunk is re-harvested instead of trusted (the old skip_chunks trusted
+    bare file existence)."""
+    import _harvest_worker as hw
+
+    hw.harvest(tmp_path / "s")
+    # tear chunk 1: bytes truncated after commit
+    _truncate(chunk_path(tmp_path / "s", 1))
+    with pytest.warns(RuntimeWarning, match="re-harvesting from chunk 1"):
+        hw.harvest(tmp_path / "s", resume=True)
+    hw.harvest(tmp_path / "ctl")
+    for i in range(hw.N_CHUNKS):
+        assert chunk_path(tmp_path / "s", i).read_bytes() == \
+            chunk_path(tmp_path / "ctl", i).read_bytes()
+
+
+def test_harvest_resume_config_mismatch_refused(tmp_path):
+    import _harvest_worker as hw
+
+    cfg, params, tokens = hw.build_subject()
+    from sparse_coding__tpu.data.activations import make_activation_dataset
+
+    chunk_gb = hw.BATCH * hw.SEQ * cfg.d_model * 2 / 1024**3
+    make_activation_dataset(
+        params, cfg, tokens, tmp_path / "s", layers=[1],
+        layer_locs=["residual"], batch_size=hw.BATCH, chunk_size_gb=chunk_gb,
+        n_chunks=2, single_folder=True,
+    )
+    with pytest.raises(ValueError, match="different configuration"):
+        make_activation_dataset(
+            params, cfg, tokens, tmp_path / "s", layers=[1],
+            layer_locs=["residual"], batch_size=hw.BATCH // 2,
+            chunk_size_gb=chunk_gb, n_chunks=2, single_folder=True,
+            resume=True,
+        )
+
+
+def test_harvest_to_device_store_dtype(tmp_path):
+    """The fused harvest's save_folder can persist quantized tiers now
+    (ISSUE 8 satellite) — int8 store with scale side files + manifests."""
+    import _harvest_worker as hw
+
+    from sparse_coding__tpu.data.activations import harvest_to_device
+
+    cfg, params, tokens = hw.build_subject()
+    chunk_gb = hw.BATCH * hw.SEQ * cfg.d_model * 2 / 1024**3
+    chunks = list(harvest_to_device(
+        params, cfg, tokens, layers=[1], layer_locs=["residual"],
+        batch_size=hw.BATCH, chunk_size_gb=chunk_gb, n_chunks=2,
+        save_folder=tmp_path / "dev", store_dtype=np.int8,
+    ))
+    assert len(chunks) == 2
+    from sparse_coding__tpu.data.activations import harvest_folder_name
+
+    folder = harvest_folder_name(tmp_path / "dev", 1, "residual")
+    assert scale_path(folder, 0).exists()
+    m = integrity.read_chunk_manifest(folder, 0)
+    assert m["store_dtype"] == "int8"
+    # the persisted quantized chunk dequantizes to ~the yielded fp16 values
+    dev = np.asarray(jax.device_get(chunks[0][(1, "residual")])).astype(np.float32)
+    disk = np.asarray(ChunkStore(folder).load(0))
+    atol = float((np.abs(dev).max(axis=1) / 100).max() + 1e-4)
+    np.testing.assert_allclose(disk, dev, atol=atol)
+
+
+# -- chaos acceptance ---------------------------------------------------------
+
+def _worker_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # match the in-process test environment exactly — the acceptance
+    # compares chunk BYTES across the process boundary
+    env["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
+    env.pop("SC_FAULT", None)
+    env.pop("SC_RESUME", None)
+    return env
+
+
+@pytest.mark.chaos
+def test_chaos_harvest_kill_scrub_repair_train(tmp_path, monkeypatch):
+    """The ISSUE 8 acceptance drill end to end:
+
+    1. harvest SIGKILLed mid-chunk-pair (`SC_FAULT=kill:chunk_pair:chunk=2`,
+       a REAL SIGKILL in a subprocess) → chunk 2 left uncommitted;
+    2. resumed harvest restarts from the last committed chunk and finishes;
+    3. one chunk bit-flipped post-hoc → scrub quarantines exactly it;
+    4. `only_chunks` repair refills the hole; the store is then bit-exact
+       vs an uninterrupted control harvest;
+    5. training over the repaired store is bit-exact vs the control;
+    6. a degraded-mode run over the UNREPAIRED store finishes inside
+       `SC_CHUNK_LOSS_BUDGET` with the skipped rows accounted.
+    """
+    import _harvest_worker as hw
+
+    from sparse_coding__tpu.telemetry.events import read_events
+    from sparse_coding__tpu.train.basic_l1_sweep import basic_l1_sweep
+    from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+    ctl = tmp_path / "ctl"
+    vic = tmp_path / "vic"
+    hw.harvest(ctl)  # uninterrupted control, in-process
+
+    # 1: SIGKILL mid-pair — must be a subprocess (SIGKILL takes no prisoners)
+    env = _worker_env()
+    env["SC_FAULT"] = "kill:chunk_pair:chunk=2"
+    res = subprocess.run(
+        [sys.executable, str(REPO / "tests" / "_harvest_worker.py"), str(vic)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == -9, (res.returncode, res.stderr[-500:])
+    # chunk 2's pair gap: bytes may exist, but it is NOT committed
+    assert integrity.read_chunk_manifest(vic, 2) is None
+    assert integrity.read_chunk_manifest(vic, 1) is not None
+
+    # 2: resume from the last committed chunk (in-process, same seeds) —
+    # the cursor says 2, so the torn chunk-2 bytes are simply re-harvested
+    hw.harvest(vic, resume=True)
+    for i in range(hw.N_CHUNKS):
+        assert chunk_path(vic, i).read_bytes() == chunk_path(ctl, i).read_bytes(), i
+
+    # 3: post-hoc bit rot in chunk 1 → scrub (digest tier) quarantines it
+    _bitflip(chunk_path(vic, 1))
+    degraded = tmp_path / "degraded"
+    shutil.copytree(vic, degraded)  # keep an unrepaired copy for step 6
+    summary = scrub_store(vic, depth="digest")
+    assert [f["chunk"] for f in summary["failed"]] == [1]
+    assert summary["missing"] == [1]
+    assert integrity.quarantined_indices(vic) == [1]
+    md = render_scrub_markdown(summary)
+    assert "UNREPAIRED LOSS" in md
+
+    # 4: repair exactly the hole; bit-exact vs control
+    hw.harvest(vic, only_chunks=[1])
+    assert scrub_store(vic, depth="digest")["missing"] == []
+    for i in range(hw.N_CHUNKS):
+        assert chunk_path(vic, i).read_bytes() == chunk_path(ctl, i).read_bytes(), i
+
+    # 5: training over the repaired store == training over the control
+    kw = dict(activation_width=16, l1_values=[1e-3], dict_ratio=2.0,
+              batch_size=64, n_epochs=1, fista_iters=2, seed=0)
+    basic_l1_sweep(str(ctl), str(tmp_path / "t_ctl"), **kw)
+    basic_l1_sweep(str(vic), str(tmp_path / "t_vic"), **kw)
+    d_ctl = load_learned_dicts(tmp_path / "t_ctl" / "epoch_0" / "learned_dicts.pkl")
+    d_vic = load_learned_dicts(tmp_path / "t_vic" / "epoch_0" / "learned_dicts.pkl")
+    np.testing.assert_array_equal(
+        np.asarray(d_ctl[0][0].get_learned_dict()),
+        np.asarray(d_vic[0][0].get_learned_dict()),
+    )
+
+    # 6: degraded mode over the UNREPAIRED copy — finishes inside budget,
+    # loss accounted in telemetry (digest tier: the rot is a bit flip, the
+    # size tier can't see it — this is what SC_CHUNK_VERIFY exists for)
+    monkeypatch.setenv(integrity.CHUNK_VERIFY_ENV, "digest")
+    monkeypatch.setenv(integrity.LOSS_BUDGET_ENV, "0.3")
+    basic_l1_sweep(str(degraded), str(tmp_path / "t_deg"), **kw)
+    assert integrity.quarantined_indices(degraded) == [1]
+    events = read_events(tmp_path / "t_deg" / "events.jsonl")
+    skips = [e for e in events if e.get("event") == "chunk_skipped"]
+    assert [s["chunk"] for s in skips] == [1]
+    snap = [e for e in events if e.get("event") == "snapshot"][-1]
+    assert snap["counters"]["data.chunks_skipped"] == 1
+    assert len([e for e in events if e.get("event") == "chunk_end"]) == hw.N_CHUNKS - 1
