@@ -230,7 +230,7 @@ def make_resumed_run_fixture():
     ckpt = "out/resumed_golden/ckpt_1"
     cursor = {"chunk": 1, "epoch": 0, "position": 1, "key": [1234, 5678]}
     gen1 = [
-        rec("run_start", run_name="resumed_golden",
+        rec("run_start", run_name="resumed_golden", generation=0,
             config={"batch": 512, "l1_values": [1e-4, 1e-3]},
             fingerprint={"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
                          "device_kind": "golden-cpu", "device_count": 1,
@@ -247,13 +247,14 @@ def make_resumed_run_fixture():
         rec("snapshot",
             counters={"chunks": 2, "train.steps": 24, "checkpoints": 1},
             gauges={}),
-        rec("run_end", status="preempted", steps=24, wall_seconds=8.1),
+        rec("run_end", status="preempted", generation=0, steps=24,
+            wall_seconds=8.1),
     ]
     # generation 2 APPENDS to the same events.jsonl (seq restarts — each
     # process writes its own monotonic seq, exactly like a real rerun)
     seq = 0
     gen2 = [
-        rec("run_start", run_name="resumed_golden",
+        rec("run_start", run_name="resumed_golden", generation=1,
             config={"batch": 512, "l1_values": [1e-4, 1e-3]},
             fingerprint={"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
                          "device_kind": "golden-cpu", "device_count": 1,
@@ -266,28 +267,144 @@ def make_resumed_run_fixture():
         rec("snapshot",
             counters={"chunks": 1, "train.steps": 12, "resumes": 1},
             gauges={}),
-        rec("run_end", status="ok", steps=12, wall_seconds=6.2),
+        rec("run_end", status="ok", generation=1, steps=12, wall_seconds=6.2),
     ]
     with open(RESUMED_RUN_DIR / "events.jsonl", "w") as f:
         for e in gen1 + gen2:
             f.write(json.dumps(e) + "\n")
     seq = 0
     t = RESUMED_BASE_TS
+    # spawn/restart records carry the child's run_dir + generation (ISSUE 9
+    # satellite) so the goodput merger joins them without path guessing;
+    # the basename matches the fixture dir, keeping the join relocatable
+    run_dir = "out/resumed_run"
     sup = [
-        rec("run_start", run_name="supervisor",
+        rec("run_start", run_name="supervisor", generation=0,
             config={"cmd": ["python", "-m", "driver"], "max_restarts": 8,
                     "restart_on": "preempt"}),
-        rec("spawn", attempt=0, cmd=["python", "-m", "driver"], resume=False),
-        rec("restart", dt=9.0, attempt=1, exit_code=75,
-            classification="preempt", backoff_seconds=1.0,
+        rec("spawn", attempt=0, generation=0, run_dir=run_dir,
+            cmd=["python", "-m", "driver"], resume=False),
+        rec("restart", dt=9.0, attempt=1, generation=1, run_dir=run_dir,
+            exit_code=75, classification="preempt", backoff_seconds=1.0,
             downtime_seconds=1.1),
-        rec("spawn", attempt=1, cmd=["python", "-m", "driver"], resume=True),
-        rec("run_end", dt=7.0, status="ok", wall_seconds=17.3),
+        rec("spawn", attempt=1, generation=1, run_dir=run_dir,
+            cmd=["python", "-m", "driver"], resume=True),
+        rec("run_end", dt=7.0, status="ok", run_name="supervisor",
+            generation=0, wall_seconds=17.3),
     ]
     with open(RESUMED_RUN_DIR / "supervisor_events.jsonl", "w") as f:
         for e in sup:
             f.write(json.dumps(e) + "\n")
     print(f"Wrote {RESUMED_RUN_DIR}/events.jsonl + supervisor_events.jsonl")
+
+
+GOODPUT_RUN_DIR = REPO / "tests" / "golden" / "goodput_run"
+GOODPUT_BASE_TS = 1_754_600_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_goodput_run_fixture():
+    """Deterministic span-instrumented preempted-and-resumed run (ISSUE 9).
+
+    Hand-stamped event logs — NOT a real training run (real runs stamp wall
+    clocks; a golden fixture must be byte-stable). The shape mirrors what a
+    span-instrumented, supervised `basic_l1_sweep` writes across one
+    preemption: generation 0 loads/trains two chunks (a compile event rides
+    inside the first step span), drains a preemption checkpoint, and exits
+    preempted; the supervisor restarts it after a 1.2 s backoff inside a
+    3.0 s gap; generation 1 restores, finishes, and exports.
+
+    Every second is accounted by construction (23.0 s total wall):
+
+        step 12.2 | compile 2.0 | data_wait 2.7 | checkpoint 0.8
+        | preempt_drain 0.7 | restart_backoff 1.2 | preempted_down 1.8
+        | unaccounted 1.6   →  goodput 53.0%
+
+    `tests/test_goodput.py` pins the ledger sums, the Chrome-trace schema,
+    and the timeline CLI's `--goodput-floor 50` exit codes (0 here; 1 after
+    an injected stall) against this directory in tier-1.
+    """
+    GOODPUT_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    T = GOODPUT_BASE_TS
+    seq = 0
+
+    def rec(ts, event, **fields):
+        nonlocal seq
+        seq += 1
+        return {"seq": seq, "ts": round(ts, 3), "event": event, **fields}
+
+    def span_rec(ts_start, seconds, category, name, **fields):
+        return rec(ts_start + seconds, "span", category=category, name=name,
+                   ts_start=round(ts_start, 3), seconds=seconds, **fields)
+
+    fp = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+          "device_kind": "golden-cpu", "device_count": 1, "git_sha": "g0lden"}
+    gen0 = [
+        rec(T, "run_start", run_name="goodput_golden", generation=0,
+            config={"batch": 512, "l1_values": [1e-4, 1e-3]}, fingerprint=fp),
+        span_rec(T + 1.0, 1.0, "data_wait", "chunk_load", chunk=0),
+        rec(T + 2.0, "chunk_start", chunk=0, position=0),
+        # the compile happened INSIDE the step span (tracked_jit measures
+        # the dispatch that compiled): the ledger's innermost-wins sweep
+        # must count [T+2.5, T+4.5] as compile and shrink step to 3.0 s
+        rec(T + 4.5, "compile", name="ensemble.step_scan", seconds=2.0),
+        span_rec(T + 2.0, 5.0, "step", "chunk_train", chunk=0),
+        rec(T + 7.0, "chunk_end", chunk=0, position=0, seconds=5.0, steps=24),
+        span_rec(T + 7.0, 0.8, "data_wait", "chunk_load", chunk=1),
+        rec(T + 7.8, "chunk_start", chunk=1, position=1),
+        span_rec(T + 7.8, 4.0, "step", "chunk_train", chunk=1),
+        rec(T + 11.8, "chunk_end", chunk=1, position=1, seconds=4.0, steps=24),
+        span_rec(T + 11.8, 0.7, "preempt_drain", "save:preempt", cursor=1),
+        rec(T + 12.5, "checkpoint", path="ckpt_1", cursor=1, reason="preempt"),
+        rec(T + 12.55, "preempt", signum=15, checkpoint="ckpt_1", cursor=1),
+        rec(T + 12.58, "snapshot",
+            counters={"chunks": 2, "train.steps": 48, "checkpoints": 1},
+            gauges={}),
+        rec(T + 12.6, "run_end", status="preempted", generation=0, steps=48,
+            wall_seconds=12.6),
+    ]
+    seq = 0
+    G1 = T + 15.6  # 3.0 s inter-generation gap (1.2 s of it backoff)
+    gen1 = [
+        rec(G1, "run_start", run_name="goodput_golden", generation=1,
+            config={"batch": 512, "l1_values": [1e-4, 1e-3]}, fingerprint=fp),
+        span_rec(G1 + 0.1, 0.4, "checkpoint", "restore"),
+        rec(G1 + 0.55, "resume", checkpoint="ckpt_1",
+            cursor={"chunk": 1, "epoch": 0, "position": 1}),
+        span_rec(G1 + 0.6, 0.9, "data_wait", "chunk_load", chunk=2),
+        rec(G1 + 1.5, "chunk_start", chunk=2, position=2),
+        span_rec(G1 + 1.5, 5.2, "step", "chunk_train", chunk=2),
+        rec(G1 + 6.7, "chunk_end", chunk=2, position=2, seconds=5.2, steps=24),
+        span_rec(G1 + 6.7, 0.4, "checkpoint", "export"),
+        rec(G1 + 7.3, "snapshot",
+            counters={"chunks": 1, "train.steps": 24, "resumes": 1},
+            gauges={}),
+        rec(G1 + 7.4, "run_end", status="ok", generation=1, steps=24,
+            wall_seconds=7.4),
+    ]
+    with open(GOODPUT_RUN_DIR / "events.jsonl", "w") as f:
+        for e in gen0 + gen1:
+            f.write(json.dumps(e) + "\n")
+    seq = 0
+    run_dir = "out/goodput_run"  # basename matches: relocatable join
+    sup = [
+        rec(T - 0.5, "run_start", run_name="supervisor", generation=0,
+            config={"cmd": ["python", "-m", "driver"], "max_restarts": 8,
+                    "restart_on": "preempt"}),
+        rec(T - 0.2, "spawn", attempt=0, generation=0, run_dir=run_dir,
+            cmd=["python", "-m", "driver"], resume=False),
+        span_rec(T + 14.3, 1.2, "restart_backoff", "backoff", run_dir=run_dir),
+        rec(T + 15.55, "restart", attempt=1, generation=1, run_dir=run_dir,
+            exit_code=75, classification="preempt", backoff_seconds=1.2,
+            downtime_seconds=3.0),
+        rec(T + 15.58, "spawn", attempt=1, generation=1, run_dir=run_dir,
+            cmd=["python", "-m", "driver"], resume=True),
+        rec(G1 + 7.5, "run_end", status="ok", run_name="supervisor",
+            generation=0, wall_seconds=23.6),
+    ]
+    with open(GOODPUT_RUN_DIR / "supervisor_events.jsonl", "w") as f:
+        for e in sup:
+            f.write(json.dumps(e) + "\n")
+    print(f"Wrote {GOODPUT_RUN_DIR}/events.jsonl + supervisor_events.jsonl")
 
 
 FLEET_RUN_DIR = REPO / "tests" / "golden" / "fleet_run"
@@ -496,6 +613,9 @@ def main():
         return
     if "--resumed-run" in sys.argv:
         make_resumed_run_fixture()
+        return
+    if "--goodput-run" in sys.argv:
+        make_goodput_run_fixture()
         return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")

@@ -464,44 +464,54 @@ def make_activation_dataset(
                     np.asarray(jax.device_get(act)).reshape(-1, act.shape[-1])
                 )
 
+        # goodput spans (docs/observability.md §7): the harvest holds no
+        # telemetry handle, so spans broadcast (the explicit ACTIVE
+        # sentinel) to whatever RunTelemetry is live — e.g. the sweep's,
+        # during init_model_dataset. The capture forward is the harvest's
+        # productive window, the chunk-pair commit its checkpoint badput.
+        # No live telemetry → two clock reads.
+        from sparse_coding__tpu.telemetry.spans import ACTIVE, span as _span
+
         # 1-deep pipeline: dispatch the next forward before fetching the
         # previous batch's activations, overlapping device compute with the
         # device→host transfer (dispatch is async; device_get is the barrier)
-        pending = None
-        for b in range(batches_per_chunk):
-            rows = tokens[(batch_cursor + b) * batch_size : (batch_cursor + b + 1) * batch_size]
-            cache = capture(params, jnp.asarray(rows))
-            if pending is not None:
-                drain(pending)
-            pending = cache
-        drain(pending)
-        for key in names:
-            chunk = np.concatenate(buffers[key], axis=0)
-            if center_dataset:
-                if chunk_idx == 0 and key not in means:
-                    means[key] = chunk.mean(axis=0)
-                    np.save(folders[key] / "mean.npy", means[key])
-                elif key not in means:
-                    means[key] = np.load(folders[key] / "mean.npy")
-                chunk = chunk - means[key]
-            save_chunk(
-                folders[key], chunk_idx, chunk, dtype=store_dtype,
-                provenance={
-                    "harvest": {
-                        "config_sha": config_sha,
-                        "layer": int(key[0]), "loc": str(key[1]),
-                        "batches": [batch_cursor, batch_cursor + batches_per_chunk],
-                        "centered": bool(center_dataset),
-                    }
-                },
-            )
-        batch_cursor += batches_per_chunk
-        chunk_idx += 1
-        if selected is None:
-            # commit the harvest position AFTER the chunk landed in every
-            # folder — the resume contract "last committed chunk" (repair
-            # passes leave the cursor alone: they fill holes, not the tail)
-            _write_harvest_cursor(folders, chunk_idx, batch_cursor, config_sha)
+        with _span(ACTIVE, "step", name="harvest_forward", chunk=chunk_idx):
+            pending = None
+            for b in range(batches_per_chunk):
+                rows = tokens[(batch_cursor + b) * batch_size : (batch_cursor + b + 1) * batch_size]
+                cache = capture(params, jnp.asarray(rows))
+                if pending is not None:
+                    drain(pending)
+                pending = cache
+            drain(pending)
+        with _span(ACTIVE, "checkpoint", name="chunk_commit", chunk=chunk_idx):
+            for key in names:
+                chunk = np.concatenate(buffers[key], axis=0)
+                if center_dataset:
+                    if chunk_idx == 0 and key not in means:
+                        means[key] = chunk.mean(axis=0)
+                        np.save(folders[key] / "mean.npy", means[key])
+                    elif key not in means:
+                        means[key] = np.load(folders[key] / "mean.npy")
+                    chunk = chunk - means[key]
+                save_chunk(
+                    folders[key], chunk_idx, chunk, dtype=store_dtype,
+                    provenance={
+                        "harvest": {
+                            "config_sha": config_sha,
+                            "layer": int(key[0]), "loc": str(key[1]),
+                            "batches": [batch_cursor, batch_cursor + batches_per_chunk],
+                            "centered": bool(center_dataset),
+                        }
+                    },
+                )
+            batch_cursor += batches_per_chunk
+            chunk_idx += 1
+            if selected is None:
+                # commit the harvest position AFTER the chunk landed in every
+                # folder — the resume contract "last committed chunk" (repair
+                # passes leave the cursor alone: they fill holes, not the tail)
+                _write_harvest_cursor(folders, chunk_idx, batch_cursor, config_sha)
 
     return folders
 

@@ -329,7 +329,11 @@ class FleetWorker:
         # member group's chunk store must be within the loss budget BEFORE
         # chips are spent training on it (docs/DATAPLANE.md)
         if self.admission_check:
-            bad = self._admission_failure(item)
+            from sparse_coding__tpu.telemetry.spans import span as _span
+
+            with _span(self.telemetry, "export_verify",
+                       name="admission_check", item=item_id):
+                bad = self._admission_failure(item)
             if bad is not None:
                 try:
                     bucket = self.queue.fail(
@@ -452,8 +456,12 @@ class FleetWorker:
                 clear_preemption()
             self._event("lease_lost", item=item_id)
             return "lease_lost"
-        write_export_manifest(run_dir)
-        ok, reason = verify_export(run_dir)
+        from sparse_coding__tpu.telemetry.spans import span as _span
+
+        with _span(self.telemetry, "export_verify", name="export_verify",
+                   item=item_id):
+            write_export_manifest(run_dir)
+            ok, reason = verify_export(run_dir)
         if not ok:
             try:
                 bucket = self.queue.fail(

@@ -125,6 +125,26 @@ def classify_exit(
     return "crash"
 
 
+def _prior_generations(run_dir: Optional[str]) -> int:
+    """How many driver generations already ran in this run dir (run_start
+    records in its ``events*.jsonl``, max over per-process files). The
+    spawn/restart generation stamps must continue this count — the child
+    derives ITS generation the same way — or a relaunched supervisor (or
+    supervision added to a previously-run dir) stamps generations that
+    join to the wrong run telemetry."""
+    if run_dir is None:
+        return 0
+    best = 0
+    for path in Path(run_dir).glob("events*.jsonl"):
+        try:
+            with open(path, "r", errors="replace") as f:
+                n = sum(1 for line in f if '"event": "run_start"' in line)
+        except OSError:
+            continue
+        best = max(best, n)
+    return best
+
+
 def run_supervised(
     cmd: List[str],
     run_dir: Optional[str] = None,
@@ -187,6 +207,9 @@ def run_supervised(
             pass
 
     attempt = 0
+    # child generations started, continuing any generations already in the
+    # run dir (attempt resets on healthy stretches; this never does)
+    spawned = _prior_generations(run_dir)
     try:
         while True:
             env = dict(os.environ)
@@ -194,11 +217,16 @@ def run_supervised(
                 env[RESUME_ENV] = "1"
             started = time.time()
             if telemetry is not None:
+                # run_dir + generation stamps: the goodput merger (and the
+                # Recovery section) join supervisor records to the child's
+                # run telemetry by these, not by path guessing
                 telemetry.event(
-                    "spawn", attempt=attempt, cmd=cmd,
+                    "spawn", attempt=attempt, generation=spawned,
+                    run_dir=run_dir, cmd=cmd,
                     resume=attempt > 0 or env.get(RESUME_ENV) == "1",
                 )
             proc = subprocess.Popen(cmd, env=env)
+            spawned += 1
             child["proc"] = proc
             if on_spawn is not None:
                 on_spawn(proc)
@@ -258,7 +286,15 @@ def run_supervised(
                 stopped("budget_exhausted")
                 return rc_out
             delay = compute_backoff(attempt, backoff_base, backoff_max, jitter)
-            time.sleep(delay)
+            # the backoff sleep is first-class badput: a live span on the
+            # supervisor's own timeline (the ledger ALSO derives the
+            # restart_backoff share of the inter-generation gap from the
+            # `restart` record's backoff_seconds)
+            from sparse_coding__tpu.telemetry.spans import span as _span
+
+            with _span(telemetry, "restart_backoff", name="backoff",
+                       run_dir=run_dir):
+                time.sleep(delay)
             if signaled["got"] is not None:
                 # preempted DURING the backoff sleep (no child to forward
                 # to): spawning another generation would blow the outer
@@ -275,6 +311,8 @@ def run_supervised(
                 telemetry.event(
                     "restart",
                     attempt=attempt,
+                    generation=spawned,  # the generation about to spawn
+                    run_dir=run_dir,
                     exit_code=rc,
                     classification=cls,
                     backoff_seconds=round(delay, 3),

@@ -22,6 +22,11 @@ Eight pieces (docs/observability.md):
                   merging per-process pod logs (and `python -m
                   sparse_coding__tpu.perfdiff OLD NEW` for bench-to-bench
                   regression gating)
+  - `spans`     — categorized wall-time `span` records (step / data_wait /
+                  checkpoint / preempt_drain / …) for goodput accounting
+  - `goodput`   — wall-time ledger across processes + resume generations
+                  (+ the supervisor log), Perfetto trace export; CLI:
+                  `python -m sparse_coding__tpu.timeline <run_dir>`
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy
@@ -51,14 +56,25 @@ from sparse_coding__tpu.telemetry.profiling import (
     record_hbm_watermarks,
     roofline_summary,
 )
+from sparse_coding__tpu.telemetry.spans import (
+    BADPUT_CATEGORIES,
+    CATEGORIES,
+    GOODPUT_CATEGORIES,
+    Span,
+    span,
+)
 
 __all__ = [
     "AnomalyAbort",
     "AnomalyGuard",
     "AnomalyPolicy",
+    "BADPUT_CATEGORIES",
+    "CATEGORIES",
     "FIRE_EMA_KEY",
+    "GOODPUT_CATEGORIES",
     "HealthConfig",
     "RunTelemetry",
+    "Span",
     "TraceTrigger",
     "TransferViolation",
     "allowed_transfer",
@@ -77,6 +93,7 @@ __all__ = [
     "record_hbm_watermarks",
     "roofline_summary",
     "run_fingerprint",
+    "span",
     "tracked_jit",
     "transfer_audit",
 ]

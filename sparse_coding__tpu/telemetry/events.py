@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "RunTelemetry",
+    "counter_add_float_active",
     "counter_inc_active",
     "event_active",
     "gauge_set_active",
@@ -107,6 +108,13 @@ def counter_inc_active(name: str, n: int = 1) -> None:
     feeding the `io.retry` counter). No live telemetry → no-op."""
     for t in list(_ACTIVE):
         t.counter_inc(name, n)
+
+
+def counter_add_float_active(name: str, v: float) -> None:
+    """Float-add a counter on EVERY live RunTelemetry — the fractional
+    sibling of `counter_inc_active` (e.g. handle-less span seconds)."""
+    for t in list(_ACTIVE):
+        t.counter_add_float(name, v)
 
 
 def gauge_set_active(name: str, value: float) -> None:
@@ -225,6 +233,8 @@ class RunTelemetry:
         self._lock = threading.Lock()
         self._seq = 0
         self._t0 = time.time()
+        self._t0_mono = time.monotonic()
+        self._chunk_t0_mono: Optional[float] = None
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._run_end_written = False
@@ -234,24 +244,53 @@ class RunTelemetry:
 
         idx, count = _mh.process_info()
         self.process_index: Optional[int] = idx if count > 1 else None
+        self.generation = 0
         if out_dir is not None:
             d = Path(out_dir)
             d.mkdir(parents=True, exist_ok=True)
             self.path = d / _mh.per_process_file_name(file_name, idx, count)
+            # generation index: a resumed process APPENDS to the same log, so
+            # the number of run_start records already on disk IS this
+            # generation's index — the key that lets goodput/report sum wall
+            # time across generations instead of under-reporting a resumed
+            # run as only its last generation (ISSUE 9 satellite)
+            self.generation = self._count_prior_generations()
             self._fh = open(self.path, "a")
         if install_jax_listeners:
             _install_jax_listeners()
         _ACTIVE.append(self)
+
+    def _count_prior_generations(self) -> int:
+        """run_start records already in this process's log file (0 on a fresh
+        run). A substring scan, not a JSON parse: the writer below emits
+        exactly ``"event": "run_start"`` and torn tail lines must not matter."""
+        if self.path is None or not self.path.exists():
+            return 0
+        n = 0
+        try:
+            with open(self.path, "r", errors="replace") as f:
+                for line in f:
+                    if '"event": "run_start"' in line:
+                        n += 1
+        except OSError:
+            return 0
+        return n
 
     # -- raw event plumbing --------------------------------------------------
 
     def event(self, etype: str, **fields) -> Dict[str, Any]:
         """Write one event record of type `etype`; returns it (tests and
         callers may inspect). Field names are free — `anomaly` events carry
-        their detector name under a ``kind`` field, for example."""
+        their detector name under a ``kind`` field, for example. Every
+        record carries both the wall clock (``ts`` — cross-host alignable
+        via the clock-offset gauges) and a monotonic stamp (``mono`` —
+        NTP-step-proof within a process generation)."""
         with self._lock:
             self._seq += 1
-            rec = {"seq": self._seq, "ts": time.time(), "event": etype, **fields}
+            rec = {
+                "seq": self._seq, "ts": time.time(),
+                "mono": round(time.monotonic(), 6), "event": etype, **fields,
+            }
             if self.process_index is not None:
                 rec["process_index"] = self.process_index
             if self._fh is not None:
@@ -262,11 +301,14 @@ class RunTelemetry:
     # -- lifecycle events ----------------------------------------------------
 
     def run_start(self, config: Optional[Dict[str, Any]] = None, mesh=None):
-        """The first record: run name, caller config, environment fingerprint."""
+        """The first record: run name, caller config, environment
+        fingerprint, and this process's resume generation index (0 = fresh;
+        a supervised restart appending to the same log counts up)."""
         cfg = config if config is not None else self._config
         return self.event(
             "run_start",
             run_name=self.run_name,
+            generation=self.generation,
             config=cfg,
             fingerprint=run_fingerprint(mesh=mesh),
         )
@@ -294,11 +336,19 @@ class RunTelemetry:
 
     def chunk_start(self, chunk: int, **fields):
         self._chunk_t0 = time.time()
+        self._chunk_t0_mono = time.monotonic()
         return self.event("chunk_start", chunk=int(chunk), **fields)
 
     def chunk_end(self, chunk: int, **fields):
-        dt = time.time() - getattr(self, "_chunk_t0", time.time())
-        self.counter_inc("chunks")
+        # monotonic-derived duration: an NTP clock step mid-chunk cannot
+        # produce a negative/inflated window. No chunk_start → seconds=None
+        # (rendered "n/a" downstream), never a fake 0 duration.
+        t0 = self._chunk_t0_mono
+        self._chunk_t0_mono = None
+        self.counter_inc("chunks")  # the chunk completed either way
+        if t0 is None:
+            return self.event("chunk_end", chunk=int(chunk), seconds=None, **fields)
+        dt = time.monotonic() - t0
         self.counter_add_float("chunk.seconds", dt)
         return self.event(
             "chunk_end", chunk=int(chunk), seconds=round(dt, 3), **fields
@@ -315,9 +365,15 @@ class RunTelemetry:
         self.snapshot()
         self._run_end_written = True
         steps = self._counters.get("train.steps")
-        wall = time.time() - self._t0
+        # monotonic wall: THIS generation's span, clock-step-proof. Resumed
+        # runs sum wall across generations in report/goodput (each run_end
+        # carries its generation index) — a single generation's wall was
+        # never the whole story for a killed-and-resumed run.
+        wall = time.monotonic() - self._t0_mono
         rec: Dict[str, Any] = {
             "status": status,
+            "run_name": self.run_name,
+            "generation": self.generation,
             "wall_seconds": round(wall, 3),
             **fields,
         }

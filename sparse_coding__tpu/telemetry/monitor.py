@@ -154,6 +154,10 @@ class RunMonitor:
         self.chunk_skips: List[Dict[str, Any]] = []
         self.budget_remaining: Optional[float] = None
         self.budget_exhausted = False
+        # goodput accounting (docs/observability.md §7): per-category span
+        # seconds + the earliest run_start for the live wall denominator
+        self.span_seconds: Dict[str, float] = {}
+        self.first_start_ts: Optional[float] = None
 
     # -- ingestion ------------------------------------------------------------
 
@@ -207,6 +211,19 @@ class RunMonitor:
             # without this reset, follow mode would exit at the first
             # generation's run_end and leave the restarted run unwatched
             p.status = "running"
+            if rec.get("run_name") != "supervisor" and isinstance(
+                ts, (int, float)
+            ):
+                if self.first_start_ts is None or ts < self.first_start_ts:
+                    self.first_start_ts = float(ts)
+        elif kind == "span":
+            if rec.get("category") is not None and isinstance(
+                rec.get("seconds"), (int, float)
+            ):
+                cat = str(rec["category"])
+                self.span_seconds[cat] = (
+                    self.span_seconds.get(cat, 0.0) + float(rec["seconds"])
+                )
         elif kind == "heartbeat":
             if rec.get("steps") is not None:
                 p.steps = int(rec["steps"])
@@ -400,6 +417,38 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         elif mon.budget_remaining is not None:
             line += f" | budget {100 * mon.budget_remaining:.1f}% remaining"
         lines.append(line)
+    # live goodput line (docs/observability.md §7): per-category span
+    # seconds vs the wall elapsed since the earliest run_start — the full
+    # ledger (generation gaps, supervisor backoff) is the timeline CLI's job
+    if mon.span_seconds:
+        from sparse_coding__tpu.telemetry.spans import INNER_CATEGORIES
+
+        last = max((p.last_ts or 0.0) for p in mon.procs.values())
+        elapsed = (
+            last - mon.first_start_ts
+            if mon.first_start_ts is not None and last > mon.first_start_ts
+            else None
+        )
+        # inner-category spans (checkpoint/preempt_drain inside a step
+        # window — big_batch's shape) ride INSIDE step spans: subtract them
+        # so the live % tracks the ledger's innermost-wins attribution
+        # (approximate — may under-report when such spans fall outside
+        # step windows; the offline ledger is exact)
+        step = max(
+            0.0,
+            mon.span_seconds.get("step", 0.0)
+            - sum(mon.span_seconds.get(c, 0.0) for c in INNER_CATEGORIES),
+        )
+        pct = (
+            f"{min(100.0, 100.0 * step / elapsed):.1f}%"
+            if elapsed
+            else "n/a"
+        )
+        cats = " | ".join(
+            f"{c} {s:.1f}s"
+            for c, s in sorted(mon.span_seconds.items(), key=lambda kv: -kv[1])
+        )
+        lines.append(f"  goodput: {pct} — {cats}")
     if mon.preempts or mon.resumes or mon.restarts:
         bits = []
         if mon.preempts:

@@ -417,7 +417,11 @@ def chunk_skew_windows(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]
     windows: Dict[tuple, Dict[int, float]] = {}
     order: List[tuple] = []
     for e in events:
-        if e.get("event") != "chunk_end" or "seconds" not in e:
+        # seconds=None = chunk_end without a matching chunk_start (resumed
+        # generation's torn window): no usable duration for skew either
+        if e.get("event") != "chunk_end" or not isinstance(
+            e.get("seconds"), (int, float)
+        ):
             continue
         key = (e.get("epoch"), e.get("chunk"), e.get("position"))
         proc = int(e.get("process_index", 0))

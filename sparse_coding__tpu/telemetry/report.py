@@ -359,9 +359,10 @@ def _pod_section(run, lines: List[str]):
         counters = (per_snap.get(p) or {}).get("counters", {})
         gauges = (per_snap.get(p) or {}).get("gauges", {})
         secs = [
-            float(c.get("seconds", 0.0))
+            float(c["seconds"])
             for c in chunk_ends
-            if c.get("process_index") == p and "seconds" in c
+            if c.get("process_index") == p
+            and isinstance(c.get("seconds"), (int, float))
         ]
         peaks = [
             v for k, v in gauges.items()
@@ -616,6 +617,8 @@ def _throughput_section(run, lines: List[str]):
         bits = [f"status **{e.get('status', '?')}**"]
         if e.get("process_index") is not None:
             bits.insert(0, f"**p{e['process_index']}**")
+        if e.get("generation") is not None:
+            bits.insert(0, f"gen {e['generation']}")
         if "steps" in e:
             bits.append(f"{e['steps']} steps")
         if e.get("steps_per_sec") is not None:
@@ -632,14 +635,83 @@ def _throughput_section(run, lines: List[str]):
             )
         lines.append("- " + ", ".join(bits))
         wrote = True
-    if chunks:
-        secs = [float(c.get("seconds", 0.0)) for c in chunks]
+    # a killed-and-resumed run writes one run_end PER GENERATION: the last
+    # one's wall is only its own generation, so the honest total is the
+    # per-(process, run) sum (ISSUE 9 satellite — under-reported before).
+    # Grouping keys on run_name so the supervisor's overlapping lifetime
+    # (or another run sharing the directory) is never lumped in, and
+    # requires generation-stamped records — legacy logs cannot distinguish
+    # a second generation from a second writer, so no total is guessed.
+    by_run: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in ends:
+        if e.get("run_name") == "supervisor" or e.get("generation") is None:
+            continue
+        by_run.setdefault((e.get("process_index"), e.get("run_name")), []).append(e)
+    for (p, _name), pe in sorted(
+        by_run.items(),
+        key=lambda kv: (
+            kv[0][0] is None, -1 if kv[0][0] is None else kv[0][0],
+            kv[0][1] or "",
+        ),
+    ):
+        if len(pe) < 2:
+            continue
+        walls = [e["wall_seconds"] for e in pe if e.get("wall_seconds") is not None]
+        steps = [e["steps"] for e in pe if e.get("steps") is not None]
+        where = "" if p is None else f" (p{p})"
         lines.append(
-            f"- {len(chunks)} chunks, mean {sum(secs) / len(secs):.2f} s/chunk"
+            f"- **total across {len(pe)} generations{where}**: "
+            f"{_fmt(sum(walls))} s wall"
+            + (f", {int(sum(steps))} steps" if steps else "")
+        )
+        wrote = True
+    if chunks:
+        # seconds=None = chunk_end without a chunk_start (a resumed
+        # generation's torn window): honest "n/a", never a fake 0 mean
+        secs = [
+            float(c["seconds"]) for c in chunks
+            if isinstance(c.get("seconds"), (int, float))
+        ]
+        mean = f"{sum(secs) / len(secs):.2f} s/chunk" if secs else "n/a s/chunk"
+        untimed = len(chunks) - len(secs)
+        lines.append(
+            f"- {len(chunks)} chunks, mean {mean}"
+            + (f" ({untimed} untimed)" if untimed else "")
         )
         wrote = True
     if not wrote:
         lines.append("_(no run_end / chunk events)_")
+    lines.append("")
+
+
+def _goodput_section(run, lines: List[str]):
+    """Wall-time attribution (`telemetry.goodput`): goodput %, the badput
+    breakdown, and the widest badput spans. Only rendered for runs that
+    emitted ``span`` events (or multiple generations) — older runs' report
+    output is a stability contract."""
+    has_spans = any(e.get("event") == "span" for e in run["events"])
+    gens = [
+        s for s in _events_of(run, "run_start")
+        if s.get("run_name") != "supervisor"
+    ]
+    if not has_spans and len(gens) < 2:
+        return
+    from sparse_coding__tpu.telemetry.goodput import build_ledger, render_ledger
+
+    try:
+        ledger = build_ledger(run["dir"])
+    except (OSError, ValueError):
+        return
+    if ledger["wall_seconds"] <= 0:
+        return
+    lines.append("## Goodput")
+    lines.append("")
+    lines.append(render_ledger(ledger))
+    lines.append("")
+    lines.append(
+        "_Full timeline + Perfetto export: `python -m "
+        f"sparse_coding__tpu.timeline {run['dir']}` (docs/observability.md §7)._"
+    )
     lines.append("")
 
 
@@ -719,6 +791,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _fingerprint_section(run, lines)
     _pod_section(run, lines)
     _recovery_section(run, lines)
+    _goodput_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

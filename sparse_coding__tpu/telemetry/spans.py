@@ -1,0 +1,169 @@
+"""Span records: categorized wall-time intervals for goodput accounting.
+
+A *span* is one contiguous stretch of a process's wall clock assigned to a
+single activity category — the unit `telemetry.goodput` reconstructs a
+run's wall-time ledger from. Drivers, the checkpointer, the harvest, the
+supervisor, and the fleet worker open spans at the boundaries they already
+have (chunk read, chunk train, checkpoint commit, preempt drain, export
+verify, restart backoff); everything the instrumentation does not cover
+surfaces honestly as ``unaccounted`` in the ledger rather than being
+guessed at.
+
+One ``span`` event is written when the span closes::
+
+    {"event": "span", "category": "data_wait", "name": "chunk_load",
+     "ts_start": <wall clock at begin>, "seconds": <monotonic duration>,
+     ...caller fields}
+
+``seconds`` is derived from ``time.monotonic()`` so an NTP step mid-span
+cannot produce a negative or inflated duration; ``ts_start`` (plus the
+record's own ``ts``) anchors the span on the cross-host wall timeline the
+existing clock-offset gauges align.
+
+Spans never nest *within a category*, but *inner* categories (``compile``,
+``checkpoint``, ``preempt_drain``) legitimately occur inside an open
+``step``/``data_wait`` span — a jit dispatch that compiles, a periodic
+checkpoint inside a step window. The ledger subtracts inner-span overlap
+from the enclosing span (`goodput._exclusive_seconds`), so every second
+still lands in exactly one category.
+
+``Span(ACTIVE, ...)`` (the explicit sentinel) broadcasts through the
+active-RunTelemetry registry (`events.event_active`) — the hook for layers
+that hold no telemetry handle (the activation harvest). ``telemetry=None``
+means what it means everywhere else in this package: telemetry disabled,
+span is a no-op — a component whose own telemetry is off must never write
+its wall time into some other live run's ledger.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from sparse_coding__tpu.telemetry import events as _events
+
+__all__ = [
+    "ACTIVE",
+    "GOODPUT_CATEGORIES",
+    "BADPUT_CATEGORIES",
+    "DERIVED_CATEGORIES",
+    "INNER_CATEGORIES",
+    "CATEGORIES",
+    "Span",
+    "span",
+]
+
+
+class _ActiveSentinel:
+    """Explicit 'broadcast to every live RunTelemetry' target. Distinct from
+    None (= telemetry disabled, span is a no-op) so a handle-less layer must
+    OPT IN to writing its wall time into other runs' logs."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return "<spans.ACTIVE>"
+
+
+ACTIVE = _ActiveSentinel()
+
+# productive wall time: fused train-step (or harvest-forward) compute windows
+GOODPUT_CATEGORIES = ("step",)
+# instrumented badput: emitted as live span events by the code paths below
+BADPUT_CATEGORIES = (
+    "compile",        # tracked_jit compile events double as spans
+    "data_wait",      # chunk read / prefetch-next / dataset load
+    "checkpoint",     # checkpoint save+restore, learned-dict export commits
+    "preempt_drain",  # the preemption checkpoint between signal and exit 75
+    "degraded_skip",  # quarantined-chunk skip accounting (docs/DATAPLANE.md)
+    "export_verify",  # fleet export/admission manifest verification
+    "restart_backoff",  # supervisor backoff sleep before a respawn
+)
+# derived-only badput: reconstructed by telemetry.goodput from event
+# adjacency, never emitted as live spans
+DERIVED_CATEGORIES = (
+    "preempted_down",  # inter-generation downtime after a preemption
+    "reassign_gap",    # fleet lease-loss → next-claim gap (item lineage)
+    "straggler_idle",  # fast hosts waiting on the slowest (skew windows)
+    "unaccounted",     # the honest remainder
+)
+# categories that may legitimately open INSIDE a step/data_wait span; the
+# ledger subtracts their overlap from the enclosing span
+INNER_CATEGORIES = ("compile", "checkpoint", "preempt_drain")
+CATEGORIES = GOODPUT_CATEGORIES + BADPUT_CATEGORIES + DERIVED_CATEGORIES
+
+
+class Span:
+    """One categorized wall-time interval; emits a ``span`` event on close.
+
+    Use as a context manager (``with span(tel, "step"): ...``) or manually
+    (``s = span(tel, "step").begin(); ...; s.end()``). ``end()`` is
+    idempotent and, like the context exit, emits even when the block raised
+    — time spent before a failure is still wall time spent.
+    """
+
+    __slots__ = ("telemetry", "category", "name", "fields", "_t0_mono",
+                 "_t0_wall", "_done")
+
+    def __init__(self, telemetry, category: str, name: Optional[str] = None,
+                 **fields):
+        if category not in GOODPUT_CATEGORIES + BADPUT_CATEGORIES:
+            raise ValueError(
+                f"unknown span category {category!r} (emittable: "
+                f"{GOODPUT_CATEGORIES + BADPUT_CATEGORIES})"
+            )
+        self.telemetry = telemetry
+        self.category = category
+        self.name = name
+        self.fields = fields
+        self._t0_mono: Optional[float] = None
+        self._t0_wall: Optional[float] = None
+        self._done = False
+
+    def begin(self) -> "Span":
+        self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self._done = False
+        return self
+
+    def end(self, **extra) -> Optional[Dict[str, Any]]:
+        """Close the span and emit its event; returns the record (None when
+        never begun, already ended, or no telemetry is live)."""
+        if self._done or self._t0_mono is None:
+            return None
+        self._done = True
+        if self.telemetry is None:
+            # telemetry disabled for this component: a span must not leak
+            # into some OTHER live run's ledger (broadcast is the explicit
+            # ACTIVE sentinel, not the None default)
+            return None
+        seconds = time.monotonic() - self._t0_mono
+        fields = dict(self.fields)
+        fields.update(extra)
+        if self.name is not None:
+            fields.setdefault("name", self.name)
+        payload = dict(
+            category=self.category,
+            ts_start=round(self._t0_wall, 6),
+            seconds=round(seconds, 6),
+            **fields,
+        )
+        if self.telemetry is not ACTIVE:
+            self.telemetry.counter_inc(f"span.{self.category}.count")
+            self.telemetry.counter_add_float(f"span.{self.category}.seconds", seconds)
+            return self.telemetry.event("span", **payload)
+        # handle-less layers (ACTIVE): broadcast to every live RunTelemetry
+        _events.counter_inc_active(f"span.{self.category}.count")
+        _events.counter_add_float_active(f"span.{self.category}.seconds", seconds)
+        _events.event_active("span", **payload)
+        return None
+
+    def __enter__(self) -> "Span":
+        return self.begin()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end()
+        return False
+
+
+def span(telemetry, category: str, name: Optional[str] = None, **fields) -> Span:
+    """Build a `Span` (not yet begun — ``with`` / ``.begin()`` starts it)."""
+    return Span(telemetry, category, name=name, **fields)

@@ -30,6 +30,7 @@ from sparse_coding__tpu.telemetry import (
     check_desync,
     heartbeat,
     record_hbm_watermarks,
+    span,
 )
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
@@ -218,22 +219,28 @@ def basic_l1_sweep(
                     continue
                 fault_point("chunk_loop", chunk=pos, epoch=epoch)
                 try:
-                    if hbm_cache:
-                        if int(chunk_idx) not in cache:
-                            cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
-                        chunk = cache[int(chunk_idx)].astype(jnp.float32)
-                    else:
-                        chunk = store.load(int(chunk_idx))
+                    # goodput: the chunk read is data-wait badput (emitted
+                    # even when the load raises — the wait was still spent)
+                    with span(telemetry, "data_wait", name="chunk_load",
+                              chunk=int(chunk_idx)):
+                        if hbm_cache:
+                            if int(chunk_idx) not in cache:
+                                cache[int(chunk_idx)] = store.load(int(chunk_idx), dtype=None)
+                            chunk = cache[int(chunk_idx)].astype(jnp.float32)
+                        else:
+                            chunk = store.load(int(chunk_idx))
                 except data_integrity.CorruptChunk as e:
                     # quarantined by the store: degraded mode — skip and
                     # account this chunk's rows against the loss budget
                     # (past budget this raises ResumableAbort → exit 75)
-                    budget.skip(
-                        e.chunk, e.reason,
-                        rows=data_integrity.quarantined_rows(
-                            store.folder, e.chunk
-                        ),
-                    )
+                    with span(telemetry, "degraded_skip", name="chunk_skip",
+                              chunk=int(chunk_idx)):
+                        budget.skip(
+                            e.chunk, e.reason,
+                            rows=data_integrity.quarantined_rows(
+                                store.folder, e.chunk
+                            ),
+                        )
                     continue
                 except (
                     FileNotFoundError, IsADirectoryError, NotADirectoryError,
@@ -256,11 +263,15 @@ def basic_l1_sweep(
                     ) from e
                 key, k = jax.random.split(key)
                 telemetry.chunk_start(int(chunk_idx), epoch=epoch, position=pos)
-                loss_fence = ensemble_train_loop(
-                    ens, chunk, batch_size=batch_size, key=k,
-                    logger=logger, fista_iters=fista_iters, fista_tol=fista_tol,
-                    telemetry=telemetry,
-                )
+                # goodput: the chunk's train pass is the run's productive
+                # window (compiles inside it are subtracted by the ledger)
+                with span(telemetry, "step", name="chunk_train",
+                          chunk=int(chunk_idx), epoch=epoch):
+                    loss_fence = ensemble_train_loop(
+                        ens, chunk, batch_size=batch_size, key=k,
+                        logger=logger, fista_iters=fista_iters, fista_tol=fista_tol,
+                        telemetry=telemetry,
+                    )
                 timer.tick()  # one tick per chunk pass; fenced at run_end
                 end_rec = telemetry.chunk_end(
                     int(chunk_idx), epoch=epoch, position=pos,
@@ -282,10 +293,11 @@ def basic_l1_sweep(
                     # named by training-sequence position (like the reference's
                     # enumerate counter, `basic_l1_sweep.py:92,114`), NOT by the
                     # shuffled store index — chunk_{k} is always the k-th state
-                    save_learned_dicts(
-                        out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
-                        learned_dicts,
-                    )
+                    with span(telemetry, "checkpoint", name="export"):
+                        save_learned_dicts(
+                            out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
+                            learned_dicts,
+                        )
 
                 # preemption/periodic checkpoint boundary: cursor = last
                 # COMPLETED (epoch, position) + the post-split key, so a
@@ -306,9 +318,10 @@ def basic_l1_sweep(
             # restored (later-epoch) state
             if not save_after_every and epoch >= start_epoch:
                 learned_dicts = export()
-                save_learned_dicts(
-                    out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
-                )
+                with span(telemetry, "checkpoint", name="export"):
+                    save_learned_dicts(
+                        out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
+                    )
     except ResumableAbort as e:
         status = f"resumable-abort: {e}"
         raise

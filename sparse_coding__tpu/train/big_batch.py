@@ -240,8 +240,10 @@ def train_big_batch(
         # the big-batch trainer samples rows, so a skipped chunk simply
         # shrinks the pool; the budget bounds how much may go missing
         from sparse_coding__tpu.data.chunks import load_store_dataset
+        from sparse_coding__tpu.telemetry.spans import span as _span
 
-        dataset, _budget = load_store_dataset(dataset, telemetry=telemetry)
+        with _span(telemetry, "data_wait", name="load_store_dataset"):
+            dataset, _budget = load_store_dataset(dataset, telemetry=telemetry)
     with px.compute(compute_dtype):
         return _train_big_batch(
             sig, init_hparams, dataset, batch_size, n_steps, key,
@@ -317,6 +319,14 @@ def _train_big_batch(
 
     worst = WorstExamples(worst_k)
     n = dataset.shape[0]
+    # goodput: per-step spans would be noise — one "step" span per window
+    # between host-sync boundaries (resurrections, end of run); checkpoint
+    # saves inside the window are subtracted by the ledger's innermost-wins
+    # sweep, so nothing is double-counted
+    from sparse_coding__tpu.telemetry.spans import span as _span
+
+    win = _span(telemetry, "step", name="step_window").begin()
+    win_start = start_step
     try:
         for i in range(start_step, n_steps):
             fault_point("step_loop", step=i)
@@ -335,6 +345,8 @@ def _train_big_batch(
                 worst.update(idxs, mses)
 
             if reinit_every and (i + 1) % reinit_every == 0:
+                win.end(steps=i + 1 - win_start)
+                win_start = i + 1
                 worst_idx = worst.get_worst(n_feats)
                 reps = dataset[np.resize(worst_idx, n_feats)]
                 state, n_dead = resurrect_dead_features(
@@ -362,6 +374,7 @@ def _train_big_batch(
                     heartbeat(telemetry, step=i + 1)
                 if n_dead:
                     print(f"step {i+1}: resurrected {n_dead} dead features")
+                win = _span(telemetry, "step", name="step_window").begin()
             if telemetry is not None:
                 telemetry.counter_inc("train.steps")
             trace_trigger.on_step(i + 1)  # host-side int compares only
@@ -390,6 +403,7 @@ def _train_big_batch(
     finally:
         # an exception mid-run must still finalize any in-flight profiler
         # window — a leaked trace blocks every later capture in the process
+        win.end()  # the open step window: emitted even on preempt/crash
         trace_trigger.close(n_steps)
         if ckpt is not None:
             ckpt.close()  # no longer polling: signals terminate normally

@@ -109,7 +109,10 @@ class DriverCheckpointer:
         latest = ckpt_lib.latest_checkpoint(self.out)
         if latest is None:
             return None
-        tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
+        from sparse_coding__tpu.telemetry.spans import span
+
+        with span(self.telemetry, "checkpoint", name="restore"):
+            tree = ckpt_lib.restore_ensemble_checkpoint(latest, template=template)
         if self.telemetry is not None:
             cursor = {
                 k: (v.tolist() if hasattr(v, "tolist") else v)
@@ -120,11 +123,18 @@ class DriverCheckpointer:
         return tree
 
     def save(self, cursor_id: int, save_fn: Callable[[Path], None], reason: str = "periodic") -> Path:
+        from sparse_coding__tpu.telemetry.spans import span
         from sparse_coding__tpu.train import checkpoint as ckpt_lib
 
         path = self.out / f"ckpt_{int(cursor_id)}"
-        save_fn(path)
-        ckpt_lib.gc_checkpoints(self.out, keep=self.keep)
+        # goodput attribution: a preemption save is drain time (the window
+        # between the signal and the resumable exit), a scheduled one is
+        # ordinary checkpoint badput
+        category = "preempt_drain" if reason == "preempt" else "checkpoint"
+        with span(self.telemetry, category, name=f"save:{reason}",
+                  cursor=int(cursor_id)):
+            save_fn(path)
+            ckpt_lib.gc_checkpoints(self.out, keep=self.keep)
         if self.telemetry is not None:
             self.telemetry.event("checkpoint", path=str(path), cursor=int(cursor_id), reason=reason)
             self.telemetry.counter_inc("checkpoints")

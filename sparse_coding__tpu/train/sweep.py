@@ -42,6 +42,7 @@ from sparse_coding__tpu.telemetry import (
     check_desync,
     heartbeat,
     record_hbm_watermarks,
+    span,
 )
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
@@ -342,7 +343,11 @@ def sweep(
     # anomaly before any pod hours burn (no-op single-host)
     check_desync(telemetry, config=run_config)
 
-    with timed(telemetry, "dataset_init"):
+    # `timed` keeps the legacy `phase` event; the span is what the goodput
+    # ledger classifies (dataset build/load = data-wait badput)
+    with timed(telemetry, "dataset_init"), span(
+        telemetry, "data_wait", name="dataset_init"
+    ):
         store = (
             init_synthetic_dataset(cfg)
             if getattr(cfg, "use_synthetic_dataset", False)
@@ -466,17 +471,23 @@ def sweep(
     try:
         for i in range(start_chunk, len(chunk_order)):
             try:
-                chunk = next(chunk_iter)
+                # goodput: time blocked on the (prefetching) chunk stream is
+                # data-wait badput — with the double-buffered iterator a
+                # fully-overlapped read shows up as a near-zero span
+                with span(telemetry, "data_wait", name="chunk_next", chunk=i):
+                    chunk = next(chunk_iter)
             except StopIteration:
                 break
             except data_integrity.CorruptChunk as e:
                 # quarantined by the load: skip-and-account within the loss
                 # budget (past budget this raises ResumableAbort → exit 75),
                 # then restart the prefetch stream past the bad slot
-                budget.skip(
-                    e.chunk, e.reason,
-                    rows=data_integrity.quarantined_rows(store.folder, e.chunk),
-                )
+                with span(telemetry, "degraded_skip", name="chunk_skip",
+                          chunk=int(e.chunk)):
+                    budget.skip(
+                        e.chunk, e.reason,
+                        rows=data_integrity.quarantined_rows(store.folder, e.chunk),
+                    )
                 # consume this position's key splits even though no training
                 # happens: the resume fast-forward above is position-based
                 # (start_chunk * len(ensembles) splits), so a skip that ate
@@ -514,16 +525,19 @@ def sweep(
                     np.save(means_path, np.asarray(jax.device_get(means)))
                 chunk = chunk - means[None, :]
 
-            for ensemble, args, name in ensembles:
-                rng_key, k = jax.random.split(rng_key)
-                ensemble_train_loop(
-                    ensemble,
-                    chunk,
-                    batch_size=args.get("batch_size", cfg.batch_size),
-                    key=k,
-                    logger=logger,
-                    telemetry=telemetry,
-                )
+            # goodput: the chunk's train pass over every ensemble is the
+            # productive window (compiles inside are subtracted by the ledger)
+            with span(telemetry, "step", name="chunk_train", chunk=i):
+                for ensemble, args, name in ensembles:
+                    rng_key, k = jax.random.split(rng_key)
+                    ensemble_train_loop(
+                        ensemble,
+                        chunk,
+                        batch_size=args.get("batch_size", cfg.batch_size),
+                        key=k,
+                        logger=logger,
+                        telemetry=telemetry,
+                    )
 
             # export learned dicts only when something consumes them (save
             # point or metric log) — unstack + export per chunk is pure
@@ -550,7 +564,8 @@ def sweep(
             if want_save:
                 iter_folder = Path(cfg.output_folder) / f"_{i}"
                 iter_folder.mkdir(parents=True, exist_ok=True)
-                ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
+                with span(telemetry, "checkpoint", name="export", chunk=i):
+                    ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
                 if hasattr(cfg, "save_yaml"):
                     cfg.save_yaml(iter_folder / "config.yaml")
                 # atomic commit + retention GC + telemetry `checkpoint` event

@@ -1,0 +1,420 @@
+"""Goodput ledger, span records, timeline CLI, and the goodput gate (ISSUE 9).
+
+`tests/golden/goodput_run/` is a checked-in span-instrumented
+preempted-and-resumed run (regenerate ONLY via
+`python scripts/make_golden_fixture.py --goodput-run`); tier-1 pins the
+ledger's category sums (every wall second attributed, within 1%), the
+Chrome trace-event schema, and the timeline CLI's `--goodput-floor` exit
+codes against it. The chaos test delivers a REAL SIGTERM to a supervised
+`basic_l1_sweep` subprocess and asserts the inter-generation gap is
+classified as preemption badput, not goodput.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.telemetry import RunTelemetry, read_events, span
+from sparse_coding__tpu.telemetry.goodput import (
+    build_ledger,
+    to_chrome_trace,
+)
+from sparse_coding__tpu.timeline import main as timeline_main
+
+REPO = Path(__file__).resolve().parent.parent
+GOLDEN = Path(__file__).parent / "golden" / "goodput_run"
+RESUMED = Path(__file__).parent / "golden" / "resumed_run"
+
+
+def test_golden_goodput_fixture_exists():
+    assert (GOLDEN / "events.jsonl").exists()
+    assert (GOLDEN / "supervisor_events.jsonl").exists()
+
+
+# -- ledger -------------------------------------------------------------------
+
+def test_every_wall_second_attributed_on_golden_fixture():
+    """The acceptance bar: goodput + badput categories (incl. unaccounted)
+    sum to the run's total wall within 1%, across both generations AND the
+    inter-generation gap."""
+    led = build_ledger(GOLDEN)
+    assert led["n_generations"] == 2
+    assert led["n_processes"] == 1
+    assert led["wall_seconds"] == pytest.approx(23.0, abs=0.01)
+    total = sum(led["categories"].values())
+    assert total == pytest.approx(led["wall_seconds"], rel=0.01)
+    cats = led["categories"]
+    # the compile event rides INSIDE the first step span: innermost-wins
+    # must count it as compile and shrink step by exactly that much
+    assert cats["step"] == pytest.approx(12.2, abs=0.01)
+    assert cats["compile"] == pytest.approx(2.0, abs=0.01)
+    assert cats["data_wait"] == pytest.approx(2.7, abs=0.01)
+    assert cats["checkpoint"] == pytest.approx(0.8, abs=0.01)
+    assert cats["preempt_drain"] == pytest.approx(0.7, abs=0.01)
+    assert led["goodput_frac"] == pytest.approx(0.5304, abs=0.002)
+
+
+def test_generation_gap_classified_as_preemption_badput():
+    """The 3.0 s between generation 0's preempted run_end and generation
+    1's run_start: 1.2 s supervisor backoff (joined via the stamped
+    ``restart`` record), the rest preempted downtime — never goodput."""
+    led = build_ledger(GOLDEN)
+    cats = led["categories"]
+    assert cats["restart_backoff"] == pytest.approx(1.2, abs=0.01)
+    assert cats["preempted_down"] == pytest.approx(1.8, abs=0.01)
+    names = [s["category"] for s in led["top_badput_spans"]]
+    assert "preempted_down" in names and "restart_backoff" in names
+
+
+def test_chrome_trace_event_schema(tmp_path):
+    """The exported trace must be loadable Chrome trace-event JSON: a
+    traceEvents list of M/X events with pid/tid/ts (+dur on X), one thread
+    track per generation."""
+    trace = json.loads(json.dumps(to_chrome_trace(build_ledger(GOLDEN))))
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for e in events:
+        assert e["ph"] in ("X", "M")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str) and e["name"]
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert e["cat"] == e["args"]["category"]
+    gen_tracks = {e["tid"] for e in events if e["ph"] == "X"}
+    assert {0, 1} <= gen_tracks, "one track per generation"
+    thread_names = [
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    ]
+    assert "gen 0" in thread_names and "gen 1" in thread_names
+
+
+# -- timeline CLI + goodput gate ----------------------------------------------
+
+def test_timeline_cli_renders_and_exports(tmp_path, capsys):
+    assert timeline_main([str(GOLDEN)]) == 0
+    out = capsys.readouterr().out
+    assert "Goodput ledger" in out
+    assert "53.0%" in out
+    assert "preempted_down" in out and "restart_backoff" in out
+    trace_path = tmp_path / "trace.json"
+    assert timeline_main([str(GOLDEN), "--trace", str(trace_path)]) == 0
+    data = json.loads(trace_path.read_text())
+    assert data["traceEvents"], "trace file must be loadable JSON"
+
+
+def test_goodput_floor_gate_exit_codes(capsys):
+    assert timeline_main([str(GOLDEN), "--goodput-floor", "50"]) == 0
+    assert timeline_main([str(GOLDEN), "--goodput-floor", "90"]) == 1
+    assert "GOODPUT REGRESSION" in capsys.readouterr().out
+
+
+def test_goodput_gate_trips_on_injected_stall(tmp_path, capsys):
+    """The CI shape: the same pinned floor passes the clean fixture and
+    fails a copy with a 30 s stall injected into generation 1."""
+    for p in GOLDEN.glob("*.jsonl"):
+        shutil.copy(p, tmp_path / p.name)
+    path = tmp_path / "events.jsonl"
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    for r in recs:
+        if r["event"] == "run_end" and r.get("generation") == 1:
+            r["wall_seconds"] = round(r["wall_seconds"] + 30.0, 3)
+            r["ts"] = round(r["ts"] + 30.0, 3)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert timeline_main([str(GOLDEN), "--goodput-floor", "50"]) == 0
+    assert timeline_main([str(tmp_path), "--goodput-floor", "50"]) == 1
+    out = capsys.readouterr().out
+    assert "GOODPUT REGRESSION" in out
+
+
+def test_timeline_cli_empty_dir_exit_code(tmp_path, capsys):
+    assert timeline_main([str(tmp_path)]) == 3
+
+
+# -- live span round trip + generation stamping -------------------------------
+
+def test_live_spans_and_generation_stamp_roundtrip(tmp_path):
+    """Real RunTelemetry: spans land as events, a second generation
+    appending to the same log stamps generation=1, and the rebuilt ledger
+    attributes both generations' wall within tolerance."""
+    d = str(tmp_path)
+    with RunTelemetry(out_dir=d, run_name="g") as tel:
+        rs = tel.run_start()
+        assert rs["generation"] == 0
+        with span(tel, "data_wait", name="load"):
+            time.sleep(0.01)
+        with span(tel, "step", name="train"):
+            time.sleep(0.04)
+        tel.run_end()
+    with RunTelemetry(out_dir=d, run_name="g") as tel:
+        rs = tel.run_start()
+        assert rs["generation"] == 1, "second generation counts prior run_start"
+        with span(tel, "step", name="train"):
+            time.sleep(0.02)
+        end = tel.run_end()
+        assert end["generation"] == 1
+    events = read_events(tmp_path / "events.jsonl")
+    spans = [e for e in events if e["event"] == "span"]
+    assert {s["category"] for s in spans} == {"data_wait", "step"}
+    assert all("ts_start" in s and s["seconds"] >= 0 for s in spans)
+    assert all("mono" in e for e in events), "monotonic stamp on every record"
+    led = build_ledger(d)
+    assert led["n_generations"] == 2
+    assert led["categories"]["step"] >= 0.05
+    total = sum(led["categories"].values())
+    assert total == pytest.approx(led["wall_seconds"], abs=0.05)
+
+
+def test_span_category_validated():
+    with pytest.raises(ValueError):
+        span(None, "not_a_category")
+
+
+def test_span_without_live_telemetry_is_noop():
+    from sparse_coding__tpu.telemetry.spans import ACTIVE
+
+    s = span(None, "step").begin()
+    assert s.end() is None  # telemetry disabled: never leaks into other runs
+    s = span(ACTIVE, "step").begin()
+    assert s.end() is None  # broadcast sentinel with no live RunTelemetry
+
+
+def test_disabled_telemetry_span_never_leaks_into_live_run(tmp_path):
+    """A component with telemetry=None must NOT write its spans into some
+    other live RunTelemetry's log (broadcast is the explicit ACTIVE
+    sentinel, not the None default)."""
+    from sparse_coding__tpu.telemetry.spans import ACTIVE
+
+    with RunTelemetry(out_dir=str(tmp_path), run_name="host") as tel:
+        tel.run_start()
+        span(None, "export_verify", name="foreign").begin().end()
+        span(ACTIVE, "step", name="broadcast").begin().end()
+        tel.run_end()
+    events = read_events(tmp_path / "events.jsonl")
+    spans = [e for e in events if e["event"] == "span"]
+    assert [s.get("name") for s in spans] == ["broadcast"]
+
+
+def test_chunk_end_without_start_reports_none_not_zero(tmp_path, capsys):
+    """Satellite: a chunk_end with no matching chunk_start must emit
+    seconds=None (rendered n/a), never a fake 0 that skews means."""
+    with RunTelemetry(out_dir=str(tmp_path), run_name="torn") as tel:
+        tel.run_start()
+        rec = tel.chunk_end(0)
+        assert rec["seconds"] is None
+        tel.chunk_start(1)
+        tel.chunk_end(1)
+        tel.run_end()
+    from sparse_coding__tpu.report import main as report_main
+
+    assert report_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "(1 untimed)" in out
+    from sparse_coding__tpu.monitor import main as monitor_main
+
+    assert monitor_main([str(tmp_path), "--once"]) == 0
+
+
+def test_chunk_duration_survives_wall_clock_step(tmp_path, monkeypatch):
+    """Satellite: durations are monotonic-derived — an NTP step between
+    chunk_start and chunk_end cannot produce a negative/huge window."""
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="ntp")
+    try:
+        tel.run_start()
+        tel.chunk_start(0)
+        real = time.time
+        monkeypatch.setattr(time, "time", lambda: real() - 3600.0)
+        rec = tel.chunk_end(0)
+        monkeypatch.setattr(time, "time", real)
+        assert 0.0 <= rec["seconds"] < 5.0, "an hour-long NTP step must not leak in"
+        tel.run_end()
+    finally:
+        tel.close()
+
+
+def test_resumed_run_report_sums_wall_across_generations(capsys):
+    """Satellite regression (the `run_end.wall` bug): the report on the
+    golden resumed run must show the per-generation ends AND the summed
+    total (8.1 + 6.2 s), plus a Goodput section for the multi-generation
+    run."""
+    from sparse_coding__tpu.report import main as report_main
+
+    assert report_main([str(RESUMED)]) == 0
+    out = capsys.readouterr().out
+    assert "total across 2 generations" in out
+    assert "14.3" in out, "8.1 + 6.2 summed, not just the last generation"
+    assert "## Goodput" in out
+
+
+def test_fleet_reassignment_gaps_from_lineage():
+    """The golden fleet fixture's lineage (w0 loses g0 at t+40, w1 claims
+    at t+45; w2 churns g1) must surface as reassign_gap badput."""
+    fleet = Path(__file__).parent / "golden" / "fleet_run"
+    led = build_ledger(fleet)
+    assert led["categories"].get("reassign_gap", 0) > 0
+    gaps = led["reassignment_gaps"]
+    assert any(g["item"] == "g0" and g["seconds"] == pytest.approx(5.0, abs=0.01)
+               for g in gaps)
+
+
+def test_one_restart_record_joins_exactly_one_gap():
+    """Crash-loop shape (generations shorter than any slack window): each
+    restart's backoff must land in ITS gap only — stamped records join by
+    generation, legacy ones by containment, and either way a record is
+    consumed at most once."""
+    from sparse_coding__tpu.telemetry.goodput import build_ledger_from_streams
+
+    T = 1000.0
+
+    def gen(start, wall, idx, status="preempted"):
+        return [
+            {"seq": 1, "ts": start, "event": "run_start", "run_name": "x",
+             "generation": idx},
+            {"seq": 2, "ts": start + wall, "event": "preempt"},
+            {"seq": 3, "ts": start + wall, "event": "run_end", "status": status,
+             "generation": idx, "wall_seconds": wall},
+        ]
+
+    records = gen(T, 10, 0) + gen(T + 13, 5, 1) + gen(T + 21, 5, 2, status="ok")
+    restarts = [
+        {"seq": 2, "ts": T + 12.5, "event": "restart", "generation": 1,
+         "backoff_seconds": 2.0},
+        {"seq": 3, "ts": T + 20.5, "event": "restart", "generation": 2,
+         "backoff_seconds": 2.0},
+    ]
+
+    def streams():
+        return [
+            {"file": "events.jsonl", "records": records,
+             "process_index": 0, "supervisor": False},
+            {"file": "supervisor_events.jsonl",
+             "records": [{"seq": 1, "ts": T - 1, "event": "run_start",
+                          "run_name": "supervisor"}] + restarts,
+             "process_index": 0, "supervisor": True},
+        ]
+
+    led = build_ledger_from_streams(streams())
+    assert led["categories"]["restart_backoff"] == pytest.approx(4.0)
+    assert led["categories"]["preempted_down"] == pytest.approx(2.0)
+    # legacy records without generation stamps: timestamp containment +
+    # the used-set give the same split
+    for r in restarts:
+        r.pop("generation")
+    led = build_ledger_from_streams(streams())
+    assert led["categories"]["restart_backoff"] == pytest.approx(4.0)
+    assert led["categories"]["preempted_down"] == pytest.approx(2.0)
+
+
+# -- chaos: real SIGTERM → supervised resume → gap is preemption badput -------
+
+@pytest.mark.chaos
+def test_sigterm_resume_gap_is_preemption_badput(tmp_path, monkeypatch):
+    """A REAL SIGTERM (SC_FAULT=sigterm:chunk=1, delivered through the OS)
+    kills a supervised smoke-scale `basic_l1_sweep` mid-run; the supervisor
+    restarts it after backoff and it finishes. The rebuilt ledger must show
+    two generations with the inter-generation gap classified as
+    restart_backoff + preempted_down — never goodput — and the supervisor
+    records stamped with the child's run_dir + generation."""
+    import jax
+    import numpy as np
+
+    from sparse_coding__tpu import supervise
+    from sparse_coding__tpu.data import RandomDatasetGenerator, save_chunk
+
+    gen = RandomDatasetGenerator(
+        activation_dim=16, n_ground_truth_components=32, batch_size=384,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    dataset = tmp_path / "chunks"
+    for i in range(3):
+        save_chunk(dataset, i, np.asarray(next(gen)))
+    out = tmp_path / "out"
+
+    monkeypatch.setenv("SC_FAULT", "sigterm:chunk=1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "PYTHONPATH", str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    monkeypatch.delenv("SC_RESUME", raising=False)
+    telemetry = RunTelemetry(
+        out_dir=str(out), run_name="supervisor",
+        file_name="supervisor_events.jsonl",
+    )
+    telemetry.run_start()
+    try:
+        rc = supervise.run_supervised(
+            [sys.executable, str(REPO / "tests" / "_preempt_worker.py"),
+             str(dataset), str(out)],
+            run_dir=str(out), backoff_base=0.3, jitter=0.0,
+            telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    assert rc == 0
+
+    # satellite: supervisor records carry the child's run_dir + generation
+    sup = read_events(out / "supervisor_events.jsonl")
+    restarts = [e for e in sup if e["event"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["run_dir"] == str(out)
+    assert restarts[0]["generation"] == 1
+    spawns = [e for e in sup if e["event"] == "spawn"]
+    assert [s["generation"] for s in spawns] == [0, 1]
+
+    led = build_ledger(out)
+    assert led["n_generations"] == 2
+    cats = led["categories"]
+    assert cats.get("step", 0) > 0, "span-instrumented driver goodput"
+    assert cats.get("restart_backoff", 0) >= 0.2, "supervisor backoff joined"
+    gap = cats.get("restart_backoff", 0) + cats.get("preempted_down", 0)
+    assert gap > 0.25, "the inter-generation gap is badput, not goodput"
+    # the sum-to-wall contract holds on a REAL run too
+    total = sum(cats.values())
+    assert total == pytest.approx(led["wall_seconds"], rel=0.02)
+
+    # surfaces render: Goodput report section + monitor goodput line
+    import io
+    from contextlib import redirect_stdout
+
+    from sparse_coding__tpu.monitor import main as monitor_main
+    from sparse_coding__tpu.report import main as report_main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert report_main([str(out)]) == 0
+    assert "## Goodput" in buf.getvalue()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert monitor_main([str(out), "--once"]) == 0
+    assert "goodput:" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_timeline_module_entrypoint_subprocess():
+    """`python -m sparse_coding__tpu.timeline --goodput-floor` end to end
+    (slow: one full interpreter + jax import); exit codes pinned."""
+    import subprocess
+
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin",
+           "HOME": "/tmp"}
+    ok = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.timeline", str(GOLDEN),
+         "--goodput-floor", "50"],
+        capture_output=True, text=True, cwd=REPO, timeout=240, env=env,
+    )
+    assert ok.returncode == 0, ok.stderr[-2000:]
+    assert "53.0%" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.timeline", str(GOLDEN),
+         "--goodput-floor", "90"],
+        capture_output=True, text=True, cwd=REPO, timeout=240, env=env,
+    )
+    assert bad.returncode == 1, (bad.returncode, bad.stdout, bad.stderr)

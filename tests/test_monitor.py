@@ -212,6 +212,44 @@ def test_monitor_anomaly_and_desync_lines(tmp_path, capsys):
     assert "anomalies: 1" in out
 
 
+def test_follow_mode_exits_when_all_processes_wrote_run_end(capsys):
+    """Follow mode (no --once) on a finished run dir must exit 0 on its own
+    — every discovered process already wrote run_end (ISSUE 9 satellite:
+    previously untested path)."""
+    rc = main([str(GOLDEN), "--interval", "0.01"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "all processes wrote run_end" in out
+    assert "p0" in out and "p1" in out
+
+
+def test_once_on_dir_with_only_torn_tail_line(tmp_path, capsys):
+    """--once on a run dir whose only event file holds a single torn line
+    (a writer mid-append, no newline yet): the tail buffers it — exit 0,
+    no MALFORMED, '(no events yet)' rendered (ISSUE 9 satellite:
+    previously untested path)."""
+    (tmp_path / "events.jsonl").write_text('{"seq": 1, "event": "run_st')
+    rc = main([str(tmp_path), "--once"])
+    captured = capsys.readouterr()
+    assert rc == 0, "a torn tail is not malformed"
+    assert "(no events yet)" in captured.out
+    assert "MALFORMED" not in captured.out
+
+
+GOODPUT = Path(__file__).parent / "golden" / "goodput_run"
+
+
+def test_monitor_goodput_line_on_span_instrumented_run(capsys):
+    """Runs that emit span events get a live `goodput:` line with the
+    per-category split (docs/observability.md §7)."""
+    assert main([str(GOODPUT), "--once"]) == 0
+    out = capsys.readouterr().out
+    goodput_lines = [l for l in out.splitlines() if l.strip().startswith("goodput:")]
+    assert goodput_lines, out
+    line = goodput_lines[0]
+    assert "step" in line and "data_wait" in line and "%" in line
+
+
 @pytest.mark.slow
 def test_monitor_module_entrypoint_subprocess():
     """`python -m sparse_coding__tpu.monitor --once` end to end (slow: one
