@@ -376,6 +376,77 @@ def prep_control(stack):
     return measure
 
 
+def prep_serve(stack, telemetry=None):
+    """Rows/sec through the online encode service (`serve/`, docs/SERVING.md):
+    a 4-dict multi-tenant registry behind the continuous micro-batching
+    engine, driven by `scripts/loadgen.py`'s closed-loop clients. The
+    returned measure is the MICRO-BATCHED path; ``measure.naive`` is the
+    same load through per-request dispatches at equal batch budget — the
+    ratio of their medians is the ``serve.speedup_vs_naive`` the ISSUE-10
+    acceptance pins at ≥3x (micro-batching amortizes dispatch overhead and
+    fills padding that per-request buckets waste).
+
+    Serve shape is deliberately smaller than the training bench shape: the
+    serving regime is dispatch-bound (many small requests), not
+    compute-bound — 2-row requests against 256→2048 dicts keep the compute
+    small enough that the dispatch amortization under measurement IS the
+    thing micro-batching exists to win."""
+    import sys
+    from pathlib import Path
+
+    import numpy as np
+
+    scripts_dir = str(Path(__file__).resolve().parent / "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from loadgen import run_load
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.engine import EncodeEngine
+    from sparse_coding__tpu.serve.registry import DictRegistry
+
+    D, NF, G = 256, 4096, 4
+    rng = np.random.default_rng(7)
+    registry = DictRegistry()
+    for i in range(G):
+        registry.add(
+            f"d{i}",
+            TiedSAE(
+                jnp.asarray(rng.standard_normal((NF, D), dtype=np.float32)),
+                jnp.zeros((NF,)),
+            ),
+            hyperparams={"bench_lane": i},
+        )
+    engine = EncodeEngine(
+        registry, max_batch=256, max_wait_ms=3.0, telemetry=telemetry
+    ).start()
+    stack.callback(engine.stop)
+    engine.warmup()
+    load_kw = dict(
+        dict_ids=registry.ids(), n_clients=32, requests_per_client=8,
+        rows_per_request=2, width=D,
+    )
+    # warm BOTH paths (naive G=1 stacks compile on first use; thread pools
+    # and jnp.asarray caches warm too) so round 1 isn't a cold outlier
+    run_load(engine.encode, seed=1234, **load_kw)
+    run_load(engine.encode_naive, seed=1234, **load_kw)
+    lat_rounds: list = []
+
+    def measure() -> float:
+        r = run_load(engine.encode, seed=len(lat_rounds), **load_kw)
+        lat_rounds.append(r)
+        return r["rows_per_sec"]
+
+    def measure_naive() -> float:
+        return run_load(engine.encode_naive, seed=99, **load_kw)["rows_per_sec"]
+
+    measure.naive = measure_naive
+    measure.lat_rounds = lat_rounds
+    measure.engine = engine
+    measure.n_dicts = G
+    return measure
+
+
 def prep_bigbatch(stack):
     """acts/s of the SAME flagship ensemble at batch 16384 through the
     batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
@@ -520,6 +591,9 @@ def main(argv=None):
             "control_matmul_tflops": prep_control(stack),
             "bigbatch16k_acts_per_sec": prep_bigbatch(stack),
         }
+        serve_measure = prep_serve(stack, telemetry=telemetry)
+        benches["serve_rows_per_sec"] = serve_measure
+        benches["serve_naive_rows_per_sec"] = serve_measure.naive
         samples = {k: [] for k in ["headline", *benches]}
         # per-key HBM watermark samples (satellite: BENCH_r*.json must track
         # memory, not just throughput). Sampled AFTER each key's timed
@@ -574,6 +648,28 @@ def main(argv=None):
         out["bigbatch16k_acts_per_sec"] * flops_per_act / (peak * 1e12), 3
     )
     out["control_fraction_of_peak"] = round(out["control_matmul_tflops"] / peak, 3)
+    # serving block (docs/SERVING.md): latency percentiles are the median of
+    # each round's closed-loop percentile (same interleaved-window protocol
+    # as every other key), speedup is the ratio of the two gated medians
+    lat_rounds = serve_measure.lat_rounds
+    if lat_rounds and medians.get("serve_naive_rows_per_sec"):
+        med = lambda key: sorted(r[key] for r in lat_rounds)[len(lat_rounds) // 2]
+        stats = serve_measure.engine.stats
+        out["serve"] = {
+            "p50_ms": round(med("p50_ms"), 3),
+            "p95_ms": round(med("p95_ms"), 3),
+            "p99_ms": round(med("p99_ms"), 3),
+            "requests_per_sec": round(med("requests_per_sec"), 1),
+            "speedup_vs_naive": round(
+                medians["serve_rows_per_sec"] / medians["serve_naive_rows_per_sec"], 2
+            ),
+            "n_dicts": serve_measure.n_dicts,
+            "batch_budget": serve_measure.engine.max_batch,
+            "batch_occupancy": round(
+                stats["rows"] / max(1, stats["rows"] + stats["padded_rows"]), 3
+            ),
+            "compiled_steps": len(serve_measure.engine.compiled_shapes),
+        }
     # per-key HBM watermarks (median in-use / max peak observed right after
     # that key's windows; absent on backends without memory_stats). peak is
     # a process-global high-water mark, so with interleaved rounds a key's

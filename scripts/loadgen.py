@@ -1,0 +1,230 @@
+"""Closed-loop synthetic load generator for the serving path (ISSUE 10).
+
+Drives the encode service — in-process (`EncodeEngine`) or over HTTP
+(`ServeClient`) — with N client threads in *closed loop*: each client sends
+its next request only after the previous one returned, the standard
+latency-measurement discipline (open-loop generators overstate achievable
+throughput and understate latency under queueing).
+
+Output: a JSON blob with sustained throughput (rows/s, requests/s), a
+latency histogram (log-spaced buckets), and p50/p95/p99 — the numbers
+`bench.py`'s ``serve`` key reports and `perfdiff.py` gates.
+
+CLI::
+
+    python scripts/loadgen.py --url http://127.0.0.1:8777 --dict d0 \
+        --clients 8 --requests 64 --rows 4 --width 512
+    python scripts/loadgen.py --export out/learned_dicts.pkl --clients 8 ...
+
+Importable: `run_load` / `latency_stats` are what bench and the serve tests
+call directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+__all__ = ["latency_stats", "latency_histogram", "run_load", "main"]
+
+# single nearest-rank implementation: the engine's SLO gauges and the
+# loadgen's reported percentiles must never diverge
+from sparse_coding__tpu.serve.engine import _percentile
+
+
+def latency_stats(latencies_ms: Sequence[float]) -> Dict[str, float]:
+    """p50/p95/p99 (nearest-rank), mean, max over a latency sample."""
+    lat = sorted(float(v) for v in latencies_ms)
+    if not lat:
+        return {"n": 0, "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                "mean_ms": 0.0, "max_ms": 0.0}
+    return {
+        "n": len(lat),
+        "p50_ms": round(_percentile(lat, 0.50), 3),
+        "p95_ms": round(_percentile(lat, 0.95), 3),
+        "p99_ms": round(_percentile(lat, 0.99), 3),
+        "mean_ms": round(sum(lat) / len(lat), 3),
+        "max_ms": round(lat[-1], 3),
+    }
+
+
+def latency_histogram(
+    latencies_ms: Sequence[float], n_buckets: int = 12, base_ms: float = 0.25
+) -> List[Dict[str, Any]]:
+    """Log-spaced latency buckets (each bound 2x the previous): the shape a
+    dashboard heatmap wants, cheap enough to print in a terminal."""
+    bounds = [base_ms * (2 ** i) for i in range(n_buckets)]
+    counts = [0] * (n_buckets + 1)
+    for v in latencies_ms:
+        for i, b in enumerate(bounds):
+            if v <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    out = []
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        if counts[i]:
+            out.append({"le_ms": round(b, 3), "gt_ms": round(lo, 3),
+                        "count": counts[i]})
+        lo = b
+    if counts[-1]:
+        out.append({"le_ms": None, "gt_ms": round(lo, 3), "count": counts[-1]})
+    return out
+
+
+def run_load(
+    encode_fn: Callable[[str, np.ndarray], np.ndarray],
+    dict_ids: Sequence[str],
+    n_clients: int = 8,
+    requests_per_client: int = 32,
+    rows_per_request: int = 4,
+    width: int = 512,
+    seed: int = 0,
+    histogram: bool = False,
+) -> Dict[str, Any]:
+    """Closed-loop load: ``n_clients`` threads, each sending
+    ``requests_per_client`` encodes of ``rows_per_request`` rows round-robin
+    across ``dict_ids``, next request only after the previous returned.
+
+    ``encode_fn(dict_id, rows) -> codes`` may raise; exceptions whose type
+    name contains "Retryable"/"EngineClosed" count as ``rejected`` (the
+    clean drain hand-back), anything else as ``errors``. Returns the stats
+    blob described in the module docstring."""
+    rng = np.random.default_rng(seed)
+    # pre-generate request payloads so generation cost never pollutes timing
+    payloads = [
+        rng.standard_normal((rows_per_request, width)).astype(np.float32)
+        for _ in range(min(64, n_clients * requests_per_client))
+    ]
+    latencies: List[float] = []
+    counts = {"ok": 0, "rejected": 0, "errors": 0, "rows": 0}
+    lock = threading.Lock()
+
+    def client(cid: int) -> None:
+        for i in range(requests_per_client):
+            did = dict_ids[(cid + i) % len(dict_ids)]
+            rows = payloads[(cid * requests_per_client + i) % len(payloads)]
+            t0 = time.monotonic()
+            try:
+                encode_fn(did, rows)
+            except Exception as e:
+                kind = type(e).__name__
+                with lock:
+                    if "Retryable" in kind or "EngineClosed" in kind:
+                        counts["rejected"] += 1
+                    else:
+                        counts["errors"] += 1
+                continue
+            dt_ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                latencies.append(dt_ms)
+                counts["ok"] += 1
+                counts["rows"] += rows.shape[0]
+
+    threads = [
+        threading.Thread(target=client, args=(c,), name=f"loadgen-{c}")
+        for c in range(n_clients)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    out: Dict[str, Any] = {
+        "clients": n_clients,
+        "requests": counts["ok"],
+        "rejected": counts["rejected"],
+        "errors": counts["errors"],
+        "rows": counts["rows"],
+        "wall_seconds": round(wall, 4),
+        "rows_per_sec": round(counts["rows"] / wall, 1) if wall > 0 else 0.0,
+        "requests_per_sec": round(counts["ok"] / wall, 1) if wall > 0 else 0.0,
+        **latency_stats(latencies),
+    }
+    if histogram:
+        out["histogram"] = latency_histogram(latencies)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    target = ap.add_mutually_exclusive_group(required=True)
+    target.add_argument("--url", help="serve server base URL (HTTP mode)")
+    target.add_argument(
+        "--export",
+        help="learned-dict export path — spin up an IN-PROCESS engine "
+        "(no HTTP) and drive it directly",
+    )
+    ap.add_argument("--dict", dest="dicts", action="append", default=None,
+                    help="dict id(s) to target (default: all registered)")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=32,
+                    help="requests per client")
+    ap.add_argument("--rows", type=int, default=4, help="rows per request")
+    ap.add_argument("--width", type=int, default=None,
+                    help="activation width (default: read from /dicts or "
+                    "the loaded export)")
+    ap.add_argument("--max-batch", type=int, default=256,
+                    help="in-process engine batch budget")
+    ap.add_argument("--naive", action="store_true",
+                    help="in-process mode: drive the naive per-request path "
+                    "instead of the micro-batched engine")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.url:
+        from sparse_coding__tpu.serve.server import ServeClient
+
+        client = ServeClient(args.url)
+        dicts = args.dicts or [d["dict"] for d in client.dicts()]
+        width = args.width
+        if width is None:
+            width = next(
+                d["activation_size"] for d in client.dicts()
+                if d["dict"] == dicts[0]
+            )
+        encode_fn = client.encode
+        result = run_load(
+            encode_fn, dicts, n_clients=args.clients,
+            requests_per_client=args.requests, rows_per_request=args.rows,
+            width=width, seed=args.seed, histogram=True,
+        )
+    else:
+        from sparse_coding__tpu.serve.engine import EncodeEngine
+        from sparse_coding__tpu.serve.registry import DictRegistry
+
+        registry = DictRegistry()
+        registry.load_export(args.export)
+        dicts = args.dicts or registry.ids()
+        width = args.width or registry.get(dicts[0]).activation_size
+        engine = EncodeEngine(registry, max_batch=args.max_batch).start()
+        engine.warmup()
+        try:
+            encode_fn = engine.encode_naive if args.naive else engine.encode
+            result = run_load(
+                encode_fn, dicts, n_clients=args.clients,
+                requests_per_client=args.requests, rows_per_request=args.rows,
+                width=width, seed=args.seed, histogram=True,
+            )
+        finally:
+            engine.stop()
+    print(json.dumps(result, indent=1))
+    return 0 if result["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -407,6 +407,109 @@ def make_goodput_run_fixture():
     print(f"Wrote {GOODPUT_RUN_DIR}/events.jsonl + supervisor_events.jsonl")
 
 
+SERVE_RUN_DIR = REPO / "tests" / "golden" / "serve_run"
+SERVE_BASE_TS = 1_754_500_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_serve_run_fixture():
+    """Deterministic serving-run fixture (ISSUE 10 satellite): a
+    hand-stamped `serve` event log pinning the report "Serving" section and
+    the monitor `serve:` line, plus a bench-style JSON pinning the bench
+    ``serve`` block schema for the tier-1 perfdiff smoke.
+
+    Hand-stamped, not a real run — golden fixtures must be byte-stable.
+    The shape mirrors what `serve.server` writes across one load + SIGTERM
+    drain: 4 dicts registered, 96 requests drained into 12 micro-batches
+    (request_wait/encode/dequant spans, serve.* counters + SLO gauges in
+    the closing snapshot), then a clean drain."""
+    SERVE_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    T = SERVE_BASE_TS
+    seq = 0
+
+    def rec(ts, event, **fields):
+        nonlocal seq
+        seq += 1
+        return {"seq": seq, "ts": round(ts, 3), "event": event, **fields}
+
+    def span_rec(ts_start, seconds, category, name, **fields):
+        return rec(ts_start + seconds, "span", category=category, name=name,
+                   ts_start=round(ts_start, 3), seconds=seconds, **fields)
+
+    fp = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+          "device_kind": "golden-cpu", "device_count": 1, "git_sha": "g0lden"}
+    events = [
+        rec(T, "run_start", run_name="serve", generation=0,
+            config={"exports": ["out/learned_dicts.pkl"], "weights": "native",
+                    "max_batch": 128, "max_wait_ms": 2.0,
+                    "dicts": ["d0", "d1", "d2", "d3"]},
+            fingerprint=fp),
+    ]
+    for i in range(4):
+        events.append(rec(
+            T + 0.1 + 0.01 * i, "serve_dict_added", dict=f"d{i}",
+            weights="native", source="out/learned_dicts.pkl",
+        ))
+    # 12 micro-batches over ~6 s: each 8 requests x 2 rows -> bucket 16
+    t = T + 1.0
+    for b in range(12):
+        events.append(span_rec(t, 0.004, "request_wait", "queue",
+                               n_requests=8, mean_wait_ms=2.1))
+        events.append(span_rec(t + 0.004, 0.031, "encode",
+                               "encode_g4_b16", lanes=4, rows=16, bucket=16,
+                               n_requests=8))
+        t += 0.5
+    # one int8-resident batch rides a dequant span — NESTED inside its
+    # encode window, exactly as the engine emits it (the dequant dispatch
+    # happens inside the timed encode window in `_run_group`); the ledger's
+    # innermost-wins sweep must attribute the overlap to dequant
+    events.append(span_rec(t, 0.006, "dequant", "dequant_int8", lanes=4))
+    events.append(span_rec(t, 0.040, "encode", "encode_g4_b16",
+                           lanes=4, rows=16, bucket=16, n_requests=8))
+    counters = {
+        "serve.requests": 96, "serve.rows": 192, "serve.batches": 13,
+        "serve.padded_rows": 16, "serve.rejected": 2, "serve.errors": 0,
+        "serve.compiles": 3,
+        "span.request_wait.count": 12, "span.request_wait.seconds": 0.048,
+        "span.encode.count": 13, "span.encode.seconds": 0.412,
+        "span.dequant.count": 1, "span.dequant.seconds": 0.006,
+    }
+    gauges = {
+        "serve.queue_depth": 0, "serve.batch_occupancy": 0.875,
+        "serve.latency_p50_ms": 8.3, "serve.latency_p95_ms": 14.9,
+        "serve.latency_p99_ms": 21.4,
+    }
+    events.append(rec(T + 8.0, "serve_drain", queue_depth=3))
+    events.append(rec(T + 8.4, "serve_drained", signum=15, requests=96))
+    events.append(rec(T + 8.5, "snapshot", counters=counters, gauges=gauges))
+    events.append(rec(T + 8.6, "run_end", status="drained", run_name="serve",
+                      generation=0, wall_seconds=8.6))
+    with open(SERVE_RUN_DIR / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # bench-style JSON pinning the serve block schema for perfdiff: medians
+    # + spreads for the two gated keys, the pinned control, and the derived
+    # `serve` dict (which perfdiff ignores — only *_spread keys gate)
+    bench = {
+        "metric": "serve_fixture (golden: schema pin for the bench serve block)",
+        "control_matmul_tflops": 0.21,
+        "control_matmul_tflops_spread": [0.2, 0.22],
+        "serve_rows_per_sec": 420.0,
+        "serve_rows_per_sec_spread": [395.0, 445.0],
+        "serve_naive_rows_per_sec": 100.0,
+        "serve_naive_rows_per_sec_spread": [92.0, 110.0],
+        "serve": {
+            "p50_ms": 8.3, "p95_ms": 14.9, "p99_ms": 21.4,
+            "requests_per_sec": 210.0, "speedup_vs_naive": 4.2,
+            "n_dicts": 4, "batch_budget": 128, "batch_occupancy": 0.875,
+            "compiled_steps": 3,
+        },
+    }
+    with open(SERVE_RUN_DIR / "bench_serve_fixture.json", "w") as f:
+        json.dump(bench, f, indent=1)
+    print(f"Wrote {SERVE_RUN_DIR}/events.jsonl + bench_serve_fixture.json")
+
+
 FLEET_RUN_DIR = REPO / "tests" / "golden" / "fleet_run"
 FLEET_BASE_TS = 1_754_400_000.0  # fixed: the fixture must regenerate identically
 
@@ -616,6 +719,9 @@ def main():
         return
     if "--goodput-run" in sys.argv:
         make_goodput_run_fixture()
+        return
+    if "--serve-run" in sys.argv:
+        make_serve_run_fixture()
         return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")

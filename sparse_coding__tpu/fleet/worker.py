@@ -51,8 +51,8 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from sparse_coding__tpu.fleet.queue import LeaseLost, WorkQueue, _write_json
-from sparse_coding__tpu.train.checkpoint import _sha256
+from sparse_coding__tpu.fleet.queue import LeaseLost, WorkQueue
+from sparse_coding__tpu.utils.manifest import verify_manifest, write_manifest
 
 __all__ = [
     "FleetWorker",
@@ -66,6 +66,9 @@ EXPORT_MANIFEST = "export_manifest.json"
 
 
 # -- learned-dict export verification -----------------------------------------
+# The manifest write/verify mechanics live in the shared `utils.manifest`
+# (ISSUE 10 satellite): fleet export commits and the serving registry's
+# admission checks consume ONE format.
 
 def _export_files(run_dir: Path) -> List[Path]:
     return sorted(run_dir.rglob("learned_dicts.pkl"))
@@ -73,43 +76,25 @@ def _export_files(run_dir: Path) -> List[Path]:
 
 def write_export_manifest(run_dir) -> Path:
     """Hash every learned-dict export under the run dir into
-    ``export_manifest.json`` (per-file bytes + sha256, atomic write via the
-    queue's shared `_write_json` commit idiom). The manifest is what turns
-    "the driver returned" into "the member's dict is provably on disk" —
+    ``export_manifest.json`` (per-file bytes + sha256, committed atomically
+    by `utils.manifest.write_manifest`). The manifest is what turns "the
+    driver returned" into "the member's dict is provably on disk" —
     completion requires it to verify."""
     run_dir = Path(run_dir)
-    files: Dict[str, Dict[str, Any]] = {}
-    for p in _export_files(run_dir):
-        rel = str(p.relative_to(run_dir))
-        files[rel] = {"bytes": p.stat().st_size, "sha256": _sha256(p)}
-    path = run_dir / EXPORT_MANIFEST
-    _write_json(path, {"format": 1, "created_at": time.time(), "files": files})
-    return path
+    files = {str(p.relative_to(run_dir)): p for p in _export_files(run_dir)}
+    return write_manifest(run_dir / EXPORT_MANIFEST, files)
 
 
 def verify_export(run_dir) -> Tuple[bool, str]:
     """Does every export file match the manifest (and does at least one
     export exist)? Returns (ok, reason)."""
-    import json
-
     run_dir = Path(run_dir)
-    try:
-        with open(run_dir / EXPORT_MANIFEST) as f:
-            manifest = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return False, "no export manifest"
-    files = manifest.get("files", {})
-    if not files:
-        return False, "manifest lists no exports"
-    for rel, meta in files.items():
-        p = run_dir / rel
-        if not p.is_file():
-            return False, f"missing export {rel}"
-        if p.stat().st_size != meta.get("bytes"):
-            return False, f"size mismatch on {rel}"
-        if _sha256(p) != meta.get("sha256"):
-            return False, f"digest mismatch on {rel}"
-    return True, "ok"
+    ok, reason = verify_manifest(run_dir / EXPORT_MANIFEST, base_dir=run_dir)
+    if not ok and reason == "no manifest":
+        reason = "no export manifest"
+    if not ok and reason == "manifest lists no files":
+        reason = "manifest lists no exports"
+    return ok, reason
 
 
 # -- item execution ------------------------------------------------------------

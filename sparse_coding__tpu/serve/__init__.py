@@ -1,0 +1,46 @@
+"""Online feature-inference serving over trained `LearnedDict`s (ISSUE 10).
+
+Everything else in this repo *trains* dictionaries; this package *serves*
+them — the "heavy traffic from millions of users" leg of the ROADMAP north
+star (docs/SERVING.md). Three layers:
+
+  - `serve.registry.DictRegistry` — manifest-verified loading of learned-dict
+    exports (the `utils.manifest` format fleet workers commit), hot
+    add/swap/remove, optional int8-resident weights via the chunk-quant
+    dequant tier.
+  - `serve.engine.EncodeEngine` — a persistent pre-compiled encode step with
+    continuous micro-batching: a request queue drained into padded
+    batch-size buckets (no per-request recompiles), multi-dict multi-tenancy
+    through the same vmapped fan-out the eval metrics use, per-request
+    slicing back out.
+  - `serve.server` — a stdlib `ThreadingHTTPServer` JSON API (``/encode``,
+    ``/dicts``, ``/healthz``) with graceful SIGTERM drain riding the PR-5
+    preemption machinery, plus `ServeClient` for tests and `loadgen`.
+"""
+
+__all__ = [
+    "DictRegistry",
+    "EncodeEngine",
+    "EngineClosed",
+    "ServeClient",
+    "ServeServer",
+]
+
+_EXPORTS = {
+    "DictRegistry": "sparse_coding__tpu.serve.registry",
+    "EncodeEngine": "sparse_coding__tpu.serve.engine",
+    "EngineClosed": "sparse_coding__tpu.serve.engine",
+    "ServeClient": "sparse_coding__tpu.serve.server",
+    "ServeServer": "sparse_coding__tpu.serve.server",
+}
+
+
+def __getattr__(name: str):
+    # lazy re-exports: `python -m sparse_coding__tpu.serve.server` must not
+    # trip runpy's found-in-sys.modules warning by importing the submodule
+    # from the package __init__
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

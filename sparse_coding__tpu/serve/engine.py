@@ -1,0 +1,597 @@
+"""Continuous micro-batching encode engine over a `DictRegistry`.
+
+The serving hot path (docs/SERVING.md). One drainer thread owns the device:
+
+  1. requests land in a queue (`submit` — thread-safe, called by the HTTP
+     handler threads or the in-process client);
+  2. the drainer pulls everything waiting (up to ``max_batch`` rows,
+     lingering ``max_wait_ms`` for stragglers so a lone request doesn't
+     monopolize a dispatch), groups requests by the registry's stack key,
+     concatenates their rows, and pads to the next *batch-size bucket* —
+     so the compiled-step cache only ever sees ``len(buckets) ×
+     len(groups)`` shapes, never a fresh shape per request;
+  3. each group dispatches ONE vmapped encode: same-shape dictionaries are
+     stacked on a leading axis (`metrics.standard`'s eval fan-out, reused
+     verbatim) and every request's rows are encoded through every stacked
+     dict in one program — multi-tenancy for the price of one dispatch;
+  4. per-request results are sliced back out (`[lane, start:end]`) and the
+     caller's future is resolved.
+
+Per-lane results are **bit-identical** to a single-dict encode of the same
+rows (tests/test_serve.py pins this): padding rows and widening the stack
+only add independent batch/vmap lanes, they never change a served row's
+arithmetic.
+
+int8-resident groups (``DictRegistry`` ``weights="int8"``) run a separate
+jitted dequant step per micro-batch — the chunk store's symmetric per-row
+absmax tier (`data.chunks`), fp16 intermediate, cast back to the native
+dtype — under a ``dequant`` span, so the report attributes residency's
+bandwidth cost honestly.
+
+Observability: ``request_wait`` / ``encode`` / ``dequant`` spans per
+micro-batch, ``serve.*`` counters (requests, rows, batches, padded rows,
+rejected, errors, compiles) and gauges (queue depth, batch occupancy,
+latency p50/p95/p99) on the telemetry bus — `monitor` renders them live,
+`report` renders the Serving section from them.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["EncodeEngine", "EngineClosed", "EncodeRequest", "default_buckets"]
+
+
+class EngineClosed(RuntimeError):
+    """Raised by `submit` once draining began — the retryable-503 signal."""
+
+
+def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
+    """Power-of-two padded batch sizes up to ``max_batch`` (always
+    included): the full shape menu the compiled-step cache can ever see."""
+    out: List[int] = []
+    b = min_bucket
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+def _emit_span(telemetry, category: str, name: str, ts_start: float,
+               seconds: float, **fields) -> None:
+    """A span record with an externally-measured duration (the engine knows
+    a request's enqueue time after the fact — `spans.Span` only measures
+    begin→end). Same counters + event schema as `Span.end`."""
+    if telemetry is None:
+        return
+    telemetry.counter_inc(f"span.{category}.count")
+    telemetry.counter_add_float(f"span.{category}.seconds", seconds)
+    telemetry.event(
+        "span", category=category, ts_start=round(ts_start, 6),
+        seconds=round(seconds, 6), name=name, **fields,
+    )
+
+
+class EncodeRequest:
+    """One in-flight encode: rows in, codes (or an error) out."""
+
+    __slots__ = ("dict_id", "rows", "t_enqueue_mono", "t_enqueue_wall",
+                 "done", "codes", "error", "latency_ms")
+
+    def __init__(self, dict_id: str, rows: np.ndarray):
+        self.dict_id = dict_id
+        self.rows = rows
+        self.t_enqueue_mono = time.monotonic()
+        self.t_enqueue_wall = time.time()
+        self.done = threading.Event()
+        self.codes: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.latency_ms: Optional[float] = None
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"encode request for {self.dict_id!r} timed out after {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.codes
+
+    def _resolve(self, codes: Optional[np.ndarray],
+                 error: Optional[BaseException] = None) -> None:
+        self.codes = codes
+        self.error = error
+        self.latency_ms = (time.monotonic() - self.t_enqueue_mono) * 1e3
+        self.done.set()
+
+
+# ONE vmapped encode program for every dictionary class: jit retraces per
+# (pytree structure, leaf shapes, batch shape) — which the bucket scheme
+# bounds to len(groups) × len(buckets) entries
+def _vmapped_encode_impl(stacked_ld, batch):
+    return jax.vmap(lambda d, b: d.encode(b), in_axes=(0, None))(stacked_ld, batch)
+
+
+_vmapped_encode = jax.jit(_vmapped_encode_impl)
+
+
+class _Stack:
+    """One group's stacked operand: dict ids in lane order + the stacked
+    pytree (native) or stacked quantized leaves + a dequant closure (int8)."""
+
+    __slots__ = ("ids", "stacked", "quant", "dequant_fn", "weights", "shape_key")
+
+    def __init__(self, entries):
+        self.ids = [e.dict_id for e in entries]
+        self.weights = entries[0].weights
+        example = entries[0]
+        if self.weights == "native":
+            self.stacked = jax.tree.map(
+                lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+                *[e.ld for e in entries],
+            )
+            self.quant = None
+            self.dequant_fn = None
+        else:
+            # int8 residency: the HBM-resident form is the quantized leaves;
+            # a jitted dequant (the chunk tier's math: fp16 intermediate,
+            # cast to the native dtype) rebuilds the fp stack per micro-batch
+            leaves_per_entry = [jax.tree.flatten(e.ld)[0] for e in entries]
+            treedef = example.treedef
+            qmeta = example.quant_leaves
+            is_quant = tuple(m is not None for m in qmeta)
+            dtypes = tuple(
+                None if m is None else jnp.dtype(m["dtype"]) for m in qmeta
+            )
+            packed: List[Any] = []
+            for i in range(len(qmeta)):
+                if is_quant[i]:
+                    packed.append((
+                        jnp.stack([e.quant_leaves[i]["q"] for e in entries]),
+                        jnp.stack([e.quant_leaves[i]["scales"] for e in entries]),
+                    ))
+                else:
+                    packed.append(jnp.stack([
+                        jnp.asarray(lv[i]) for lv in leaves_per_entry
+                    ]))
+            self.quant = tuple(packed)
+            self.stacked = None
+
+            def dequant(qleaves):
+                out = []
+                for i, leaf in enumerate(qleaves):
+                    if is_quant[i]:
+                        q, scales = leaf
+                        fp = (
+                            q.astype(jnp.float16)
+                            * scales[..., None].astype(jnp.float16)
+                        ).astype(dtypes[i])
+                        out.append(fp)
+                    else:
+                        out.append(leaf)
+                # unflatten each lane's leaves back into the class, stacked:
+                # leaves already carry the leading G axis, and unflatten only
+                # reattaches structure/aux — shape-agnostic for every
+                # registered LearnedDict
+                return jax.tree.unflatten(treedef, out)
+
+            self.dequant_fn = jax.jit(dequant)
+
+    @property
+    def size(self) -> int:
+        return len(self.ids)
+
+
+class EncodeEngine:
+    """See module docstring. Lifecycle: ``start()`` → submits → ``stop()``
+    (``drain=True`` completes everything already accepted — the graceful-
+    drain contract the server's SIGTERM path rides)."""
+
+    def __init__(
+        self,
+        registry,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        buckets: Optional[Sequence[int]] = None,
+        telemetry=None,
+        latency_window: int = 4096,
+    ):
+        self.registry = registry
+        self.telemetry = telemetry
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.buckets = tuple(sorted(buckets)) if buckets else default_buckets(self.max_batch)
+        if self.buckets[-1] < self.max_batch:
+            raise ValueError("largest bucket must cover max_batch")
+        self._q: "queue.Queue[Optional[EncodeRequest]]" = queue.Queue()
+        self._accepting = False
+        # serializes the accepting-check-then-enqueue in submit against the
+        # accepting-flip in stop: without it a submitter could enqueue AFTER
+        # stop's final queue sweep and block until its timeout instead of
+        # getting the clean EngineClosed
+        self._submit_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stacks: Dict[Tuple, _Stack] = {}
+        self._naive_stacks: Dict[str, Tuple[int, _Stack]] = {}
+        self._stacks_generation = -1
+        self._lock = threading.Lock()
+        self._latencies: List[float] = []  # ring buffer, _lock-guarded
+        self._latency_window = int(latency_window)
+        # (group shape signature, bucket) combinations dispatched so far —
+        # a new member here means XLA compiled a new program; a steady set
+        # under varied request sizes IS the no-per-request-recompile proof
+        self.compiled_shapes: set = set()
+        self.stats = {
+            "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
+            "rejected": 0, "errors": 0,
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "EncodeEngine":
+        if self._thread is not None:
+            return self
+        self._accepting = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="encode-engine"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop accepting and shut the drainer down. ``drain=True`` (the
+        graceful path) completes every request already accepted before the
+        thread exits; ``drain=False`` fails them with `EngineClosed`."""
+        with self._submit_lock:
+            # once this flip is visible no submit can enqueue (the lock
+            # orders every check-then-put against it), so the sentinel below
+            # is guaranteed to land after the last accepted request
+            self._accepting = False
+        if self._thread is None:
+            self._fail_pending(EngineClosed("engine never started"))
+            return
+        if not drain:
+            self._fail_pending(EngineClosed("engine stopped without drain"))
+        self._q.put(None)  # wake the drainer so it sees _accepting=False
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("encode engine failed to drain in time")
+        self._thread = None
+        self._fail_pending(EngineClosed("engine stopped"))
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None:
+                req._resolve(None, exc)
+
+    # -- submission ------------------------------------------------------------
+
+    def _validate(self, dict_id: str, rows) -> np.ndarray:
+        entry = self.registry.get(dict_id)  # KeyError → 404 upstream
+        arr = np.asarray(rows, dtype=np.float32)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2 or arr.shape[0] == 0:
+            raise ValueError(
+                f"rows must be [n, {entry.activation_size}], got {arr.shape}"
+            )
+        if arr.shape[1] != entry.activation_size:
+            raise ValueError(
+                f"dict {dict_id!r} encodes width {entry.activation_size}, "
+                f"got rows of width {arr.shape[1]}"
+            )
+        if arr.shape[0] > self.max_batch:
+            raise ValueError(
+                f"request of {arr.shape[0]} rows exceeds max_batch "
+                f"{self.max_batch} — split it client-side"
+            )
+        return arr
+
+    def submit(self, dict_id: str, rows) -> EncodeRequest:
+        """Enqueue one encode; returns the request future. Raises
+        `EngineClosed` when draining (the caller maps it to a retryable
+        503), `KeyError` for an unknown dict, `ValueError` for bad rows."""
+        arr = self._validate(dict_id, rows)
+        with self._submit_lock:
+            if not self._accepting:
+                with self._lock:
+                    self.stats["rejected"] += 1
+                if self.telemetry is not None:
+                    self.telemetry.counter_inc("serve.rejected")
+                raise EngineClosed(
+                    "engine is draining — retry against a live replica"
+                )
+            req = EncodeRequest(dict_id, arr)
+            self._q.put(req)
+        if self.telemetry is not None:
+            self.telemetry.gauge_set("serve.queue_depth", self._q.qsize())
+        return req
+
+    def encode(self, dict_id: str, rows, timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Blocking convenience wrapper around `submit`."""
+        return self.submit(dict_id, rows).result(timeout)
+
+    # -- the naive baseline (bench comparison) ---------------------------------
+
+    def encode_naive(self, dict_id: str, rows) -> np.ndarray:
+        """One dispatch for THIS request alone — the same bucket-padded
+        compiled step, stack of one, no batching with neighbors. The
+        baseline `bench.py`'s serve key compares the micro-batched path
+        against at equal batch budget."""
+        arr = self._validate(dict_id, rows)
+        stack = self._group_stack_for(dict_id, naive=True)
+        bucket = self._bucket_for(arr.shape[0])
+        padded = self._pad(arr, bucket)
+        out = self._dispatch(stack, padded)
+        return np.asarray(out[0, : arr.shape[0]])
+
+    # -- internals -------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    @staticmethod
+    def _pad(arr: np.ndarray, bucket: int) -> np.ndarray:
+        if arr.shape[0] == bucket:
+            return arr
+        out = np.zeros((bucket, arr.shape[1]), dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    def _rebuild_stacks(self) -> None:
+        gen, entries = self.registry.snapshot()
+        groups: Dict[Tuple, List] = {}
+        for e in entries.values():
+            groups.setdefault((e.group_key, e.weights), []).append(e)
+        self._stacks = {
+            key: _Stack(sorted(es, key=lambda e: e.dict_id))
+            for key, es in groups.items()
+        }
+        self._stacks_generation = gen
+
+    def _stacks_current(self) -> Dict[Tuple, _Stack]:
+        if self._stacks_generation != self.registry.generation:
+            self._rebuild_stacks()
+        return self._stacks
+
+    def _group_stack_for(self, dict_id: str, naive: bool = False) -> _Stack:
+        entry = self.registry.get(dict_id)
+        if naive:
+            # cached per generation so the naive baseline doesn't pay a
+            # re-stack per request the batched path doesn't pay either
+            cached = self._naive_stacks.get(dict_id)
+            if cached is not None and cached[0] == self.registry.generation:
+                return cached[1]
+            stack = _Stack([entry])
+            self._naive_stacks[dict_id] = (self.registry.generation, stack)
+            return stack
+        stacks = self._stacks_current()
+        return stacks[(entry.group_key, entry.weights)]
+
+    def _dispatch(self, stack: _Stack, padded: np.ndarray) -> jax.Array:
+        """Run one micro-batch through the group's compiled step (dequant
+        first for int8-resident groups), fenced by fetching the result."""
+        batch = jnp.asarray(padded)
+        if stack.weights == "int8":
+            t0 = time.time()
+            t0m = time.monotonic()
+            stacked = stack.dequant_fn(stack.quant)
+            jax.block_until_ready(jax.tree.leaves(stacked)[0])
+            _emit_span(
+                self.telemetry, "dequant", "dequant_int8", t0,
+                time.monotonic() - t0m, lanes=stack.size,
+            )
+        else:
+            stacked = stack.stacked
+        key = ("encode", stack.weights, stack.size, padded.shape)
+        if key not in self.compiled_shapes:
+            self.compiled_shapes.add(key)
+            if self.telemetry is not None:
+                self.telemetry.counter_inc("serve.compiles")
+        out = _vmapped_encode(stacked, batch)
+        return out
+
+    def _drain_once(self, block_s: float) -> bool:
+        """One scheduler cycle. Returns False when the engine should exit
+        (sentinel seen / stopped and queue empty)."""
+        try:
+            first = self._q.get(timeout=block_s)
+        except queue.Empty:
+            return self._accepting or not self._q.empty()
+        if first is None:
+            # sentinel: only exit once the queue is fully drained
+            return not self._q.empty()
+        batch_reqs: List[EncodeRequest] = [first]
+        rows_budget = self.max_batch - first.rows.shape[0]
+        deadline = time.monotonic() + self.max_wait_ms / 1e3
+        saw_sentinel = False
+        while rows_budget > 0:
+            wait = deadline - time.monotonic()
+            try:
+                nxt = self._q.get(timeout=max(0.0, wait) if wait > 0 else 0.0)
+            except queue.Empty:
+                break
+            if nxt is None:
+                saw_sentinel = True
+                break
+            if nxt.rows.shape[0] > rows_budget:
+                # over budget: hand it back for the next cycle (order within
+                # a dict's stream is preserved by per-request slicing, not
+                # queue position)
+                self._q.put(nxt)
+                break
+            batch_reqs.append(nxt)
+            rows_budget -= nxt.rows.shape[0]
+        try:
+            self._process(batch_reqs)
+        except Exception as e:
+            # the drainer must NEVER die: an unexpected failure resolves the
+            # whole batch with the error and the loop keeps serving
+            for r in batch_reqs:
+                if not r.done.is_set():
+                    self._record_error(r, e)
+        if saw_sentinel:
+            return not self._q.empty()
+        return True
+
+    def _process(self, reqs: List[EncodeRequest]) -> None:
+        t_drain_wall = time.time()
+        t_drain_mono = time.monotonic()
+        # one request_wait span per drained batch: the WINDOW from the
+        # earliest enqueue to the drain — per-request waits overlap, and
+        # the ledger must not double-count wall time
+        oldest = min(r.t_enqueue_mono for r in reqs)
+        waits_ms = [(t_drain_mono - r.t_enqueue_mono) * 1e3 for r in reqs]
+        _emit_span(
+            self.telemetry, "request_wait", "queue",
+            min(r.t_enqueue_wall for r in reqs), t_drain_mono - oldest,
+            n_requests=len(reqs),
+            mean_wait_ms=round(sum(waits_ms) / len(waits_ms), 3),
+        )
+        by_group: Dict[Tuple, List[EncodeRequest]] = {}
+        for r in reqs:
+            try:
+                entry = self.registry.get(r.dict_id)
+                by_group.setdefault((entry.group_key, entry.weights), []).append(r)
+            except KeyError as e:
+                # removed between submit and drain (hot remove under load)
+                self._record_error(r, e)
+        stacks = self._stacks_current()
+        for key, group_reqs in by_group.items():
+            stack = stacks.get(key)
+            if stack is None:
+                # registry mutated between lookup and stack build: retry once
+                self._rebuild_stacks()
+                stack = self._stacks.get(key)
+            if stack is None:
+                for r in group_reqs:
+                    self._record_error(r, KeyError(r.dict_id))
+                continue
+            self._run_group(stack, group_reqs, t_drain_wall)
+
+    def _run_group(self, stack: _Stack, reqs: List[EncodeRequest],
+                   t_wall: float) -> None:
+        # a dict can be hot-removed between grouping and here while its
+        # group key survives (same-shape siblings remain): those requests
+        # error out; the rest of the batch still serves
+        lane_of = {did: i for i, did in enumerate(stack.ids)}
+        orphans = [r for r in reqs if r.dict_id not in lane_of]
+        for r in orphans:
+            self._record_error(r, KeyError(r.dict_id))
+        reqs = [r for r in reqs if r.dict_id in lane_of]
+        if not reqs:
+            return
+        rows = np.concatenate([r.rows for r in reqs], axis=0)
+        bucket = self._bucket_for(rows.shape[0])
+        padded = self._pad(rows, bucket)
+        try:
+            t0_wall, t0 = time.time(), time.monotonic()
+            out = self._dispatch(stack, padded)
+            out.block_until_ready()
+            _emit_span(
+                self.telemetry, "encode", f"encode_g{stack.size}_b{bucket}",
+                t0_wall, time.monotonic() - t0,
+                lanes=stack.size, rows=int(rows.shape[0]), bucket=bucket,
+                n_requests=len(reqs),
+            )
+        except Exception as e:  # a failed dispatch must not kill the drainer
+            for r in reqs:
+                self._record_error(r, e)
+            return
+        start = 0
+        for r in reqs:
+            n = r.rows.shape[0]
+            lane = lane_of[r.dict_id]
+            r._resolve(np.asarray(out[lane, start : start + n]))
+            start += n
+        self._note_served(reqs, rows.shape[0], bucket)
+
+    def _record_error(self, req: EncodeRequest, exc: BaseException) -> None:
+        with self._lock:
+            self.stats["errors"] += 1
+        if self.telemetry is not None:
+            self.telemetry.counter_inc("serve.errors")
+        req._resolve(None, exc)
+
+    def _note_served(self, reqs: List[EncodeRequest], n_rows: int,
+                     bucket: int) -> None:
+        with self._lock:
+            self.stats["requests"] += len(reqs)
+            self.stats["rows"] += n_rows
+            self.stats["batches"] += 1
+            self.stats["padded_rows"] += bucket - n_rows
+            self._latencies.extend(
+                r.latency_ms for r in reqs if r.latency_ms is not None
+            )
+            if len(self._latencies) > self._latency_window:
+                self._latencies = self._latencies[-self._latency_window :]
+            lat = sorted(self._latencies)
+        if self.telemetry is not None:
+            self.telemetry.counter_inc("serve.requests", len(reqs))
+            self.telemetry.counter_inc("serve.rows", n_rows)
+            self.telemetry.counter_inc("serve.batches")
+            self.telemetry.counter_inc("serve.padded_rows", bucket - n_rows)
+            self.telemetry.gauge_set("serve.queue_depth", self._q.qsize())
+            self.telemetry.gauge_set("serve.batch_occupancy", n_rows / bucket)
+            self.telemetry.gauge_set("serve.latency_p50_ms", _percentile(lat, 0.50))
+            self.telemetry.gauge_set("serve.latency_p95_ms", _percentile(lat, 0.95))
+            self.telemetry.gauge_set("serve.latency_p99_ms", _percentile(lat, 0.99))
+
+    def _loop(self) -> None:
+        while self._drain_once(block_s=0.05):
+            pass
+
+    # -- warmup / introspection ------------------------------------------------
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> int:
+        """Pre-compile the encode (and dequant) step for every registered
+        group × bucket, so the first real request never pays a compile.
+        Returns the number of programs dispatched."""
+        n = 0
+        for stack in self._stacks_current().values():
+            width = None
+            for did in stack.ids:
+                width = self.registry.get(did).activation_size
+                break
+            for b in buckets or self.buckets:
+                batch = np.zeros((int(b), int(width)), dtype=np.float32)
+                self._dispatch(stack, batch).block_until_ready()
+                n += 1
+        return n
+
+    def latency_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+        return {
+            "n": len(lat),
+            "p50_ms": _percentile(lat, 0.50),
+            "p95_ms": _percentile(lat, 0.95),
+            "p99_ms": _percentile(lat, 0.99),
+        }
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()

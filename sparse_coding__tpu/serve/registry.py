@@ -1,0 +1,319 @@
+"""Serving-side dictionary registry: verified loads, hot swap, int8 residency.
+
+The registry is the serving process's source of truth for *which*
+dictionaries exist and *what bytes back them*:
+
+  - **Verified loads.** `load_export` accepts either a single
+    ``learned_dicts.pkl`` (verified against its `utils.manifest` sidecar —
+    the format `save_learned_dicts` now emits by default) or a fleet run
+    directory carrying an ``export_manifest.json`` (`fleet.worker`'s commit
+    format). Legacy manifest-less exports still load, with a warning — the
+    same compatibility contract as `load_learned_dicts`.
+  - **Hot add/swap.** `add`/`swap`/`remove` mutate the registry under a lock
+    and bump a ``generation`` counter; the engine rebuilds its stacked
+    operands lazily when the generation moves, so a dictionary can be
+    replaced under live traffic without restarting the server (in-flight
+    batches finish on the stack they started with).
+  - **int8 residency.** ``weights="int8"`` quantizes every 2-D weight leaf
+    with the chunk store's symmetric per-row absmax tier
+    (`data.chunks.quantize_rows_int8`) and keeps the quantized bytes as the
+    HBM-resident form; the engine dequantizes per micro-batch with the same
+    dequant math the int8 chunk tier uses, under a ``dequant`` span. Half
+    the resident bytes per dictionary — the knob that doubles how many
+    dictionaries one chip can serve.
+
+Multi-tenancy grouping rides the eval fan-out's stacking rule
+(`metrics.standard.group_stackable_dicts`): dicts with identical pytree
+structure + leaf shapes/dtypes share a ``group_key`` and are encoded by one
+vmapped compiled step.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ServedDict", "DictRegistry", "group_key_of"]
+
+
+def group_key_of(ld) -> Tuple[str, Tuple]:
+    """The stackability key (pytree structure + leaf shapes/dtypes) — two
+    dicts with equal keys can ride one vmapped encode program. Mirrors
+    `metrics.standard.group_stackable_dicts`."""
+    leaves, treedef = jax.tree.flatten(ld)
+    return (
+        str(treedef),
+        tuple((tuple(jnp.shape(l)), str(jnp.result_type(l))) for l in leaves),
+    )
+
+
+def _quantize_leaf(leaf: jax.Array):
+    """int8-resident form of one leaf: 2-D floating leaves get the chunk
+    store's symmetric per-row absmax tier; everything else (biases,
+    scalars, RNG keys) stays as-is — their bytes are negligible and
+    quantizing a bias buys nothing.
+
+    Floating-ness is decided by `jnp.issubdtype`, NOT numpy's dtype.kind:
+    ml_dtypes bfloat16 (the repo's default training dtype) reports kind
+    'V', which would silently skip quantization for exactly the
+    dictionaries residency matters most for."""
+    from sparse_coding__tpu.data.chunks import quantize_rows_int8
+
+    try:
+        dt = jnp.result_type(leaf)
+    except TypeError:
+        return None
+    if jnp.ndim(leaf) != 2 or not jnp.issubdtype(dt, jnp.floating) or not jnp.size(leaf):
+        return None
+    # quantize in fp32 (quantize_rows_int8 upcasts internally); the stored
+    # dtype string restores the NATIVE dtype at dequant time
+    arr = np.asarray(jax.device_get(leaf), dtype=np.float32)
+    q, scales = quantize_rows_int8(arr)
+    return {
+        "q": jnp.asarray(q),
+        "scales": jnp.asarray(scales),
+        "dtype": str(dt),
+    }
+
+
+class ServedDict:
+    """One registered dictionary: the LearnedDict, its serving metadata, and
+    (when int8-resident) the quantized leaf forms the engine dequantizes
+    per batch."""
+
+    __slots__ = (
+        "dict_id", "ld", "hyperparams", "source", "weights", "group_key",
+        "quant_leaves", "treedef", "n_feats", "activation_size",
+    )
+
+    def __init__(self, dict_id: str, ld, hyperparams=None, source=None,
+                 weights: str = "native"):
+        if weights not in ("native", "int8"):
+            raise ValueError(f"unknown weights residency {weights!r}")
+        self.dict_id = str(dict_id)
+        self.ld = ld
+        self.hyperparams = dict(hyperparams or {})
+        self.source = None if source is None else str(source)
+        self.weights = weights
+        self.n_feats = int(getattr(ld, "n_feats", 0))
+        self.activation_size = int(getattr(ld, "activation_size", 0))
+        leaves, treedef = jax.tree.flatten(ld)
+        self.treedef = treedef
+        self.quant_leaves: Optional[List[Any]] = None
+        if weights == "int8":
+            if not leaves:
+                raise ValueError(
+                    f"{type(ld).__name__} has no array leaves to quantize — "
+                    "int8 residency needs weight-bearing dictionaries"
+                )
+            self.quant_leaves = [_quantize_leaf(l) for l in leaves]
+        # the group key is computed over the dict's SERVED form: int8
+        # residency dequantizes back to the original shapes/dtypes, so the
+        # key stays the native one — int8 and native instances of the same
+        # geometry share a compiled step but never a stack (the engine
+        # groups by (group_key, weights))
+        self.group_key = group_key_of(ld)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "dict": self.dict_id,
+            "class": type(self.ld).__name__,
+            "n_feats": self.n_feats,
+            "activation_size": self.activation_size,
+            "weights": self.weights,
+            "hyperparams": self.hyperparams,
+            "source": self.source,
+        }
+
+
+class DictRegistry:
+    """Thread-safe id → `ServedDict` map with a generation counter the
+    engine watches to invalidate its stacked operands."""
+
+    def __init__(self, telemetry=None):
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._dicts: Dict[str, ServedDict] = {}
+        self.generation = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dicts)
+
+    def _event(self, etype: str, **fields):
+        if self.telemetry is not None:
+            self.telemetry.event(etype, **fields)
+
+    # -- mutation --------------------------------------------------------------
+
+    def add(self, dict_id: str, ld, hyperparams=None, source=None,
+            weights: str = "native") -> ServedDict:
+        """Register a new dictionary. Raises on an already-taken id — use
+        `swap` for replacement so accidental double-adds stay loud."""
+        entry = ServedDict(dict_id, ld, hyperparams=hyperparams,
+                           source=source, weights=weights)
+        with self._lock:
+            if entry.dict_id in self._dicts:
+                raise ValueError(
+                    f"dict id {entry.dict_id!r} already registered "
+                    "(use swap to replace it)"
+                )
+            self._dicts[entry.dict_id] = entry
+            self.generation += 1
+        self._event("serve_dict_added", dict=entry.dict_id,
+                    weights=weights, source=entry.source)
+        return entry
+
+    def swap(self, dict_id: str, ld, hyperparams=None, source=None,
+             weights: str = "native") -> ServedDict:
+        """Atomically replace an existing dictionary (hot swap): requests
+        drained after the swap encode through the new weights; batches
+        in flight finish on the stack they started with."""
+        entry = ServedDict(dict_id, ld, hyperparams=hyperparams,
+                           source=source, weights=weights)
+        with self._lock:
+            if entry.dict_id not in self._dicts:
+                raise KeyError(f"dict id {entry.dict_id!r} not registered")
+            self._dicts[entry.dict_id] = entry
+            self.generation += 1
+        self._event("serve_dict_swapped", dict=entry.dict_id,
+                    weights=weights, source=entry.source)
+        return entry
+
+    def remove(self, dict_id: str) -> None:
+        with self._lock:
+            if dict_id not in self._dicts:
+                raise KeyError(f"dict id {dict_id!r} not registered")
+            del self._dicts[dict_id]
+            self.generation += 1
+        self._event("serve_dict_removed", dict=dict_id)
+
+    # -- reads -----------------------------------------------------------------
+
+    def get(self, dict_id: str) -> ServedDict:
+        with self._lock:
+            entry = self._dicts.get(dict_id)
+        if entry is None:
+            raise KeyError(f"dict id {dict_id!r} not registered")
+        return entry
+
+    def __contains__(self, dict_id: str) -> bool:
+        with self._lock:
+            return dict_id in self._dicts
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._dicts)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = list(self._dicts.values())
+        return [e.describe() for e in sorted(entries, key=lambda e: e.dict_id)]
+
+    def snapshot(self) -> Tuple[int, Dict[str, ServedDict]]:
+        """(generation, id → entry) under one lock hold — what the engine
+        stacks from. The dict is a copy; entries are immutable."""
+        with self._lock:
+            return self.generation, dict(self._dicts)
+
+    # -- export loading --------------------------------------------------------
+
+    def load_export(
+        self,
+        path,
+        dict_ids: Optional[List[str]] = None,
+        weights: str = "native",
+        prefix: Optional[str] = None,
+    ) -> List[str]:
+        """Load a learned-dict export into the registry. Returns the
+        registered ids, in export order.
+
+        ``path`` is either one ``learned_dicts.pkl`` (sidecar-manifest
+        verified; legacy exports warn) or a directory. A directory with an
+        ``export_manifest.json`` (a fleet run dir) is verified as a whole
+        first — `fleet.worker.verify_export` — then every listed
+        ``learned_dicts.pkl`` loads; a plain directory loads every
+        ``learned_dicts.pkl`` under it, each verified by its own sidecar.
+
+        ``dict_ids`` overrides the generated ids (``<stem or prefix>:<i>``).
+        """
+        path = Path(path)
+        pkls: List[Path]
+        dir_verified = False
+        if path.is_dir():
+            from sparse_coding__tpu.fleet.worker import (
+                EXPORT_MANIFEST,
+                verify_export,
+            )
+
+            if (path / EXPORT_MANIFEST).is_file():
+                ok, reason = verify_export(path)
+                if not ok:
+                    raise ValueError(
+                        f"export dir {path} failed manifest verification: {reason}"
+                    )
+                dir_verified = True
+            pkls = sorted(path.rglob("learned_dicts.pkl"))
+            if not pkls:
+                raise FileNotFoundError(f"no learned_dicts.pkl under {path}")
+        elif path.is_file():
+            pkls = [path]
+        else:
+            raise FileNotFoundError(path)
+
+        from sparse_coding__tpu.train.checkpoint import load_learned_dicts
+
+        # load-and-validate FIRST, mutate the registry only once everything
+        # checks out — a failed load must not leave a half-populated
+        # registry serving an unintended dict set (and must not bump the
+        # generation the live engine watches). `within` is the dict's index
+        # WITHIN its pkl, so ids are stable whatever else loads alongside.
+        # When the dir-level export manifest already digest-verified every
+        # pkl, skip the per-file sidecar verification — re-hashing identical
+        # bytes doubles startup I/O for zero added integrity.
+        loaded: List[Tuple[Path, int, Any, Dict[str, Any]]] = []
+        for pkl in pkls:
+            records = load_learned_dicts(
+                pkl, verify=False if dir_verified else None
+            )
+            for within, (ld, hp) in enumerate(records):
+                loaded.append((pkl, within, ld, hp))
+        if dict_ids is not None:
+            if len(dict_ids) < len(loaded):
+                raise ValueError(
+                    f"dict_ids lists {len(dict_ids)} ids but the export "
+                    f"holds {len(loaded)} dictionaries"
+                )
+            if len(dict_ids) > len(loaded):
+                warnings.warn(
+                    f"dict_ids lists {len(dict_ids)} ids but the export "
+                    f"holds only {len(loaded)} dictionaries",
+                    RuntimeWarning,
+                )
+        planned: List[str] = []
+        for next_id, (pkl, within, _ld, _hp) in enumerate(loaded):
+            if dict_ids is not None:
+                planned.append(str(dict_ids[next_id]))
+            else:
+                # run-dir loads: qualify by the member folder so two
+                # members' dict 0 don't collide; index WITHIN the pkl so
+                # the same physical dict keeps its id whatever siblings
+                # load alongside (stable hot-swap addressing)
+                base = prefix
+                if base is None:
+                    base = pkl.parent.name if len(pkls) > 1 else pkl.stem
+                planned.append(f"{base}:{within}")
+        taken = [d for d in planned if d in self or planned.count(d) > 1]
+        if taken:
+            raise ValueError(
+                f"export ids already registered or duplicated: {sorted(set(taken))}"
+            )
+        for did, (pkl, _within, ld, hp) in zip(planned, loaded):
+            self.add(did, ld, hyperparams=hp, source=pkl, weights=weights)
+        return planned

@@ -1,0 +1,359 @@
+"""Stdlib HTTP front end for the encode engine, with graceful SIGTERM drain.
+
+``python -m sparse_coding__tpu.serve.server <export> [--port 0] ...`` loads
+learned-dict exports into a `DictRegistry`, warms the engine's compiled
+steps, and serves a JSON API (docs/SERVING.md):
+
+  - ``POST /encode``  — ``{"dict": "<id>", "rows": [[...], ...]}`` →
+    ``{"dict", "n_rows", "codes", "latency_ms"}``. Unknown dict → 404;
+    malformed rows → 400; draining → **503 with Retry-After and
+    ``{"retryable": true}``** — the clean hand-back a load balancer retries
+    against another replica.
+  - ``GET /dicts``    — registry metadata (id, class, shape, residency).
+  - ``GET /healthz``  — ``{"status": "ok"|"draining", "queue_depth", ...}``.
+
+**Drain protocol** (the PR-5 preemption machinery, re-used): SIGTERM/SIGINT
+set the host-side preemption flag (`train.preemption.install_signal_handlers`
++ `poller_started` — same handler the training drivers install). The serve
+loop polls the flag; when set it (1) flips the engine to rejecting (new
+``/encode`` → retryable 503), (2) drains every request already accepted
+(`EncodeEngine.stop(drain=True)` — in-flight requests COMPLETE), (3) keeps
+answering 503s while draining, then shuts the listener down and exits **0**.
+A served request is never dropped: it either returns 200 with its codes or
+was never accepted. tests/test_serve.py's chaos test SIGTERMs a loaded
+server and asserts exactly that.
+
+`ServeClient` is the stdlib in-process client the tests and
+`scripts/loadgen.py` use; `ServeServer` runs the same server in-process on
+an ephemeral port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sparse_coding__tpu.serve.engine import EncodeEngine, EngineClosed
+from sparse_coding__tpu.serve.registry import DictRegistry
+
+__all__ = ["ServeServer", "ServeClient", "main"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the ThreadingHTTPServer instance carries .serve (ServeServer)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # stdlib default spams stderr
+        if self.server.serve.verbose:
+            sys.stderr.write(f"[serve] {fmt % args}\n")
+
+    def _json(self, code: int, payload: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reject_draining(self) -> None:
+        self._json(
+            503,
+            {"error": "draining", "retryable": True,
+             "detail": "server is draining for shutdown — retry elsewhere"},
+            headers={"Retry-After": "1"},
+        )
+
+    def do_GET(self):
+        srv = self.server.serve
+        if self.path == "/healthz":
+            self._json(200, srv.health())
+            return
+        if self.path == "/dicts":
+            self._json(200, {"dicts": srv.registry.describe()})
+            return
+        self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv = self.server.serve
+        if self.path != "/encode":
+            self._json(404, {"error": f"no route {self.path}"})
+            return
+        if srv.draining:
+            self._reject_draining()
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length))
+            dict_id = payload["dict"]
+            rows = payload["rows"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        t0 = time.monotonic()
+        try:
+            codes = srv.engine.encode(dict_id, rows, timeout=srv.request_timeout)
+        except EngineClosed:
+            self._reject_draining()
+            return
+        except KeyError:
+            self._json(404, {"error": f"unknown dict {dict_id!r}",
+                             "dicts": srv.registry.ids()})
+            return
+        except (ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._json(504, {"error": str(e), "retryable": True})
+            return
+        self._json(200, {
+            "dict": dict_id,
+            "n_rows": int(codes.shape[0]),
+            "codes": np.asarray(codes).tolist(),
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+        })
+
+
+class ServeServer:
+    """The serving process object: registry + engine + HTTP listener.
+
+    In-process use (tests, loadgen)::
+
+        with ServeServer(registry) as srv:
+            client = srv.client()
+            codes = client.encode("d0", rows)
+
+    Process use: `main` — which adds the SIGTERM drain loop.
+    """
+
+    def __init__(
+        self,
+        registry: DictRegistry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        engine: Optional[EncodeEngine] = None,
+        telemetry=None,
+        request_timeout: float = 60.0,
+        verbose: bool = False,
+        **engine_kwargs,
+    ):
+        self.registry = registry
+        self.telemetry = telemetry
+        self.engine = engine or EncodeEngine(
+            registry, telemetry=telemetry, **engine_kwargs
+        )
+        self.request_timeout = float(request_timeout)
+        self.verbose = verbose
+        self.draining = False
+        self._t0 = time.time()
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.serve = self  # handler back-reference
+        self._http_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServeServer":
+        self.engine.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="serve-http"
+        )
+        self._http_thread.start()
+        return self
+
+    def health(self) -> Dict[str, Any]:
+        lat = self.engine.latency_snapshot()
+        return {
+            "status": "draining" if self.draining else "ok",
+            "dicts": len(self.registry),
+            "queue_depth": self.engine.queue_depth,
+            "requests": self.engine.stats["requests"],
+            "uptime_seconds": round(time.time() - self._t0, 3),
+            "latency_p50_ms": round(lat["p50_ms"], 3),
+            "latency_p99_ms": round(lat["p99_ms"], 3),
+        }
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """The graceful half of shutdown: reject new encodes (503), complete
+        everything already accepted. The listener stays up (answering 503s
+        and health checks) until `close`."""
+        self.draining = True
+        if self.telemetry is not None:
+            self.telemetry.event(
+                "serve_drain", queue_depth=self.engine.queue_depth
+            )
+        self.engine.stop(drain=True, timeout=timeout)
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def stop(self, timeout: float = 60.0) -> None:
+        self.drain(timeout=timeout)
+        self.close()
+
+    def client(self, timeout: float = 30.0) -> "ServeClient":
+        return ServeClient(self.address, timeout=timeout)
+
+    def __enter__(self) -> "ServeServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+class RetryableRejection(RuntimeError):
+    """A clean 503/"draining" hand-back: safe to retry against a replica."""
+
+
+class ServeClient:
+    """Minimal stdlib HTTP client (tests, loadgen — no deps)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except Exception:
+                body = {"error": str(e)}
+            if e.code in (503, 504) and body.get("retryable"):
+                raise RetryableRejection(body.get("error", "rejected"))
+            raise RuntimeError(f"HTTP {e.code}: {body.get('error')}") from e
+
+    def encode(self, dict_id: str, rows) -> np.ndarray:
+        out = self._request(
+            "POST", "/encode",
+            {"dict": dict_id, "rows": np.asarray(rows).tolist()},
+        )
+        return np.asarray(out["codes"], dtype=np.float32)
+
+    def dicts(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/dicts")["dicts"]
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._request("GET", "/healthz")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.serve.server",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "exports", nargs="+",
+        help="learned-dict export(s): learned_dicts.pkl files or fleet run "
+        "dirs with export_manifest.json",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8777,
+                    help="0 = ephemeral (see --port-file)")
+    ap.add_argument("--port-file", default=None,
+                    help="write the bound port here once listening "
+                    "(subprocess tests / init systems)")
+    ap.add_argument("--weights", choices=("native", "int8"), default="native",
+                    help="weight residency for loaded dicts (int8 = chunk-"
+                    "quant tier, half the resident bytes)")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--events", default=None, metavar="DIR",
+                    help="write serve telemetry (events.jsonl) under DIR — "
+                    "renderable with `python -m sparse_coding__tpu.report`")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip bucket pre-compilation at startup")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from sparse_coding__tpu.telemetry import RunTelemetry
+    from sparse_coding__tpu.train import preemption
+
+    telemetry = RunTelemetry(out_dir=args.events, run_name="serve")
+    registry = DictRegistry(telemetry=telemetry)
+    for exp in args.exports:
+        ids = registry.load_export(exp, weights=args.weights)
+        print(f"[serve] loaded {len(ids)} dict(s) from {exp}: {ids}")
+    telemetry.run_start(config={
+        "exports": list(args.exports), "weights": args.weights,
+        "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+        "dicts": registry.ids(),
+    })
+
+    srv = ServeServer(
+        registry, host=args.host, port=args.port, telemetry=telemetry,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        verbose=args.verbose,
+    )
+    srv.engine.start()
+    if not args.no_warmup:
+        n = srv.engine.warmup()
+        print(f"[serve] warmed {n} compiled step(s)")
+    srv.start()
+    if args.port_file:
+        Path(args.port_file).write_text(str(srv.port))
+    print(f"[serve] listening on {srv.address} "
+          f"({len(registry)} dict(s), max_batch {args.max_batch})", flush=True)
+
+    # SIGTERM drain: the PR-5 preemption flag, polled here instead of at a
+    # chunk boundary — serving's "boundary" is every loop tick
+    preemption.install_signal_handlers()
+    preemption.poller_started()
+    status = "ok"
+    try:
+        while not preemption.preemption_requested():
+            time.sleep(0.05)
+        sig = preemption.preemption_signal()
+        print(f"[serve] drain requested (signal {sig}) — rejecting new "
+              "requests, completing in-flight", flush=True)
+        srv.drain()
+        telemetry.event("serve_drained", signum=sig,
+                        requests=srv.engine.stats["requests"])
+        srv.close()
+        status = "drained"
+        print("[serve] drained clean — exit 0", flush=True)
+        return 0
+    except KeyboardInterrupt:
+        srv.drain()
+        srv.close()
+        status = "drained"
+        return 0
+    finally:
+        preemption.poller_stopped()
+        telemetry.close(status=status)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -509,8 +509,11 @@ def build_ledger(run_dir) -> Dict[str, Any]:
 # ignores unknown cnames, so this degrades gracefully)
 _CNAME = {
     "step": "thread_state_running",
+    "encode": "thread_state_running",
     "compile": "thread_state_runnable",
     "data_wait": "thread_state_iowait",
+    "request_wait": "thread_state_iowait",
+    "dequant": "rail_load",
     "checkpoint": "rail_idle",
     "preempt_drain": "terrible",
     "preempted_down": "terrible",

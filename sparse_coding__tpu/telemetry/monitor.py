@@ -158,6 +158,12 @@ class RunMonitor:
         # seconds + the earliest run_start for the live wall denominator
         self.span_seconds: Dict[str, float] = {}
         self.first_start_ts: Optional[float] = None
+        # serving state (docs/SERVING.md): last-snapshot serve.* counters
+        # and gauges + the drain lifecycle events
+        self.serve_counters: Dict[str, float] = {}
+        self.serve_gauges: Dict[str, float] = {}
+        self.serve_draining = False
+        self.serve_drained = False
 
     # -- ingestion ------------------------------------------------------------
 
@@ -252,6 +258,11 @@ class RunMonitor:
             self.chunk_skips.append(rec)
         elif kind == "loss_budget_exhausted":
             self.budget_exhausted = True
+        elif kind == "serve_drain":
+            self.serve_draining = True
+        elif kind == "serve_drained":
+            self.serve_draining = False
+            self.serve_drained = True
         elif kind == "snapshot":
             counters = rec.get("counters") or {}
             if "train.steps" in counters:
@@ -259,7 +270,17 @@ class RunMonitor:
             p.data = {
                 k: float(v) for k, v in counters.items() if k.startswith("data.")
             } or p.data
+            serve_c = {
+                k: float(v) for k, v in counters.items() if k.startswith("serve.")
+            }
+            if serve_c:
+                self.serve_counters.update(serve_c)
             gauges = rec.get("gauges") or {}
+            serve_g = {
+                k: float(v) for k, v in gauges.items() if k.startswith("serve.")
+            }
+            if serve_g:
+                self.serve_gauges.update(serve_g)
             if "data.budget_remaining_frac" in gauges:
                 self.budget_remaining = float(gauges["data.budget_remaining_frac"])
             if "skew.flush.spread_seconds" in gauges:
@@ -417,11 +438,42 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         elif mon.budget_remaining is not None:
             line += f" | budget {100 * mon.budget_remaining:.1f}% remaining"
         lines.append(line)
+    # serving line (docs/SERVING.md): last-snapshot serve.* counters/gauges
+    # + the drain lifecycle — only for runs that served (stability contract)
+    if mon.serve_counters or mon.serve_gauges or mon.serve_draining or mon.serve_drained:
+        c, g = mon.serve_counters, mon.serve_gauges
+        bits = [
+            f"{int(c.get('serve.requests', 0))} req "
+            f"({int(c.get('serve.rows', 0))} rows, "
+            f"{int(c.get('serve.batches', 0))} batches)"
+        ]
+        if g.get("serve.latency_p50_ms") is not None:
+            bits.append(
+                f"p50 {g['serve.latency_p50_ms']:.1f}ms "
+                f"p95 {g.get('serve.latency_p95_ms', 0):.1f}ms "
+                f"p99 {g.get('serve.latency_p99_ms', 0):.1f}ms"
+            )
+        if g.get("serve.queue_depth") is not None:
+            bits.append(f"queue {int(g['serve.queue_depth'])}")
+        if g.get("serve.batch_occupancy") is not None:
+            bits.append(f"occupancy {100 * g['serve.batch_occupancy']:.0f}%")
+        rej, err = int(c.get("serve.rejected", 0)), int(c.get("serve.errors", 0))
+        if rej or err:
+            bits.append(f"{rej} rejected / {err} errors")
+        line = "  serve: " + " | ".join(bits)
+        if mon.serve_draining:
+            line += " | DRAINING"
+        elif mon.serve_drained:
+            line += " | drained clean"
+        lines.append(line)
     # live goodput line (docs/observability.md §7): per-category span
     # seconds vs the wall elapsed since the earliest run_start — the full
     # ledger (generation gaps, supervisor backoff) is the timeline CLI's job
     if mon.span_seconds:
-        from sparse_coding__tpu.telemetry.spans import INNER_CATEGORIES
+        from sparse_coding__tpu.telemetry.spans import (
+            GOODPUT_CATEGORIES,
+            INNER_CATEGORIES,
+        )
 
         last = max((p.last_ts or 0.0) for p in mon.procs.values())
         elapsed = (
@@ -436,7 +488,7 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         # step windows; the offline ledger is exact)
         step = max(
             0.0,
-            mon.span_seconds.get("step", 0.0)
+            sum(mon.span_seconds.get(c, 0.0) for c in GOODPUT_CATEGORIES)
             - sum(mon.span_seconds.get(c, 0.0) for c in INNER_CATEGORIES),
         )
         pct = (

@@ -607,6 +607,82 @@ def _data_section(run, lines: List[str]):
         lines.append("")
 
 
+def _serving_section(run, lines: List[str]):
+    """Online-serving stats (docs/SERVING.md): request/row/batch totals,
+    latency SLO gauges, span-time attribution (request_wait/encode/dequant),
+    registry mutations, and the drain outcome. Omitted entirely for runs
+    with no serving activity — ordinary report output is a stability
+    contract."""
+    counters = _merged_counters(run)
+    gauges = _merged_gauges(run)
+    serve_counters = {k: v for k, v in counters.items() if k.startswith("serve.")}
+    dict_events = [
+        e for e in run["events"]
+        if e.get("event") in
+        ("serve_dict_added", "serve_dict_swapped", "serve_dict_removed")
+    ]
+    drains = _events_of(run, "serve_drained")
+    if not (serve_counters or dict_events or drains):
+        return
+    lines.append("## Serving")
+    lines.append("")
+    reqs = int(counters.get("serve.requests", 0))
+    rows = int(counters.get("serve.rows", 0))
+    batches = int(counters.get("serve.batches", 0))
+    bits = [f"**{reqs}** requests ({rows} rows) in {batches} micro-batch(es)"]
+    rej = int(counters.get("serve.rejected", 0))
+    err = int(counters.get("serve.errors", 0))
+    if rej or err:
+        bits.append(f"{rej} rejected (retryable), {err} error(s)")
+    compiles = counters.get("serve.compiles")
+    if compiles:
+        bits.append(f"{int(compiles)} compiled step shape(s)")
+    lines.append("- " + "; ".join(bits))
+    if gauges.get("serve.latency_p50_ms") is not None:
+        lines.append(
+            f"- latency: p50 **{gauges['serve.latency_p50_ms']:.2f} ms**, "
+            f"p95 {gauges.get('serve.latency_p95_ms', 0):.2f} ms, "
+            f"p99 {gauges.get('serve.latency_p99_ms', 0):.2f} ms"
+        )
+    extras = []
+    if gauges.get("serve.queue_depth") is not None:
+        extras.append(f"queue depth {int(gauges['serve.queue_depth'])}")
+    if gauges.get("serve.batch_occupancy") is not None:
+        extras.append(
+            f"batch occupancy {100 * gauges['serve.batch_occupancy']:.1f}%"
+        )
+    padded = counters.get("serve.padded_rows")
+    if padded:
+        extras.append(f"{int(padded)} padded rows dispatched")
+    if extras:
+        lines.append("- " + ", ".join(extras))
+    span_bits = []
+    for cat in ("encode", "request_wait", "dequant"):
+        secs = counters.get(f"span.{cat}.seconds")
+        if secs:
+            span_bits.append(f"{cat} {secs:.2f} s")
+    if span_bits:
+        lines.append("- span time: " + ", ".join(span_bits))
+    if dict_events:
+        lines.append("")
+        lines.append("| dict | event | weights | source |")
+        lines.append("|---|---|---|---|")
+        for e in dict_events:
+            lines.append(
+                f"| {e.get('dict', '?')} "
+                f"| {e.get('event', '?').replace('serve_dict_', '')} "
+                f"| {e.get('weights', '-')} | {_fmt(e.get('source'))} |"
+            )
+    if drains:
+        d = drains[-1]
+        lines.append("")
+        lines.append(
+            f"- drained clean (signal {_fmt(d.get('signum'))}) after "
+            f"{_fmt(d.get('requests'))} request(s) — zero dropped in-flight"
+        )
+    lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -792,6 +868,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _pod_section(run, lines)
     _recovery_section(run, lines)
     _goodput_section(run, lines)
+    _serving_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

@@ -65,8 +65,10 @@ class _ActiveSentinel:
 
 ACTIVE = _ActiveSentinel()
 
-# productive wall time: fused train-step (or harvest-forward) compute windows
-GOODPUT_CATEGORIES = ("step",)
+# productive wall time: fused train-step (or harvest-forward) compute
+# windows, and — for a serving process (docs/SERVING.md) — the batched
+# encode dispatch itself
+GOODPUT_CATEGORIES = ("step", "encode")
 # instrumented badput: emitted as live span events by the code paths below
 BADPUT_CATEGORIES = (
     "compile",        # tracked_jit compile events double as spans
@@ -76,6 +78,8 @@ BADPUT_CATEGORIES = (
     "degraded_skip",  # quarantined-chunk skip accounting (docs/DATAPLANE.md)
     "export_verify",  # fleet export/admission manifest verification
     "restart_backoff",  # supervisor backoff sleep before a respawn
+    "request_wait",   # serve: enqueue → drain-into-a-batch queueing delay
+    "dequant",        # serve: int8-resident weight dequantization per batch
 )
 # derived-only badput: reconstructed by telemetry.goodput from event
 # adjacency, never emitted as live spans
@@ -85,9 +89,12 @@ DERIVED_CATEGORIES = (
     "straggler_idle",  # fast hosts waiting on the slowest (skew windows)
     "unaccounted",     # the honest remainder
 )
-# categories that may legitimately open INSIDE a step/data_wait span; the
-# ledger subtracts their overlap from the enclosing span
-INNER_CATEGORIES = ("compile", "checkpoint", "preempt_drain")
+# categories that may legitimately open INSIDE an enclosing goodput span
+# (compile/checkpoint/preempt_drain inside a step window; dequant inside a
+# serve encode window); the ledger's timestamp sweep handles nesting
+# exactly, and the monitor's live approximation subtracts these from its
+# goodput sum so the two surfaces agree
+INNER_CATEGORIES = ("compile", "checkpoint", "preempt_drain", "dequant")
 CATEGORIES = GOODPUT_CATEGORIES + BADPUT_CATEGORIES + DERIVED_CATEGORIES
 
 

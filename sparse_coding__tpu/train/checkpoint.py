@@ -40,7 +40,6 @@ wrong moment and prove all of the above.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import pickle
@@ -57,6 +56,9 @@ from sparse_coding__tpu.utils.faults import fault_point
 
 MANIFEST_NAME = "sc_manifest.json"
 
+# legacy-export warning dedup: one warning per export path per process
+_WARNED_LEGACY_EXPORTS: set = set()
+
 # verification depth for latest_checkpoint / verify_checkpoint:
 #   digest (default) — sizes + sha256 of every file (resume is rare; reading
 #                      the checkpoint once more is cheap insurance)
@@ -68,7 +70,11 @@ VERIFY_ENV = "SC_CKPT_VERIFY"
 
 # -- learned-dict export (the reference's learned_dicts.pt) -------------------
 
-def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
+def save_learned_dicts(
+    path,
+    learned_dicts: List[Tuple[Any, Dict[str, Any]]],
+    manifest: bool = True,
+):
     """Save a `[(LearnedDict, hyperparams), ...]` list.
 
     Records store fields BY NAME (`{class, arrays, statics}`) via the
@@ -81,6 +87,12 @@ def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
     is `os.replace`d onto `path`, so a kill mid-export leaves either the
     previous complete file or nothing — never a truncated pickle for
     `load_learned_dicts` to explode on.
+
+    By default (ISSUE 10 satellite) a ``<name>.manifest.json`` sidecar
+    (bytes + sha256, `utils.manifest`) is committed after the pickle —
+    the ONE verified export format that fleet export verification and the
+    serving registry both consume. `load_learned_dicts` verifies it when
+    present; legacy manifest-less exports still load, with a warning.
     """
     from sparse_coding__tpu.models.learned_dict import LEARNED_DICT_REGISTRY
 
@@ -119,20 +131,72 @@ def save_learned_dicts(path, learned_dicts: List[Tuple[Any, Dict[str, Any]]]):
             stale.unlink(missing_ok=True)  # dead or unparseable writer
         except PermissionError:
             pass  # alive under another uid: leave it
+    from sparse_coding__tpu.utils.manifest import (
+        export_manifest_path,
+        write_manifest,
+    )
+
     tmp = path.with_name(f".{path.name}.tmp{os.getpid()}")
     try:
         with open(tmp, "wb") as f:
             pickle.dump(records, f)
             f.flush()
             os.fsync(f.fileno())
+        # a stale sidecar from the PREVIOUS export must never describe the
+        # new bytes: unlink it BEFORE the pickle lands, so every kill window
+        # leaves a consistent pair — (old pkl + old sidecar), (old pkl + no
+        # sidecar → legacy warning), or (new pkl + no sidecar → legacy
+        # warning) — and never a verifying-but-wrong or bricked export
+        export_manifest_path(path).unlink(missing_ok=True)
         os.replace(tmp, path)
     finally:
         tmp.unlink(missing_ok=True)
+    if manifest:
+        write_manifest(export_manifest_path(path), {path.name: path})
 
 
-def load_learned_dicts(path) -> List[Tuple[Any, Dict[str, Any]]]:
+def load_learned_dicts(
+    path, verify: Optional[bool] = None
+) -> List[Tuple[Any, Dict[str, Any]]]:
+    """Load a `save_learned_dicts` export, verifying its sidecar manifest.
+
+    ``verify=None`` (default): verify when the sidecar exists, warn (once
+    per path per process) when it doesn't — legacy exports predate the
+    manifest and must keep loading. ``verify=True`` requires the manifest;
+    ``verify=False`` skips verification entirely. A size/digest mismatch
+    raises ``ValueError`` — truncated or bit-rotted dictionary bytes must
+    never be decoded into a model something then serves or evaluates."""
     import importlib
 
+    from sparse_coding__tpu.utils.manifest import (
+        export_manifest_path,
+        verify_manifest,
+    )
+
+    path = Path(path)
+    sidecar = export_manifest_path(path)
+    if verify is not False:
+        if sidecar.is_file():
+            ok, reason = verify_manifest(sidecar, base_dir=path.parent)
+            if not ok:
+                raise ValueError(
+                    f"learned-dict export {path} failed manifest verification: "
+                    f"{reason} (re-export with save_learned_dicts, or pass "
+                    "verify=False to load anyway)"
+                )
+        elif verify:
+            raise ValueError(
+                f"learned-dict export {path} has no {sidecar.name} manifest "
+                "and verify=True was requested"
+            )
+        elif str(path) not in _WARNED_LEGACY_EXPORTS:
+            _WARNED_LEGACY_EXPORTS.add(str(path))
+            warnings.warn(
+                f"learned-dict export {path} has no sidecar manifest "
+                f"({sidecar.name}): loading unverified legacy export — "
+                "re-export with save_learned_dicts to get integrity checks",
+                RuntimeWarning,
+            )
     with open(path, "rb") as f:
         records = pickle.load(f)
     out = []
@@ -167,11 +231,12 @@ def _staging_dir(final: Path) -> Path:
 
 
 def _sha256(path: Path) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for block in iter(lambda: f.read(1 << 20), b""):
-            h.update(block)
-    return h.hexdigest()
+    # single implementation in utils.manifest (ISSUE 10: fleet export,
+    # checkpoint commit, and serving registry share one digest discipline);
+    # the name stays importable here for existing callers
+    from sparse_coding__tpu.utils.manifest import sha256_file
+
+    return sha256_file(path)
 
 
 def _write_manifest(ckpt_dir: Path, extra: Optional[Dict[str, Any]] = None) -> None:

@@ -1,0 +1,246 @@
+"""Export round-trip contract for EVERY registered LearnedDict (ISSUE 10).
+
+Serving correctness rests on one invariant: a dictionary that went through
+`save_learned_dicts` → `load_learned_dicts` must be the SAME model — same
+class, same dtypes, same center/normalization flags, bit-identical `encode`.
+A silently-dropped `norm_encoder` flag or an fp32→fp16 dtype flip would
+serve wrong features with no error anywhere.
+
+The test is parametrized over `LEARNED_DICT_REGISTRY` itself with a
+builder per class; a newly registered class without a builder FAILS the
+suite (`test_every_registered_class_has_a_builder`) instead of silently
+escaping the contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.models import learned_dict as ld_mod
+from sparse_coding__tpu.models.learned_dict import LEARNED_DICT_REGISTRY
+from sparse_coding__tpu.train.checkpoint import load_learned_dicts, save_learned_dicts
+
+D, N = 8, 12
+
+
+def _key(i: int):
+    return jax.random.PRNGKey(i)
+
+
+def _r(i, shape, dtype=jnp.float32):
+    return jax.random.normal(_key(i), shape, dtype)
+
+
+def _build_tied(dtype=jnp.float32):
+    # exercises the affine-centering arrays AND the norm_encoder flag
+    return ld_mod.TiedSAE(
+        _r(0, (N, D), dtype),
+        _r(1, (N,), dtype),
+        centering=(
+            _r(2, (D,), dtype),
+            jnp.eye(D, dtype=dtype),
+            1.0 + 0.1 * jax.random.uniform(_key(3), (D,), dtype),
+        ),
+        norm_encoder=True,
+    )
+
+
+def _build_thresholding():
+    from sparse_coding__tpu.models.sae import FunctionalThresholdingSAE
+
+    params, _ = FunctionalThresholdingSAE.init(_key(4), D, N, 1e-3)
+    return ld_mod.ThresholdingSAE_export(params)
+
+
+def _build_direct_coef():
+    from sparse_coding__tpu.models.direct_coef import DirectCoefOptimizer
+
+    params, buffers = DirectCoefOptimizer.init(_key(5), D, N, 1e-3)
+    from sparse_coding__tpu.models.direct_coef import DirectCoefSearch
+
+    return DirectCoefSearch(params, buffers)
+
+
+def _build_fista():
+    from sparse_coding__tpu.models.fista import Fista
+
+    return Fista(_r(6, (N, D)), _r(7, (N,)), norm_encoder=True)
+
+
+def _build_lista():
+    from sparse_coding__tpu.models.lista import (
+        FunctionalLISTADenoisingSAE,
+        LISTADenoisingSAE,
+    )
+
+    params, _ = FunctionalLISTADenoisingSAE.init(_key(8), D, N, 2, 1e-3)
+    return LISTADenoisingSAE(params)
+
+
+def _build_residual():
+    from sparse_coding__tpu.models.lista import (
+        FunctionalResidualDenoisingSAE,
+        ResidualDenoisingSAE,
+    )
+
+    params, _ = FunctionalResidualDenoisingSAE.init(_key(9), D, N, 2, 1e-3)
+    return ResidualDenoisingSAE(params)
+
+
+def _build_semilinear():
+    from sparse_coding__tpu.models.semilinear import SemiLinearSAE, SemiLinearSAE_export
+
+    params, _ = SemiLinearSAE.init(_key(10), D, N, 1e-3)
+    return SemiLinearSAE_export(params)
+
+
+def _build_topk():
+    from sparse_coding__tpu.models.topk import TopKLearnedDict
+
+    return TopKLearnedDict(_r(11, (N, D)), 3)
+
+
+def _build_pca():
+    from sparse_coding__tpu.models.pca import PCAEncoder
+
+    return PCAEncoder(_r(12, (D, D)), 3)
+
+
+def _build_rica():
+    from sparse_coding__tpu.models.rica import RICADict
+
+    return RICADict(_r(13, (N, D)))
+
+
+def _build_tied_positive():
+    from sparse_coding__tpu.models.positive import TiedPositiveSAE
+
+    return TiedPositiveSAE(_r(14, (N, D)), _r(15, (N,)), norm_encoder=True)
+
+
+def _build_untied_positive():
+    from sparse_coding__tpu.models.positive import UntiedPositiveSAE
+
+    return UntiedPositiveSAE(
+        _r(16, (N, D)), _r(17, (N,)), _r(18, (N, D)), norm_encoder=True
+    )
+
+
+# class name -> zero-arg builder. Every class in LEARNED_DICT_REGISTRY must
+# appear here (enforced below).
+BUILDERS = {
+    "Identity": lambda: ld_mod.Identity(D),
+    "IdentityReLU": lambda: ld_mod.IdentityReLU(D, bias=_r(20, (D,))),
+    "AddedNoise": lambda: ld_mod.AddedNoise(0.1, D),
+    "RandomDict": lambda: ld_mod.RandomDict(D, N),
+    "UntiedSAE": lambda: ld_mod.UntiedSAE(_r(21, (N, D)), _r(22, (N, D)), _r(23, (N,))),
+    "TiedSAE": _build_tied,
+    "ReverseSAE": lambda: ld_mod.ReverseSAE(_r(24, (N, D)), _r(25, (N,)), norm_encoder=True),
+    "Rotation": lambda: ld_mod.Rotation(_r(26, (D, D))),
+    "ThresholdingSAE_export": _build_thresholding,
+    "DirectCoefSearch": _build_direct_coef,
+    "Fista": _build_fista,
+    "LISTADenoisingSAE": _build_lista,
+    "ResidualDenoisingSAE": _build_residual,
+    "SemiLinearSAE_export": _build_semilinear,
+    "TopKLearnedDict": _build_topk,
+    "PCAEncoder": _build_pca,
+    "RICADict": _build_rica,
+    "TiedPositiveSAE": _build_tied_positive,
+    "UntiedPositiveSAE": _build_untied_positive,
+}
+
+
+def _registered_classes():
+    return sorted(LEARNED_DICT_REGISTRY, key=lambda c: c.__name__)
+
+
+def test_every_registered_class_has_a_builder():
+    """A class registered for export without a round-trip builder here is a
+    serving-correctness blind spot — fail loudly."""
+    missing = [c.__name__ for c in _registered_classes() if c.__name__ not in BUILDERS]
+    assert not missing, (
+        f"registered LearnedDict classes without a round-trip contract "
+        f"builder: {missing} — add them to BUILDERS in {__file__}"
+    )
+
+
+def _encode(ld, batch):
+    # AddedNoise is stochastic by design: pin the key so determinism is
+    # comparable pre/post round-trip
+    if isinstance(ld, ld_mod.AddedNoise):
+        return ld.encode(batch, key=jax.random.PRNGKey(99))
+    return ld.encode(batch)
+
+
+@pytest.mark.parametrize(
+    "cls", _registered_classes(), ids=lambda c: c.__name__
+)
+def test_roundtrip_preserves_class_statics_dtypes_and_encode(cls, tmp_path):
+    ld = BUILDERS[cls.__name__]()
+    batch = _r(50, (4, D))
+    before = np.asarray(jax.device_get(_encode(ld, batch)))
+
+    path = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(path, [(ld, {"cls": cls.__name__})])
+    (ld2, hp), = load_learned_dicts(path)
+
+    assert type(ld2) is cls
+    assert hp == {"cls": cls.__name__}
+    array_fields, static_fields = LEARNED_DICT_REGISTRY[cls]
+    # statics (norm_encoder, sparsity, n_feats, activation_size, ...) must
+    # survive EXACTLY — a dropped normalization flag serves wrong features
+    for f in static_fields:
+        assert getattr(ld2, f, None) == getattr(ld, f, None), f
+    # every array leaf keeps dtype, shape, and bits
+    for f in array_fields:
+        leaves_a = jax.tree.leaves(getattr(ld, f))
+        leaves_b = jax.tree.leaves(getattr(ld2, f))
+        assert len(leaves_a) == len(leaves_b), f
+        for a, b in zip(leaves_a, leaves_b):
+            assert jnp.result_type(a) == jnp.result_type(b), f
+            assert jnp.shape(a) == jnp.shape(b), f
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+            )
+    after = np.asarray(jax.device_get(_encode(ld2, batch)))
+    np.testing.assert_array_equal(before, after, err_msg=f"{cls.__name__}.encode")
+
+
+def test_reexport_never_pairs_new_bytes_with_stale_sidecar(tmp_path):
+    """Review regression: overwriting an export unlinks the previous sidecar
+    BEFORE the new pickle lands, so a kill before the new sidecar is
+    written leaves a manifest-less (legacy-warning) export — never a new
+    pickle failing verification against the old export's digests."""
+    from sparse_coding__tpu.utils.manifest import export_manifest_path
+
+    path = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(path, [(BUILDERS["TiedSAE"](), {"v": 1})])
+    assert export_manifest_path(path).is_file()
+    # manifest=False stops right where a kill in the gap would: new bytes
+    # on disk, no new sidecar yet
+    save_learned_dicts(path, [(BUILDERS["Rotation"](), {"v": 2})], manifest=False)
+    assert not export_manifest_path(path).is_file()
+    with pytest.warns(RuntimeWarning, match="legacy"):
+        (ld, hp), = load_learned_dicts(path)
+    assert hp == {"v": 2}
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float16"])
+def test_roundtrip_preserves_nondefault_dtypes(dtype, tmp_path):
+    """The dtype half of the contract on the class serving cares most
+    about: a bf16-trained TiedSAE must come back bf16, not silently f32."""
+    dt = jnp.dtype(dtype)
+    ld = _build_tied(dtype=dt)
+    path = tmp_path / "ld.pkl"
+    save_learned_dicts(path, [(ld, {})])
+    (ld2, _), = load_learned_dicts(path)
+    for f in ("encoder", "encoder_bias", "center_trans", "center_rot", "center_scale"):
+        assert jnp.result_type(getattr(ld2, f)) == dt, f
+    assert ld2.norm_encoder is True
+    batch = _r(51, (4, D)).astype(dt)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(ld.encode(batch))),
+        np.asarray(jax.device_get(ld2.encode(batch))),
+    )
