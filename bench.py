@@ -226,11 +226,22 @@ def prep_harvest_longctx(stack):
     return measure
 
 
-def prep_topk(stack):
+def prep_topk(stack, fused: bool = False):
     """Steps/sec of the BASELINE config-4 top-k train step (7-member k-sweep,
     gpt2-small geometry, `TopKEncoderApprox` + bf16 + scan-8 — the r3
     PartialReduce threshold path, THROUGHPUT.md r3a; r2's argsort path ran
-    ~2 steps/sec here)."""
+    ~2 steps/sec here). ``fused=False`` PINS the XLA path: this key is the
+    fused kernel's comparison baseline and must not silently change meaning
+    now that the signature auto-fuses on TPU.
+
+    ``fused=True`` is the `topk_fused_steps_per_sec` key: the same workload
+    through the fused Pallas step (`ops/topk_kernel.py` — scores + exact
+    radix-select threshold + decode + the tied bwd/Adam kernels at l1=0).
+    Fused selection is exact-threshold (recall 1.0), so the two keys differ
+    by a few boundary entries per row in WHICH features train — the
+    documented approx-vs-exact envelope, not a numerics bug. On non-TPU
+    hosts the fused build falls back to XLA (auto gate), making the two
+    keys measure the same program — the fixture documents this."""
     import numpy as np
 
     from sparse_coding__tpu import build_ensemble
@@ -247,6 +258,7 @@ def prep_topk(stack):
         n_features=12288,
         sparsity_cap=151,
         compute_dtype=jnp.bfloat16,
+        fused=None if fused else False,
     )
     batches = jax.device_put(
         np.random.default_rng(0).standard_normal((S, 2048, 768), dtype=np.float32)
@@ -264,6 +276,75 @@ def prep_topk(stack):
     # rate is steps/sec — so one cost unit corresponds to 1 rate unit
     measure.cost = ens.compiled_cost(batches)
     measure.units_per_cost = 1
+    measure.fused = ens.fused
+    return measure
+
+
+def prep_tied_variant(stack, optimizer_kwargs=None, recompute_code=False):
+    """acts/s of the HEADLINE ensemble under a moment-storage or
+    code-recompute variant — the round-6 capacity/parity study keys:
+
+      - ``optimizer_kwargs={"mu_dtype": "int8", "nu_dtype": "bfloat16"}``:
+        first moment stored int8 with per-row absmax scales, kept
+        compressed inside the bwd kernel's `_adam_epilogue`
+        (`headline_int8mom_acts_per_sec`). nu deliberately stays bf16: the
+        linear absmax codec quantizes sub-scale second moments to zero and
+        Adam's denominator collapses to eps for exactly those elements
+        (tests/test_fused_signatures.py::
+        test_int8_nu_denominator_collapse_is_real; THROUGHPUT round 6) —
+        int8 nu remains available but is not the recommended config;
+      - ``recompute_code=True`` (`SC_RECOMPUTE_CODE=1`): the bwd kernel
+        rebuilds each code tile for one extra MXU pass instead of
+        round-tripping the [M, B, N] code tensor
+        (`recompute_code_acts_per_sec`; §r5b modeled ~0.775 five-pass MFU).
+
+    One 128-step scan window per round (a third of the headline's window —
+    variants track the lever, the headline carries the claim)."""
+    import os
+
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    okw = {"learning_rate": 1e-3, "mu_dtype": "bfloat16"}
+    okw.update(optimizer_kwargs or {})
+    prev = os.environ.get("SC_RECOMPUTE_CODE")
+    if recompute_code:
+        os.environ["SC_RECOMPUTE_CODE"] = "1"
+    try:
+        ens = build_ensemble(
+            FunctionalTiedSAE,
+            jax.random.PRNGKey(0),
+            [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(N_MODELS)],
+            optimizer_kwargs=okw,
+            activation_size=D_ACT,
+            n_dict_components=N_DICT,
+            compute_dtype=jnp.bfloat16,
+        )
+    finally:
+        if recompute_code:
+            if prev is None:
+                os.environ.pop("SC_RECOMPUTE_CODE", None)
+            else:
+                os.environ["SC_RECOMPUTE_CODE"] = prev
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT, n_ground_truth_components=2 * D_ACT,
+        batch_size=BATCH, feature_num_nonzero=8, feature_prob_decay=0.996,
+        correlated=False, key=jax.random.PRNGKey(1),
+    )
+    uniq = jnp.stack([next(gen) for _ in range(8)]).astype(jnp.bfloat16)
+    batches = jnp.tile(uniq, (SCAN_STEPS // 8, 1, 1))
+    jax.device_get(ens.step_scan(batches)["loss"])  # compile
+
+    def measure() -> float:
+        t0 = time.perf_counter()
+        losses = ens.step_scan(batches)
+        jax.device_get(losses["loss"])
+        return SCAN_STEPS * BATCH / (time.perf_counter() - t0)
+
+    # cost block covers ONE scan step = BATCH activation rows
+    measure.cost = ens.compiled_cost(batches)
+    measure.units_per_cost = BATCH
     return measure
 
 
@@ -587,9 +668,16 @@ def main(argv=None):
             "sustained_sweep_rows_per_sec": prep_sweep_disk(stack),
             "fista500_codes_per_sec": prep_fista(stack),
             "topk_steps_per_sec": prep_topk(stack),
+            "topk_fused_steps_per_sec": prep_topk(stack, fused=True),
             "harvest_seq4096_tokens_per_sec": prep_harvest_longctx(stack),
             "control_matmul_tflops": prep_control(stack),
             "bigbatch16k_acts_per_sec": prep_bigbatch(stack),
+            "headline_int8mom_acts_per_sec": prep_tied_variant(
+                stack, {"mu_dtype": "int8", "nu_dtype": "bfloat16"}
+            ),
+            "recompute_code_acts_per_sec": prep_tied_variant(
+                stack, recompute_code=True
+            ),
         }
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure
@@ -648,6 +736,17 @@ def main(argv=None):
         out["bigbatch16k_acts_per_sec"] * flops_per_act / (peak * 1e12), 3
     )
     out["control_fraction_of_peak"] = round(out["control_matmul_tflops"] / peak, 3)
+    # the ISSUE-12 acceptance ratio, computed in-session (same interleaved
+    # rounds, same pinned control); `topk_fused_is_fused` records whether
+    # the fused build actually engaged the Pallas path — False on non-TPU
+    # hosts, where both keys measure the XLA program and the ratio is ~1
+    out["topk_fused_is_fused"] = bool(
+        getattr(benches["topk_fused_steps_per_sec"], "fused", False)
+    )
+    if medians.get("topk_steps_per_sec"):
+        out["topk_fused_speedup"] = round(
+            medians["topk_fused_steps_per_sec"] / medians["topk_steps_per_sec"], 2
+        )
     # serving block (docs/SERVING.md): latency percentiles are the median of
     # each round's closed-loop percentile (same interleaved-window protocol
     # as every other key), speedup is the ratio of the two gated medians

@@ -510,6 +510,76 @@ def make_serve_run_fixture():
     print(f"Wrote {SERVE_RUN_DIR}/events.jsonl + bench_serve_fixture.json")
 
 
+BENCH_FIXTURE = REPO / "tests" / "golden" / "bench_fixture.json"
+
+
+def make_bench_fixture():
+    """Regenerate tests/golden/bench_fixture.json — the perfdiff tier-1
+    smoke's schema pin (tests/test_perfdiff.py).
+
+    Two provenance classes, recorded in ``fixture_note``:
+      - the r05-era keys carry the REAL TPU-v5e medians/spreads measured in
+        BENCH_r05.json's session (copied verbatim — do not invent);
+      - the round-6 keys (topk_fused_steps_per_sec,
+        headline_int8mom_acts_per_sec, recompute_code_acts_per_sec) are
+        MODELED pins stamped from THROUGHPUT round-6 arithmetic so the
+        comparator exercises the new schema — an ISSUE-12 session had no
+        TPU; the first on-chip bench run replaces them with measurements
+        (and perfdiff reports them as "new" against older envelopes either
+        way). Values only shape the smoke tests, which compare the fixture
+        against itself.
+    """
+    bench = {
+        "metric": (
+            "ensemble_sae_train_throughput "
+            "(8x tied-SAE 512->4096, batch 2048, bf16+scan128)"
+        ),
+        "fixture_note": (
+            "perfdiff schema pin; r05 keys measured on TPU v5 lite, "
+            "round-6 keys (topk_fused/int8mom/recompute_code) are MODELED "
+            "placeholders pending a TPU session — see "
+            "scripts/make_golden_fixture.py --bench-fixture"
+        ),
+        "value": 818039.4,
+        "unit": "activations/sec/chip",
+        "mfu": 0.697,
+        "device": "TPU v5 lite",
+        "rounds": 5,
+        "value_spread": [816556.6, 818505.8],
+        "harvest_tokens_per_sec": 26631.6,
+        "harvest_tokens_per_sec_spread": [23686.8, 27856.2],
+        "stream_rows_per_sec": 48993.7,
+        "stream_rows_per_sec_spread": [47237.8, 50142.8],
+        "fista500_codes_per_sec": 2058.1,
+        "fista500_codes_per_sec_spread": [1704.4, 2141.6],
+        "topk_steps_per_sec": 30.1,
+        "topk_steps_per_sec_spread": [30.0, 32.5],
+        # round-6 modeled pins (see fixture_note): fused TopK at ~0.6 MFU of
+        # its 1.35 TFLOP/step (~68 steps/s vs the 30.1 XLA path) ...
+        "topk_fused_steps_per_sec": 68.0,
+        "topk_fused_steps_per_sec_spread": [64.0, 71.0],
+        "control_matmul_tflops": 60.3,
+        "control_matmul_tflops_spread": [54.6, 60.6],
+        "bigbatch16k_acts_per_sec": 802482.5,
+        "bigbatch16k_acts_per_sec_spread": [759208.9, 804113.7],
+        # ... int8-mu headline modeled ~flat (r5b: the moment stream was
+        # already overlapped) ...
+        "headline_int8mom_acts_per_sec": 820000.0,
+        "headline_int8mom_acts_per_sec_spread": [812000.0, 828000.0],
+        # ... and code-recompute at r5b's modeled 0.775/0.69 five-pass MFU
+        # ratio over the measured headline, discounted for overlap
+        "recompute_code_acts_per_sec": 860000.0,
+        "recompute_code_acts_per_sec_spread": [845000.0, 882000.0],
+        "topk_fused_is_fused": True,
+        "topk_fused_speedup": 2.26,
+        "control_fraction_of_peak": 0.306,
+    }
+    with open(BENCH_FIXTURE, "w") as f:
+        json.dump(bench, f, indent=1)
+        f.write("\n")
+    print(f"Wrote {BENCH_FIXTURE}")
+
+
 FLEET_RUN_DIR = REPO / "tests" / "golden" / "fleet_run"
 FLEET_BASE_TS = 1_754_400_000.0  # fixed: the fixture must regenerate identically
 
@@ -722,6 +792,9 @@ def main():
         return
     if "--serve-run" in sys.argv:
         make_serve_run_fixture()
+        return
+    if "--bench-fixture" in sys.argv:
+        make_bench_fixture()
         return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")

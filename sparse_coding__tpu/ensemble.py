@@ -37,7 +37,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from sparse_coding__tpu.telemetry.events import tracked_jit
+from sparse_coding__tpu.telemetry.events import (
+    counter_inc_active,
+    event_active,
+    tracked_jit,
+)
 from sparse_coding__tpu.telemetry.health import (
     FIRE_EMA_KEY,
     HealthConfig,
@@ -127,6 +131,41 @@ def l1_warmup_buffers(buffers: Pytree, step: jax.Array, warmup_steps: int, sig=N
     return {**buffers, "l1_alpha": buffers["l1_alpha"] * ramp}
 
 
+# dtypes the fused-Adam kernels' `_adam_epilogue` actually implements for
+# moment storage (f32/bf16 dense; int8 via the QuantMoment tier). Anything
+# else must REFUSE the in-kernel path — a silently-diverging kernel is the
+# failure mode this whitelist exists to prevent.
+_FUSED_ADAM_MOMENT_DTYPES = (
+    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8),
+)
+_FUSED_ADAM_KWARGS = {
+    "learning_rate", "b1", "b2", "eps", "mu_dtype", "nu_dtype", "seed",
+}
+_FUSED_ADAM_WARNED: set = set()
+
+
+def _refuse_fused_adam(sig, reason: str) -> None:
+    """The fused-Adam gate's refusal path: the step falls back to the fused
+    GRADS kernel + the vmapped optax update — same semantics, more HBM
+    traffic — and says so ONCE per (signature, reason) via telemetry
+    (`ensemble.fused_adam_refused` counter + event) and a warning, instead
+    of silently running a slower program (ISSUE 12 satellite)."""
+    key = (getattr(sig, "__qualname__", str(sig)), reason)
+    if key in _FUSED_ADAM_WARNED:
+        return
+    _FUSED_ADAM_WARNED.add(key)
+    import warnings
+
+    warnings.warn(
+        f"fused-Adam kernel refused for {key[0]}: {reason}; falling back to "
+        "fused grads + optax (same update semantics, the optimizer stream "
+        "round-trips HBM)",
+        stacklevel=3,
+    )
+    counter_inc_active("ensemble.fused_adam_refused")
+    event_active("fused_adam_refused", sig=key[0], reason=reason)
+
+
 def _mask_updates(updates: Pytree, mask: jax.Array) -> Pytree:
     """Zero the optimizer updates of masked-out models, NaN-safely.
 
@@ -205,9 +244,13 @@ def make_ensemble_step(
       fused: compute grads via the signature's Pallas `fused_grads` kernel
         (`ops/tied_sae_kernel.py`) instead of `jax.grad`. Implies the bf16
         policy inside the kernel; no aux is returned on this path.
-      fused_adam: dict(lr, b1, b2, eps) — additionally run the optimizer
-        update inside the kernel (`fused_adam_step`); only valid when `tx`
-        IS optax.adam with those exact constants.
+      fused_adam: dict(lr, b1, b2, eps[, recompute_code]) — additionally run
+        the optimizer update inside the kernel (`fused_adam_step`); only
+        valid when `tx` IS optax.adam with those exact constants (moment
+        storage may be f32/bf16/int8 — the kernel reads the layout from the
+        opt state). ``recompute_code=True`` (the ``SC_RECOMPUTE_CODE=1``
+        lever) is threaded through to signatures whose bwd can rebuild the
+        code tile instead of round-tripping it.
       l1_warmup_steps: > 0 ramps every member's ``l1_alpha`` buffer linearly
         from ~0 to its configured value over that many steps, computed from
         ``state.step`` inside the trace (one compiled program serves the whole
@@ -633,29 +676,59 @@ class Ensemble:
             getattr(self, "fused", False)
             and self.optimizer_name == "adam"
             and hasattr(self.sig, "fused_adam_step")
-            and isinstance(self.optimizer_kwargs.get("learning_rate", 1e-3), (int, float))
+        ):
             # the in-kernel update is vanilla Adam: refuse kwargs that change
-            # optax.adam's semantics (nesterov, eps_root, ...). mu_dtype is
-            # supported — the kernel reads/writes mu in the state's dtype and
-            # accumulates in f32, exactly like optax. nu_dtype=bfloat16 is
-            # supported via the kernel's stochastic-rounding store (same
-            # contract as utils.optim.adam, THROUGHPUT §r4d)
+            # optax.adam's semantics (nesterov, eps_root, ...). mu_dtype /
+            # nu_dtype are supported for the dtypes `_adam_epilogue`
+            # implements: f32/bf16 dense storage (bf16 nu via the
+            # stochastic-rounding store, THROUGHPUT §r4d) and int8 via the
+            # QuantMoment per-row-absmax tier (round 6) — anything else, or
+            # any unknown kwarg, falls back to fused grads + vmapped optax
+            # with a one-time telemetry warning (`_refuse_fused_adam`)
+            # rather than silently diverging.
             # "seed" is harmless here: the kernel derives its rounding stream
             # from the step count, not utils.optim.adam's seed
-            and set(self.optimizer_kwargs)
-            <= {"learning_rate", "b1", "b2", "eps", "mu_dtype", "nu_dtype", "seed"}
-            # the kernel is only validated for f32/bf16 moment storage
-            and jnp.dtype(self.optimizer_kwargs.get("mu_dtype") or jnp.float32)
-            in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
-            and jnp.dtype(self.optimizer_kwargs.get("nu_dtype") or jnp.float32)
-            in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
-        ):
-            fused_adam = dict(
-                lr=float(self.optimizer_kwargs.get("learning_rate", 1e-3)),
-                b1=float(self.optimizer_kwargs.get("b1", 0.9)),
-                b2=float(self.optimizer_kwargs.get("b2", 0.999)),
-                eps=float(self.optimizer_kwargs.get("eps", 1e-8)),
+            extra = set(self.optimizer_kwargs) - _FUSED_ADAM_KWARGS
+            bad_dtypes = [
+                f"{name}={self.optimizer_kwargs.get(name)}"
+                for name in ("mu_dtype", "nu_dtype")
+                if jnp.dtype(self.optimizer_kwargs.get(name) or jnp.float32)
+                not in _FUSED_ADAM_MOMENT_DTYPES
+            ]
+            schedule_lr = not isinstance(
+                self.optimizer_kwargs.get("learning_rate", 1e-3), (int, float)
             )
+            if extra:
+                _refuse_fused_adam(
+                    self.sig, f"unknown optimizer kwargs {sorted(extra)}"
+                )
+            elif bad_dtypes:
+                _refuse_fused_adam(
+                    self.sig, f"unsupported moment storage {bad_dtypes}"
+                )
+            elif schedule_lr:
+                _refuse_fused_adam(
+                    self.sig, "non-scalar learning_rate (schedule)"
+                )
+            else:
+                fused_adam = dict(
+                    lr=float(self.optimizer_kwargs.get("learning_rate", 1e-3)),
+                    b1=float(self.optimizer_kwargs.get("b1", 0.9)),
+                    b2=float(self.optimizer_kwargs.get("b2", 0.999)),
+                    eps=float(self.optimizer_kwargs.get("eps", 1e-8)),
+                )
+                # opt-in code-recompute bwd (SC_RECOMPUTE_CODE=1): threaded
+                # as a kwarg only when on, so default traces/cache keys are
+                # unchanged; signatures without the round-trip (TopK) accept
+                # and ignore it
+                from sparse_coding__tpu.ops.tied_sae_kernel import (
+                    recompute_code_default,
+                )
+
+                if recompute_code_default():
+                    fused_adam["recompute_code"] = True
+        # observability + tests: which Adam path the compiled step will run
+        self.fused_adam = fused_adam
         kw = dict(
             unstacked=self.unstacked,
             compute_dtype=self.compute_dtype,
@@ -952,6 +1025,7 @@ def build_ensemble(
     optimizer: str = "adam",
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compute_dtype=None,
+    fused: Optional[bool] = None,
     l1_warmup_steps: int = 0,
     health: bool | HealthConfig = False,
     **common_hparams,
@@ -962,6 +1036,9 @@ def build_ensemble(
     ``{"l1_alpha": 1e-3}``); ``common_hparams`` the shared ones (e.g.
     ``activation_size=512, n_dict_components=2048``). This replaces the
     reference's per-experiment init loops (`big_sweep_experiments.py:209-229`).
+    ``fused`` passes through to `Ensemble` (None = auto; ``False`` pins the
+    XLA path — e.g. the bench's control keys must not silently change
+    meaning when a signature gains a fused kernel).
     """
     keys = jax.random.split(key, len(hparams_list))
     models = [
@@ -969,5 +1046,5 @@ def build_ensemble(
     ]
     return Ensemble(
         models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype,
-        l1_warmup_steps=l1_warmup_steps, health=health,
+        fused=fused, l1_warmup_steps=l1_warmup_steps, health=health,
     )

@@ -328,17 +328,24 @@ class FunctionalTiedSAE:
         return grads, loss_data
 
     @staticmethod
-    def fused_adam_step(params, buffers, batch, opt_state, lr, b1, b2, eps, interpret=False):
+    def fused_adam_step(
+        params, buffers, batch, opt_state, lr, b1, b2, eps,
+        interpret=False, recompute_code=False,
+    ):
         """Whole training step (grads + Adam) via the fully fused kernel.
 
         The encoder's gradient/moment/param updates happen inside the bwd
         Pallas kernel (`ops.tied_sae_kernel.tied_sae_adam_step_stacked`) — the
         gradient never reaches HBM; the (tiny) bias Adam update replicates
         optax's `scale_by_adam` formulas in jnp. ``opt_state`` must be the
-        optax.adam state tuple ``(ScaleByAdamState, ...)``; returns
-        ``(new_params, new_opt_state, loss_dict)`` matching one
-        ``tx.update`` + ``apply_updates`` step bit-for-bit in structure and
-        to bf16 tolerance in values.
+        optax.adam state tuple ``(ScaleByAdamState, ...)``; encoder moments
+        may be f32/bf16 arrays or int8 `utils.optim.QuantMoment`s (the
+        kernel dequantizes/requantizes in VMEM — compressed across HBM).
+        ``recompute_code=True`` (the ``SC_RECOMPUTE_CODE=1`` lever) rebuilds
+        the code tile in bwd instead of round-tripping the [M, B, N] code
+        tensor. Returns ``(new_params, new_opt_state, loss_dict)`` matching
+        one ``tx.update`` + ``apply_updates`` step bit-for-bit in structure
+        and to bf16 tolerance in values.
         """
         from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
 
@@ -348,8 +355,9 @@ class FunctionalTiedSAE:
         bc1 = 1.0 - jnp.power(b1, tf)
         bc2 = 1.0 - jnp.power(b2, tf)
         bc = jnp.stack([bc1, bc2], axis=-1)
-        # step count seeds the in-kernel stochastic-rounding stream for bf16
-        # nu storage (all members share the count; ignored for f32 nu)
+        # step count seeds the in-kernel stochastic-rounding/quantization
+        # streams for bf16/int8 moment storage (all members share the
+        # count; ignored for f32 moments)
         seed = t.reshape(-1)[0].astype(jnp.int32)
         d_new, mu_d, nu_d, g_bias, l_rec, l_l1_raw = tied_sae_adam_step_stacked(
             params["encoder"],
@@ -365,6 +373,7 @@ class FunctionalTiedSAE:
             float(b2),
             float(eps),
             interpret=interpret,
+            recompute_code=recompute_code,
         )
         b = params["encoder_bias"]
         bias_l2 = jnp.sqrt(jnp.maximum(jnp.sum(b * b, axis=-1), 1e-24))

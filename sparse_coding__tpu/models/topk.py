@@ -19,6 +19,14 @@ loop, no full-width argsort. (Round 2 sorted the full score row twice per
 member per step, `topk_mask_code`; that path is kept only as the semantic
 reference for tests.) For static k (inference) `lax.top_k` + scatter is used
 directly.
+
+Round 6: `TopKEncoderApprox` additionally carries the fused Pallas train
+step (`ops/topk_kernel.py` — encode, exact radix-select thresholding,
+decode, loss sums and the bwd/Adam contractions as three kernels, the
+[B, N]-sized intermediates' HBM round-trips mostly gone). On TPU with bf16
+compute the ensemble auto-selects it through the same `fused`/`fused_adam`
+dispatch as the tied SAE; the XLA path below remains the reference
+semantics and the CPU/fallback path.
 """
 
 from __future__ import annotations
@@ -250,6 +258,101 @@ class TopKEncoderApprox(TopKEncoder):
             branches = [topk_mask_code_approx(scores, k, cap, p) for p in palette]
             code = jnp.select([idx == i for i in range(len(palette))], branches)
         return jax.nn.relu(code)
+
+    # -- fused TPU step (ops/topk_kernel.py) --------------------------------
+    #
+    # Selection semantics on this path: the threshold is the EXACT k-th
+    # largest bf16 score (in-kernel radix select == recall_target 1.0); the
+    # member recall palette is deliberately ignored — recall < 1 exists to
+    # make the XLA PartialReduce cheap, and the radix select's cost does not
+    # depend on it. Ties with the threshold are all kept, exactly like the
+    # approx path's documented semantics.
+
+    @staticmethod
+    def fused_supported(params, buffers) -> bool:
+        """Construction-time gate: tile-divisible shapes and the TopK fwd
+        kernels' batch-independent VMEM fit (`ops.topk_kernel.
+        topk_fwd_fits` — the score-row scratch grows with n_features).
+        Batch-dependent bwd fit is checked per-trace via
+        `fused_batch_supported`."""
+        from sparse_coding__tpu.ops.topk_kernel import topk_fwd_fits
+
+        n_features, d_activation = params["dict"].shape
+        return (
+            n_features % 256 == 0
+            and d_activation % 128 == 0
+            and topk_fwd_fits(n_features, d_activation)
+        )
+
+    @staticmethod
+    def fused_batch_supported(stacked_params, batch_size: int, adam_fused: bool = True) -> bool:
+        """Trace-time gate mirroring `topk_adam_step_stacked`'s dispatch
+        (`ops.topk_kernel.topk_batch_supported`): fwd fit + the tied bwd
+        family's own predicate at the TopK bwd tiling."""
+        from sparse_coding__tpu.ops.topk_kernel import topk_batch_supported
+
+        n_features, d_activation = stacked_params["dict"].shape[-2:]
+        return topk_batch_supported(
+            n_features, d_activation, batch_size, adam_fused=adam_fused
+        )
+
+    @staticmethod
+    def fused_grads_stacked(params, buffers, batch, interpret: bool = False):
+        """Stacked-ensemble gradients + loss dict via the fused kernels.
+        Same contract as `FunctionalTiedSAE.fused_grads_stacked`: leading
+        model axes, shared [B, d] batch, bf16-policy math, no aux code
+        tensor (keeping it out of HBM is the point)."""
+        from sparse_coding__tpu.ops.topk_kernel import topk_grads_stacked
+
+        g, l_rec = topk_grads_stacked(
+            params["dict"], buffers["sparsity"], batch, interpret=interpret
+        )
+        return {"dict": g}, {"loss": l_rec}
+
+    @staticmethod
+    def fused_grads(params, buffers, batch, interpret: bool = False):
+        """Single-model convenience wrapper over `fused_grads_stacked`."""
+        p1 = jax.tree.map(lambda x: x[None], params)
+        b1 = jax.tree.map(lambda x: x[None], buffers)
+        grads, loss_data = TopKEncoderApprox.fused_grads_stacked(p1, b1, batch, interpret)
+        return (
+            jax.tree.map(lambda x: x[0], grads),
+            jax.tree.map(lambda x: x[0], loss_data),
+        )
+
+    @staticmethod
+    def fused_adam_step(
+        params, buffers, batch, opt_state, lr, b1, b2, eps,
+        interpret: bool = False, recompute_code: bool = False,
+    ):
+        """Whole training step (grads + Adam) via the fused kernels — the
+        TopK analogue of `FunctionalTiedSAE.fused_adam_step` (no bias/l1
+        terms; `opt_state` is the optax.adam state tuple; moments may be
+        f32/bf16 arrays or int8 `QuantMoment`s, updated entirely in VMEM).
+        ``recompute_code`` is accepted for dispatch uniformity and ignored:
+        the score tensor must round-trip HBM for the threshold regardless,
+        so recomputing the code in bwd would save only its write."""
+        del recompute_code
+        from sparse_coding__tpu.ops.topk_kernel import topk_adam_step_stacked
+
+        adam_st = opt_state[0]
+        t = adam_st.count + 1
+        tf = t.astype(jnp.float32)
+        bc = jnp.stack([1.0 - jnp.power(b1, tf), 1.0 - jnp.power(b2, tf)], axis=-1)
+        seed = t.reshape(-1)[0].astype(jnp.int32)
+        d_new, mu_new, nu_new, l_rec = topk_adam_step_stacked(
+            params["dict"], adam_st.mu["dict"], adam_st.nu["dict"], batch,
+            buffers["sparsity"], bc, seed,
+            float(lr), float(b1), float(b2), float(eps), interpret=interpret,
+        )
+        new_adam = adam_st._replace(
+            count=t, mu={"dict": mu_new}, nu={"dict": nu_new}
+        )
+        return (
+            {"dict": d_new},
+            (new_adam,) + tuple(opt_state[1:]),
+            {"loss": l_rec},
+        )
 
 
 class TopKLearnedDict(LearnedDict):

@@ -25,12 +25,32 @@ Semantics match `models.sae.FunctionalTiedSAE.loss` under the bf16 precision
 policy (`utils.precision`), for the un-whitened centering=None case; parity is
 asserted in tests (interpret mode) against `jax.grad` of that loss.
 
+Round-6 extensions (ISSUE 12):
+  - **int8 Adam moments**: mu/nu may arrive as `utils.optim.QuantMoment`
+    (int8 codes + per-row absmax scales, the chunk-store transport tier's
+    math). Dequantization, the fp32 EMA, and the stochastically-rounded
+    requantization all happen inside `_adam_epilogue` — the moments cross
+    the HBM boundary compressed, which is the whole point (a cast at the
+    boundary would stream fp32 anyway).
+  - **code-recompute bwd** (``recompute_code=True``, default from
+    ``SC_RECOMPUTE_CODE=1``): the fwd kernel skips the ``c`` store and the
+    bwd kernels rebuild each code tile from the resident x and the derived
+    dictionary tile (one extra MXU pass) — the [M, B, N] code tensor never
+    exists in HBM (§r5b modeled this at ~0.775 five-pass MFU vs 0.69;
+    perfdiff decides on the chip). Bit-identical to the round-trip path:
+    same bf16 operands, same f32 dot, same bf16 cast.
+  - The bwd+Adam call assembly is factored into `_bwd_adam_call` so the
+    TopK kernels (`ops/topk_kernel.py`) reuse the exact bwd/Adam programs
+    with ``l1_alpha = 0`` (a top-k code's selection mask and a relu's both
+    arrive as ``c > 0``).
+
 Reference being replaced: the torch autograd backward of
 `autoencoders/sae_ensemble.py:80-160` (no fused equivalent exists there).
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Tuple
 
@@ -39,9 +59,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sparse_coding__tpu.utils.optim import QuantMoment
+
 f32 = jnp.float32
 bf16 = jnp.bfloat16
 u32 = jnp.uint32
+
+
+def recompute_code_default() -> bool:
+    """The ``SC_RECOMPUTE_CODE=1`` opt-in (read at trace-build time by
+    `Ensemble._build_steps`; an env flip retraces on the next build)."""
+    return os.environ.get("SC_RECOMPUTE_CODE", "0") == "1"
 
 
 def _mix32(h):
@@ -54,6 +82,40 @@ def _mix32(h):
     h = h * u32(0xC2B2AE35)
     h = h ^ (h >> 16)
     return h
+
+
+def _uniform_bits(shape, seed_u32, hw_prng: bool):
+    """Uniform u32 bits for the in-kernel stochastic stores: the on-core
+    hardware PRNG when compiled, the `_mix32` counter hash in interpret mode
+    (the pltpu prng primitives have no interpret path in this JAX version).
+    Both deterministic given ``seed_u32``; streams differ across modes —
+    unbiasedness is the only property the stores need."""
+    if hw_prng:
+        pltpu.prng_seed(seed_u32)
+        return pltpu.prng_random_bits(shape).astype(u32)
+    r = jax.lax.broadcasted_iota(u32, shape, 0)
+    c = jax.lax.broadcasted_iota(u32, shape, 1)
+    return _mix32((r * u32(shape[1]) + c) ^ seed_u32)
+
+
+def _quantize_rows_int8_sr(x, seed_u32, hw_prng: bool):
+    """Symmetric per-row absmax int8 quantization with a stochastic store —
+    the in-kernel mirror of `utils.optim.quantize_rows_stochastic` (same
+    scale math as the chunk store's `quantize_rows_int8`; the bit stream
+    differs per `_uniform_bits`, which is fine: unbiasedness is the
+    contract, exact streams are not). Returns (q int8 [R, D], scale f32
+    [R, 1]); non-finite handling MATCHES the XLA path exactly — NaN ratios
+    store 0, ±inf saturate to ±127."""
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    v = x / scale
+    # nan_to_num(nan=0, posinf=127, neginf=-127), spelled out for Mosaic
+    v = jnp.clip(jnp.where(jnp.isnan(v), 0.0, v), -127.0, 127.0)
+    bits = _uniform_bits(v.shape, seed_u32, hw_prng)
+    # top-24-bits route: u32->f32 converts via a supported i32 path
+    u = (bits >> 8).astype(jnp.int32).astype(f32) * jnp.float32(2.0**-24)
+    q = jnp.clip(jnp.floor(v + u), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
 
 
 def _stochastic_round_bf16(x, seed_u32, hw_prng: bool):
@@ -73,13 +135,7 @@ def _stochastic_round_bf16(x, seed_u32, hw_prng: bool):
     are unbiased, which is the only property the nu EMA needs
     (utils/optim.py module doc, reason 2).
     """
-    if hw_prng:
-        pltpu.prng_seed(seed_u32)
-        bits = pltpu.prng_random_bits(x.shape).astype(u32)
-    else:
-        r = jax.lax.broadcasted_iota(u32, x.shape, 0)
-        c = jax.lax.broadcasted_iota(u32, x.shape, 1)
-        bits = _mix32((r * u32(x.shape[1]) + c) ^ seed_u32)
+    bits = _uniform_bits(x.shape, seed_u32, hw_prng)
     xb = jax.lax.bitcast_convert_type(x, u32)
     up = ((xb + (bits & u32(0xFFFF))) >> 16).astype(jnp.uint16)
     rounded = jax.lax.bitcast_convert_type(up, bf16)
@@ -89,15 +145,17 @@ def _stochastic_round_bf16(x, seed_u32, hw_prng: bool):
     return jnp.where(jnp.isfinite(x), rounded, x.astype(bf16))
 
 
-def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):
+def _fwd_body(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, n_tile, scale):
     """One (member, batch-tile) program: encode all dict tiles, accumulate
     x_hat, emit the scaled reconstruction cotangent.
 
     x_ref [Tb, D] bf16 (shared across members); d_ref [1, N, D] bf16 (whole
     member dictionary, VMEM-resident); b_ref [1, 1, N] f32; outputs
-    c_ref [1, Tb, N] bf16, dxh_ref [1, Tb, D] bf16, lrec/ll1 [M, 1] whole-
-    array SMEM buffers indexed by member, accumulated across batch tiles
-    (t is the fastest grid dim).
+    c_ref [1, Tb, N] bf16 (None on the code-recompute path — the bwd kernel
+    rebuilds each tile and the code tensor never exists in HBM),
+    dxh_ref [1, Tb, D] bf16, lrec/ll1 [M, 1] whole-array SMEM buffers
+    indexed by member, accumulated across batch tiles (t is the fastest
+    grid dim).
     """
     m = pl.program_id(0)
     x = x_ref[:]
@@ -113,7 +171,8 @@ def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_til
         )
         c = jnp.maximum(cpre, 0.0)
         cb = c.astype(bf16)
-        c_ref[0, :, sl] = cb
+        if c_ref is not None:
+            c_ref[0, :, sl] = cb
         xh = xh + jax.lax.dot_general(cb, dj, (((1,), (0,)), ((), ())), preferred_element_type=f32)
         ll1 += jnp.sum(c)
     err = xh - x.astype(f32)
@@ -126,6 +185,16 @@ def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_til
     lrec_ref[m, 0] += jnp.sum(err * err)
     ll1_ref[m, 0] += ll1
     dxh_ref[0, :, :] = (scale * err).astype(bf16)
+
+
+def _fwd_kernel(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):
+    _fwd_body(x_ref, d_ref, b_ref, c_ref, dxh_ref, lrec_ref, ll1_ref, n_tile, scale)
+
+
+def _fwd_kernel_nocode(x_ref, d_ref, b_ref, dxh_ref, lrec_ref, ll1_ref, *, n_tile, scale):
+    """`_fwd_kernel` without the code store — the recompute-code path's fwd
+    (the bwd kernels rebuild each code tile from resident operands)."""
+    _fwd_body(x_ref, d_ref, b_ref, None, dxh_ref, lrec_ref, ll1_ref, n_tile, scale)
 
 
 def _bwd_kernel(l1b_ref, x_ref, dxh_ref, d_ref, nrm_ref, c_ref, gd_ref, gb_ref):
@@ -158,11 +227,33 @@ def _bwd_kernel(l1b_ref, x_ref, dxh_ref, d_ref, nrm_ref, c_ref, gd_ref, gb_ref):
     gb_ref[0, 0, :] = jnp.sum(dc, axis=0)
 
 
+def _moments_from(it, int8: bool):
+    """Pull one moment operand group off the ref iterator: a 1-tuple (dense
+    f32/bf16 tile ref) or, for int8 storage, a 2-tuple (q tile ref, per-row
+    scale ref)."""
+    a = next(it)
+    return (a, next(it)) if int8 else (a,)
+
+
+def _code_tile(cb_ref, x, dj, recompute: bool):
+    """The code tile the bwd contractions consume: read back from HBM
+    (cb_ref = the fwd kernel's [1, B(or Tb), Nt] bf16 block), or rebuilt
+    from the resident x and the derived dictionary tile (cb_ref = the
+    [1, 1, Nt] f32 bias block) for one extra MXU pass. The rebuild is
+    bit-identical to the fwd store: same bf16 operands (dj is the same
+    fp32-divide + bf16-round tile), same f32-accumulated dot, same bf16
+    cast."""
+    if not recompute:
+        return cb_ref[0]
+    cpre = jax.lax.dot_general(
+        x, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32
+    ) + cb_ref[0, 0, :][None, :]
+    return jnp.maximum(cpre, 0.0).astype(bf16)
+
+
 def _bwd_adam_kernel(
-    l1b_ref, hp_ref, bc_ref, seed_ref, x_ref, dxh_ref, nrm_ref, c_ref,
-    draw_ref, mu_ref, nu_ref,
-    dnew_ref, munew_ref, nunew_ref, gb_ref,
-    *, hw_prng: bool,
+    l1b_ref, hp_ref, bc_ref, seed_ref, *refs,
+    hw_prng: bool, mu_int8: bool, nu_int8: bool, recompute: bool,
 ):
     """`_bwd_kernel` + the Adam update for the encoder, all in VMEM: the
     encoder gradient is consumed by the moment/param updates without ever
@@ -174,21 +265,32 @@ def _bwd_adam_kernel(
     complements computed in python-float precision by the caller (see the
     moment-update comment below); bc_ref [M, 2] f32 =
     per-member bias corrections (1-b1^t, 1-b2^t); seed_ref [1] int32 step
-    seed for the nu stochastic-rounding stream (unused for f32 nu). Blocks:
-    draw [1, Nt, D] f32 raw encoder; mu/nu [1, Nt, D] Adam moments (mu may
-    be bf16 when the optimizer uses `mu_dtype=bfloat16`; nu may be bf16 with
-    `nu_dtype=bfloat16`, stored via stochastic rounding — see
-    `utils/optim.py` for why round-to-nearest would freeze the EMA); outputs
-    dnew/munew/nunew.
+    seed for the stochastic store streams (unused for f32 moments).
+
+    ``refs`` (layout assembled by `_bwd_adam_call`, flags static):
+    x [B, D] bf16, dxh [1, B, D] bf16, nrm [1, 1, Nt] f32, then the code
+    block [1, B, Nt] bf16 (or the bias block [1, 1, Nt] f32 when
+    ``recompute`` — see `_code_tile`), draw [1, Nt, D] f32, the mu then nu
+    input groups (dense [1, Nt, D] tile in the storage dtype, or int8 q
+    [1, Nt, D] + scale [1, 1, Nt] f32 pairs), then outputs: dnew, the mu/nu
+    output groups (same layouts), g_bias [1, 1, Nt] f32.
     """
     m = pl.program_id(0)
+    it = iter(refs)
+    x_ref, dxh_ref, nrm_ref, cb_ref, draw_ref = (next(it) for _ in range(5))
+    mu_in = _moments_from(it, mu_int8)
+    nu_in = _moments_from(it, nu_int8)
+    dnew_ref = next(it)
+    mu_out = _moments_from(it, mu_int8)
+    nu_out = _moments_from(it, nu_int8)
+    gb_ref = next(it)
     x = x_ref[:]
     dxh = dxh_ref[0]
-    cj = c_ref[0]
     nrm_col = nrm_ref[0, 0, :][:, None]
     # normalized rows derived in VMEM (fp32 divide + bf16 round, bit-identical
     # to the old separate d_hat-bf16 HBM stream and to `_bwd_kernel`'s tile)
     dj = (draw_ref[0] / nrm_col).astype(bf16)
+    cj = _code_tile(cb_ref, x, dj, recompute)
     djf = dj.astype(f32)
     dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
     dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
@@ -204,46 +306,66 @@ def _bwd_adam_kernel(
     # complements in hp[4]/hp[5], storage-dtype b1*mu, f32 nu EMA,
     # per-(step, member, dict-tile) stochastic-rounding seed)
     _adam_epilogue(
-        g, draw_ref[0], mu_ref[0], nu_ref[0], hp_ref, bc_ref, seed_ref,
-        m, pl.program_id(1), dnew_ref, munew_ref, nunew_ref, hw_prng,
+        g, draw_ref[0], mu_in, nu_in, hp_ref, bc_ref, seed_ref,
+        m, pl.program_id(1), dnew_ref, mu_out, nu_out, hw_prng,
     )
 
 
 def _adam_epilogue(
-    g, draw, mu_prev, nu_prev, hp_ref, bc_ref, seed_ref, m, j,
-    dnew_ref, munew_ref, nunew_ref, hw_prng: bool,
+    g, draw, mu_in, nu_in, hp_ref, bc_ref, seed_ref, m, j,
+    dnew_ref, mu_out, nu_out, hw_prng: bool,
 ):
-    """Shared Adam tail of the two bwd kernels: moments, bias correction,
-    param update, (stochastically-rounded) stores. `g` is the full-batch
-    gradient tile w.r.t. the RAW encoder; `draw` the raw encoder tile."""
+    """Shared Adam tail of the bwd kernels (tied-SAE and TopK): moments,
+    bias correction, param update, (stochastically-rounded/quantized)
+    stores. `g` is the full-batch gradient tile w.r.t. the RAW encoder;
+    `draw` the raw encoder tile. ``mu_in``/``nu_in``/``mu_out``/``nu_out``
+    are the 1- or 2-tuple ref groups of `_moments_from`: int8 moments are
+    dequantized HERE, updated in fp32, and requantized HERE — they cross
+    the HBM boundary compressed."""
     lr = hp_ref[0]
     b1 = hp_ref[1]
     b2 = hp_ref[2]
     eps = hp_ref[3]
     # hp[4]/hp[5]: python-float (1-b1)/(1-b2) — see tied_sae_adam_step_stacked
-    mu = (b1.astype(mu_prev.dtype) * mu_prev).astype(f32) + hp_ref[4] * g
-    nu = b2 * nu_prev.astype(f32) + hp_ref[5] * g * g
+    if len(mu_in) == 2:
+        mu_prev = mu_in[0][0].astype(f32) * mu_in[1][0, 0, :][:, None]
+        mu = b1 * mu_prev + hp_ref[4] * g
+    else:
+        mu_prev = mu_in[0][0]
+        mu = (b1.astype(mu_prev.dtype) * mu_prev).astype(f32) + hp_ref[4] * g
+    if len(nu_in) == 2:
+        nu_prev = nu_in[0][0].astype(f32) * nu_in[1][0, 0, :][:, None]
+    else:
+        nu_prev = nu_in[0][0].astype(f32)
+    nu = b2 * nu_prev + hp_ref[5] * g * g
     mhat = mu / bc_ref[m, 0]
     vhat = nu / bc_ref[m, 1]
-    munew_ref[0, :, :] = mu.astype(munew_ref.dtype)
-    if nunew_ref.dtype == bf16:
-        seed = _mix32(
-            seed_ref[0].astype(u32)
-            ^ (jnp.asarray(m).astype(u32) * u32(0x9E3779B9))
-            ^ (jnp.asarray(j).astype(u32) * u32(0x7FEB352D))
-        )
-        nunew_ref[0, :, :] = _stochastic_round_bf16(nu, seed, hw_prng)
+    base_seed = (
+        seed_ref[0].astype(u32)
+        ^ (jnp.asarray(m).astype(u32) * u32(0x9E3779B9))
+        ^ (jnp.asarray(j).astype(u32) * u32(0x7FEB352D))
+    )
+    if len(mu_out) == 2:
+        qm, sm = _quantize_rows_int8_sr(mu, _mix32(base_seed ^ u32(0x5117A55A)), hw_prng)
+        mu_out[0][0, :, :] = qm
+        mu_out[1][0, 0, :] = sm[:, 0]
     else:
-        nunew_ref[0, :, :] = nu
+        mu_out[0][0, :, :] = mu.astype(mu_out[0].dtype)
+    if len(nu_out) == 2:
+        qn, sn = _quantize_rows_int8_sr(nu, _mix32(base_seed ^ u32(0x00A11CE5)), hw_prng)
+        nu_out[0][0, :, :] = qn
+        nu_out[1][0, 0, :] = sn[:, 0]
+    elif nu_out[0].dtype == bf16:
+        nu_out[0][0, :, :] = _stochastic_round_bf16(nu, _mix32(base_seed), hw_prng)
+    else:
+        nu_out[0][0, :, :] = nu
     dnew_ref[0, :, :] = draw - lr * mhat / (jnp.sqrt(vhat) + eps)
 
 
 def _bwd_adam_accum_kernel(
-    l1b_ref, hp_ref, bc_ref, seed_ref, x_ref, dxh_ref, nrm_ref, c_ref,
-    draw_ref, mu_ref, nu_ref,
-    dnew_ref, munew_ref, nunew_ref, gb_ref,
-    g_acc,
-    *, hw_prng: bool, n_batch_tiles: int,
+    l1b_ref, hp_ref, bc_ref, seed_ref, *refs,
+    hw_prng: bool, n_batch_tiles: int, mu_int8: bool, nu_int8: bool,
+    recompute: bool,
 ):
     """Large-batch variant of `_bwd_adam_kernel`: grid (M, dict-tiles,
     batch-tiles) with the batch dim INNERMOST. The dictionary/moment tiles
@@ -255,15 +377,26 @@ def _bwd_adam_accum_kernel(
 
     Extra traffic vs the resident kernel: x and dxh are re-streamed once per
     dict tile (2·(N/dict_tile)·D bytes/row ≈ 33 KB/row at the bench shape —
-    vs the ~166 KB/row param stream it replaces at batch 2048)."""
+    vs the ~166 KB/row param stream it replaces at batch 2048). ``refs``
+    layout matches `_bwd_adam_kernel` (batch-tiled x/dxh/code blocks) plus
+    the trailing g_acc VMEM scratch."""
     m = pl.program_id(0)
     j = pl.program_id(1)  # hoisted: program_id inside pl.when fails interpret
     t = pl.program_id(2)
+    it = iter(refs)
+    x_ref, dxh_ref, nrm_ref, cb_ref, draw_ref = (next(it) for _ in range(5))
+    mu_in = _moments_from(it, mu_int8)
+    nu_in = _moments_from(it, nu_int8)
+    dnew_ref = next(it)
+    mu_out = _moments_from(it, mu_int8)
+    nu_out = _moments_from(it, nu_int8)
+    gb_ref = next(it)
+    g_acc = next(it)
     x = x_ref[:]
     dxh = dxh_ref[0]
-    cj = c_ref[0]
     nrm_col = nrm_ref[0, 0, :][:, None]
     dj = (draw_ref[0] / nrm_col).astype(bf16)
+    cj = _code_tile(cb_ref, x, dj, recompute)
     dc = jax.lax.dot_general(dxh, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32)
     dc = jnp.where(cj.astype(f32) > 0, dc + l1b_ref[m], 0.0)
     dcb = dc.astype(bf16)
@@ -292,23 +425,173 @@ def _bwd_adam_accum_kernel(
         radial = jnp.sum(g_dhat * djf, axis=-1, keepdims=True)
         g = (g_dhat - djf * radial) / nrm_col
         _adam_epilogue(
-            g, draw_ref[0], mu_ref[0], nu_ref[0], hp_ref, bc_ref, seed_ref,
-            m, j, dnew_ref, munew_ref, nunew_ref, hw_prng,
+            g, draw_ref[0], mu_in, nu_in, hp_ref, bc_ref, seed_ref,
+            m, j, dnew_ref, mu_out, nu_out, hw_prng,
         )
+
+
+def _moment_operands(mom, M, N, D, dict_tile, tile_map, scale_map):
+    """(input arrays, block specs, out ShapeDtypeStructs) for one Adam
+    moment: a dense [M, N, D] tile stream in the storage dtype, or — for
+    `QuantMoment` storage — the int8 code tensor plus the [M, 1, N] per-row
+    scale stream (out specs mirror the in specs; scales are tiny)."""
+    if isinstance(mom, QuantMoment):
+        return (
+            [mom.q, mom.scale.reshape(M, 1, N).astype(f32)],
+            [
+                pl.BlockSpec((1, dict_tile, D), tile_map),
+                pl.BlockSpec((1, 1, dict_tile), scale_map),
+            ],
+            [
+                jax.ShapeDtypeStruct((M, N, D), jnp.int8),
+                jax.ShapeDtypeStruct((M, 1, N), f32),
+            ],
+        )
+    return (
+        [mom],
+        [pl.BlockSpec((1, dict_tile, D), tile_map)],
+        [jax.ShapeDtypeStruct((M, N, D), mom.dtype)],
+    )
+
+
+def _rewrap_moment(mom_prev, outs, M, N):
+    """Reassemble a kernel output group into the caller's moment layout."""
+    if isinstance(mom_prev, QuantMoment):
+        q, scale = outs
+        return QuantMoment(q=q, scale=scale.reshape(M, N))
+    return outs[0]
+
+
+def _bwd_adam_call(
+    xb, dxh, nrm3, bias3, c, d_raw, mu_d, nu_d, l1_over_b, hp, bc, seed,
+    *, batch_tile, dict_tile, interpret, force_accum, recompute_code,
+    include_fwd=True,
+):
+    """Assemble and run the fused bwd+Adam pallas_call for one stacked
+    encode/decode dictionary — shared by the tied-SAE step and the TopK step
+    (`ops/topk_kernel.py`, which passes ``l1_over_b = 0``). Dispatches
+    between the batch-resident kernel and the batch-tiled accumulating one
+    exactly as before; ``c = None`` + ``recompute_code`` swaps the code
+    stream for the bias block and one extra MXU pass (`_code_tile`).
+    Returns (d_new, mu_new, nu_new, g_bias [M, 1, N])."""
+    M, N, D = d_raw.shape
+    B = xb.shape[0]
+    prefetch = (
+        l1_over_b, hp, bc.astype(f32), jnp.asarray(seed, jnp.int32).reshape(1),
+    )
+    mu_int8 = isinstance(mu_d, QuantMoment)
+    nu_int8 = isinstance(nu_d, QuantMoment)
+    kernel_kw = dict(
+        hw_prng=not interpret, mu_int8=mu_int8, nu_int8=nu_int8,
+        recompute=recompute_code,
+    )
+    if not force_accum and fused_fits(
+        N, D, B, batch_tile, dict_tile, adam_tiles=True, include_fwd=include_fwd
+    ):
+        # batch fits VMEM-resident: the (M, dict-tiles) kernel reads x/dxh
+        # once and keeps them resident across dict tiles
+        tile3 = lambda m, j, *_: (m, j, 0)
+        scale_map = lambda m, j, *_: (m, 0, j)
+        kernel = partial(_bwd_adam_kernel, **kernel_kw)
+        grid = (M, N // dict_tile)
+        x_specs = [
+            pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
+            pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
+        ]
+        cb_input = bias3 if recompute_code else c
+        cb_spec = pl.BlockSpec(
+            (1, 1, dict_tile) if recompute_code else (1, B, dict_tile), scale_map
+        )
+        scratch_shapes = []
+        n_bt = None
+    else:
+        # large batch: (M, dict-tiles, batch-tiles) accumulating kernel —
+        # gradient lives in a VMEM scratch, params/moments stream ONCE per
+        # step whatever the batch (`_bwd_adam_accum_kernel`)
+        a_bt = ACCUM_BATCH_TILE
+        if not accum_path_supported(N, D, B, dict_tile, include_fwd=include_fwd):
+            raise ValueError(
+                f"no fused Adam kernel covers B={B} at ({N},{D}) with "
+                f"dict_tile={dict_tile}: resident kernel does not fit and "
+                f"accum kernel needs B%{a_bt}==0, accum_fits and the fwd "
+                "fused_fits — gate callers with fused_batch_supported / "
+                "adam_step_supported"
+            )
+        n_bt = B // a_bt
+        tile3 = lambda m, j, t, *_: (m, j, 0)
+        scale_map = lambda m, j, t, *_: (m, 0, j)
+        kernel = partial(_bwd_adam_accum_kernel, n_batch_tiles=n_bt, **kernel_kw)
+        grid = (M, N // dict_tile, n_bt)
+        x_specs = [
+            pl.BlockSpec((a_bt, D), lambda m, j, t, *_: (t, 0)),
+            pl.BlockSpec((1, a_bt, D), lambda m, j, t, *_: (m, t, 0)),
+        ]
+        cb_input = bias3 if recompute_code else c
+        cb_spec = (
+            pl.BlockSpec((1, 1, dict_tile), scale_map)
+            if recompute_code
+            else pl.BlockSpec((1, a_bt, dict_tile), lambda m, j, t, *_: (m, t, j))
+        )
+        scratch_shapes = [pltpu.VMEM((dict_tile, D), f32)]
+
+    mu_in, mu_specs, mu_outs = _moment_operands(mu_d, M, N, D, dict_tile, tile3, scale_map)
+    nu_in, nu_specs, nu_outs = _moment_operands(nu_d, M, N, D, dict_tile, tile3, scale_map)
+    in_specs = x_specs + [
+        pl.BlockSpec((1, 1, dict_tile), scale_map),  # nrm3
+        cb_spec,
+        pl.BlockSpec((1, dict_tile, D), tile3),  # d_raw
+    ] + mu_specs + nu_specs
+    out_specs = (
+        [pl.BlockSpec((1, dict_tile, D), tile3)]
+        + mu_specs + nu_specs
+        + [pl.BlockSpec((1, 1, dict_tile), scale_map)]
+    )
+    out_shape = (
+        [jax.ShapeDtypeStruct((M, N, D), f32)]
+        + mu_outs + nu_outs
+        + [jax.ShapeDtypeStruct((M, 1, N), f32)]
+    )
+    # write the new encoder/moments into the donated input buffers: inside a
+    # scanned train step the carry must live in fixed buffers, and without
+    # aliasing XLA inserts a 67 MB copy per array per step (indices count
+    # the scalar-prefetch operands). d_raw sits at input index 8 (4 prefetch
+    # + x/dxh/nrm/cb), output 0; the moment groups follow in order.
+    aliases = {8: 0}
+    for off in range(len(mu_in) + len(nu_in)):
+        aliases[9 + off] = 1 + off
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch_shapes,
+        ),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*prefetch, xb, dxh, nrm3, cb_input, d_raw, *mu_in, *nu_in)
+    it = iter(outs)
+    d_new = next(it)
+    mu_new = _rewrap_moment(mu_d, [next(it) for _ in mu_in], M, N)
+    nu_new = _rewrap_moment(nu_d, [next(it) for _ in nu_in], M, N)
+    g_bias = next(it)
+    return d_new, mu_new, nu_new, g_bias
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "lr", "b1", "b2", "eps", "batch_tile", "dict_tile", "interpret",
-        "force_accum",
+        "force_accum", "recompute_code",
     ),
 )
 def tied_sae_adam_step_stacked(
     d_raw: jax.Array,
     bias: jax.Array,
-    mu_d: jax.Array,
-    nu_d: jax.Array,
+    mu_d,
+    nu_d,
     batch: jax.Array,
     l1_alpha: jax.Array,
     bc: jax.Array,
@@ -321,15 +604,22 @@ def tied_sae_adam_step_stacked(
     dict_tile: int = 256,
     interpret: bool = False,
     force_accum: bool = False,
+    recompute_code: bool = False,
 ):
     """Fused fwd + bwd + encoder-Adam for the stacked tied-SAE ensemble.
 
     d_raw [M, N, D] f32 raw encoder; mu_d/nu_d its Adam moments (mu bf16 with
     `mu_dtype=bfloat16`; nu bf16 with `nu_dtype=bfloat16`, stored via
     stochastic rounding seeded by `seed` [1] int32 — pass the step count so
-    the stream differs per step); bc [M, 2] bias corrections (1-b1^t, 1-b2^t)
-    for THIS step. Returns (d_new, mu_new, nu_new, g_bias, l_rec, l_l1_raw).
-    The bias' own Adam update (tiny) is left to the caller.
+    the stream differs per step; either may be a `utils.optim.QuantMoment`
+    for int8 storage — dequant/EMA/requant happen inside `_adam_epilogue`,
+    the moments cross HBM compressed). bc [M, 2] bias corrections
+    (1-b1^t, 1-b2^t) for THIS step. ``recompute_code=True`` skips the
+    [M, B, N] code round-trip: the fwd kernel writes no code tensor and the
+    bwd kernels rebuild each tile for one extra MXU pass (§r5b's modeled
+    lever; default from ``SC_RECOMPUTE_CODE=1`` at the ensemble layer).
+    Returns (d_new, mu_new, nu_new, g_bias, l_rec, l_l1_raw). The bias' own
+    Adam update (tiny) is left to the caller.
     """
     M, N, D = d_raw.shape
     B = batch.shape[0]
@@ -345,120 +635,55 @@ def tied_sae_adam_step_stacked(
     b3 = bias.astype(f32).reshape(M, 1, N)
     scale = 2.0 / (B * D)
 
-    c, dxh, lrec, ll1 = pl.pallas_call(
-        partial(_fwd_kernel, n_tile=fwd_tile, scale=scale),
+    fwd_kernel = (
+        partial(_fwd_kernel_nocode, n_tile=fwd_tile, scale=scale)
+        if recompute_code
+        else partial(_fwd_kernel, n_tile=fwd_tile, scale=scale)
+    )
+    code_out_specs = (
+        [] if recompute_code
+        else [pl.BlockSpec((1, batch_tile, N), lambda m, t: (m, t, 0))]
+    )
+    code_out_shape = (
+        [] if recompute_code else [jax.ShapeDtypeStruct((M, B, N), bf16)]
+    )
+    fwd_outs = pl.pallas_call(
+        fwd_kernel,
         grid=(M, B // batch_tile),
         in_specs=[
             pl.BlockSpec((batch_tile, D), lambda m, t: (t, 0)),
             pl.BlockSpec((1, N, D), lambda m, t: (m, 0, 0)),
             pl.BlockSpec((1, 1, N), lambda m, t: (m, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, batch_tile, N), lambda m, t: (m, t, 0)),
+        out_specs=code_out_specs + [
             pl.BlockSpec((1, batch_tile, D), lambda m, t: (m, t, 0)),
             pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((M, 1), lambda m, t: (0, 0), memory_space=pltpu.SMEM),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((M, B, N), bf16),
+        out_shape=code_out_shape + [
             jax.ShapeDtypeStruct((M, B, D), bf16),
             jax.ShapeDtypeStruct((M, 1), f32),
             jax.ShapeDtypeStruct((M, 1), f32),
         ],
         interpret=interpret,
     )(xb, db, b3)
+    if recompute_code:
+        c = None
+        dxh, lrec, ll1 = fwd_outs
+    else:
+        c, dxh, lrec, ll1 = fwd_outs
 
     l1_over_b = (jnp.asarray(l1_alpha, f32) / B).reshape(M)
     # lr/b1/b2/eps are STATIC (python floats at trace time), so `1 - b1` here
     # is python-double subtraction rounded once to f32 — the same value
     # optax's update_moment uses; a traced f32 `1.0 - b1` would be ~3 ulp off
     hp = jnp.asarray([lr, b1, b2, eps, 1 - b1, 1 - b2], f32)
-    tile3 = lambda m, j, *_: (m, j, 0)
-    prefetch = (
-        l1_over_b, hp, bc.astype(f32), jnp.asarray(seed, jnp.int32).reshape(1),
-    )
-    out_shape = [
-        jax.ShapeDtypeStruct((M, N, D), f32),
-        jax.ShapeDtypeStruct((M, N, D), mu_d.dtype),
-        jax.ShapeDtypeStruct((M, N, D), nu_d.dtype),
-        jax.ShapeDtypeStruct((M, 1, N), f32),
-    ]
     nrm3 = nrm.astype(f32).reshape(M, 1, N)
-    if not force_accum and fused_fits(N, D, B, batch_tile, dict_tile, adam_tiles=True):
-        # batch fits VMEM-resident: the (M, dict-tiles) kernel reads x/dxh
-        # once and keeps them resident across dict tiles
-        d_new, mu_new, nu_new, g_bias = pl.pallas_call(
-            partial(_bwd_adam_kernel, hw_prng=not interpret),
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=4,
-                grid=(M, N // dict_tile),
-                in_specs=[
-                    pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
-                    pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
-                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
-                    pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                ],
-                out_specs=[
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                    pl.BlockSpec((1, dict_tile, D), tile3),
-                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
-                ],
-            ),
-            out_shape=out_shape,
-            # write the new encoder/moments into the donated input buffers:
-            # inside a scanned train step the carry must live in fixed
-            # buffers, and without aliasing XLA inserts a 67 MB copy per
-            # array per step (indices count the scalar-prefetch operands)
-            input_output_aliases={8: 0, 9: 1, 10: 2},
-            interpret=interpret,
-        )(*prefetch, xb, dxh, nrm3, c, d_raw, mu_d, nu_d)
-    else:
-        # large batch: (M, dict-tiles, batch-tiles) accumulating kernel —
-        # gradient lives in a VMEM scratch, params/moments stream ONCE per
-        # step whatever the batch (`_bwd_adam_accum_kernel`)
-        a_bt = ACCUM_BATCH_TILE
-        if not accum_path_supported(N, D, B, dict_tile):
-            raise ValueError(
-                f"no fused Adam kernel covers B={B} at ({N},{D}) with "
-                f"dict_tile={dict_tile}: resident kernel does not fit and "
-                f"accum kernel needs B%{a_bt}==0, accum_fits and the fwd "
-                "fused_fits — gate callers with fused_batch_supported / "
-                "adam_step_supported"
-            )
-        n_bt = B // a_bt
-        tile_mj = lambda m, j, t, *_: (m, j, 0)
-        d_new, mu_new, nu_new, g_bias = pl.pallas_call(
-            partial(
-                _bwd_adam_accum_kernel, hw_prng=not interpret, n_batch_tiles=n_bt
-            ),
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=4,
-                grid=(M, N // dict_tile, n_bt),
-                in_specs=[
-                    pl.BlockSpec((a_bt, D), lambda m, j, t, *_: (t, 0)),
-                    pl.BlockSpec((1, a_bt, D), lambda m, j, t, *_: (m, t, 0)),
-                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, t, *_: (m, 0, j)),
-                    pl.BlockSpec((1, a_bt, dict_tile), lambda m, j, t, *_: (m, t, j)),
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                ],
-                out_specs=[
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                    pl.BlockSpec((1, dict_tile, D), tile_mj),
-                    pl.BlockSpec((1, 1, dict_tile), lambda m, j, t, *_: (m, 0, j)),
-                ],
-                scratch_shapes=[pltpu.VMEM((dict_tile, D), f32)],
-            ),
-            out_shape=out_shape,
-            input_output_aliases={8: 0, 9: 1, 10: 2},
-            interpret=interpret,
-        )(*prefetch, xb, dxh, nrm3, c, d_raw, mu_d, nu_d)
+    d_new, mu_new, nu_new, g_bias = _bwd_adam_call(
+        xb, dxh, nrm3, b3, c, d_raw, mu_d, nu_d, l1_over_b, hp, bc, seed,
+        batch_tile=batch_tile, dict_tile=dict_tile, interpret=interpret,
+        force_accum=force_accum, recompute_code=recompute_code,
+    )
 
     l_rec = lrec[:, 0] / (B * D)
     l_l1_raw = ll1[:, 0] / B
@@ -519,17 +744,34 @@ def tied_sae_grads_stacked(
     )(xb, db, b3)
 
     l1_over_b = (jnp.asarray(l1_alpha, f32) / B).reshape(M)
-    g_enc, g_bias = pl.pallas_call(
+    g_enc, g_bias = _bwd_grads_call(
+        xb, dxh, db, nrm.astype(f32).reshape(M, 1, N), c, l1_over_b,
+        dict_tile=dict_tile, interpret=interpret,
+    )
+
+    l_rec = lrec[:, 0] / (B * D)
+    l_l1_raw = ll1[:, 0] / B
+    return g_enc, g_bias[:, 0, :], l_rec, l_l1_raw
+
+
+def _bwd_grads_call(xb, dxh, db, nrm3, c, l1_over_b, *, dict_tile, interpret):
+    """Assemble and run the plain-grads bwd pallas_call (`_bwd_kernel`) —
+    shared by `tied_sae_grads_stacked` and the TopK grads path
+    (`ops/topk_kernel.py`, ``l1_over_b = 0``). Returns
+    (g_enc [M, N, D] f32, g_bias [M, 1, N] f32)."""
+    M, _, N = nrm3.shape
+    D = xb.shape[1]
+    return pl.pallas_call(
         _bwd_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(M, N // dict_tile),
             in_specs=[
-                pl.BlockSpec((B, D), lambda m, j, *_: (0, 0)),
-                pl.BlockSpec((1, B, D), lambda m, j, *_: (m, 0, 0)),
+                pl.BlockSpec((xb.shape[0], D), lambda m, j, *_: (0, 0)),
+                pl.BlockSpec((1, xb.shape[0], D), lambda m, j, *_: (m, 0, 0)),
                 pl.BlockSpec((1, dict_tile, D), lambda m, j, *_: (m, j, 0)),
                 pl.BlockSpec((1, 1, dict_tile), lambda m, j, *_: (m, 0, j)),
-                pl.BlockSpec((1, B, dict_tile), lambda m, j, *_: (m, 0, j)),
+                pl.BlockSpec((1, xb.shape[0], dict_tile), lambda m, j, *_: (m, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, dict_tile, D), lambda m, j, *_: (m, j, 0)),
@@ -541,11 +783,7 @@ def tied_sae_grads_stacked(
             jax.ShapeDtypeStruct((M, 1, N), f32),
         ],
         interpret=interpret,
-    )(l1_over_b, xb, dxh, db, nrm.astype(f32).reshape(M, 1, N), c)
-
-    l_rec = lrec[:, 0] / (B * D)
-    l_l1_raw = ll1[:, 0] / B
-    return g_enc, g_bias[:, 0, :], l_rec, l_l1_raw
+    )(l1_over_b, xb, dxh, db, nrm3, c)
 
 
 def on_tpu() -> bool:
@@ -575,19 +813,23 @@ ACCUM_BATCH_TILE = 1024
 
 
 def accum_path_supported(
-    n_dict: int, d_act: int, batch: int, dict_tile: int = 256
+    n_dict: int, d_act: int, batch: int, dict_tile: int = 256,
+    include_fwd: bool = True,
 ) -> bool:
     """THE predicate of `tied_sae_adam_step_stacked`'s batch-tiled
     accumulating branch — the exact condition whose failure raises its
     trace-time ValueError. One definition, shared by the kernel's guard and
     `FunctionalTiedSAE.fused_batch_supported`, so the gate and the error can
-    never disagree (they previously duplicated the terms)."""
+    never disagree (they previously duplicated the terms).
+    ``include_fwd=False`` drops the tied fwd kernel's whole-dict-resident
+    term — the TopK step reuses only the bwd kernels and brings its own
+    tiled fwd (`ops.topk_kernel.topk_fwd_fits`)."""
     return (
         batch % ACCUM_BATCH_TILE == 0
         and accum_fits(n_dict, d_act, dict_tile)
         # the shared fwd kernel keeps the whole member dict VMEM-resident —
         # its batch-independent fit is part of this path's contract too
-        and fused_fits(n_dict, d_act, None)
+        and (not include_fwd or fused_fits(n_dict, d_act, None))
     )
 
 
@@ -597,16 +839,22 @@ def adam_step_supported(
     batch: int,
     batch_tile: int = 256,
     dict_tile: int = 256,
+    include_fwd: bool = True,
 ) -> bool:
     """Whether SOME fused-Adam kernel covers (shape, batch, tiles): the
     batch-resident kernel's VMEM fit, or the accumulating kernel's
     (`accum_path_supported`). Mirrors `tied_sae_adam_step_stacked`'s
-    dispatch exactly, including its tile-divisibility ValueError."""
+    dispatch exactly, including its tile-divisibility ValueError.
+    ``include_fwd=False``: bwd-only view for the TopK reuse (see
+    `accum_path_supported`)."""
     if batch % batch_tile or n_dict % dict_tile:
         return False
     return fused_fits(
-        n_dict, d_act, batch, batch_tile, dict_tile, adam_tiles=True
-    ) or accum_path_supported(n_dict, d_act, batch, dict_tile)
+        n_dict, d_act, batch, batch_tile, dict_tile, adam_tiles=True,
+        include_fwd=include_fwd,
+    ) or accum_path_supported(
+        n_dict, d_act, batch, dict_tile, include_fwd=include_fwd
+    )
 
 
 def accum_fits(
@@ -636,6 +884,7 @@ def fused_fits(
     batch_tile: int = 256,
     dict_tile: int | None = None,
     adam_tiles: bool = True,
+    include_fwd: bool = True,
 ) -> bool:
     """Whether the fused tied-SAE kernels' VMEM working sets fit.
 
@@ -647,17 +896,19 @@ def fused_fits(
     streams the dictionary and gradient tiles at ``dict_tile`` 512
     (`_bwd_kernel`) — the defaults of `tied_sae_adam_step_stacked` and
     `tied_sae_grads_stacked` respectively; pass ``dict_tile`` explicitly if
-    calling those with non-default tiles.
+    calling those with non-default tiles. ``include_fwd=False`` checks only
+    the bwd kernel (the TopK reuse brings its own fwd).
     """
     if dict_tile is None:
         dict_tile = 256 if adam_tiles else 512
-    fwd = (
-        2 * n_dict * d_act * 2  # member dictionary, double-buffered
-        + 2 * batch_tile * (n_dict + 2 * d_act) * 2  # c out tile + x + dxh
-        + batch_tile * d_act * 4  # f32 x_hat accumulator
-    )
-    if fwd > VMEM_BUDGET_BYTES:
-        return False
+    if include_fwd:
+        fwd = (
+            2 * n_dict * d_act * 2  # member dictionary, double-buffered
+            + 2 * batch_tile * (n_dict + 2 * d_act) * 2  # c out tile + x + dxh
+            + batch_tile * d_act * 4  # f32 x_hat accumulator
+        )
+        if fwd > VMEM_BUDGET_BYTES:
+            return False
     if batch is not None:
         bwd = (
             batch * d_act * 2 * 2  # resident x + dxh (bf16)

@@ -1,0 +1,396 @@
+"""Fused Pallas TPU kernels for the TopK train step (ISSUE 12 tentpole).
+
+Why (THROUGHPUT.md round 6 / BENCH_r05): the TopK train step ran as jnp glue
+at ~30 steps/s on the config-4 geometry (7 members, 768→12288, batch 2048) —
+far under its matmul roofline — because every [B, N] intermediate (scores,
+the candidate strip, the code, the code cotangent) round-trips HBM between
+XLA fusions, and the dense scatter/threshold machinery adds passes of its
+own. These kernels compute the whole stacked step as three Pallas programs
+with the member axis as an outer grid dimension:
+
+  scores (grid (M, batch-tiles, dict-tiles), dict innermost): encode tile
+      ``s = x·D_m^T`` on the MXU, write the bf16 score tensor ONCE, keep the
+      batch-tile's full score row in a VMEM scratch, and — on the last dict
+      tile — find each row's k-th largest score EXACTLY by a 16-pass radix
+      select over the bf16 bit patterns (monotone-ordered u16 space; per-row
+      bisection builds the threshold bit by bit, each pass one
+      compare+count over the resident row). No sort, no scatter, no
+      candidate strip in HBM. The per-member ``k`` arrives as scalar
+      prefetch, so a mixed-k sweep runs as one program.
+  decode (grid (M, batch-tiles, dict-tiles), dict innermost): threshold mask
+      + relu in VMEM, write the bf16 code (consumed by bwd), accumulate
+      x_hat in a VMEM scratch across dict tiles, emit the scaled
+      reconstruction cotangent and the loss sums on the last tile.
+  bwd(+Adam): EXACTLY the tied-SAE bwd kernels (`tied_sae_kernel.
+      _bwd_adam_call` / `_bwd_grads_call`) with ``l1_alpha = 0`` — a top-k
+      selection mask and a relu derivative both reach the backward as
+      ``c > 0``, and the TopK loss has no l1/bias term. The normalization
+      VJP, the VMEM-resident Adam update (f32/bf16/int8 moment storage),
+      and the batch-innermost accumulating large-batch variant all carry
+      over unchanged. The (tiny) bias-gradient output is discarded — TopK
+      has no bias parameter.
+
+Selection semantics: the threshold is the EXACT k-th largest bf16 score
+(radix select is exact, not approximate), entries TIED with it are all kept,
+and relu zeroes non-positive survivors — i.e. `models.topk.
+topk_mask_code_approx` at recall_target = 1.0. `TopKEncoderApprox`'s recall
+palette is deliberately ignored on this path: recall < 1 exists to make the
+XLA PartialReduce cheap, and the radix select costs O(16·N) VPU ops per row
+regardless. Training parity tests pin the fused step against `jax.grad` of
+that threshold-semantics loss (tests/test_topk_fused.py).
+
+Unlike the tied-SAE fwd kernel, NOTHING here requires the whole member
+dictionary to be VMEM-resident — the dictionary streams in tiles — so the
+config-4 geometry (12288×768 ≈ 18.9 MB bf16) is in scope. The decode kernel
+re-streams the dictionary once per batch tile (its one luxury; batch tiles
+are sized 1024 to bound it); `SC_RECOMPUTE_CODE` is a no-op here — the
+score tensor must round-trip for the threshold regardless, so recomputing
+the code in bwd would save only its write.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sparse_coding__tpu.ops.tied_sae_kernel import (
+    VMEM_BUDGET_BYTES,
+    _bwd_adam_call,
+    _bwd_grads_call,
+    adam_step_supported,
+    fused_fits,
+)
+
+f32 = jnp.float32
+bf16 = jnp.bfloat16
+i32 = jnp.int32
+
+# bwd tile narrower than the tied default: three f32 moment tiles at
+# d_act=768 (config-4 geometry) must fit beside the resident batch
+TOPK_BWD_DICT_TILE = 128
+# decode batch tile (bounds the dictionary re-stream: one pass per tile)
+DECODE_BATCH_TILE = 1024
+# radix-select count chunk: bf16->i32 upcast temp stays ~[Tb, 2048]
+_SELECT_CHUNK = 2048
+
+
+def _ordered_i32(sb):
+    """Map bf16 scores to a monotone non-negative i32 key: bitcast to u16,
+    then ``b | 0x8000`` for non-negatives and ``~b`` for negatives — float
+    order becomes unsigned-integer order (computed in i32: Mosaic's 16-bit
+    vector compare support is spotty on v5e, the widened form lowers
+    everywhere)."""
+    b = jax.lax.bitcast_convert_type(sb, jnp.uint16).astype(i32)
+    return jnp.where(b >= 0x8000, 0xFFFF - b, b + 0x8000)
+
+
+def _unordered_bf16(ordered):
+    """Inverse of `_ordered_i32`: i32 key back to the bf16 value."""
+    b = jnp.where(ordered >= 0x8000, ordered - 0x8000, 0xFFFF - ordered)
+    return jax.lax.bitcast_convert_type(b.astype(jnp.uint16), bf16)
+
+
+def _topk_scores_kernel(
+    k_ref, x_ref, d_ref, scores_ref, thresh_ref, s_scratch,
+    *, n_dict_tiles: int, dict_tile: int,
+):
+    """One (member, batch-tile, dict-tile) program: encode tile, stash the
+    row in scratch; on the last dict tile, radix-select each row's exact
+    k-th largest score as the member's threshold.
+
+    k_ref: scalar-prefetch [M] i32 per-member sparsity. Blocks: x [Tb, D]
+    bf16 (shared across members), d [1, Nt, D] bf16; outs scores
+    [1, Tb, Nt] bf16, thresh [1, Tb] f32 (written on the last dict tile —
+    the block index is (m, t), constant across the inner dict dim, so the
+    buffer flushes exactly once). Scratch: the batch-tile's full score row
+    [Tb, N] bf16, rebuilt every (m, t).
+    """
+    m = pl.program_id(0)
+    j = pl.program_id(2)
+    x = x_ref[:]
+    dj = d_ref[0]
+    s = jax.lax.dot_general(
+        x, dj, (((1,), (1,)), ((), ())), preferred_element_type=f32
+    )
+    sb = s.astype(bf16)
+    scores_ref[0, :, :] = sb
+    s_scratch[:, pl.ds(j * dict_tile, dict_tile)] = sb
+
+    @pl.when(j == n_dict_tiles - 1)
+    def _select():
+        tb, n = s_scratch.shape
+        chunk = _SELECT_CHUNK if n % _SELECT_CHUNK == 0 else n
+        k = k_ref[m]
+        # bisect the 16-bit ordered key from the MSB down: after the loop,
+        # ``prefix`` is the LARGEST key with count(row >= key) >= k — i.e.
+        # exactly the k-th largest value's key (the feasible set is
+        # downward closed, and greedy MSB descent finds its max).
+        prefix = jnp.zeros((tb, 1), i32)
+        for bit in range(15, -1, -1):
+            cand = prefix + (1 << bit)
+            cnt = jnp.zeros((tb, 1), i32)
+            for c0 in range(0, n, chunk):
+                u = _ordered_i32(s_scratch[:, pl.ds(c0, chunk)])
+                cnt += jnp.sum((u >= cand).astype(i32), axis=1, keepdims=True)
+            prefix = jnp.where(cnt >= k, cand, prefix)
+        thresh_ref[0, :] = _unordered_bf16(prefix[:, 0]).astype(f32)
+
+
+def _topk_decode_kernel(
+    scores_ref, thresh_ref, d_ref, x_ref, c_ref, dxh_ref, lrec_ref, xh_scratch,
+    *, n_dict_tiles: int, scale: float,
+):
+    """One (member, batch-tile, dict-tile) program: threshold mask + relu,
+    code store, x_hat accumulation; loss sums and the scaled reconstruction
+    cotangent on the last dict tile. Mirrors the tied `_fwd_body` epilogue
+    (same scale, same SMEM loss layout) so the bwd kernels are drop-in.
+    """
+    m = pl.program_id(0)
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    s = scores_ref[0]
+    sf = s.astype(f32)
+    tcol = thresh_ref[0][:, None]
+    # keep scores at-or-above the k-th largest (ties all kept), relu'd —
+    # masks in f32 (no bf16 vector compare on v5e)
+    cb = jnp.where((sf >= tcol) & (sf > 0), s, jnp.zeros((), bf16))
+    c_ref[0, :, :] = cb
+    part = jax.lax.dot_general(
+        cb, d_ref[0], (((1,), (0,)), ((), ())), preferred_element_type=f32
+    )
+
+    @pl.when(j == 0)
+    def _init():
+        xh_scratch[:, :] = part
+
+    @pl.when(j > 0)
+    def _accum():
+        xh_scratch[:, :] += part
+
+    @pl.when((j == n_dict_tiles - 1) & (t == 0))
+    def _init_loss():
+        lrec_ref[m, 0] = 0.0
+
+    @pl.when(j == n_dict_tiles - 1)
+    def _emit():
+        err = xh_scratch[:, :] - x_ref[:].astype(f32)
+        lrec_ref[m, 0] += jnp.sum(err * err)
+        dxh_ref[0, :, :] = (scale * err).astype(bf16)
+
+
+def _topk_fwd(d_hat_b, k, batch, batch_tile, dict_tile, interpret):
+    """Run the two fwd kernels; returns (c, dxh, lrec, scale artifacts)."""
+    M, N, D = d_hat_b.shape
+    B = batch.shape[0]
+    xb = batch.astype(bf16)
+    n_dt = N // dict_tile
+    scores, thresh = pl.pallas_call(
+        partial(_topk_scores_kernel, n_dict_tiles=n_dt, dict_tile=dict_tile),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(M, B // batch_tile, n_dt),
+            in_specs=[
+                pl.BlockSpec((batch_tile, D), lambda m, t, j, *_: (t, 0)),
+                pl.BlockSpec((1, dict_tile, D), lambda m, t, j, *_: (m, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, batch_tile, dict_tile), lambda m, t, j, *_: (m, t, j)),
+                pl.BlockSpec((1, batch_tile), lambda m, t, j, *_: (m, t)),
+            ],
+            scratch_shapes=[pltpu.VMEM((batch_tile, N), bf16)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((M, B, N), bf16),
+            jax.ShapeDtypeStruct((M, B), f32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(k, i32).reshape(M), xb, d_hat_b)
+
+    dec_tile = DECODE_BATCH_TILE if B % DECODE_BATCH_TILE == 0 else batch_tile
+    scale = 2.0 / (B * D)
+    c, dxh, lrec = pl.pallas_call(
+        partial(_topk_decode_kernel, n_dict_tiles=n_dt, scale=scale),
+        grid=(M, B // dec_tile, n_dt),
+        in_specs=[
+            pl.BlockSpec((1, dec_tile, dict_tile), lambda m, t, j: (m, t, j)),
+            pl.BlockSpec((1, dec_tile), lambda m, t, j: (m, t)),
+            pl.BlockSpec((1, dict_tile, D), lambda m, t, j: (m, j, 0)),
+            pl.BlockSpec((dec_tile, D), lambda m, t, j: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dec_tile, dict_tile), lambda m, t, j: (m, t, j)),
+            pl.BlockSpec((1, dec_tile, D), lambda m, t, j: (m, t, 0)),
+            pl.BlockSpec((M, 1), lambda m, t, j: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, B, N), bf16),
+            jax.ShapeDtypeStruct((M, B, D), bf16),
+            jax.ShapeDtypeStruct((M, 1), f32),
+        ],
+        scratch_shapes=[pltpu.VMEM((dec_tile, D), f32)],
+        interpret=interpret,
+    )(scores, thresh, d_hat_b, xb)
+    return xb, c, dxh, lrec
+
+
+@partial(
+    jax.jit,
+    static_argnames=("batch_tile", "dict_tile", "interpret"),
+)
+def topk_grads_stacked(
+    d_raw: jax.Array,
+    k: jax.Array,
+    batch: jax.Array,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Stacked-ensemble TopK gradient w.r.t. the RAW dictionary.
+
+    d_raw [M, N, D] f32; k [M] i32 per-member sparsity; batch [B, D] shared.
+    Returns (g_dict [M, N, D] f32 — through the normalization VJP,
+    l_rec [M] f32 = the MSE loss). Gate with `topk_batch_supported`.
+    """
+    M, N, D = d_raw.shape
+    B = batch.shape[0]
+    if B % batch_tile or N % dict_tile or N % TOPK_BWD_DICT_TILE:
+        raise ValueError(
+            f"shapes ({B},{N}) not divisible by tiles "
+            f"({batch_tile},{dict_tile},{TOPK_BWD_DICT_TILE})"
+        )
+    nrm = jnp.sqrt(jnp.sum(d_raw * d_raw, axis=-1))
+    d_hat_b = (d_raw / nrm[..., None]).astype(bf16)
+    xb, c, dxh, lrec = _topk_fwd(d_hat_b, k, batch, batch_tile, dict_tile, interpret)
+    # the tied bwd kernel with l1=0: selection mask == relu mask == c > 0;
+    # dict_tile 256 (not the tied 512 default) fits the d=768 geometry
+    g_enc, _g_bias = _bwd_grads_call(
+        xb, dxh, d_hat_b, nrm.astype(f32).reshape(M, 1, N), c,
+        jnp.zeros((M,), f32), dict_tile=256 if N % 256 == 0 else TOPK_BWD_DICT_TILE,
+        interpret=interpret,
+    )
+    return g_enc, lrec[:, 0] / (B * D)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "lr", "b1", "b2", "eps", "batch_tile", "dict_tile", "interpret",
+        "force_accum",
+    ),
+)
+def topk_adam_step_stacked(
+    d_raw: jax.Array,
+    mu_d,
+    nu_d,
+    batch: jax.Array,
+    k: jax.Array,
+    bc: jax.Array,
+    seed: jax.Array,
+    lr: float,
+    b1: float,
+    b2: float,
+    eps: float,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+    interpret: bool = False,
+    force_accum: bool = False,
+):
+    """Fused fwd + bwd + Adam for the stacked TopK ensemble.
+
+    Same contract as `tied_sae_adam_step_stacked` minus the bias/l1 terms:
+    mu_d/nu_d may be arrays (f32/bf16 storage) or `utils.optim.QuantMoment`
+    (int8); bc [M, 2] bias corrections for THIS step; seed [1] i32 step
+    count for the stochastic store streams. Returns
+    (d_new, mu_new, nu_new, l_rec).
+    """
+    M, N, D = d_raw.shape
+    B = batch.shape[0]
+    bwd_tile = TOPK_BWD_DICT_TILE
+    if B % batch_tile or N % dict_tile or N % bwd_tile:
+        raise ValueError(
+            f"shapes ({B},{N}) not divisible by tiles "
+            f"({batch_tile},{dict_tile},{bwd_tile})"
+        )
+    nrm = jnp.sqrt(jnp.sum(d_raw * d_raw, axis=-1))
+    d_hat_b = (d_raw / nrm[..., None]).astype(bf16)
+    xb, c, dxh, lrec = _topk_fwd(d_hat_b, k, batch, batch_tile, dict_tile, interpret)
+    hp = jnp.asarray([lr, b1, b2, eps, 1 - b1, 1 - b2], f32)
+    d_new, mu_new, nu_new, _g_bias = _bwd_adam_call(
+        xb, dxh, nrm.astype(f32).reshape(M, 1, N), None, c, d_raw, mu_d, nu_d,
+        jnp.zeros((M,), f32), hp, bc, seed,
+        batch_tile=batch_tile, dict_tile=bwd_tile, interpret=interpret,
+        force_accum=force_accum, recompute_code=False, include_fwd=False,
+    )
+    return d_new, mu_new, nu_new, lrec[:, 0] / (B * D)
+
+
+def topk_fwd_fits(
+    n_dict: int,
+    d_act: int,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+) -> bool:
+    """VMEM fit of the two TopK fwd kernels — batch-independent (both tile
+    the batch; the scores kernel's scratch grows with n_dict, which is the
+    binding constraint: a batch-tile's full score row must sit in VMEM for
+    the radix select). Same coarse-estimate philosophy as `fused_fits`."""
+    # the radix select counts in chunks of _SELECT_CHUNK columns — but ONLY
+    # when n_dict divides evenly; otherwise the kernel falls back to one
+    # whole-row chunk, and the i32 upcast temp must be budgeted at full
+    # width (the predicate must mirror `_topk_scores_kernel`'s choice
+    # exactly or it approves shapes the kernel cannot fit)
+    sel_chunk = _SELECT_CHUNK if n_dict % _SELECT_CHUNK == 0 else n_dict
+    score = (
+        2 * batch_tile * d_act * 2        # x tile, buffered
+        + 2 * dict_tile * d_act * 2       # dict tile, buffered
+        + 2 * batch_tile * dict_tile * 2  # scores out tile, buffered
+        + batch_tile * n_dict * 2         # score-row scratch (bf16)
+        + batch_tile * sel_chunk * 4      # i32 select chunk temp
+        + batch_tile * dict_tile * 4      # f32 encode accumulator
+    )
+    if score > VMEM_BUDGET_BYTES:
+        return False
+    dec_tile = DECODE_BATCH_TILE
+    decode = (
+        2 * 2 * dec_tile * dict_tile * 2  # scores in + c out, buffered
+        + 2 * dict_tile * d_act * 2       # dict tile, buffered
+        + 2 * dec_tile * d_act * 2        # x tile, buffered
+        + 2 * dec_tile * d_act * 2        # dxh out, buffered
+        + dec_tile * d_act * 4            # x_hat accumulator scratch
+        + dec_tile * dict_tile * 4        # f32 mask/dot temp
+    )
+    return decode <= VMEM_BUDGET_BYTES
+
+
+def topk_batch_supported(
+    n_dict: int,
+    d_act: int,
+    batch: int,
+    adam_fused: bool = True,
+    batch_tile: int = 256,
+    dict_tile: int = 256,
+) -> bool:
+    """Whether the fused TopK kernels cover (shape, batch): fwd fit +
+    divisibility, and the tied bwd family's own predicate at the TopK bwd
+    tiling (`adam_step_supported` at dict_tile 128 for the Adam kernels —
+    resident or batch-tiled accumulating; plain-grads kernel at 256).
+    Mirrors `topk_adam_step_stacked`'s trace-time ValueError exactly."""
+    if batch % batch_tile or n_dict % dict_tile or n_dict % TOPK_BWD_DICT_TILE:
+        return False
+    if not topk_fwd_fits(n_dict, d_act, batch_tile, dict_tile):
+        return False
+    if adam_fused:
+        return adam_step_supported(
+            n_dict, d_act, batch, batch_tile=batch_tile,
+            dict_tile=TOPK_BWD_DICT_TILE, include_fwd=False,
+        )
+    grad_tile = 256 if n_dict % 256 == 0 else TOPK_BWD_DICT_TILE
+    return fused_fits(
+        n_dict, d_act, batch, batch_tile=batch_tile, dict_tile=grad_tile,
+        adam_tiles=False, include_fwd=False,
+    )

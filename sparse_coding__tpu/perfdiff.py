@@ -81,8 +81,10 @@ def compare(
 
     ``status`` is ``"ok"`` (inside the weather-scaled old spread),
     ``"regressed"`` (new median below old spread-min * ratio * (1-threshold)),
-    ``"improved"`` (above old spread-max * ratio * (1+threshold)), or
-    ``"control"``/``"missing"``.
+    ``"improved"`` (above old spread-max * ratio * (1+threshold)),
+    ``"control"``/``"missing"``, or ``"new"`` — a measured key present only
+    in NEW (a bench that grew a key must still compare cleanly against an
+    older BENCH_r* envelope; new keys are reported, never gated).
     """
     ratio = 1.0
     oc, nc = old.get(control_key), new.get(control_key)
@@ -116,6 +118,15 @@ def compare(
             else:
                 row["status"] = "ok"
         rows.append(row)
+    old_keys = set(_measured_keys(old))
+    for key in _measured_keys(new):
+        if key in old_keys:
+            continue
+        rows.append({
+            "key": key, "old": None, "old_spread": None,
+            "new": float(new[key]), "delta": None, "adj_delta": None,
+            "status": "new",
+        })
     return {
         "control_ratio": round(ratio, 4),
         "threshold": threshold,
@@ -143,6 +154,7 @@ _STATUS_LABEL = {
     "improved": "improved",
     "control": "(control)",
     "missing": "missing in NEW",
+    "new": "new in NEW",
 }
 
 
@@ -156,9 +168,12 @@ def render_table(result: Dict[str, Any]) -> str:
         "|---|---:|---:|---:|---:|---:|---|",
     ]
     for r in result["rows"]:
-        lo, hi = r["old_spread"]
+        spread = (
+            "-" if r["old_spread"] is None
+            else f"[{_fmt(r['old_spread'][0])}, {_fmt(r['old_spread'][1])}]"
+        )
         lines.append(
-            f"| {r['key']} | {_fmt(r['old'])} | [{_fmt(lo)}, {_fmt(hi)}] "
+            f"| {r['key']} | {_fmt(r['old'])} | {spread} "
             f"| {_fmt(r['new'])} | {_fmt_pct(r['delta'])} "
             f"| {_fmt_pct(r['adj_delta'])} | {_STATUS_LABEL[r['status']]} |"
         )

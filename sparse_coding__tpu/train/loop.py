@@ -384,6 +384,15 @@ def ensemble_train_loop(
     step/dispatch counters — Python ints, zero device syncs; chunk-level
     events stay with the drivers, which know the chunk indices.
     """
+    if telemetry is not None:
+        # which execution path the compiled step runs (THROUGHPUT's
+        # refutation protocol needs the artifact to say, not the reader to
+        # guess): fused Pallas grads, in-kernel Adam, or plain XLA
+        telemetry.gauge_set("train.fused", float(bool(getattr(ensemble, "fused", False))))
+        telemetry.gauge_set(
+            "train.fused_adam",
+            float(getattr(ensemble, "fused_adam", None) is not None),
+        )
     if fista_update is None:
         fista_update = bool(getattr(ensemble.sig, "has_fista_decoder_update", False))
     fista_fn = (

@@ -1,4 +1,5 @@
-"""Adam with compressed second-moment storage (``nu_dtype``) via stochastic rounding.
+"""Adam with compressed moment storage (``mu_dtype``/``nu_dtype``) via
+stochastic rounding — bf16 and int8 tiers.
 
 Why this exists (THROUGHPUT.md §r4c): the fused tied-SAE train step is
 memory-bound on its parameter/optimizer stream — params 134 MB + Adam moments
@@ -21,9 +22,25 @@ distinct reasons this module is built to avoid:
    expectation with ~0.2% relative storage noise (≈0.1% on the ``sqrt(nu)``
    denominator — per-parameter lr jitter far below Adam's own noise floor).
 
+**int8 tier (round 6)**: ``mu_dtype``/``nu_dtype`` may also be ``"int8"`` —
+symmetric per-row absmax quantization (the chunk store's transport tier,
+`data.chunks.quantize_rows_int8`: ``row ≈ q * scale``, scale = absmax/127,
+all-zero rows get scale 1) applied to every moment leaf of ndim >= 2, stored
+as a `QuantMoment` pytree node (int8 codes + one fp32 scale per row).
+Quarter the bf16 footprint per compressed moment; 1-D leaves (biases) stay
+fp32 — per-row scales need a row axis, and the bias stream is noise. The
+same two safety rules apply, sharpened: the EMA is still computed in fp32
+from the *dequantized* previous value, and the store is *stochastically*
+rounded (``floor(x/scale + u)``, u ~ U[0,1)) — an int8 step at a typical row
+is ~0.8% of absmax, so round-to-nearest would freeze exactly like bf16 does.
+The storage noise is ~absmax/254 per element: elements far below their row's
+absmax carry large RELATIVE noise, which is why int8 moments are an opt-in
+capacity knob with a parity study (THROUGHPUT round 6), not a default.
+
 The fused Pallas kernel mirrors this contract with the on-core PRNG
-(`ops/tied_sae_kernel.py:_bwd_adam_kernel`); this module is the XLA/CPU path
-and the reference semantics.
+(`ops/tied_sae_kernel.py:_adam_epilogue` — moments dequantized, updated and
+requantized in VMEM, never cast at the HBM boundary); this module is the
+XLA/CPU path and the reference semantics.
 
 The reference framework has no counterpart (torchopt adam keeps fp32 moments;
 `/root/reference/autoencoders/ensemble.py:85-95` inits torchopt state) — this
@@ -32,6 +49,7 @@ is a TPU-HBM-bandwidth optimization with measured loss parity.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -39,6 +57,59 @@ import jax.numpy as jnp
 import optax
 
 _MASK16 = jnp.uint32(0xFFFF)
+_INT8 = jnp.dtype(jnp.int8)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantMoment:
+    """An int8-quantized Adam moment leaf: ``value ≈ q * scale[..., None]``.
+
+    ``q`` int8 with the parent param leaf's shape; ``scale`` fp32 with that
+    shape minus the last axis (one symmetric absmax scale per row — the
+    chunk-store transport tier's layout). A pytree node, so vmapped optax
+    updates, checkpointing, and `jax.device_get` all traverse it untouched.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    def dequant(self) -> jax.Array:
+        return self.q.astype(jnp.float32) * self.scale[..., None]
+
+
+def quantize_rows_stochastic(x: jax.Array, key: jax.Array) -> QuantMoment:
+    """Symmetric per-row absmax int8 quantization with an unbiased store.
+
+    Scale math is `data.chunks.quantize_rows_int8`'s (absmax/127, all-zero
+    rows get scale 1); the rounding is ``floor(v + u)`` with u ~ U[0,1) so
+    ``E[q * scale] = x`` exactly — round-to-nearest would freeze the moment
+    EMA (module doc, reason 2). Non-finite handling (shared EXACTLY with the
+    in-kernel mirror, `ops.tied_sae_kernel._quantize_rows_int8_sr`): NaN
+    ratios store 0, ±inf saturate to ±127 — int8 has no inf payload; the
+    blown-up scale still records the divergence.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    v = xf / scale[..., None]
+    v = jnp.nan_to_num(v, nan=0.0, posinf=127.0, neginf=-127.0)
+    u = jax.random.uniform(key, xf.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(v + u), -127, 127).astype(jnp.int8)
+    return QuantMoment(q=q, scale=scale)
+
+
+def _moment_dequant(m):
+    return m.dequant() if isinstance(m, QuantMoment) else m
+
+
+def _moment_map(f, ref_tree, *moment_trees):
+    """`jax.tree.map` over ``ref_tree``'s leaf positions while letting the
+    moment trees carry `QuantMoment` SUBTREES at those positions (a plain
+    tree.map would descend into the node and break on structure mismatch)."""
+    flat_ref, treedef = jax.tree.flatten(ref_tree)
+    flats = [treedef.flatten_up_to(t) for t in moment_trees]
+    return treedef.unflatten([f(*args) for args in zip(flat_ref, *flats)])
 
 
 def stochastic_round(x: jax.Array, key: jax.Array, dtype) -> jax.Array:
@@ -71,39 +142,60 @@ def scale_by_adam_compressed(
     nu_dtype=None,
     seed: int = 0,
 ) -> optax.GradientTransformation:
-    """`optax.scale_by_adam` + a ``nu_dtype`` storage policy (see module doc).
+    """`optax.scale_by_adam` + ``mu_dtype``/``nu_dtype`` storage policies
+    (see module doc).
 
     Bit-compatibility contract:
       - ``nu_dtype=None`` → the update math IS optax's (same expressions, same
         python-float complements); only code identity differs.
-      - ``mu_dtype`` follows optax exactly (decay multiply in storage dtype,
-        cast-back at the end) so existing mu_dtype=bf16 numbers carry over.
+      - ``mu_dtype`` in float dtypes follows optax exactly (decay multiply in
+        storage dtype, cast-back at the end) so existing mu_dtype=bf16
+        numbers carry over.
       - ``nu_dtype=bfloat16`` → fp32 EMA + bias-corrected update from the
         UNROUNDED fp32 value; only the carried state is stochastically rounded.
         The rounding stream is derived from (seed, step) — deterministic given
         the seed, and NOT correlated step-to-step. State layout stays
         `optax.ScaleByAdamState`, so checkpoints/fused-kernel plumbing that
         read ``.count/.mu/.nu`` keep working.
+      - ``mu_dtype="int8"`` / ``nu_dtype="int8"`` → leaves of ndim >= 2
+        become `QuantMoment` nodes (per-row absmax int8, stochastic store);
+        the EMA and the bias-corrected update always use the dequantized
+        fp32 value, so the update math degrades only by the carried storage
+        noise. 1-D leaves stay fp32.
     """
     mu_dtype = None if mu_dtype is None else jnp.dtype(mu_dtype)
     nu_dtype = None if nu_dtype is None else jnp.dtype(nu_dtype)
-    if nu_dtype not in (None, jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
-        raise ValueError(f"nu_dtype must be None/float32/bfloat16, got {nu_dtype}")
+    _ok = (None, jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16), _INT8)
+    if nu_dtype not in _ok:
+        raise ValueError(f"nu_dtype must be None/float32/bfloat16/int8, got {nu_dtype}")
+
+    def _init_moment(p, dtype):
+        if dtype == _INT8 and p.ndim >= 2:
+            return QuantMoment(
+                q=jnp.zeros(p.shape, jnp.int8),
+                scale=jnp.ones(p.shape[:-1], jnp.float32),
+            )
+        if dtype == _INT8:  # 1-D leaves stay fp32 (module doc)
+            return jnp.zeros_like(p, dtype=jnp.float32)
+        return jnp.zeros_like(p, dtype=dtype or p.dtype)
 
     def init_fn(params):
-        mu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
-        nu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params)
+        mu = jax.tree.map(lambda p: _init_moment(p, mu_dtype), params)
+        nu = jax.tree.map(lambda p: _init_moment(p, nu_dtype), params)
         return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
 
     def update_fn(updates, state, params=None):
         del params
         # mu: optax's update_moment expression verbatim (storage-dtype decay
-        # multiply under weak typing — bit parity with optax mu_dtype runs)
-        mu = jax.tree.map(lambda g, t: (1 - b1) * g + b1 * t, updates, state.mu)
+        # multiply under weak typing — bit parity with optax mu_dtype runs);
+        # int8 leaves are dequantized first, making the expression pure fp32
+        mu = _moment_map(
+            lambda g, t: (1 - b1) * g + b1 * _moment_dequant(t), updates, state.mu
+        )
         # nu: fp32 EMA regardless of storage dtype (reason 1 in module doc)
-        nu = jax.tree.map(
+        nu = _moment_map(
             lambda g, t: (1 - b2) * jnp.square(g.astype(jnp.float32))
-            + b2 * t.astype(jnp.float32),
+            + b2 * _moment_dequant(t).astype(jnp.float32),
             updates,
             state.nu,
         )
@@ -118,19 +210,37 @@ def scale_by_adam_compressed(
         new_updates = jax.tree.map(
             lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2 + eps_root) + eps), mu, nu
         )
-        mu = jax.tree.map(lambda t: t.astype(mu_dtype) if mu_dtype else t, mu)
+        # one key per step; leaves decorrelated by fold_in(leaf index).
+        # Under the ensemble's vmap all members share `count`, so members
+        # share a bit stream — harmless: their moment VALUES differ, so the
+        # rounding outcomes are independent where it matters.
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), count_inc)
+
+        def _store_int8(tree, prev_tree, leaf_key):
+            """Requantize the fp32 moment tree into the prev tree's layout:
+            QuantMoment leaves get a fresh stochastic int8 store, fp32 leaves
+            (the 1-D ones) stay fp32."""
+            leaves, treedef = jax.tree.flatten(tree)
+            prevs = treedef.flatten_up_to(prev_tree)
+            return treedef.unflatten([
+                quantize_rows_stochastic(l, jax.random.fold_in(leaf_key, i))
+                if isinstance(p, QuantMoment) else l.astype(jnp.float32)
+                for i, (l, p) in enumerate(zip(leaves, prevs))
+            ])
+
+        if mu_dtype == _INT8:
+            mu = _store_int8(mu, state.mu, jax.random.fold_in(key, 0x5117))
+        else:
+            mu = jax.tree.map(lambda t: t.astype(mu_dtype) if mu_dtype else t, mu)
         if nu_dtype == jnp.bfloat16:
-            # one key per step; leaves decorrelated by fold_in(leaf index).
-            # Under the ensemble's vmap all members share `count`, so members
-            # share a bit stream — harmless: their nu VALUES differ, so the
-            # rounding outcomes are independent where it matters.
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), count_inc)
             leaves, treedef = jax.tree.flatten(nu)
             leaves = [
                 stochastic_round(leaf, jax.random.fold_in(key, i), jnp.bfloat16)
                 for i, leaf in enumerate(leaves)
             ]
             nu = jax.tree.unflatten(treedef, leaves)
+        elif nu_dtype == _INT8:
+            nu = _store_int8(nu, state.nu, key)
         elif nu_dtype is not None:
             nu = jax.tree.map(lambda t: t.astype(nu_dtype), nu)
         return new_updates, optax.ScaleByAdamState(count=count_inc, mu=mu, nu=nu)
@@ -143,22 +253,30 @@ def adam(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    eps_root: float = 0.0,
     mu_dtype=None,
     nu_dtype=None,
     seed: int = 0,
 ) -> optax.GradientTransformation:
-    """Drop-in `optax.adam` with the extra ``nu_dtype`` knob.
+    """Drop-in `optax.adam` with the extra ``nu_dtype`` / int8-storage knobs.
 
-    ``nu_dtype=None`` returns literal `optax.adam` (bit-identical programs and
-    shared-step cache identity); ``nu_dtype='bfloat16'`` swaps in
-    `scale_by_adam_compressed`. This is what `ensemble.optim_str_to_func`
-    resolves ``"adam"`` to.
+    Plain float configs (``nu_dtype=None``, ``eps_root=0``, non-int8
+    ``mu_dtype``) return literal `optax.adam` (bit-identical programs and
+    shared-step cache identity); anything compressed or ``eps_root != 0``
+    swaps in `scale_by_adam_compressed`. This is what
+    `ensemble.optim_str_to_func` resolves ``"adam"`` to.
     """
-    if nu_dtype is None:
+    plain = (
+        nu_dtype is None
+        and eps_root == 0.0
+        and (mu_dtype is None or jnp.dtype(mu_dtype) != _INT8)
+    )
+    if plain:
         return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)
     return optax.chain(
         scale_by_adam_compressed(
-            b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype, nu_dtype=nu_dtype, seed=seed
+            b1=b1, b2=b2, eps=eps, eps_root=eps_root, mu_dtype=mu_dtype,
+            nu_dtype=nu_dtype, seed=seed,
         ),
         optax.scale_by_learning_rate(learning_rate),
     )

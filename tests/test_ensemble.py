@@ -325,3 +325,74 @@ def test_l1_warmup_rejects_signature_without_l1():
     with pytest.raises(ValueError, match="l1_alpha"):
         Ensemble(models, TopKEncoder, optimizer_kwargs={"learning_rate": 1e-3},
                  l1_warmup_steps=8)
+
+
+# -- fused-Adam gating: refuse cleanly, never silently diverge (ISSUE 12) ----
+
+def _bf16_tpu_build(monkeypatch, **optimizer_kwargs):
+    """A would-be-fused ensemble (simulated TPU) with the given adam kwargs."""
+    from sparse_coding__tpu.ops import tied_sae_kernel
+
+    monkeypatch.setattr(tied_sae_kernel, "on_tpu", lambda: True)
+    return build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3, **optimizer_kwargs},
+        activation_size=512,
+        n_dict_components=4096,
+        compute_dtype=jnp.bfloat16,
+    )
+
+
+def test_fused_adam_accepts_supported_moment_dtypes(monkeypatch):
+    """f32/bf16/int8 moment storage all keep the in-kernel Adam path."""
+    assert _bf16_tpu_build(monkeypatch).fused_adam is not None
+    assert _bf16_tpu_build(
+        monkeypatch, mu_dtype="bfloat16", nu_dtype="bfloat16"
+    ).fused_adam is not None
+    ens = _bf16_tpu_build(monkeypatch, mu_dtype="int8", nu_dtype="bfloat16")
+    assert ens.fused_adam is not None
+    # the optimizer actually built the QuantMoment state the kernel reads
+    from sparse_coding__tpu.utils.optim import QuantMoment
+
+    assert isinstance(ens.state.opt_state[0].mu["encoder"], QuantMoment)
+
+
+def test_fused_adam_refuses_unknown_kwargs_with_telemetry(monkeypatch):
+    """A kwarg the in-kernel update cannot honor (eps_root) must fall back
+    to fused grads + optax — STILL fused for gradients, never a silently
+    different Adam — and say so once via warning + telemetry counter."""
+    import warnings as _w
+
+    from sparse_coding__tpu import ensemble as ens_mod
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    monkeypatch.setattr(ens_mod, "_FUSED_ADAM_WARNED", set())
+    telemetry = RunTelemetry(out_dir=None, run_name="t")
+    try:
+        with pytest.warns(UserWarning, match="unknown optimizer kwargs"):
+            ens = _bf16_tpu_build(monkeypatch, eps_root=1e-8)
+        assert ens.fused is True          # fused grads stay on
+        assert ens.fused_adam is None     # in-kernel Adam refused
+        assert telemetry.counters.get("ensemble.fused_adam_refused") == 1
+        # warn-once: an identical second build stays silent
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            ens2 = _bf16_tpu_build(monkeypatch, eps_root=1e-8)
+        assert ens2.fused_adam is None
+        assert telemetry.counters.get("ensemble.fused_adam_refused") == 1
+    finally:
+        telemetry.close()
+
+
+def test_fused_adam_refuses_unsupported_moment_dtype(monkeypatch):
+    """A moment dtype `_adam_epilogue` does not implement (float16) refuses
+    the kernel path; optax still trains with that storage."""
+    from sparse_coding__tpu import ensemble as ens_mod
+
+    monkeypatch.setattr(ens_mod, "_FUSED_ADAM_WARNED", set())
+    with pytest.warns(UserWarning, match="unsupported moment storage"):
+        ens = _bf16_tpu_build(monkeypatch, mu_dtype="float16")
+    assert ens.fused_adam is None
+    assert ens.state.opt_state[0].mu["encoder"].dtype == jnp.float16

@@ -15,6 +15,8 @@ from sparse_coding__tpu.ensemble import stack_pytrees
 from sparse_coding__tpu.models import FunctionalTiedSAE
 from sparse_coding__tpu.utils import precision as px
 
+pytestmark = pytest.mark.kernels
+
 D, N, B, M = 128, 512, 256, 2
 
 

@@ -1,0 +1,303 @@
+"""Parametrized interpret-mode parity matrix over EVERY fused signature.
+
+ISSUE 12 satellite: each (signature × moment-storage × dispatch) variant of
+the fused Pallas step is pinned against the `jax.grad` + optax reference —
+new kernels cannot land without a parity pin. Covers:
+
+  - tied-SAE and TopK `fused_adam_step` with f32 / bf16 / int8 moment
+    storage vs the same gradients through `utils.optim.adam` (the XLA
+    reference semantics for each storage tier);
+  - the batch-tiled accumulating bwd dispatch vs the batch-resident one,
+    per moment dtype;
+  - the code-recompute bwd variant, which must be BIT-identical to the
+    code-round-trip path (same bf16 operands, same f32 dot, same cast).
+
+Tolerances: f32/bf16 parity as in tests/test_fused_kernel.py; int8 stored
+moments agree only up to the quantization step (~absmax/127 per row,
+stochastic), but the PARAMS agree tightly at step 1 because both sides
+update from the pre-quantization fp32 EMA.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sparse_coding__tpu.ensemble import stack_pytrees
+from sparse_coding__tpu.models import FunctionalTiedSAE, TopKEncoderApprox
+from sparse_coding__tpu.utils.optim import QuantMoment, adam as uadam
+
+pytestmark = pytest.mark.kernels
+
+D, N, M = 128, 512, 2
+B_RES, B_ACC = 256, 1024  # resident-path batch; one ACCUM_BATCH_TILE
+
+
+def _tied_stack():
+    key = jax.random.PRNGKey(0)
+    models = [
+        FunctionalTiedSAE.init(k, D, N, l1_alpha=a, bias_decay=1e-4)
+        for k, a in zip(jax.random.split(key, M), [1e-3, 3e-3])
+    ]
+    params = stack_pytrees([p for p, _ in models])
+    params["encoder_bias"] = 0.01 * jax.random.normal(jax.random.PRNGKey(5), (M, N))
+    buffers = stack_pytrees([b for _, b in models])
+    return params, buffers
+
+
+def _topk_stack():
+    key = jax.random.PRNGKey(1)
+    models = [
+        TopKEncoderApprox.init(k, D, N, sparsity=s, sparsity_cap=31)
+        for k, s in zip(jax.random.split(key, M), [7, 31])
+    ]
+    return (
+        stack_pytrees([p for p, _ in models]),
+        stack_pytrees([b for _, b in models]),
+    )
+
+
+SIGS = {
+    "tied": (FunctionalTiedSAE, _tied_stack, ("encoder", "encoder_bias")),
+    "topk": (TopKEncoderApprox, _topk_stack, ("dict",)),
+}
+MOMENTS = {
+    "f32": dict(),
+    "bf16": dict(mu_dtype="bfloat16", nu_dtype="bfloat16"),
+    "int8": dict(mu_dtype="int8", nu_dtype="int8"),
+}
+
+
+def _dq(x):
+    return np.asarray(x.dequant() if isinstance(x, QuantMoment) else x, np.float32)
+
+
+def _moment_atol(prev):
+    """int8 stored moments carry one stochastic quantization step of noise
+    per element: compare dequantized within the largest row scale."""
+    if isinstance(prev, QuantMoment):
+        return 1.5 * float(np.abs(np.asarray(prev.scale)).max() + 1e-8)
+    return 0.0
+
+
+@pytest.mark.parametrize("sig_name", sorted(SIGS))
+@pytest.mark.parametrize("moments", sorted(MOMENTS))
+def test_fused_adam_step_parity(sig_name, moments):
+    """`fused_adam_step` == fused grads -> `utils.optim.adam` -> apply, for
+    every (signature, moment-storage) pair."""
+    sig, mk, param_keys = SIGS[sig_name]
+    params, buffers = mk()
+    batch = jax.random.normal(jax.random.PRNGKey(2), (B_RES, D))
+    tx = uadam(1e-3, **MOMENTS[moments])
+    os0 = jax.vmap(tx.init)(params)
+
+    grads, ld_ref = sig.fused_grads_stacked(params, buffers, batch, interpret=True)
+    upd, os_ref = jax.vmap(tx.update)(grads, os0, params)
+    p_ref = optax.apply_updates(params, upd)
+    p_f, os_f, ld_f = sig.fused_adam_step(
+        params, buffers, batch, os0, 1e-3, 0.9, 0.999, 1e-8, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(ld_ref["loss"]), np.asarray(ld_f["loss"]), rtol=1e-5
+    )
+    for k in param_keys:
+        a, b = np.asarray(p_ref[k]), np.asarray(p_f[k])
+        assert np.abs(a - b).max() / (np.abs(a).max() + 1e-8) < 1e-5, k
+        for mom, rt, ft in [("mu", os_ref[0].mu, os_f[0].mu), ("nu", os_ref[0].nu, os_f[0].nu)]:
+            ma, mb = _dq(rt[k]), _dq(ft[k])
+            atol = _moment_atol(ft[k]) + 1e-12
+            denom = np.abs(ma).max() + 1e-12
+            assert (np.abs(ma - mb) - atol).max() / denom < 1e-2, (mom, k)
+    # storage layout round-trips: int8 leaves stay QuantMoment, 1-D leaves f32
+    if moments == "int8":
+        for k in param_keys:
+            lead = os_f[0].mu[k]
+            if params[k].ndim >= 3:  # [M, rows, d] leaves are quantized
+                assert isinstance(lead, QuantMoment)
+                assert lead.q.dtype == jnp.int8
+            else:
+                assert not isinstance(lead, QuantMoment)
+
+
+@pytest.mark.parametrize("moments", sorted(MOMENTS))
+def test_tied_accum_matches_resident(moments):
+    """The batch-tiled accumulating Adam dispatch == the resident one for
+    every moment storage (partial sums reorder; int8 additionally requants
+    from near-identical fp32 values with different bit streams)."""
+    from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
+    from sparse_coding__tpu.utils.optim import quantize_rows_stochastic
+
+    params, _buffers = _tied_stack()
+    batch = jax.random.normal(jax.random.PRNGKey(3), (B_ACC, D))
+    mu = jnp.zeros((M, N, D)) + 0.01
+    nu = jnp.zeros((M, N, D)) + 0.001
+    if moments == "bf16":
+        mu, nu = mu.astype(jnp.bfloat16), nu.astype(jnp.bfloat16)
+    elif moments == "int8":
+        keys = jax.random.split(jax.random.PRNGKey(9), M)
+        mu = jax.vmap(quantize_rows_stochastic)(mu, keys)
+        nu = jax.vmap(quantize_rows_stochastic)(nu, jax.vmap(jax.random.fold_in)(keys, jnp.arange(M)))
+    l1 = jnp.asarray([1e-3, 3e-3])
+    bc = jnp.tile(jnp.asarray([[0.1, 0.001]]), (M, 1))
+    seed = jnp.asarray([7], jnp.int32)
+    args = (params["encoder"], params["encoder_bias"], mu, nu, batch, l1, bc, seed)
+    kw = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, interpret=True)
+    res = tied_sae_adam_step_stacked(*args, **kw)
+    acc = tied_sae_adam_step_stacked(*args, **kw, force_accum=True)
+    names = ["d_new", "mu_new", "nu_new", "g_bias", "l_rec", "l_l1_raw"]
+    for name, a, b in zip(names, res, acc):
+        if isinstance(a, QuantMoment):
+            atol = _moment_atol(a) + 1e-5
+            np.testing.assert_allclose(_dq(a), _dq(b), rtol=2e-3, atol=atol, err_msg=name)
+            np.testing.assert_allclose(
+                np.asarray(a.scale), np.asarray(b.scale), rtol=2e-3, err_msg=name
+            )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5, err_msg=name
+            )
+
+
+@pytest.mark.parametrize("force_accum", [False, True])
+@pytest.mark.parametrize("moments", sorted(MOMENTS))
+def test_recompute_code_is_bit_identical(moments, force_accum):
+    """`recompute_code=True` must be BIT-identical to the code-round-trip
+    path on every (moment storage × dispatch) variant: the rebuilt code tile
+    uses the same bf16 operands, f32-accumulated dot, and bf16 cast as the
+    fwd store, so every downstream contraction sees identical inputs."""
+    from sparse_coding__tpu.ops.tied_sae_kernel import tied_sae_adam_step_stacked
+    from sparse_coding__tpu.utils.optim import quantize_rows_stochastic
+
+    params, _buffers = _tied_stack()
+    batch = jax.random.normal(jax.random.PRNGKey(4), (B_ACC if force_accum else B_RES, D))
+    mu = jnp.zeros((M, N, D)) + 0.01
+    nu = jnp.zeros((M, N, D)) + 0.001
+    if moments == "bf16":
+        mu, nu = mu.astype(jnp.bfloat16), nu.astype(jnp.bfloat16)
+    elif moments == "int8":
+        keys = jax.random.split(jax.random.PRNGKey(9), M)
+        mu = jax.vmap(quantize_rows_stochastic)(mu, keys)
+        nu = jax.vmap(quantize_rows_stochastic)(nu, keys)
+    l1 = jnp.asarray([1e-3, 3e-3])
+    bc = jnp.tile(jnp.asarray([[0.1, 0.001]]), (M, 1))
+    seed = jnp.asarray([7], jnp.int32)
+    args = (params["encoder"], params["encoder_bias"], mu, nu, batch, l1, bc, seed)
+    kw = dict(
+        lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, interpret=True,
+        force_accum=force_accum,
+    )
+    ref = tied_sae_adam_step_stacked(*args, **kw)
+    rec = tied_sae_adam_step_stacked(*args, **kw, recompute_code=True)
+    names = ["d_new", "mu_new", "nu_new", "g_bias", "l_rec", "l_l1_raw"]
+    for name, a, b in zip(names, ref, rec):
+        fa, fb = jax.tree.flatten(a)[0], jax.tree.flatten(b)[0]
+        for la, lb in zip(fa, fb):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), err_msg=name)
+
+
+def test_int8_two_step_state_roundtrip():
+    """Step 2 reads back the QuantMoment state step 1 wrote: the kernel and
+    the XLA reference keep tracking each other within the quantization
+    envelope, on the PARITY-SANE config (mu int8, nu bf16 — see
+    `test_int8_nu_denominator_collapse` for why nu stays bf16). NOTE what
+    the envelope is: Adam normalizes every element's update to ~±lr, so for
+    small-gradient elements the stored-mu noise (one int8 step, independent
+    bit streams on the two sides) flips step-2 update DIRECTIONS —
+    per-element direction agreement is NOT part of the int8 contract — the
+    stored-mu noise (~row_absmax/127) passes through Adam's ``mhat/sqrt
+    (vhat)`` normalization, which AMPLIFIES it by ~1/|g| for small-gradient
+    elements (measured: elements at gmax/1000 see ~7·lr of step-2 noise).
+    What must hold: dequantized moments agree within the quant step, the
+    BULK of elements track within ~lr, and the tail stays bounded (a
+    runaway would mean a state-layout bug, not codec noise)."""
+    params, buffers = _tied_stack()
+    batch = jax.random.normal(jax.random.PRNGKey(6), (B_RES, D))
+    tx = uadam(1e-3, mu_dtype="int8", nu_dtype="bfloat16")
+    os0 = jax.vmap(tx.init)(params)
+
+    # reference chain: fused grads through the XLA int8 optax path, twice
+    p_r, os_r = params, os0
+    for _ in range(2):
+        g, _ = FunctionalTiedSAE.fused_grads_stacked(p_r, buffers, batch, interpret=True)
+        upd, os_r = jax.vmap(tx.update)(g, os_r, p_r)
+        p_r = optax.apply_updates(p_r, upd)
+    # kernel chain, twice, from the same start
+    p_f, os_f = params, os0
+    for _ in range(2):
+        p_f, os_f, _ = FunctionalTiedSAE.fused_adam_step(
+            p_f, buffers, batch, os_f, 1e-3, 0.9, 0.999, 1e-8, interpret=True
+        )
+    assert isinstance(os_f[0].mu["encoder"], QuantMoment)
+    assert int(os_f[0].count[0]) == 2
+    lr = 1e-3
+    for k in ["encoder", "encoder_bias"]:
+        diff = np.abs(np.asarray(p_r[k]) - np.asarray(p_f[k]))
+        assert np.median(diff) < lr, k          # the bulk tracks tightly
+        assert diff.max() < 50 * lr, k          # the 1/|g| tail is bounded
+        assert np.all(np.isfinite(np.asarray(p_f[k]))), k
+    for mom_r, mom_f in [(os_r[0].mu, os_f[0].mu), (os_r[0].nu, os_f[0].nu)]:
+        ma, mb = _dq(mom_r["encoder"]), _dq(mom_f["encoder"])
+        atol = _moment_atol(mom_f["encoder"]) + 1e-12
+        # moments track within the quant envelope plus the grad difference
+        # induced by the (bounded) param divergence above
+        assert np.abs(ma - mb).max() < 4 * atol + 1e-3, "moment divergence"
+
+
+def test_int8_nu_denominator_collapse_is_real():
+    """Documentation-grade pin of WHY nu stays bf16 in the recommended
+    config (THROUGHPUT round 6): the per-row absmax int8 codec quantizes
+    sub-scale second moments to zero, so ``sqrt(vhat) + eps`` collapses to
+    ``eps`` for small-gradient elements while mu's noise survives the
+    numerator — an element can then receive an update orders of magnitude
+    above lr. This is a property of the codec (linear levels vs nu's wide
+    dynamic range), not a kernel bug — both the kernel and the XLA
+    reference do it, with independent noise."""
+    nu_row = jnp.asarray([[1.0, 1e-5, 1e-6, 0.0] + [0.0] * 124])  # wide range
+    from sparse_coding__tpu.utils.optim import quantize_rows_stochastic
+
+    qm = quantize_rows_stochastic(nu_row, jax.random.PRNGKey(0))
+    dq = np.asarray(qm.dequant())[0]
+    # the large element survives; the sub-scale ones quantize to exactly 0
+    assert dq[0] > 0.9
+    assert dq[1] == 0.0 and dq[2] == 0.0
+    # ... and a zero vhat under Adam means the update is mhat/eps — the
+    # denominator protection is gone for exactly those elements
+    mhat, eps = 1e-4, 1e-8
+    assert mhat / (np.sqrt(dq[1]) + eps) > 1e3  # >1000x an lr-sized step
+
+
+def test_int8_nonfinite_handling_matches_across_paths():
+    """Review fix: the kernel's `_quantize_rows_int8_sr` and the XLA
+    `quantize_rows_stochastic` must agree on non-finite inputs (NaN ratio
+    -> 0, ±inf -> ±127) — divergent NaN codings would make the two paths'
+    carried optimizer states differ structurally, not by codec noise."""
+    from sparse_coding__tpu.ops.tied_sae_kernel import _quantize_rows_int8_sr
+    from sparse_coding__tpu.utils.optim import quantize_rows_stochastic
+
+    x = jnp.asarray([[np.nan, np.inf, -np.inf, 1.0, -0.5] + [0.0] * 123])
+    ref = quantize_rows_stochastic(x, jax.random.PRNGKey(0))
+    qk, sk = _quantize_rows_int8_sr(x, jnp.uint32(7), hw_prng=False)
+    # scales identical (same absmax math; absmax here is inf -> scale inf)
+    assert np.asarray(ref.scale)[0] == np.asarray(sk)[0, 0]
+    # non-finite codes identical and as documented: NaN/inf-ratio -> 0
+    # (x/inf-scale is 0 or nan), never an arbitrary saturation mismatch
+    np.testing.assert_array_equal(np.asarray(ref.q)[0, :3], np.asarray(qk)[0, :3])
+    # a finite-absmax row with an inf element cannot exist (absmax would be
+    # inf), so ±127 saturation is exercised via a huge-but-finite outlier:
+    y = jnp.asarray([[3.4e38, 1.0] + [0.0] * 126])
+    rq = quantize_rows_stochastic(y, jax.random.PRNGKey(1))
+    kq, _ = _quantize_rows_int8_sr(y, jnp.uint32(9), hw_prng=False)
+    assert np.asarray(rq.q)[0, 0] == 127 and np.asarray(kq)[0, 0] == 127
+
+
+def test_topk_fwd_fits_budgets_whole_row_select_chunk():
+    """Review fix: when n_dict is not divisible by the radix-select chunk,
+    the kernel counts over the WHOLE row in i32 — the predicate must budget
+    that (12800 at d=768: real working set ~22 MB; the pre-fix estimate
+    passed it at ~10.7 MB and the Mosaic compile would have to eat it)."""
+    from sparse_coding__tpu.ops.topk_kernel import _SELECT_CHUNK, topk_fwd_fits
+
+    assert 12800 % 256 == 0 and 12800 % _SELECT_CHUNK != 0
+    assert topk_fwd_fits(12288, 768)       # divisible: chunked temp, fits
+    assert not topk_fwd_fits(12800, 768)   # whole-row i32 temp: refused

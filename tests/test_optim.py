@@ -205,3 +205,77 @@ def test_ensemble_trains_with_bf16_nu_and_roundtrips():
     assert ens2.state.opt_state[0].nu["encoder"].dtype == jnp.bfloat16
     ld2, _ = ens2.step_batch(data[0])
     assert np.isfinite(float(ld2["loss"].mean()))
+
+
+# -- int8 moment storage (QuantMoment tier, round 6) -------------------------
+
+def test_quantize_rows_stochastic_unbiased_and_exact_scale():
+    """The int8 store is unbiased (E[dequant] == x) and uses the chunk-store
+    scale math (absmax/127, all-zero rows scale 1)."""
+    x = jnp.tile(jnp.asarray([[0.5, -1.0, 0.01234, 0.0]]), (20_000, 1))
+    qm = optim.quantize_rows_stochastic(x, jax.random.PRNGKey(0))
+    assert qm.q.dtype == jnp.int8 and qm.scale.shape == (20_000,)
+    np.testing.assert_allclose(np.asarray(qm.scale), 1.0 / 127.0, rtol=1e-6)
+    mean = np.asarray(qm.dequant()).mean(axis=0)
+    np.testing.assert_allclose(mean, np.asarray(x[0]), atol=3e-4)
+    # all-zero row: scale 1, dequant exact
+    z = optim.quantize_rows_stochastic(jnp.zeros((2, 8)), jax.random.PRNGKey(1))
+    assert float(z.scale[0]) == 1.0
+    np.testing.assert_array_equal(np.asarray(z.dequant()), 0.0)
+
+
+def test_int8_adam_tracks_f32_adam():
+    """Training with int8-stored moments tracks fp32 Adam the way bf16-nu
+    does: same trajectory within the storage-noise envelope (bulk of params
+    close; loss curve equivalent)."""
+    params, buffers, batch = _stacked()
+    tx32 = optim.adam(1e-3)
+    tx8 = optim.adam(1e-3, mu_dtype="int8", nu_dtype="bfloat16")
+    s32 = jax.vmap(tx32.init)(params)
+    s8 = jax.vmap(tx8.init)(params)
+    assert isinstance(s8[0].mu["encoder"], optim.QuantMoment)
+    # 1-D leaves stay fp32 under the int8 policy (no row axis to scale)
+    assert s8[0].mu["encoder_bias"].dtype == jnp.float32
+
+    grad_fn = jax.vmap(jax.grad(FunctionalTiedSAE.loss, has_aux=True), in_axes=(0, 0, None))
+    p32, p8 = params, params
+    for _ in range(20):
+        g32, _ = grad_fn(p32, buffers, batch)
+        u32, s32 = jax.vmap(tx32.update)(g32, s32, p32)
+        p32 = optax.apply_updates(p32, u32)
+        g8, _ = grad_fn(p8, buffers, batch)
+        u8, s8 = jax.vmap(tx8.update)(g8, s8, p8)
+        p8 = optax.apply_updates(p8, u8)
+    for k in ["encoder", "encoder_bias"]:
+        diff = np.abs(np.asarray(p32[k]) - np.asarray(p8[k]))
+        assert np.median(diff) < 2e-3, k  # ~2 lr of bulk drift over 20 steps
+        assert np.isfinite(np.asarray(p8[k])).all(), k
+    # moments stayed compressed the whole way
+    assert s8[0].mu["encoder"].q.dtype == jnp.int8
+
+
+def test_int8_state_checkpoint_roundtrip():
+    """QuantMoment survives device_get + re-asarray (the checkpoint path:
+    `Ensemble.state_dict` / `from_state` traverse it as a pytree)."""
+    params, _buffers, _batch = _stacked()
+    tx = optim.adam(1e-3, mu_dtype="int8", nu_dtype="int8")
+    st = jax.vmap(tx.init)(params)
+    host = jax.device_get(st)
+    back = jax.tree.map(jnp.asarray, host)
+    assert isinstance(back[0].mu["encoder"], optim.QuantMoment)
+    g = jax.tree.map(lambda p: jnp.ones_like(p) * 0.01, params)
+    upd, _ = jax.vmap(tx.update)(g, back, params)  # restored state steps
+    assert np.isfinite(np.asarray(upd["encoder"])).all()
+
+
+def test_adam_eps_root_passthrough_changes_update():
+    """`eps_root` routes through the compressed implementation and changes
+    the update (the fused-Adam whitelist refuses it; the optax fallback
+    must actually honor it)."""
+    g = {"w": jnp.ones((4, 8)) * 1e-4}
+    p = {"w": jnp.zeros((4, 8))}
+    tx0 = optim.adam(1e-3)
+    tx1 = optim.adam(1e-3, eps_root=1e-2)
+    u0, _ = tx0.update(g, tx0.init(p), p)
+    u1, _ = tx1.update(g, tx1.init(p), p)
+    assert not np.allclose(np.asarray(u0["w"]), np.asarray(u1["w"]))

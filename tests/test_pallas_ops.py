@@ -9,6 +9,8 @@ import pytest
 from sparse_coding__tpu.models.fista import fista
 from sparse_coding__tpu.ops import fista_pallas
 
+pytestmark = pytest.mark.kernels
+
 
 @pytest.fixture(scope="module")
 def planted():

@@ -138,3 +138,45 @@ def test_module_entry_point(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert "No regressions" in proc.stdout
+
+
+def test_new_key_reported_but_never_gates(bench):
+    """A measured key present only in NEW (a bench that grew keys — e.g.
+    round 6's topk_fused_steps_per_sec — compared against an older BENCH_r*
+    envelope that predates them) is reported as "new", and neither crashes
+    nor gates."""
+    old = copy.deepcopy(bench)
+    del old["topk_fused_steps_per_sec"]
+    del old["topk_fused_steps_per_sec_spread"]
+    result = compare(old, bench)
+    row = next(r for r in result["rows"] if r["key"] == "topk_fused_steps_per_sec")
+    assert row["status"] == "new"
+    assert row["old"] is None and row["new"] == bench["topk_fused_steps_per_sec"]
+    assert result["regressions"] == [] and result["improvements"] == []
+    table = render_table(result)
+    assert "new in NEW" in table and "topk_fused_steps_per_sec" in table
+
+
+def test_new_key_without_spread_is_ignored(bench):
+    """Only measured keys (median + spread pair) participate — a derived
+    scalar added to NEW produces no row."""
+    new = copy.deepcopy(bench)
+    new["topk_fused_speedup"] = 2.2  # derived ratio, no _spread sibling
+    result = compare(bench, new)
+    assert all(r["key"] != "topk_fused_speedup" for r in result["rows"])
+
+
+def test_both_directions_asymmetric_keys(bench):
+    """Keys missing from NEW and keys new in NEW coexist in one comparison
+    (the exact shape of an old-envelope vs new-bench diff)."""
+    old = copy.deepcopy(bench)
+    del old["recompute_code_acts_per_sec"]
+    del old["recompute_code_acts_per_sec_spread"]
+    new = copy.deepcopy(bench)
+    del new["fista500_codes_per_sec"]
+    result = compare(old, new)
+    statuses = {r["key"]: r["status"] for r in result["rows"]}
+    assert statuses["fista500_codes_per_sec"] == "missing"
+    assert statuses["recompute_code_acts_per_sec"] == "new"
+    assert result["regressions"] == []
+    render_table(result)  # must not crash on the mixed row shapes
