@@ -528,6 +528,78 @@ def prep_serve(stack, telemetry=None):
     return measure
 
 
+def prep_router(stack, telemetry=None):
+    """Router overhead (ISSUE 13, docs/SERVING.md): rows/s of the SAME
+    closed-loop HTTP load through `serve.router.Router` → replica vs
+    direct-to-replica, at equal load. The ratio of the two gated medians is
+    the ``router.overhead_ratio`` the replica-tier acceptance pins at
+    ≥ 0.8x — the router's forwarding hop (header parse, pick, one extra
+    loopback round trip) must cost at most 20% of direct throughput.
+
+    One replica behind the router: overhead is per-forward, so a single
+    backend measures it without conflating with multi-replica balancing."""
+    import sys
+    from pathlib import Path
+
+    import numpy as np
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.registry import DictRegistry
+    from sparse_coding__tpu.serve.router import Router
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    scripts_dir = str(Path(__file__).resolve().parent / "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from loadgen import run_load
+
+    D, NF = 256, 1024
+    rng = np.random.default_rng(11)
+    registry = DictRegistry()
+    for i in range(2):
+        registry.add(
+            f"d{i}",
+            TiedSAE(
+                jnp.asarray(rng.standard_normal((NF, D), dtype=np.float32)),
+                jnp.zeros((NF,)),
+            ),
+        )
+    srv = ServeServer(registry, max_batch=256, max_wait_ms=3.0).start()
+    stack.callback(srv.stop)
+    srv.engine.warmup()
+    router = Router(
+        {"r0": srv.address}, telemetry=telemetry, health_interval=0.5,
+        max_attempts=3,
+    ).start()
+    stack.callback(router.stop)
+    rclient = router.client()
+    dclient = srv.client()
+    load_kw = dict(
+        dict_ids=registry.ids(), n_clients=16, requests_per_client=8,
+        rows_per_request=2, width=D,
+    )
+    # warm both paths (HTTP thread pools, jnp caches) off the clock
+    run_load(rclient.encode_with_meta, seed=77, with_meta=True, **load_kw)
+    run_load(dclient.encode, seed=77, **load_kw)
+    rounds: list = []
+
+    def measure() -> float:
+        r = run_load(
+            rclient.encode_with_meta, seed=len(rounds), with_meta=True,
+            **load_kw,
+        )
+        rounds.append(r)
+        return r["rows_per_sec"]
+
+    def measure_direct() -> float:
+        return run_load(dclient.encode, seed=88, **load_kw)["rows_per_sec"]
+
+    measure.direct = measure_direct
+    measure.rounds = rounds
+    measure.router = router
+    return measure
+
+
 def prep_bigbatch(stack):
     """acts/s of the SAME flagship ensemble at batch 16384 through the
     batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
@@ -682,6 +754,9 @@ def main(argv=None):
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure
         benches["serve_naive_rows_per_sec"] = serve_measure.naive
+        router_measure = prep_router(stack, telemetry=telemetry)
+        benches["router_rows_per_sec"] = router_measure
+        benches["router_direct_rows_per_sec"] = router_measure.direct
         samples = {k: [] for k in ["headline", *benches]}
         # per-key HBM watermark samples (satellite: BENCH_r*.json must track
         # memory, not just throughput). Sampled AFTER each key's timed
@@ -768,6 +843,25 @@ def main(argv=None):
                 stats["rows"] / max(1, stats["rows"] + stats["padded_rows"]), 3
             ),
             "compiled_steps": len(serve_measure.engine.compiled_shapes),
+        }
+    # router block (docs/SERVING.md "Replicas"): the overhead ratio the
+    # replica-tier acceptance pins at >= 0.8x, plus the router's own
+    # retry/hedge/shed accounting over the bench load (all zero on a
+    # healthy single-replica bench — nonzero values mean the bench replica
+    # itself misbehaved and the ratio is suspect)
+    if medians.get("router_direct_rows_per_sec"):
+        rstats = router_measure.router.stats
+        out["router"] = {
+            "overhead_ratio": round(
+                medians["router_rows_per_sec"]
+                / medians["router_direct_rows_per_sec"], 3
+            ),
+            "retries": int(rstats["retries"]),
+            "hedges": int(rstats["hedges"]),
+            "sheds": int(rstats["sheds"]),
+            "failed": int(rstats["failed"]),
+            "client_errors": int(rstats["client_errors"]),
+            "replicas": 1,
         }
     # per-key HBM watermarks (median in-use / max peak observed right after
     # that key's windows; absent on backends without memory_stats). peak is

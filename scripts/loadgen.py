@@ -94,15 +94,21 @@ def run_load(
     width: int = 512,
     seed: int = 0,
     histogram: bool = False,
+    with_meta: bool = False,
 ) -> Dict[str, Any]:
     """Closed-loop load: ``n_clients`` threads, each sending
     ``requests_per_client`` encodes of ``rows_per_request`` rows round-robin
     across ``dict_ids``, next request only after the previous returned.
 
     ``encode_fn(dict_id, rows) -> codes`` may raise; exceptions whose type
-    name contains "Retryable"/"EngineClosed" count as ``rejected`` (the
-    clean drain hand-back), anything else as ``errors``. Returns the stats
-    blob described in the module docstring."""
+    name contains "Shed" count as ``shed`` (the router's fast load-shed
+    503), other "Retryable"/"EngineClosed" as ``rejected`` (the clean drain
+    hand-back), anything else as ``errors``. ``with_meta=True`` expects
+    ``encode_fn`` to return ``(codes, meta)`` (a `RouterClient
+    .encode_with_meta`) and splits ``ok`` into first-try vs ``retried_ok``
+    (``meta["attempts"] > 1`` — the router retried transparently) — the
+    per-outcome accounting the replica-tier chaos acceptance reads.
+    Returns the stats blob described in the module docstring."""
     rng = np.random.default_rng(seed)
     # pre-generate request payloads so generation cost never pollutes timing
     payloads = [
@@ -110,7 +116,10 @@ def run_load(
         for _ in range(min(64, n_clients * requests_per_client))
     ]
     latencies: List[float] = []
-    counts = {"ok": 0, "rejected": 0, "errors": 0, "rows": 0}
+    counts = {
+        "ok": 0, "retried_ok": 0, "rejected": 0, "shed": 0, "errors": 0,
+        "rows": 0,
+    }
     lock = threading.Lock()
 
     def client(cid: int) -> None:
@@ -119,19 +128,24 @@ def run_load(
             rows = payloads[(cid * requests_per_client + i) % len(payloads)]
             t0 = time.monotonic()
             try:
-                encode_fn(did, rows)
+                result = encode_fn(did, rows)
             except Exception as e:
                 kind = type(e).__name__
                 with lock:
-                    if "Retryable" in kind or "EngineClosed" in kind:
+                    if "Shed" in kind:
+                        counts["shed"] += 1
+                    elif "Retryable" in kind or "EngineClosed" in kind:
                         counts["rejected"] += 1
                     else:
                         counts["errors"] += 1
                 continue
             dt_ms = (time.monotonic() - t0) * 1e3
+            meta = result[1] if with_meta else {}
             with lock:
                 latencies.append(dt_ms)
                 counts["ok"] += 1
+                if with_meta and int(meta.get("attempts", 1) or 1) > 1:
+                    counts["retried_ok"] += 1
                 counts["rows"] += rows.shape[0]
 
     threads = [
@@ -147,7 +161,9 @@ def run_load(
     out: Dict[str, Any] = {
         "clients": n_clients,
         "requests": counts["ok"],
+        "retried_ok": counts["retried_ok"],
         "rejected": counts["rejected"],
+        "shed": counts["shed"],
         "errors": counts["errors"],
         "rows": counts["rows"],
         "wall_seconds": round(wall, 4),
@@ -169,6 +185,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="learned-dict export path — spin up an IN-PROCESS engine "
         "(no HTTP) and drive it directly",
     )
+    target.add_argument(
+        "--targets", nargs="+", metavar="URL",
+        help="backend serve replica URLs — spin up an IN-PROCESS "
+        "`serve.router.Router` in front of them and drive THROUGH it, "
+        "with per-outcome accounting (ok / retried-ok / shed / failed)",
+    )
     ap.add_argument("--dict", dest="dicts", action="append", default=None,
                     help="dict id(s) to target (default: all registered)")
     ap.add_argument("--clients", type=int, default=8)
@@ -183,10 +205,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--naive", action="store_true",
                     help="in-process mode: drive the naive per-request path "
                     "instead of the micro-batched engine")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="--targets mode: router hedge threshold")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
-    if args.url:
+    if args.targets:
+        from sparse_coding__tpu.serve.router import Router
+
+        with Router(args.targets, hedge_ms=args.hedge_ms) as router:
+            client = router.client()
+            dicts = args.dicts or [d["dict"] for d in client.dicts()]
+            width = args.width
+            if width is None:
+                width = next(
+                    d["activation_size"] for d in client.dicts()
+                    if d["dict"] == dicts[0]
+                )
+            result = run_load(
+                client.encode_with_meta, dicts, n_clients=args.clients,
+                requests_per_client=args.requests, rows_per_request=args.rows,
+                width=width, seed=args.seed, histogram=True, with_meta=True,
+            )
+            result["router"] = dict(router.stats)
+            result["replica_states"] = router.states()
+    elif args.url:
         from sparse_coding__tpu.serve.server import ServeClient
 
         client = ServeClient(args.url)

@@ -510,6 +510,220 @@ def make_serve_run_fixture():
     print(f"Wrote {SERVE_RUN_DIR}/events.jsonl + bench_serve_fixture.json")
 
 
+ROUTER_RUN_DIR = REPO / "tests" / "golden" / "router_run"
+ROUTER_BASE_TS = 1_754_600_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_router_run_fixture():
+    """Deterministic replica-tier fixture (ISSUE 13): a hand-stamped run
+    dir shaped like what `serve.replicaset` + `serve.router` write — a
+    replicaset log, a router log, and three per-replica serve logs (every
+    record tagged ``replica``) — pinning the report **Router** section, the
+    per-replica Serving merge, and the monitor ``router:`` /
+    ``serve[replicaN]:`` lines; plus a bench-style JSON pinning the
+    ``router_rows_per_sec`` key + ``router`` block schema for the tier-1
+    perfdiff smoke.
+
+    The modeled story: 3 replicas serve 480 requests; replica1 is
+    SIGKILLed mid-run (router marks it dead, retries its in-flight
+    traffic, supervisor restarts it after backoff), then a rolling swap
+    rolls generation 0 → 1 across all three."""
+    ROUTER_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    T = ROUTER_BASE_TS
+
+    def writer(path):
+        seq = {"n": 0}
+
+        def rec(ts, event, **fields):
+            seq["n"] += 1
+            return {"seq": seq["n"], "ts": round(ts, 3), "event": event,
+                    **fields}
+
+        return path, rec, []
+
+    fp = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+          "device_kind": "golden-cpu", "device_count": 1, "git_sha": "g0lden"}
+
+    # -- per-replica serve logs (tagged `replica`) --------------------------
+    for i in range(3):
+        rid = f"replica{i}"
+        d = ROUTER_RUN_DIR / rid
+        d.mkdir(parents=True, exist_ok=True)
+        _, rec, events = writer(d / "events.jsonl")
+        events.append(rec(
+            T + 0.2 * i, "run_start", run_name="serve", generation=0,
+            replica=rid,
+            config={"exports": ["out/learned_dicts.pkl"], "weights": "native",
+                    "max_batch": 64, "max_wait_ms": 2.0,
+                    "dicts": ["d0", "d1"], "replica_id": rid,
+                    "dict_generation": 0},
+            fingerprint=fp,
+        ))
+        for j in range(2):
+            events.append(rec(
+                T + 0.3 + 0.2 * i + 0.01 * j, "serve_dict_added",
+                replica=rid, dict=f"d{j}", weights="native",
+                source="out/learned_dicts.pkl",
+            ))
+        counters = {
+            "serve.requests": 160, "serve.rows": 320, "serve.batches": 24,
+            "serve.padded_rows": 40, "serve.rejected": 2 if i == 1 else 0,
+            "serve.errors": 0,
+        }
+        gauges = {
+            "serve.queue_depth": 0, "serve.batch_occupancy": 0.889,
+            "serve.latency_p50_ms": 7.9 + 0.2 * i,
+            "serve.latency_p95_ms": 13.8 + 0.2 * i,
+            "serve.latency_p99_ms": 19.5 + 0.2 * i,
+        }
+        events.append(rec(T + 24.0 + 0.2 * i, "snapshot", replica=rid,
+                          counters=counters, gauges=gauges))
+        events.append(rec(T + 24.5 + 0.2 * i, "run_end", status="drained",
+                          replica=rid, run_name="serve", generation=0,
+                          wall_seconds=24.5))
+        with open(d / "events.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    # -- router log ---------------------------------------------------------
+    _, rec, events = writer(ROUTER_RUN_DIR / "router_events.jsonl")
+    events.append(rec(T, "run_start", run_name="router", generation=0,
+                      config={"replicas": 3, "hedge_ms": 20.0,
+                              "max_inflight": 64},
+                      fingerprint=fp))
+    for i in range(3):
+        events.append(rec(T + 0.5 + 0.05 * i, "router_replica_state",
+                          replica=f"replica{i}", frm="suspect", to="live",
+                          reason="probe_ok"))
+    # replica1 SIGKILLed: forward fails -> suspect -> marked dead by the
+    # replicaset, restarted, readmitted
+    events.append(rec(T + 9.0, "router_replica_state", replica="replica1",
+                      frm="live", to="suspect", reason="ConnectionResetError"))
+    events.append(rec(T + 9.1, "router_replica_state", replica="replica1",
+                      frm="suspect", to="dead", reason="killed"))
+    events.append(rec(T + 11.3, "router_replica_state", replica="replica1",
+                      frm="dead", to="live", reason="admitted"))
+    # rolling swap: each replica drains (no penalty) and readmits
+    for i, t_off in enumerate((16.0, 18.0, 20.0)):
+        rid = f"replica{i}"
+        events.append(rec(T + t_off, "router_replica_quiesced", replica=rid))
+        events.append(rec(T + t_off + 0.3, "router_replica_state",
+                          replica=rid, frm="live", to="dead",
+                          reason="marked_down"))
+        events.append(rec(T + t_off + 1.6, "router_replica_state",
+                          replica=rid, frm="dead", to="live",
+                          reason="admitted"))
+        events.append(rec(T + t_off + 1.7, "router_replica_readmitted",
+                          replica=rid))
+    counters = {
+        "router.requests": 482, "router.ok": 478, "router.retried_ok": 7,
+        "router.client_errors": 2, "router.retries": 9, "router.hedges": 2,
+        "router.sheds": 2, "router.failed": 0, "router.forwards": 489,
+        "router.state_changes": 16,
+    }
+    gauges = {
+        "router.replicas": 3, "router.live_replicas": 3,
+        "router.inflight": 0,
+        "router.replica.replica0.p50_ms": 8.1,
+        "router.replica.replica0.p99_ms": 20.3,
+        "router.replica.replica1.p50_ms": 8.4,
+        "router.replica.replica1.p99_ms": 23.1,
+        "router.replica.replica2.p50_ms": 8.2,
+        "router.replica.replica2.p99_ms": 21.0,
+        "router.replica.replica0.state": 0,
+        "router.replica.replica1.state": 0,
+        "router.replica.replica2.state": 0,
+    }
+    events.append(rec(T + 24.8, "snapshot", counters=counters, gauges=gauges))
+    events.append(rec(T + 25.0, "run_end", status="drained",
+                      run_name="router", generation=0, wall_seconds=25.0))
+    with open(ROUTER_RUN_DIR / "router_events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # -- replicaset log -----------------------------------------------------
+    _, rec, events = writer(ROUTER_RUN_DIR / "replicaset_events.jsonl")
+    events.append(rec(T, "run_start", run_name="replicaset", generation=0,
+                      config={"exports": ["out/learned_dicts.pkl"],
+                              "replicas": 3, "weights": "native",
+                              "max_batch": 64},
+                      fingerprint=fp))
+    events.append(rec(T + 0.05, "replicaset_start", replicas=3))
+    for i in range(3):
+        rid = f"replica{i}"
+        events.append(rec(T + 0.1 + 0.05 * i, "replica_spawn", replica=rid,
+                          generation=0, pid=41000 + i,
+                          exports=["out/learned_dicts.pkl"]))
+        events.append(rec(T + 0.4 + 0.05 * i, "replica_ready", replica=rid,
+                          url=f"http://127.0.0.1:{8770 + i}", generation=0,
+                          downtime_seconds=None))
+    # the SIGKILL: exit classified, backoff span, restart, readmission
+    events.append(rec(T + 9.05, "replica_exit", replica="replica1",
+                      exit_code=-9, classification="killed", generation=0))
+    events.append(rec(T + 9.55, "span", category="restart_backoff",
+                      name="replica_backoff", ts_start=round(T + 9.05, 3),
+                      seconds=0.5, replica="replica1"))
+    events.append(rec(T + 9.55, "replica_restart", replica="replica1",
+                      attempt=1, classification="killed",
+                      backoff_seconds=0.5))
+    events.append(rec(T + 9.6, "replica_spawn", replica="replica1",
+                      generation=0, pid=41017,
+                      exports=["out/learned_dicts.pkl"]))
+    events.append(rec(T + 11.3, "replica_ready", replica="replica1",
+                      url="http://127.0.0.1:8793", generation=0,
+                      downtime_seconds=2.25))
+    # the rolling swap, one replica at a time
+    events.append(rec(T + 15.8, "rolling_swap_start", from_generation=0,
+                      to_generation=1, replicas=3))
+    for i, t_off in enumerate((16.0, 18.0, 20.0)):
+        rid = f"replica{i}"
+        events.append(rec(T + t_off + 0.4, "replica_drained", replica=rid,
+                          exit_code=0, seconds=0.4))
+        events.append(rec(T + t_off + 0.5, "replica_spawn", replica=rid,
+                          generation=1, pid=41020 + i,
+                          exports=["out/learned_dicts_v2.pkl"]))
+        events.append(rec(T + t_off + 1.6, "replica_ready", replica=rid,
+                          url=f"http://127.0.0.1:{8800 + i}", generation=1,
+                          downtime_seconds=None))
+        events.append(rec(T + t_off + 1.7, "replica_swapped", replica=rid,
+                          generation=1))
+    events.append(rec(T + 21.8, "rolling_swap_done", generation=1,
+                      replicas=3, seconds=6.0))
+    counters = {
+        "replicaset.deaths": 1, "replicaset.deaths.killed": 1,
+        "replicaset.restarts": 1, "replicaset.restarts.killed": 1,
+        "replicaset.swaps": 1,
+        "span.restart_backoff.count": 1,
+        "span.restart_backoff.seconds": 0.5,
+    }
+    events.append(rec(T + 24.9, "snapshot", counters=counters, gauges={}))
+    events.append(rec(T + 25.1, "run_end", status="drained",
+                      run_name="replicaset", generation=0,
+                      wall_seconds=25.1))
+    with open(ROUTER_RUN_DIR / "replicaset_events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # bench-style JSON pinning the router keys + block schema for perfdiff
+    bench = {
+        "metric": "router_fixture (golden: schema pin for the bench router block)",
+        "control_matmul_tflops": 0.21,
+        "control_matmul_tflops_spread": [0.2, 0.22],
+        "router_rows_per_sec": 390.0,
+        "router_rows_per_sec_spread": [370.0, 405.0],
+        "router_direct_rows_per_sec": 430.0,
+        "router_direct_rows_per_sec_spread": [410.0, 450.0],
+        "router": {
+            "overhead_ratio": 0.907, "retries": 0, "hedges": 0,
+            "sheds": 0, "failed": 0, "client_errors": 0, "replicas": 1,
+        },
+    }
+    with open(ROUTER_RUN_DIR / "bench_router_fixture.json", "w") as f:
+        json.dump(bench, f, indent=1)
+    print(f"Wrote {ROUTER_RUN_DIR}/ (replicaset/router/replica logs + "
+          "bench_router_fixture.json)")
+
+
 BENCH_FIXTURE = REPO / "tests" / "golden" / "bench_fixture.json"
 
 
@@ -792,6 +1006,9 @@ def main():
         return
     if "--serve-run" in sys.argv:
         make_serve_run_fixture()
+        return
+    if "--router-run" in sys.argv:
+        make_router_run_fixture()
         return
     if "--bench-fixture" in sys.argv:
         make_bench_fixture()

@@ -16,22 +16,39 @@ star (docs/SERVING.md). Three layers:
   - `serve.server` — a stdlib `ThreadingHTTPServer` JSON API (``/encode``,
     ``/dicts``, ``/healthz``) with graceful SIGTERM drain riding the PR-5
     preemption machinery, plus `ServeClient` for tests and `loadgen`.
+  - `serve.router` — the fault-tolerant replica front-end (ISSUE 13):
+    live/draining/suspect/dead replica tracking from heartbeat probes +
+    per-request outcomes, retry-against-a-different-replica on the shared
+    backoff engine, bounded load shedding, optional hedging, and
+    byte-exact generation-stamped passthrough.
+  - `serve.replicaset` — the replica supervisor: N server subprocesses
+    auto-restarted via `supervise`'s exit-classification/restart-budget
+    machinery, with drain-aware rolling dict swaps (quiesce → drain →
+    swap → warm → readmit) that never show a client a torn rollout.
 """
 
 __all__ = [
     "DictRegistry",
     "EncodeEngine",
     "EngineClosed",
+    "ReplicaSet",
+    "Router",
+    "RouterClient",
     "ServeClient",
     "ServeServer",
+    "ShedRejection",
 ]
 
 _EXPORTS = {
     "DictRegistry": "sparse_coding__tpu.serve.registry",
     "EncodeEngine": "sparse_coding__tpu.serve.engine",
     "EngineClosed": "sparse_coding__tpu.serve.engine",
+    "ReplicaSet": "sparse_coding__tpu.serve.replicaset",
+    "Router": "sparse_coding__tpu.serve.router",
+    "RouterClient": "sparse_coding__tpu.serve.router",
     "ServeClient": "sparse_coding__tpu.serve.server",
     "ServeServer": "sparse_coding__tpu.serve.server",
+    "ShedRejection": "sparse_coding__tpu.serve.router",
 }
 
 

@@ -595,3 +595,13 @@ class EncodeEngine:
     @property
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    @property
+    def batch_occupancy(self) -> float:
+        """Lifetime fraction of dispatched rows that were real (not bucket
+        padding) — the healthz-exposed form of the per-batch gauge."""
+        with self._lock:
+            rows = self.stats["rows"]
+            padded = self.stats["padded_rows"]
+        total = rows + padded
+        return round(rows / total, 4) if total else 1.0

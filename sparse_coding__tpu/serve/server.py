@@ -121,6 +121,7 @@ class _Handler(BaseHTTPRequestHandler):
             "n_rows": int(codes.shape[0]),
             "codes": np.asarray(codes).tolist(),
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "generation": srv.dict_generation,
         })
 
 
@@ -145,6 +146,8 @@ class ServeServer:
         telemetry=None,
         request_timeout: float = 60.0,
         verbose: bool = False,
+        dict_generation: int = 0,
+        replica_id: Optional[str] = None,
         **engine_kwargs,
     ):
         self.registry = registry
@@ -154,6 +157,12 @@ class ServeServer:
         )
         self.request_timeout = float(request_timeout)
         self.verbose = verbose
+        # the dict generation this replica serves (a rolling swap relaunches
+        # replicas with the next generation): stamped into every /encode
+        # response so a client/router can SEE which rollout answered — the
+        # torn-rollout detector the replica-tier chaos test asserts on
+        self.dict_generation = int(dict_generation)
+        self.replica_id = replica_id
         self.draining = False
         self._t0 = time.time()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -179,16 +188,31 @@ class ServeServer:
         return self
 
     def health(self) -> Dict[str, Any]:
+        """The enriched healthz body (ISSUE 13): everything a router health
+        probe needs in ONE response — queue depth, batch occupancy, the
+        registry generation (hot-swap watermark), the dict generation
+        (rolling-rollout watermark), and the draining flag — previously
+        these existed only as internal gauges."""
         lat = self.engine.latency_snapshot()
-        return {
+        stats = self.engine.stats
+        out = {
             "status": "draining" if self.draining else "ok",
+            "draining": self.draining,
             "dicts": len(self.registry),
             "queue_depth": self.engine.queue_depth,
-            "requests": self.engine.stats["requests"],
+            "batch_occupancy": self.engine.batch_occupancy,
+            "registry_generation": self.registry.generation,
+            "dict_generation": self.dict_generation,
+            "requests": stats["requests"],
+            "rejected": stats["rejected"],
+            "errors": stats["errors"],
             "uptime_seconds": round(time.time() - self._t0, 3),
             "latency_p50_ms": round(lat["p50_ms"], 3),
             "latency_p99_ms": round(lat["p99_ms"], 3),
         }
+        if self.replica_id is not None:
+            out["replica"] = self.replica_id
+        return out
 
     def drain(self, timeout: float = 60.0) -> None:
         """The graceful half of shutdown: reject new encodes (503), complete
@@ -221,18 +245,49 @@ class ServeServer:
 
 
 class RetryableRejection(RuntimeError):
-    """A clean 503/"draining" hand-back: safe to retry against a replica."""
+    """A clean 503/"draining" hand-back: safe to retry against a replica.
+    ``retry_after`` carries the server's Retry-After hint (seconds, 0.0
+    when absent) — retry loops use it as a floor on their backoff."""
+
+    retry_after: float = 0.0
 
 
 class ServeClient:
-    """Minimal stdlib HTTP client (tests, loadgen — no deps)."""
+    """Minimal stdlib HTTP client (tests, loadgen — no deps).
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    ``retries > 1`` makes `encode` retry clean retryable rejections
+    (draining 503s, 504 timeouts with ``retryable: true``) through the
+    repo-wide `utils.sync.retry_with_backoff` engine — same schedule as
+    chunk reads and remote syncs, honoring the server's ``Retry-After`` as
+    a floor on each sleep and bumping a ``serve.client.retry`` counter on
+    the active telemetry. Connection errors are NOT retried here: against
+    a single server they mean it is gone; `serve.router.RouterClient`
+    fronting a replica set is the layer that retries those (elsewhere)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 retries: int = 1, backoff_base: float = 0.05):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.backoff_base = float(backoff_base)
 
-    def _request(self, method: str, path: str,
-                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    def _retryable_exc(self, payload: Dict[str, Any],
+                       headers: Dict[str, str]) -> RetryableRejection:
+        """Build the retryable-rejection exception for a 503/504 hand-back
+        (subclasses refine the type — `RouterClient` raises ShedRejection
+        for router sheds)."""
+        exc = RetryableRejection(payload.get("error", "rejected"))
+        try:
+            exc.retry_after = float(headers.get("Retry-After", 0) or 0)
+        except (TypeError, ValueError):
+            exc.retry_after = 0.0
+        return exc
+
+    def _request_full(
+        self, method: str, path: str,
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> tuple:
+        """One HTTP round trip; returns (parsed body, response headers)."""
         import urllib.error
         import urllib.request
 
@@ -244,20 +299,42 @@ class ServeClient:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
+                return json.loads(resp.read()), dict(resp.headers.items())
         except urllib.error.HTTPError as e:
             try:
                 body = json.loads(e.read())
             except Exception:
                 body = {"error": str(e)}
+            headers = dict(e.headers.items())
             if e.code in (503, 504) and body.get("retryable"):
-                raise RetryableRejection(body.get("error", "rejected"))
+                raise self._retryable_exc(body, headers)
             raise RuntimeError(f"HTTP {e.code}: {body.get('error')}") from e
 
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return self._request_full(method, path, payload)[0]
+
+    def _with_retries(self, fn):
+        """Run `fn` under this client's retry policy: `retries` attempts of
+        the shared backoff engine over clean retryable rejections only."""
+        if self.retries <= 1:
+            return fn()
+        from sparse_coding__tpu.telemetry.events import counter_inc_active
+        from sparse_coding__tpu.utils.sync import retry_with_backoff
+
+        return retry_with_backoff(
+            lambda _attempt: fn(),
+            attempts=self.retries,
+            base_delay=self.backoff_base,
+            retry_on=(RetryableRejection,),
+            on_retry=lambda a, e: counter_inc_active("serve.client.retry"),
+            delay_floor_from=lambda e: getattr(e, "retry_after", 0.0),
+        )
+
     def encode(self, dict_id: str, rows) -> np.ndarray:
-        out = self._request(
-            "POST", "/encode",
-            {"dict": dict_id, "rows": np.asarray(rows).tolist()},
+        payload = {"dict": dict_id, "rows": np.asarray(rows).tolist()}
+        out = self._with_retries(
+            lambda: self._request("POST", "/encode", payload)
         )
         return np.asarray(out["codes"], dtype=np.float32)
 
@@ -293,6 +370,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--events", default=None, metavar="DIR",
                     help="write serve telemetry (events.jsonl) under DIR — "
                     "renderable with `python -m sparse_coding__tpu.report`")
+    ap.add_argument("--replica-id", default=None,
+                    help="this replica's id in a replica set (stamped into "
+                    "every telemetry record and the healthz body)")
+    ap.add_argument("--dict-generation", type=int, default=0,
+                    help="the dict rollout generation this replica serves "
+                    "(rolling swaps relaunch replicas with the next one); "
+                    "stamped into every /encode response")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip bucket pre-compilation at startup")
     ap.add_argument("--verbose", action="store_true")
@@ -300,8 +384,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from sparse_coding__tpu.telemetry import RunTelemetry
     from sparse_coding__tpu.train import preemption
+    from sparse_coding__tpu.utils.faults import fault_point
 
-    telemetry = RunTelemetry(out_dir=args.events, run_name="serve")
+    telemetry = RunTelemetry(
+        out_dir=args.events, run_name="serve",
+        tags={"replica": args.replica_id} if args.replica_id else None,
+    )
     registry = DictRegistry(telemetry=telemetry)
     for exp in args.exports:
         ids = registry.load_export(exp, weights=args.weights)
@@ -309,13 +397,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     telemetry.run_start(config={
         "exports": list(args.exports), "weights": args.weights,
         "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
-        "dicts": registry.ids(),
+        "dicts": registry.ids(), "replica_id": args.replica_id,
+        "dict_generation": args.dict_generation,
     })
 
     srv = ServeServer(
         registry, host=args.host, port=args.port, telemetry=telemetry,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        verbose=args.verbose,
+        verbose=args.verbose, dict_generation=args.dict_generation,
+        replica_id=args.replica_id,
     )
     srv.engine.start()
     if not args.no_warmup:
@@ -333,7 +423,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     preemption.poller_started()
     status = "ok"
     try:
+        tick = 0
         while not preemption.preemption_requested():
+            # replica-death chaos site: `SC_FAULT=kill:serve_loop:tick=N`
+            # SIGKILLs this replica mid-flight, deterministically
+            fault_point("serve_loop", tick=tick)
+            tick += 1
             time.sleep(0.05)
         sig = preemption.preemption_signal()
         print(f"[serve] drain requested (signal {sig}) — rejecting new "

@@ -56,7 +56,10 @@ from typing import List, Optional
 
 from sparse_coding__tpu.train.preemption import RESUME_ENV, RESUMABLE_EXIT_CODE
 
-__all__ = ["classify_exit", "compute_backoff", "run_supervised", "main"]
+__all__ = [
+    "RestartBudget", "classify_exit", "compute_backoff", "run_supervised",
+    "main",
+]
 
 
 def compute_backoff(
@@ -77,6 +80,65 @@ def compute_backoff(
     if jitter > 0:
         delay *= 1.0 + jitter * (rng or random).random()
     return delay
+
+
+class RestartBudget:
+    """Bounded-restart bookkeeping shared by this supervisor and the serve
+    replica supervisor (`serve.replicaset.ReplicaSet`): a restart budget of
+    ``max_restarts`` attempts, exponential backoff with jitter between
+    them (`compute_backoff`), and an optional healthy-stretch reset —
+    a child/replica that survived ``reset_after`` seconds proves the run
+    itself is fine, so its next exit starts the schedule over while a
+    crash loop (rapid exits) still burns the budget down.
+
+    Usage: ``note_healthy(seconds)`` after each exit (returns the number
+    of attempts cleared, 0 when no reset applied), check ``exhausted``,
+    take ``next_delay()`` for the sleep, then ``charge()`` when the
+    restart is actually taken."""
+
+    def __init__(
+        self,
+        max_restarts: int = 8,
+        backoff_base: float = 1.0,
+        backoff_max: float = 60.0,
+        jitter: float = 0.25,
+        reset_after: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.reset_after = reset_after
+        self.rng = rng
+        self.attempt = 0
+
+    def note_healthy(self, healthy_seconds: float) -> int:
+        """Reset the budget when the last run stretch was healthy enough;
+        returns the attempts cleared (0 = no reset)."""
+        if (
+            self.reset_after is not None
+            and self.attempt > 0
+            and healthy_seconds >= self.reset_after
+        ):
+            cleared, self.attempt = self.attempt, 0
+            return cleared
+        return 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.attempt >= self.max_restarts
+
+    def next_delay(self) -> float:
+        return compute_backoff(
+            self.attempt, self.backoff_base, self.backoff_max, self.jitter,
+            rng=self.rng,
+        )
+
+    def charge(self) -> int:
+        """Record one taken restart; returns the new attempt count."""
+        self.attempt += 1
+        return self.attempt
 
 
 def _recent_abort(run_dir: Optional[str], since_ts: float) -> bool:
@@ -206,12 +268,17 @@ def run_supervised(
         except (ValueError, OSError):  # non-main thread (tests)
             pass
 
-    attempt = 0
+    budget = RestartBudget(
+        max_restarts=max_restarts, backoff_base=backoff_base,
+        backoff_max=backoff_max, jitter=jitter,
+        reset_after=backoff_reset_after,
+    )
     # child generations started, continuing any generations already in the
-    # run dir (attempt resets on healthy stretches; this never does)
+    # run dir (the budget resets on healthy stretches; this never does)
     spawned = _prior_generations(run_dir)
     try:
         while True:
+            attempt = budget.attempt
             env = dict(os.environ)
             if attempt > 0:
                 env[RESUME_ENV] = "1"
@@ -251,20 +318,16 @@ def run_supervised(
                 restart_on == "any" and cls in ("killed", "crash")
             )
             healthy_seconds = exited - started
-            if (
-                backoff_reset_after is not None
-                and attempt > 0
-                and healthy_seconds >= backoff_reset_after
-            ):
+            cleared = budget.note_healthy(healthy_seconds)
+            if cleared:
                 # a long-healthy generation proves the run itself is fine —
                 # this exit is fresh churn, not a continuing crash loop
                 if telemetry is not None:
                     telemetry.event(
                         "backoff_reset",
                         healthy_seconds=round(healthy_seconds, 3),
-                        attempts_cleared=attempt,
+                        attempts_cleared=cleared,
                     )
-                attempt = 0
             rc_out = rc if rc > 0 else 128 + abs(rc)
             if should_continue is not None and not should_continue():
                 # the embedder withdrew (e.g. the fleet worker's lease was
@@ -278,14 +341,15 @@ def run_supervised(
                     telemetry.event("give_up", reason=cls, exit_code=rc)
                 stopped(cls)
                 return rc_out
-            if attempt >= max_restarts:
+            if budget.exhausted:
                 if telemetry is not None:
                     telemetry.event(
-                        "budget_exhausted", restarts=attempt, exit_code=rc
+                        "budget_exhausted", restarts=budget.attempt,
+                        exit_code=rc,
                     )
                 stopped("budget_exhausted")
                 return rc_out
-            delay = compute_backoff(attempt, backoff_base, backoff_max, jitter)
+            delay = budget.next_delay()
             # the backoff sleep is first-class badput: a live span on the
             # supervisor's own timeline (the ledger ALSO derives the
             # restart_backoff share of the inter-generation gap from the
@@ -306,11 +370,11 @@ def run_supervised(
                     )
                 stopped("supervisor_preempted")
                 return rc if rc > 0 else RESUMABLE_EXIT_CODE
-            attempt += 1
+            taken = budget.charge()
             if telemetry is not None:
                 telemetry.event(
                     "restart",
-                    attempt=attempt,
+                    attempt=taken,
                     generation=spawned,  # the generation about to spawn
                     run_dir=run_dir,
                     exit_code=rc,

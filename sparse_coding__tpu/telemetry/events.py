@@ -227,9 +227,15 @@ class RunTelemetry:
         config: Optional[Dict[str, Any]] = None,
         file_name: str = "events.jsonl",
         install_jax_listeners: bool = True,
+        tags: Optional[Dict[str, Any]] = None,
     ):
         self.run_name = run_name
         self._config = config
+        # constant fields stamped into EVERY record (e.g. a serve replica's
+        # ``{"replica": "replica0"}``) so merged run dirs can attribute
+        # events/snapshots per writer — the serve replica tier's report and
+        # monitor views key on this
+        self.tags = dict(tags or {})
         self._lock = threading.Lock()
         self._seq = 0
         self._t0 = time.time()
@@ -289,7 +295,8 @@ class RunTelemetry:
             self._seq += 1
             rec = {
                 "seq": self._seq, "ts": time.time(),
-                "mono": round(time.monotonic(), 6), "event": etype, **fields,
+                "mono": round(time.monotonic(), 6), "event": etype,
+                **self.tags, **fields,
             }
             if self.process_index is not None:
                 rec["process_index"] = self.process_index

@@ -159,11 +159,17 @@ class RunMonitor:
         self.span_seconds: Dict[str, float] = {}
         self.first_start_ts: Optional[float] = None
         # serving state (docs/SERVING.md): last-snapshot serve.* counters
-        # and gauges + the drain lifecycle events
-        self.serve_counters: Dict[str, float] = {}
-        self.serve_gauges: Dict[str, float] = {}
-        self.serve_draining = False
-        self.serve_drained = False
+        # and gauges + the drain lifecycle events, keyed by the writer's
+        # ``replica`` tag ("" = a single un-tagged serve process) so a
+        # replica tier renders ONE line per replica
+        self.serve_by: Dict[str, Dict[str, Any]] = {}
+        # router state (serve/router.py): counters + the live replica-state
+        # map from the transition event timeline (per-replica latency
+        # gauges are the REPORT's job — the live line stays one-glance)
+        self.router_counters: Dict[str, float] = {}
+        self.router_states: Dict[str, str] = {}
+        self.replica_restarts = 0
+        self.swap_events: List[Dict[str, Any]] = []
 
     # -- ingestion ------------------------------------------------------------
 
@@ -192,6 +198,17 @@ class RunMonitor:
     @property
     def n_files(self) -> int:
         return len(self._tails)
+
+    def _serve_state(self, rec) -> Dict[str, Any]:
+        """Per-replica serve aggregation slot, keyed by the record's
+        ``replica`` tag ("" for a plain single-process serve run)."""
+        key = str(rec.get("replica") or "")
+        if key not in self.serve_by:
+            self.serve_by[key] = {
+                "counters": {}, "gauges": {}, "draining": False,
+                "drained": False,
+            }
+        return self.serve_by[key]
 
     def _proc(self, rec) -> _ProcState:
         idx = int(rec.get("process_index", 0))
@@ -259,10 +276,19 @@ class RunMonitor:
         elif kind == "loss_budget_exhausted":
             self.budget_exhausted = True
         elif kind == "serve_drain":
-            self.serve_draining = True
+            self._serve_state(rec)["draining"] = True
         elif kind == "serve_drained":
-            self.serve_draining = False
-            self.serve_drained = True
+            st = self._serve_state(rec)
+            st["draining"] = False
+            st["drained"] = True
+        elif kind == "router_replica_state":
+            self.router_states[str(rec.get("replica", "?"))] = str(
+                rec.get("to", "?")
+            )
+        elif kind == "replica_restart":
+            self.replica_restarts += 1
+        elif kind == "rolling_swap_done":
+            self.swap_events.append(rec)
         elif kind == "snapshot":
             counters = rec.get("counters") or {}
             if "train.steps" in counters:
@@ -274,13 +300,19 @@ class RunMonitor:
                 k: float(v) for k, v in counters.items() if k.startswith("serve.")
             }
             if serve_c:
-                self.serve_counters.update(serve_c)
+                self._serve_state(rec)["counters"].update(serve_c)
+            router_c = {
+                k: float(v) for k, v in counters.items()
+                if k.startswith("router.")
+            }
+            if router_c:
+                self.router_counters.update(router_c)
             gauges = rec.get("gauges") or {}
             serve_g = {
                 k: float(v) for k, v in gauges.items() if k.startswith("serve.")
             }
             if serve_g:
-                self.serve_gauges.update(serve_g)
+                self._serve_state(rec)["gauges"].update(serve_g)
             if "data.budget_remaining_frac" in gauges:
                 self.budget_remaining = float(gauges["data.budget_remaining_frac"])
             if "skew.flush.spread_seconds" in gauges:
@@ -438,10 +470,14 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         elif mon.budget_remaining is not None:
             line += f" | budget {100 * mon.budget_remaining:.1f}% remaining"
         lines.append(line)
-    # serving line (docs/SERVING.md): last-snapshot serve.* counters/gauges
-    # + the drain lifecycle — only for runs that served (stability contract)
-    if mon.serve_counters or mon.serve_gauges or mon.serve_draining or mon.serve_drained:
-        c, g = mon.serve_counters, mon.serve_gauges
+    # serving lines (docs/SERVING.md): last-snapshot serve.* counters/gauges
+    # + the drain lifecycle, one line per replica tag — only for runs that
+    # served (stability contract; a plain serve run keeps the old layout)
+    for key in sorted(mon.serve_by):
+        st = mon.serve_by[key]
+        c, g = st["counters"], st["gauges"]
+        if not (c or g or st["draining"] or st["drained"]):
+            continue
         bits = [
             f"{int(c.get('serve.requests', 0))} req "
             f"({int(c.get('serve.rows', 0))} rows, "
@@ -460,12 +496,51 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         rej, err = int(c.get("serve.rejected", 0)), int(c.get("serve.errors", 0))
         if rej or err:
             bits.append(f"{rej} rejected / {err} errors")
-        line = "  serve: " + " | ".join(bits)
-        if mon.serve_draining:
+        label = "serve" if not key else f"serve[{key}]"
+        line = f"  {label}: " + " | ".join(bits)
+        if st["draining"]:
             line += " | DRAINING"
-        elif mon.serve_drained:
+        elif st["drained"]:
             line += " | drained clean"
         lines.append(line)
+    # router line (serve/router.py): routed totals + the live replica-state
+    # map — the replica tier's one-glance health view
+    if mon.router_counters or mon.router_states:
+        c = mon.router_counters
+        bits = [
+            f"{int(c.get('router.requests', 0))} req "
+            f"({int(c.get('router.ok', 0))} ok, "
+            f"{int(c.get('router.retried_ok', 0))} retried-ok)"
+        ]
+        bits.append(
+            f"{int(c.get('router.retries', 0))} retries / "
+            f"{int(c.get('router.hedges', 0))} hedges / "
+            f"{int(c.get('router.sheds', 0))} shed / "
+            f"{int(c.get('router.failed', 0))} failed"
+        )
+        if mon.router_states:
+            bits.append(
+                "replicas: "
+                + ", ".join(
+                    f"{rid} {state}"
+                    for rid, state in sorted(mon.router_states.items())
+                )
+            )
+        line = "  router: " + " | ".join(bits)
+        dead = sum(1 for s in mon.router_states.values() if s == "dead")
+        if dead:
+            line += f"  ⚠ {dead} DEAD"
+        lines.append(line)
+        if mon.replica_restarts or mon.swap_events:
+            bits = []
+            if mon.replica_restarts:
+                bits.append(f"{mon.replica_restarts} replica restart(s)")
+            for s in mon.swap_events:
+                bits.append(
+                    f"rolled to gen {s.get('generation', '?')} "
+                    f"in {s.get('seconds', '?')}s"
+                )
+            lines.append("  replicaset: " + ", ".join(bits))
     # live goodput line (docs/observability.md §7): per-category span
     # seconds vs the wall elapsed since the earliest run_start — the full
     # ledger (generation gaps, supervisor backoff) is the timeline CLI's job

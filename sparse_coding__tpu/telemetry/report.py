@@ -120,10 +120,14 @@ def _processes(run) -> List[Any]:
 
 
 def _last_snapshots(run) -> List[Dict[str, Any]]:
-    """The final snapshot of each process (one element single-host)."""
+    """The final snapshot of each writer (one element single-host). Writers
+    are distinguished by ``process_index`` (pods) AND the ``replica`` tag
+    (serve replica tiers write one log per replica into the same run dir —
+    without the second key, only the last replica's counters would
+    survive the merge)."""
     last: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
     for s in _events_of(run, "snapshot"):
-        last[s.get("process_index")] = s
+        last[(s.get("process_index"), s.get("replica"))] = s
     return list(last.values())
 
 
@@ -683,6 +687,118 @@ def _serving_section(run, lines: List[str]):
     lines.append("")
 
 
+def _router_section(run, lines: List[str]):
+    """Replica-tier front-end stats (ISSUE 13, docs/SERVING.md): routed
+    totals (retries / hedges / sheds / failures), a per-replica table
+    (last known state, forward latency, restarts, state transitions),
+    replica supervision outcomes, and rolling-swap rollouts. Omitted for
+    runs with no router activity — report output is a stability
+    contract."""
+    counters = _merged_counters(run)
+    gauges = _merged_gauges(run)
+    router_counters = {k: v for k, v in counters.items() if k.startswith("router.")}
+    state_events = _events_of(run, "router_replica_state")
+    swaps = _events_of(run, "rolling_swap_done")
+    if not (router_counters or state_events or swaps):
+        return
+    lines.append("## Router")
+    lines.append("")
+    reqs = int(counters.get("router.requests", 0))
+    ok = int(counters.get("router.ok", 0))
+    retried_ok = int(counters.get("router.retried_ok", 0))
+    bits = [
+        f"**{reqs}** requests routed: {ok} ok "
+        f"({retried_ok} after transparent retries), "
+        f"{int(counters.get('router.client_errors', 0))} client-error, "
+        f"{int(counters.get('router.sheds', 0))} shed, "
+        f"{int(counters.get('router.failed', 0))} failed"
+    ]
+    lines.append("- " + "; ".join(bits))
+    lines.append(
+        f"- {int(counters.get('router.forwards', 0))} forwards, "
+        f"{int(counters.get('router.retries', 0))} retries, "
+        f"{int(counters.get('router.hedges', 0))} hedges"
+    )
+    if gauges.get("router.replicas") is not None:
+        lines.append(
+            f"- replicas at close: {int(gauges.get('router.live_replicas', 0))}"
+            f"/{int(gauges['router.replicas'])} live"
+        )
+    # per-replica rows: last state from the transition timeline, latency
+    # gauges, and supervision outcomes from the replicaset's events
+    restarts_by: Dict[str, int] = {}
+    for e in _events_of(run, "replica_restart"):
+        rid = str(e.get("replica", "?"))
+        restarts_by[rid] = restarts_by.get(rid, 0) + 1
+    exits_by: Dict[str, List[str]] = {}
+    for e in _events_of(run, "replica_exit"):
+        rid = str(e.get("replica", "?"))
+        exits_by.setdefault(rid, []).append(str(e.get("classification", "?")))
+    last_state: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    transitions: Dict[str, int] = {}
+    for e in state_events:
+        rid = str(e.get("replica", "?"))
+        last_state[rid] = e
+        transitions[rid] = transitions.get(rid, 0) + 1
+    rids = sorted(
+        set(last_state)
+        | set(restarts_by)
+        | set(exits_by)
+        | {
+            k.split(".")[2]
+            for k in gauges
+            if k.startswith("router.replica.") and len(k.split(".")) > 3
+        }
+    )
+    if rids:
+        lines.append("")
+        lines.append(
+            "| replica | state | p50 ms | p99 ms | transitions "
+            "| exits | restarts |"
+        )
+        lines.append("|---|---|---:|---:|---:|---|---:|")
+        for rid in rids:
+            st = last_state.get(rid, {})
+            lines.append(
+                f"| {rid} | {st.get('to', '?')} "
+                f"| {_fmt(gauges.get(f'router.replica.{rid}.p50_ms'))} "
+                f"| {_fmt(gauges.get(f'router.replica.{rid}.p99_ms'))} "
+                f"| {transitions.get(rid, 0)} "
+                f"| {', '.join(exits_by.get(rid, [])) or '-'} "
+                f"| {restarts_by.get(rid, 0)} |"
+            )
+    downtime = [
+        e.get("downtime_seconds")
+        for e in _events_of(run, "replica_ready")
+        if e.get("downtime_seconds") is not None
+    ]
+    if restarts_by or downtime:
+        lines.append("")
+        lines.append(
+            f"- replica supervision: {sum(restarts_by.values())} restart(s)"
+            + (
+                f", {sum(downtime):.1f} s total replica downtime "
+                "(router retried traffic around it)"
+                if downtime
+                else ""
+            )
+        )
+    exhausted = _events_of(run, "replica_budget_exhausted")
+    if exhausted:
+        lines.append(
+            f"- ⚠ **restart budget exhausted** for "
+            f"{', '.join(sorted({str(e.get('replica')) for e in exhausted}))}"
+            " — replica left dead (escalate)"
+        )
+    for s in swaps:
+        lines.append(
+            f"- rolling swap → generation **{_fmt(s.get('generation'))}** "
+            f"across {_fmt(s.get('replicas'))} replica(s) in "
+            f"{_fmt(s.get('seconds'))} s — drain-aware, zero dropped"
+        )
+    lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -718,16 +834,22 @@ def _throughput_section(run, lines: List[str]):
     # (or another run sharing the directory) is never lumped in, and
     # requires generation-stamped records — legacy logs cannot distinguish
     # a second generation from a second writer, so no total is guessed.
+    # ... and on the `replica` tag: a serve replica tier writes one
+    # same-named log per replica — their generation-0 run_ends are three
+    # WRITERS, not three generations, and must not sum
     by_run: Dict[Any, List[Dict[str, Any]]] = {}
     for e in ends:
         if e.get("run_name") == "supervisor" or e.get("generation") is None:
             continue
-        by_run.setdefault((e.get("process_index"), e.get("run_name")), []).append(e)
+        by_run.setdefault(
+            (e.get("process_index"), (e.get("run_name"), e.get("replica"))),
+            [],
+        ).append(e)
     for (p, _name), pe in sorted(
         by_run.items(),
         key=lambda kv: (
             kv[0][0] is None, -1 if kv[0][0] is None else kv[0][0],
-            kv[0][1] or "",
+            str(kv[0][1]),
         ),
     ):
         if len(pe) < 2:
@@ -869,6 +991,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _recovery_section(run, lines)
     _goodput_section(run, lines)
     _serving_section(run, lines)
+    _router_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

@@ -49,9 +49,17 @@ Sites (ctx fields in parentheses)
     checkpoint_commit     after checkpoint data is on disk, before commit
     checkpoint_committed  right after a successful commit (path)
     export                top of `save_learned_dicts` (path)
+    serve_loop            each tick of the serve server's drain-wait loop
+                          (tick) — `kill:serve_loop:tick=40` SIGKILLs a
+                          serve replica mid-flight deterministically (the
+                          replica-death chaos tests' hammer)
+    router_forward        in `serve.router` just before an encode forward
+                          (replica) — io_error here simulates a transport
+                          failure the router must retry elsewhere
 
 Selectors (all optional; every given selector must match)
     chunk=N / step=N / epoch=N   fire only when the ctx field equals N
+    tick=N / replica=ID          same, for the serving sites
     every=N                      fire on every Nth matching hit (1-based)
     times=N                      stop after N fires (default: unlimited,
                                  except torn/corrupt which default to 1)
@@ -165,6 +173,8 @@ def parse_faults(text: str) -> List[_Spec]:
                 site = "chunk_loop"
             elif site is None and "step" in params:
                 site = "step_loop"
+            elif site is None and "tick" in params:
+                site = "serve_loop"
         if site is None:
             raise ValueError(
                 f"{FAULT_ENV} spec {raw!r} names no site and none can be "
@@ -260,7 +270,7 @@ def fault_point(site: str, **ctx) -> None:
             continue
         # positional selectors must all match the ctx
         matched = True
-        for key in ("chunk", "step", "epoch"):
+        for key in ("chunk", "step", "epoch", "tick", "replica"):
             if key in spec.params and ctx.get(key) != spec.params[key]:
                 matched = False
                 break

@@ -73,17 +73,22 @@ def retry_with_backoff(
     give_up_on: Tuple[Type[BaseException], ...] = (),
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     sleep: Optional[Callable[[float], None]] = None,
+    delay_floor_from: Optional[Callable[[BaseException], float]] = None,
 ):
     """Call `fn(attempt)` until it returns, retrying `retry_on` exceptions
     with exponential backoff. ONE implementation shared by the remote-sync
-    engine and `data.chunks` transient-read retries (the PR-5 satellite
-    contract: both follow the same env-configurable schedule).
+    engine, `data.chunks` transient-read retries (the PR-5 satellite
+    contract: both follow the same env-configurable schedule), and the
+    serving tier's retry paths (`serve.router`, `ServeClient`).
 
     `attempts`/`base_delay` default to the `SC_SYNC_RETRIES` /
     `SC_SYNC_BACKOFF` env values. `give_up_on` carves permanent failures
     out of a broad `retry_on` (e.g. FileNotFoundError out of OSError) —
     those re-raise immediately. `on_retry(attempt, exc)` fires before each
-    sleep — telemetry counters hook in there. The final failure re-raises.
+    sleep — telemetry counters hook in there. `delay_floor_from(exc)`, if
+    given, returns a per-failure minimum sleep the schedule is raised to —
+    how HTTP retries honor a server's ``Retry-After`` as a floor without
+    abandoning the shared schedule. The final failure re-raises.
     """
     attempts = default_retries() if attempts is None else max(1, attempts)
     base = default_backoff() if base_delay is None else base_delay
@@ -100,8 +105,14 @@ def retry_with_backoff(
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            if delays[attempt] > 0:
-                sleep(delays[attempt])
+            delay = delays[attempt]
+            if delay_floor_from is not None:
+                try:
+                    delay = max(delay, float(delay_floor_from(e) or 0.0))
+                except (TypeError, ValueError):
+                    pass
+            if delay > 0:
+                sleep(delay)
 
 
 class _SyncFailed(Exception):

@@ -1,0 +1,742 @@
+"""Replicated serving tier (ISSUE 13, docs/SERVING.md "Replicas").
+
+Covers the router's replica state machine (healthz-driven + per-request
+outcomes), retry-against-a-different-replica with the shared backoff
+engine (Retry-After floor honored), bounded load-shedding, hedging, the
+generation-stamped passthrough, the replica supervisor's restart/rolling-
+swap machinery, loadgen's per-outcome accounting, the golden router_run
+observability fixture, and the chaos acceptance: a replica SIGKILLed
+mid-flight under closed-loop load costs zero client-visible failures, and
+a rolling dict swap under the same load never shows a torn generation.
+"""
+
+import json
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.models.learned_dict import TiedSAE
+from sparse_coding__tpu.serve.registry import DictRegistry
+from sparse_coding__tpu.serve.router import (
+    Router,
+    RouterClient,
+    ShedRejection,
+)
+from sparse_coding__tpu.serve.server import (
+    RetryableRejection,
+    ServeClient,
+    ServeServer,
+)
+from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+pytestmark = pytest.mark.serve
+
+GOLDEN_ROUTER = Path(__file__).parent / "golden" / "router_run"
+D, N = 16, 64
+
+
+def _tied(seed: int, d: int = D, n: int = N) -> TiedSAE:
+    rng = np.random.default_rng(seed)
+    return TiedSAE(
+        jnp.asarray(rng.standard_normal((n, d), dtype=np.float32)),
+        jnp.asarray(rng.standard_normal(n, dtype=np.float32) * 0.1),
+    )
+
+
+def _rows(seed: int, n: int = 4, d: int = D) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal((n, d)).astype(np.float32)
+
+
+def _registry(n_dicts: int = 2) -> DictRegistry:
+    reg = DictRegistry()
+    for i in range(n_dicts):
+        reg.add(f"d{i}", _tied(i))
+    return reg
+
+
+class StubReplica:
+    """A scriptable fake serve backend: healthz always ok; /encode replays
+    a script of (delay_s, status, retryable, retry_after) behaviors, then
+    repeats the last one. Lets the failure-mode tests be deterministic
+    without real engines."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.hits = 0
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._json(200, {"status": "ok", "dict_generation": 0})
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                with stub._lock:
+                    step = stub.script[min(stub.hits, len(stub.script) - 1)]
+                    stub.hits += 1
+                delay, status, retryable, retry_after = step
+                if delay:
+                    time.sleep(delay)
+                if status == 200:
+                    self._json(200, {"dict": "d0", "n_rows": 1,
+                                     "codes": [[1.0, 2.0]], "generation": 0})
+                else:
+                    headers = {}
+                    if retry_after is not None:
+                        headers["Retry-After"] = str(retry_after)
+                    self._json(status, {"error": "scripted",
+                                        "retryable": retryable}, headers)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def address(self):
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# -- routing correctness -------------------------------------------------------
+
+def test_router_forwards_bit_identical():
+    """The passthrough contract: codes through the router are byte-for-byte
+    what the replica served (the router never re-serializes bodies)."""
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        with Router({"r0": srv.address}, health_interval=0.2) as router:
+            client = router.client()
+            X = _rows(0)
+            codes, meta = client.encode_with_meta("d1", X)
+            direct = np.asarray(reg.get("d1").ld.encode(jnp.asarray(X)))
+            np.testing.assert_array_equal(codes, direct)
+            assert meta["attempts"] == 1 and meta["generation"] == 0
+            # client errors pass through verbatim, never retried
+            with pytest.raises(RuntimeError, match="404"):
+                client.encode("nope", X)
+            assert router.stats["retries"] == 0
+
+
+def test_router_retries_against_a_different_replica():
+    """A dead backend (connection refused) costs a transparent retry, not a
+    failure: the request lands on the live replica, the dead one goes
+    suspect/dead from the request outcome alone."""
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        # r0 points into the void (an unbound port); long health interval so
+        # ONLY the request outcome can drive its state
+        router = Router(
+            {"r0": "http://127.0.0.1:9", "r1": srv.address},
+            health_interval=30.0, max_attempts=3, retry_backoff=0.01,
+        ).start()
+        try:
+            # lie that the dead backend is live, with the live one busier —
+            # the first pick deterministically forwards into the void
+            router._targets["r0"].state = "live"
+            router._targets["r0"].consecutive_failures = 0
+            router._targets["r1"].in_flight = 1
+            client = router.client()
+            X = _rows(1)
+            codes, meta = client.encode_with_meta("d0", X)
+            np.testing.assert_array_equal(
+                codes, np.asarray(reg.get("d0").ld.encode(jnp.asarray(X)))
+            )
+            assert meta["attempts"] == 2
+            assert router.stats["retries"] == 1
+            assert router.stats["retried_ok"] == 1
+            assert router.states()["r0"] in ("suspect", "dead")
+            assert router.states()["r1"] == "live"
+        finally:
+            router.stop()
+
+
+def test_router_sheds_fast_when_no_replica_routable():
+    router = Router(
+        {"r0": "http://127.0.0.1:9"}, health_interval=30.0, max_attempts=2,
+    ).start()
+    try:
+        router._targets["r0"].state = "dead"
+        client = router.client()
+        t0 = time.monotonic()
+        with pytest.raises(ShedRejection):
+            client.encode("d0", _rows(0))
+        assert time.monotonic() - t0 < 1.0, "shed must be FAST, not queued"
+        assert router.stats["sheds"] == 1
+        assert router.health()["status"] == "unavailable"
+    finally:
+        router.stop()
+
+
+def test_router_sheds_when_saturated():
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        with Router(
+            {"r0": srv.address}, health_interval=0.2, max_inflight=0
+        ) as router:
+            with pytest.raises(ShedRejection, match="saturated"):
+                router.client().encode("d0", _rows(0))
+            assert router.stats["sheds"] == 1
+
+
+def test_router_gives_up_after_bounded_attempts():
+    """All replicas answering retryable 503s: bounded attempts, then a
+    retryable 503 back to the client — never an unbounded retry loop."""
+    stub = StubReplica([(0, 503, True, None)])
+    try:
+        with Router(
+            {"r0": stub.address}, health_interval=30.0, max_attempts=3,
+            retry_backoff=0.01,
+        ) as router:
+            router._targets["r0"].state = "live"
+            with pytest.raises(RetryableRejection):
+                router.client().encode("d0", _rows(0))
+            assert router.stats["failed"] == 1
+            assert router.stats["retries"] == 2  # attempts - 1
+            assert stub.hits == 3
+    finally:
+        stub.close()
+
+
+def test_router_request_deadline_504():
+    stub = StubReplica([(0.6, 200, False, None)])
+    try:
+        with Router(
+            {"r0": stub.address}, health_interval=30.0, max_attempts=4,
+            request_deadline=0.25, attempt_timeout=0.2, retry_backoff=0.01,
+        ) as router:
+            router._targets["r0"].state = "live"
+            with pytest.raises(RuntimeError, match="504"):
+                router.client().encode("d0", _rows(0))
+            assert router.stats["failed"] == 1
+    finally:
+        stub.close()
+
+
+def test_router_honors_retry_after_floor(monkeypatch):
+    """The satellite contract: the backoff schedule is the shared
+    `utils.sync` engine, and a replica's Retry-After raises each sleep to
+    at least that floor."""
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    stub = StubReplica([
+        (0, 503, True, "0.7"), (0, 503, True, "0.7"), (0, 200, False, None),
+    ])
+    try:
+        with Router(
+            {"r0": stub.address}, health_interval=30.0, max_attempts=3,
+            retry_backoff=0.01,
+        ) as router:
+            router._targets["r0"].state = "live"
+            codes = router.client().encode("d0", [[0.0, 0.0]])
+            assert codes.shape == (1, 2)
+            retry_sleeps = [s for s in sleeps if s >= 0.7]
+            assert len(retry_sleeps) >= 2, (
+                f"Retry-After floor not honored: {sleeps}"
+            )
+    finally:
+        stub.close()
+
+
+def test_router_hedges_slow_replica():
+    """With hedging armed, a slow primary is raced against a second live
+    replica and the fast answer wins, well before the primary finishes."""
+    slow = StubReplica([(0.8, 200, False, None)])
+    reg = _registry()
+    try:
+        with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+            with Router(
+                {"slow": slow.address, "fast": srv.address},
+                health_interval=0.2, hedge_ms=40.0, attempt_timeout=3.0,
+            ) as router:
+                time.sleep(0.4)  # probes admit both
+                assert set(router.states().values()) == {"live"}
+                # force the slow replica to be picked first
+                router._targets["fast"].in_flight = 5
+                t0 = time.monotonic()
+                codes, meta = router.client().encode_with_meta(
+                    "d0", _rows(2)
+                )
+                dt = time.monotonic() - t0
+                assert meta["hedged"] is True
+                assert router.stats["hedges"] == 1
+                assert dt < 0.7, f"hedge did not win: {dt:.3f}s"
+                np.testing.assert_array_equal(
+                    codes,
+                    np.asarray(reg.get("d0").ld.encode(jnp.asarray(_rows(2)))),
+                )
+    finally:
+        slow.close()
+
+
+def test_router_drain_aware_quiesce_and_readmit():
+    """Quiesced replicas receive no new forwards (rolling-swap step 1);
+    readmission restores them. A DRAINING healthz is never a failure."""
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as a:
+        with ServeServer(_registry(), max_batch=64, max_wait_ms=1.0) as b:
+            with Router(
+                {"a": a.address, "b": b.address}, health_interval=0.15,
+            ) as router:
+                time.sleep(0.4)
+                router.quiesce("a")
+                before = router._targets["a"].forwards
+                for i in range(6):
+                    router.client().encode("d0", _rows(i))
+                assert router._targets["a"].forwards == before
+                assert router._targets["b"].forwards >= 6
+                router.readmit("a")
+                # a draining backend transitions to 'draining', not suspect
+                a.draining = True
+                time.sleep(0.5)
+                assert router.states()["a"] == "draining"
+                assert router._targets["a"].consecutive_failures == 0
+
+
+# -- fault sites ---------------------------------------------------------------
+
+def test_serve_tier_fault_sites_grammar():
+    """The new replica-kill sites parse and select (docs/RECOVERY.md §4):
+    `tick=` infers `serve_loop`; `replica=` matches string ctx."""
+    from sparse_coding__tpu.utils import faults
+
+    specs = faults.parse_faults("kill:tick=3")
+    assert specs[0].site == "serve_loop" and specs[0].params["tick"] == 3
+    specs = faults.parse_faults("io_error:router_forward:replica=r1")
+    assert specs[0].site == "router_forward"
+    assert specs[0].params["replica"] == "r1"
+
+
+def test_router_forward_fault_injection(monkeypatch):
+    """`SC_FAULT=io_error:router_forward:replica=...` makes ONE replica's
+    forwards fail at the planted site — the router must retry elsewhere
+    and the client never sees it."""
+    from sparse_coding__tpu.utils import faults
+
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        with Router(
+            {"r0": srv.address, "r1": srv.address},
+            health_interval=30.0, max_attempts=3, retry_backoff=0.01,
+        ) as router:
+            monkeypatch.setenv(
+                faults.FAULT_ENV, "io_error:router_forward:replica=r0:persist=1"
+            )
+            faults.reset()
+            try:
+                router._targets["r1"].in_flight = 1  # r0 picked first
+                X = _rows(3)
+                codes, meta = router.client().encode_with_meta("d0", X)
+                np.testing.assert_array_equal(
+                    codes, np.asarray(reg.get("d0").ld.encode(jnp.asarray(X)))
+                )
+                assert meta["attempts"] == 2
+                assert router.stats["retries"] == 1
+                assert router.states()["r0"] in ("suspect", "dead")
+            finally:
+                faults.reset()
+
+
+# -- ServeClient retry satellite -----------------------------------------------
+
+def test_serveclient_retry_rides_shared_backoff(monkeypatch):
+    """ISSUE-13 satellite: ServeClient retries clean retryable rejections
+    through `utils.sync.retry_with_backoff` (Retry-After as a floor) and
+    bumps `serve.client.retry` on the active telemetry."""
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    stub = StubReplica([
+        (0, 503, True, "0.4"), (0, 200, False, None),
+    ])
+    try:
+        with RunTelemetry(out_dir=None, run_name="client") as tel:
+            client = ServeClient(stub.address, retries=3, backoff_base=0.01)
+            codes = client.encode("d0", [[0.0, 0.0]])
+            assert codes.shape == (1, 2)
+            assert tel.counters.get("serve.client.retry") == 1
+            assert any(s >= 0.4 for s in sleeps), (
+                f"Retry-After floor not honored: {sleeps}"
+            )
+        # retries exhausted: the rejection propagates
+        stub2 = StubReplica([(0, 503, True, None)])
+        try:
+            client2 = ServeClient(stub2.address, retries=2, backoff_base=0.0)
+            with pytest.raises(RetryableRejection):
+                client2.encode("d0", [[0.0, 0.0]])
+            assert stub2.hits == 2
+        finally:
+            stub2.close()
+    finally:
+        stub.close()
+
+
+def test_retry_with_backoff_delay_floor_unit():
+    from sparse_coding__tpu.utils.sync import retry_with_backoff
+
+    class Floored(Exception):
+        retry_after = 1.5
+
+    sleeps = []
+    calls = {"n": 0}
+
+    def fn(attempt):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise Floored()
+        return "done"
+
+    out = retry_with_backoff(
+        fn, attempts=3, base_delay=0.01, retry_on=(Floored,),
+        sleep=sleeps.append,
+        delay_floor_from=lambda e: getattr(e, "retry_after", 0.0),
+    )
+    assert out == "done"
+    assert sleeps == [1.5, 1.5]  # schedule (0.01, 0.02) raised to the floor
+
+
+# -- loadgen per-outcome accounting --------------------------------------------
+
+def test_loadgen_targets_outcome_accounting():
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    from loadgen import run_load
+
+    reg = _registry()
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        with Router({"r0": srv.address}, health_interval=0.2) as router:
+            client = router.client()
+            out = run_load(
+                client.encode_with_meta, ["d0", "d1"], n_clients=4,
+                requests_per_client=4, rows_per_request=2, width=D,
+                with_meta=True,
+            )
+            assert out["requests"] == 16 and out["errors"] == 0
+            assert {"retried_ok", "shed"} <= set(out)
+    # all replicas dead -> every request accounted as a clean shed
+    router2 = Router({"r0": "http://127.0.0.1:9"}, health_interval=30.0).start()
+    try:
+        router2._targets["r0"].state = "dead"
+        from loadgen import run_load as rl
+
+        out = rl(
+            router2.client().encode_with_meta, ["d0"], n_clients=2,
+            requests_per_client=3, rows_per_request=1, width=D,
+            with_meta=True,
+        )
+        assert out["shed"] == 6 and out["errors"] == 0 and out["requests"] == 0
+    finally:
+        router2.stop()
+
+
+# -- golden fixture: report / monitor / perfdiff -------------------------------
+
+def test_report_router_section_golden():
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(GOLDEN_ROUTER))
+    assert "## Router" in md
+    assert (
+        "**482** requests routed: 478 ok (7 after transparent retries), "
+        "2 client-error, 2 shed, 0 failed" in md
+    )
+    assert "489 forwards, 9 retries, 2 hedges" in md
+    assert "| replica1 | live | 8.4 | 23.1 | 6 | killed | 1 |" in md
+    assert "replica supervision: 1 restart(s), 2.2 s total replica downtime" in md
+    assert "rolling swap → generation **1** across 3 replica(s) in 6 s" in md
+    # the Serving section merges ALL replicas' counters (the per-writer
+    # snapshot merge), not just the last log read
+    assert "**480** requests (960 rows)" in md
+
+
+def test_monitor_router_lines_golden():
+    from sparse_coding__tpu.telemetry.monitor import RunMonitor, render
+
+    mon = RunMonitor(GOLDEN_ROUTER)
+    mon.poll()
+    out = render(mon)
+    assert "serve[replica0]: 160 req (320 rows, 24 batches)" in out
+    assert "serve[replica1]:" in out and "2 rejected" in out
+    assert "serve[replica2]:" in out
+    assert (
+        "router: 482 req (478 ok, 7 retried-ok) | 9 retries / 2 hedges / "
+        "2 shed / 0 failed" in out
+    )
+    assert "replicas: replica0 live, replica1 live, replica2 live" in out
+    assert "replicaset: 1 replica restart(s), rolled to gen 1 in 6.0s" in out
+    assert not mon.malformed
+
+
+def test_perfdiff_router_fixture_smoke():
+    import copy
+
+    from sparse_coding__tpu.perfdiff import compare, load_bench
+
+    bench = load_bench(GOLDEN_ROUTER / "bench_router_fixture.json")
+    clean = compare(bench, bench)
+    assert clean["regressions"] == []
+    statuses = {r["key"]: r["status"] for r in clean["rows"]}
+    assert statuses["router_rows_per_sec"] == "ok"
+    assert statuses["router_direct_rows_per_sec"] == "ok"
+    slow = copy.deepcopy(bench)
+    slow["router_rows_per_sec"] = bench["router_rows_per_sec"] * 0.5
+    assert compare(bench, slow)["regressions"] == ["router_rows_per_sec"]
+
+
+def test_bench_router_block_schema_pinned():
+    with open(GOLDEN_ROUTER / "bench_router_fixture.json") as f:
+        bench = json.load(f)
+    assert set(bench["router"]) == {
+        "overhead_ratio", "retries", "hedges", "sheds", "failed",
+        "client_errors", "replicas",
+    }
+    assert bench["router"]["overhead_ratio"] >= 0.8, (
+        "the fixture must model the >=0.8x acceptance floor"
+    )
+    for key in ("router_rows_per_sec", "router_direct_rows_per_sec"):
+        assert isinstance(bench[key], (int, float))
+        assert len(bench[f"{key}_spread"]) == 2
+
+
+# -- chaos acceptance ----------------------------------------------------------
+
+@pytest.mark.chaos
+def test_replica_kill_and_rolling_swap_chaos(tmp_path):
+    """THE ISSUE-13 acceptance. A 3-replica set behind the router under
+    6-thread closed-loop load:
+
+    1. one replica is SIGKILLed mid-flight → every client request still
+       ends bit-correct-200 (transparent retries) or a clean shed-503 —
+       zero accepted-but-unanswered, zero wrong bytes; the router marks
+       the replica dead within the heartbeat timeout and the supervisor
+       auto-restarts it (downtime attributed in telemetry);
+    2. a rolling dict swap under the same load completes with zero dropped
+       requests, and every single response is wholly one generation —
+       codes always bit-match the generation the response declares.
+    """
+    import os
+
+    from sparse_coding__tpu.serve.replicaset import ReplicaSet
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    # generation 0 and generation 1 exports: same ids, different weights
+    lds_a = [_tied(0), _tied(1)]
+    lds_b = [_tied(10), _tied(11)]
+    dir_a, dir_b = tmp_path / "gen0", tmp_path / "gen1"
+    dir_a.mkdir(), dir_b.mkdir()
+    export_a, export_b = dir_a / "learned_dicts.pkl", dir_b / "learned_dicts.pkl"
+    save_learned_dicts(export_a, [(ld, {}) for ld in lds_a])
+    save_learned_dicts(export_b, [(ld, {}) for ld in lds_b])
+
+    X = _rows(42, n=3)
+    expected = {}  # (generation, dict_id) -> bit-exact codes
+    for gen, lds in ((0, lds_a), (1, lds_b)):
+        for i, ld in enumerate(lds):
+            expected[(gen, f"learned_dicts:{i}")] = np.asarray(
+                ld.encode(jnp.asarray(X))
+            )
+
+    run_dir = tmp_path / "tier"
+    router_tel = RunTelemetry(out_dir=run_dir, run_name="router",
+                              file_name="router_events.jsonl")
+    rs_tel = RunTelemetry(out_dir=run_dir, run_name="replicaset",
+                          file_name="replicaset_events.jsonl")
+    router = Router(
+        telemetry=router_tel, health_interval=0.25, dead_after=2,
+        max_attempts=4, retry_backoff=0.05, request_deadline=60.0,
+        attempt_timeout=30.0, snapshot_every=8,
+    )
+    rs = ReplicaSet(
+        [str(export_a)], n_replicas=3, run_dir=run_dir, router=router,
+        telemetry=rs_tel, max_batch=64, max_wait_ms=5.0,
+        backoff_base=0.2, backoff_max=2.0, poll_interval=0.1,
+        ready_timeout=180.0,
+        env={"JAX_PLATFORMS": "cpu", "SC_PREEMPT": "1"},
+    )
+    outcomes = {"ok": 0, "retried_ok": 0, "shed": 0, "rejected": 0,
+                "bad": [], "by_gen": {0: 0, 1: 0}}
+    lock = threading.Lock()
+    stop_clients = threading.Event()
+
+    def client_loop(cid: int):
+        client = RouterClient(router.address, timeout=60)
+        i = 0
+        while not stop_clients.is_set():
+            did = f"learned_dicts:{(cid + i) % 2}"
+            i += 1
+            try:
+                codes, meta = client.encode_with_meta(did, X)
+            except ShedRejection:
+                with lock:
+                    outcomes["shed"] += 1
+                time.sleep(0.05)
+                continue
+            except RetryableRejection:
+                with lock:
+                    outcomes["rejected"] += 1
+                time.sleep(0.05)
+                continue
+            except Exception as e:  # anything unclean is a failure
+                with lock:
+                    outcomes["bad"].append(repr(e))
+                continue
+            gen = meta.get("generation")
+            want = expected.get((gen, did))
+            with lock:
+                if want is None:
+                    outcomes["bad"].append(f"unknown generation {gen!r}")
+                elif np.array_equal(codes, want):
+                    outcomes["ok"] += 1
+                    outcomes["by_gen"][gen] += 1
+                    if meta.get("attempts", 1) > 1:
+                        outcomes["retried_ok"] += 1
+                else:
+                    outcomes["bad"].append(
+                        f"torn/wrong codes for {did} gen {gen}"
+                    )
+
+    try:
+        rs.start()
+        router.start()
+        assert set(router.states().values()) == {"live"}
+        threads = [
+            threading.Thread(target=client_loop, args=(c,)) for c in range(6)
+        ]
+        for t in threads:
+            t.start()
+
+        def wait_ok(n, timeout=120.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with lock:
+                    if outcomes["ok"] >= n:
+                        return
+                time.sleep(0.05)
+            with lock:
+                pytest.fail(f"load never reached {n} ok: {outcomes}")
+
+        wait_ok(24)
+
+        # -- phase 1: SIGKILL a replica mid-flight --------------------------
+        victim = rs.replicas[1]
+        victim_pid = victim.proc.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        t_kill = time.time()
+        # the router must mark it dead within the heartbeat window (the
+        # supervisor's mark_down usually beats the probes)
+        deadline = t_kill + 10.0
+        while time.time() < deadline:
+            if router.states()["replica1"] in ("dead", "suspect"):
+                break
+            time.sleep(0.05)
+        assert router.states()["replica1"] in ("dead", "suspect"), (
+            f"kill not detected: {router.states()}"
+        )
+        # ...and the supervisor must restart it back to live
+        deadline = t_kill + 150.0
+        while time.time() < deadline:
+            if (
+                router.states()["replica1"] == "live"
+                and rs.states()["replica1"] == "running"
+            ):
+                break
+            time.sleep(0.1)
+        assert router.states()["replica1"] == "live", (
+            f"replica never readmitted: router={router.states()} "
+            f"rs={rs.states()}"
+        )
+        assert rs.replicas[1].proc.pid != victim_pid, "no new process spawned"
+        with lock:
+            ok_after_kill = outcomes["ok"]
+        wait_ok(ok_after_kill + 12)  # traffic flows across the healed set
+
+        # -- phase 2: rolling dict swap under the same load -----------------
+        gen = rs.rolling_swap([str(export_b)])
+        assert gen == 1
+        wait_ok(outcomes["ok"] + 12)
+        stop_clients.set()
+        for t in threads:
+            t.join(60)
+
+        with lock:
+            assert outcomes["bad"] == [], outcomes["bad"]
+            assert outcomes["ok"] > 0
+            # both generations served during the rollout, each bit-correct
+            # for the generation the response declared — no torn mixes
+            assert outcomes["by_gen"][0] > 0 and outcomes["by_gen"][1] > 0
+        # post-swap, only generation 1 answers
+        client = RouterClient(router.address, timeout=60)
+        for i in range(4):
+            codes, meta = client.encode_with_meta(f"learned_dicts:{i % 2}", X)
+            assert meta["generation"] == 1
+            np.testing.assert_array_equal(
+                codes, expected[(1, f"learned_dicts:{i % 2}")]
+            )
+        # the kill forced at least one transparent retry (6 closed-loop
+        # clients keep requests permanently in flight)
+        assert router.stats["retries"] >= 1
+        assert router.stats["failed"] == 0
+    finally:
+        stop_clients.set()
+        rs.stop()
+        router.stop()
+        router_tel.close()
+        rs_tel.close()
+
+    # -- telemetry: downtime attributed, sections render --------------------
+    rs_events = [
+        json.loads(l)
+        for l in (run_dir / "replicaset_events.jsonl").read_text().splitlines()
+    ]
+    exits = [e for e in rs_events if e.get("event") == "replica_exit"]
+    assert any(e.get("classification") == "killed" for e in exits)
+    restarts = [e for e in rs_events if e.get("event") == "replica_restart"]
+    assert restarts, "supervisor recorded no restart"
+    readies = [
+        e for e in rs_events
+        if e.get("event") == "replica_ready"
+        and e.get("downtime_seconds") is not None
+    ]
+    assert readies and readies[0]["downtime_seconds"] > 0, (
+        "lost wall time not attributed"
+    )
+    assert any(e.get("event") == "rolling_swap_done" for e in rs_events)
+
+    from sparse_coding__tpu.telemetry.monitor import RunMonitor, render
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(run_dir))
+    assert "## Router" in md
+    assert "rolling swap → generation **1**" in md
+    assert "replica supervision: " in md
+    mon = RunMonitor(run_dir)
+    mon.poll()
+    out = render(mon)
+    assert "router: " in out
+    assert "replicaset: " in out and "rolled to gen 1" in out

@@ -281,6 +281,62 @@ def test_load_export_validates_before_mutating(tmp_path):
     assert reg.ids() == ["taken"]
 
 
+def test_process_retries_stack_build_after_registry_race(registry4, engine4):
+    """ISSUE-13 satellite regression for the engine's retry-once path: a
+    dict registered by another thread between the drainer's request
+    grouping and its (stale-believed) stack lookup must be served by the
+    in-place rebuild — not errored. Simulated deterministically: build
+    stacks, add a NEW-group-key dict, then lie that the cached stacks are
+    current (exactly the window the generation check cannot see)."""
+    engine4.encode("d0", _rows(0, n=2))  # stacks built at this generation
+    odd = TiedSAE(
+        jnp.asarray(
+            np.random.default_rng(5).standard_normal((N // 2, D), dtype=np.float32)
+        ),
+        jnp.zeros((N // 2,), jnp.float32),
+    )
+    registry4.add("odd", odd)  # generation bumps; new group key
+    # the lie: claim the (pre-add) stacks already reflect this generation,
+    # so _stacks_current() returns a map with no 'odd' group in it
+    engine4._stacks_generation = registry4.generation
+    assert (odd_key := (registry4.get("odd").group_key, "native")) not in engine4._stacks
+    X = _rows(6, n=3)
+    out = engine4.encode("odd", X, timeout=30)
+    np.testing.assert_array_equal(
+        out, np.asarray(odd.encode(jnp.asarray(X)))
+    )
+    assert engine4.stats["errors"] == 0
+    assert odd_key in engine4._stacks  # the retry-once rebuild happened
+
+
+def test_healthz_enrichment(registry4):
+    """ISSUE-13 satellite: one /healthz response carries queue depth, batch
+    occupancy, registry generation, dict generation, and the draining flag
+    (previously internal-gauge-only — the router's probe needs them)."""
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    srv = ServeServer(
+        registry4, max_batch=64, max_wait_ms=1.0, dict_generation=3,
+        replica_id="replica7",
+    ).start()
+    try:
+        client = srv.client()
+        client.encode("d0", _rows(1, n=4))
+        h = client.healthz()
+        assert h["status"] == "ok" and h["draining"] is False
+        assert h["queue_depth"] == 0
+        assert 0.0 < h["batch_occupancy"] <= 1.0
+        assert h["registry_generation"] == registry4.generation
+        assert h["dict_generation"] == 3
+        assert h["replica"] == "replica7"
+        assert h["requests"] >= 1 and h["errors"] == 0
+        srv.drain()
+        h2 = client.healthz()
+        assert h2["status"] == "draining" and h2["draining"] is True
+    finally:
+        srv.close()
+
+
 def test_hot_swap_under_live_engine(registry4, engine4):
     X = _rows(4, n=3)
     before = engine4.encode("d0", X)

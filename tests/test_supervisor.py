@@ -228,3 +228,28 @@ def test_supervise_cli_end_to_end(tmp_path):
     ]
     res = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=300)
     assert res.returncode == 75, (res.stdout, res.stderr)
+
+
+def test_restart_budget_unit():
+    """`RestartBudget` (ISSUE 13): the bounded-restart bookkeeping shared
+    by `run_supervised` and the serve replica supervisor — schedule,
+    exhaustion, and the healthy-stretch reset."""
+    b = supervise.RestartBudget(
+        max_restarts=2, backoff_base=1.0, backoff_max=60.0, jitter=0.0,
+        reset_after=10.0,
+    )
+    assert not b.exhausted
+    assert b.next_delay() == 1.0  # attempt 0 -> base
+    assert b.charge() == 1
+    assert b.next_delay() == 2.0  # exponential
+    # a short (unhealthy) stretch does not reset
+    assert b.note_healthy(3.0) == 0 and b.attempt == 1
+    assert b.charge() == 2
+    assert b.exhausted
+    # a healthy stretch clears the whole budget
+    assert b.note_healthy(12.0) == 2
+    assert b.attempt == 0 and not b.exhausted
+    # reset_after=None never resets
+    b2 = supervise.RestartBudget(max_restarts=1, reset_after=None)
+    b2.charge()
+    assert b2.note_healthy(1e9) == 0 and b2.exhausted
