@@ -600,6 +600,84 @@ def prep_router(stack, telemetry=None):
     return measure
 
 
+def prep_slo_eval(stack):
+    """SLO-evaluation throughput (ISSUE 14): full `telemetry.slo` passes
+    per second over a synthetic 10k-event run dir (spans + request traces
+    + periodic counter/gauge/histogram snapshots — the shape a busy serve
+    replica writes). The sensor layer gates CI and will sit inside the
+    ROADMAP-3 autoscaler's control loop, so evaluating a run dir must stay
+    cheap; perfdiff gates this key like any other."""
+    import json as _json
+    import shutil
+    import tempfile
+    from pathlib import Path as _Path
+
+    from sparse_coding__tpu.telemetry.slo import evaluate_run_dir
+
+    d = _Path(tempfile.mkdtemp(prefix="bench_slo_"))
+    stack.callback(lambda: shutil.rmtree(d, ignore_errors=True))
+    T = 1_754_600_000.0
+    n_events = 10_000
+    bounds = [0.25 * 2 ** i for i in range(14)]
+    with open(d / "events.jsonl", "w") as f:
+        def w(rec):
+            f.write(_json.dumps(rec) + "\n")
+
+        w({"seq": 1, "ts": T, "event": "run_start", "run_name": "serve",
+           "generation": 0, "config": {}})
+        seq = 1
+        for i in range(n_events - 22):
+            seq += 1
+            t = T + 0.05 * i
+            if i % 10 == 9:
+                w({"seq": seq, "ts": t, "event": "snapshot",
+                   "counters": {"serve.requests": 8 * (i + 1),
+                                "serve.errors": i // 100},
+                   "gauges": {"serve.queue_depth": i % 7,
+                              "serve.latency_p99_ms": 18.0},
+                   "hists": {"serve.latency_ms": {
+                       "bounds": bounds,
+                       "counts": [0, 0, 1 * i, 2 * i, 4 * i, 2 * i, i,
+                                  0, 0, 0, 0, 0, 0, 0, 0],
+                       "sum": 40.0 * i, "count": 10 * i}}})
+            elif i % 3 == 0:
+                w({"seq": seq, "ts": t, "event": "request_trace",
+                   "trace_id": f"{i:032x}", "span_id": f"{i:016x}",
+                   "parent_span": None, "dict": "d0", "rows": 2,
+                   "ts_start": t - 0.004, "latency_ms": 4.0,
+                   "phases": {"request_wait": 0.002, "encode": 0.002,
+                              "dequant": 0.0},
+                   "bucket": 16, "lanes": 2, "n_requests": 8})
+            else:
+                w({"seq": seq, "ts": t, "event": "span",
+                   "category": "encode" if i % 3 == 1 else "request_wait",
+                   "name": "encode_g2_b16", "ts_start": t - 0.02,
+                   "seconds": 0.02, "rows": 16, "bucket": 16})
+        w({"seq": seq + 1, "ts": T + 600.0, "event": "run_end",
+           "status": "drained", "run_name": "serve", "generation": 0,
+           "wall_seconds": 600.0})
+    config = {
+        "objectives": [
+            {"name": "availability", "type": "availability", "target": 0.99},
+            {"name": "p99", "type": "latency", "percentile": 0.99,
+             "threshold_ms": 50.0},
+            {"name": "queue", "type": "queue_depth", "max_depth": 64},
+        ]
+    }
+    # warm one pass (imports, file-system cache)
+    evaluate_run_dir(d, config)
+
+    def measure() -> float:
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            result = evaluate_run_dir(d, config)
+        assert result["ok"], "bench slo fixture must stay within budget"
+        return reps / (time.perf_counter() - t0)
+
+    return measure
+
+
 def prep_bigbatch(stack):
     """acts/s of the SAME flagship ensemble at batch 16384 through the
     batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
@@ -750,6 +828,7 @@ def main(argv=None):
             "recompute_code_acts_per_sec": prep_tied_variant(
                 stack, recompute_code=True
             ),
+            "slo_eval_runs_per_sec": prep_slo_eval(stack),
         }
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure

@@ -95,6 +95,7 @@ def run_load(
     seed: int = 0,
     histogram: bool = False,
     with_meta: bool = False,
+    traced: bool = False,
 ) -> Dict[str, Any]:
     """Closed-loop load: ``n_clients`` threads, each sending
     ``requests_per_client`` encodes of ``rows_per_request`` rows round-robin
@@ -108,14 +109,24 @@ def run_load(
     .encode_with_meta`) and splits ``ok`` into first-try vs ``retried_ok``
     (``meta["attempts"] > 1`` — the router retried transparently) — the
     per-outcome accounting the replica-tier chaos acceptance reads.
-    Returns the stats blob described in the module docstring."""
+
+    ``traced=True`` mints one `telemetry.tracing` trace id per request and
+    calls ``encode_fn(dict_id, rows, trace_id)``; the result gains a
+    ``per_request`` list of ``{"trace_id", "latency_ms", "outcome",
+    "attempts", "replica"}`` records — join them against ``python -m
+    sparse_coding__tpu.trace`` on the server-side run dir to explain any
+    individual latency. Returns the stats blob described in the module
+    docstring."""
     rng = np.random.default_rng(seed)
     # pre-generate request payloads so generation cost never pollutes timing
     payloads = [
         rng.standard_normal((rows_per_request, width)).astype(np.float32)
         for _ in range(min(64, n_clients * requests_per_client))
     ]
+    if traced:
+        from sparse_coding__tpu.telemetry.tracing import mint_trace_id
     latencies: List[float] = []
+    per_request: List[Dict[str, Any]] = []
     counts = {
         "ok": 0, "retried_ok": 0, "rejected": 0, "shed": 0, "errors": 0,
         "rows": 0,
@@ -126,18 +137,30 @@ def run_load(
         for i in range(requests_per_client):
             did = dict_ids[(cid + i) % len(dict_ids)]
             rows = payloads[(cid * requests_per_client + i) % len(payloads)]
+            trace_id = mint_trace_id() if traced else None
             t0 = time.monotonic()
             try:
-                result = encode_fn(did, rows)
+                if traced:
+                    result = encode_fn(did, rows, trace_id)
+                else:
+                    result = encode_fn(did, rows)
             except Exception as e:
                 kind = type(e).__name__
                 with lock:
                     if "Shed" in kind:
                         counts["shed"] += 1
+                        outcome = "shed"
                     elif "Retryable" in kind or "EngineClosed" in kind:
                         counts["rejected"] += 1
+                        outcome = "rejected"
                     else:
                         counts["errors"] += 1
+                        outcome = f"error:{kind}"
+                    if traced:
+                        per_request.append({
+                            "trace_id": trace_id, "latency_ms": None,
+                            "outcome": outcome,
+                        })
                 continue
             dt_ms = (time.monotonic() - t0) * 1e3
             meta = result[1] if with_meta else {}
@@ -147,6 +170,16 @@ def run_load(
                 if with_meta and int(meta.get("attempts", 1) or 1) > 1:
                     counts["retried_ok"] += 1
                 counts["rows"] += rows.shape[0]
+                if traced:
+                    rec = {
+                        "trace_id": trace_id,
+                        "latency_ms": round(dt_ms, 3),
+                        "outcome": "ok",
+                    }
+                    if with_meta:
+                        rec["attempts"] = int(meta.get("attempts", 1) or 1)
+                        rec["replica"] = meta.get("replica")
+                    per_request.append(rec)
 
     threads = [
         threading.Thread(target=client, args=(c,), name=f"loadgen-{c}")
@@ -173,6 +206,8 @@ def run_load(
     }
     if histogram:
         out["histogram"] = latency_histogram(latencies)
+    if traced:
+        out["per_request"] = per_request
     return out
 
 
@@ -207,6 +242,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "instead of the micro-batched engine")
     ap.add_argument("--hedge-ms", type=float, default=None,
                     help="--targets mode: router hedge threshold")
+    ap.add_argument("--trace", action="store_true",
+                    help="mint an X-Trace-Id per request and record "
+                    "per-request trace id + latency in the JSON output "
+                    "(reconstruct server-side with `python -m "
+                    "sparse_coding__tpu.trace`)")
+    ap.add_argument("--slo", default=None, metavar="slo.json",
+                    help="evaluate SLO objectives against the measured "
+                    "latency histogram/counts at the end of the run; "
+                    "exit 1 past budget (telemetry.slo)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -222,10 +266,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     d["activation_size"] for d in client.dicts()
                     if d["dict"] == dicts[0]
                 )
+            encode_fn = (
+                (lambda d, r, t: client.encode_with_meta(d, r, trace=t))
+                if args.trace else client.encode_with_meta
+            )
             result = run_load(
-                client.encode_with_meta, dicts, n_clients=args.clients,
+                encode_fn, dicts, n_clients=args.clients,
                 requests_per_client=args.requests, rows_per_request=args.rows,
                 width=width, seed=args.seed, histogram=True, with_meta=True,
+                traced=args.trace,
             )
             result["router"] = dict(router.stats)
             result["replica_states"] = router.states()
@@ -240,11 +289,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 d["activation_size"] for d in client.dicts()
                 if d["dict"] == dicts[0]
             )
-        encode_fn = client.encode
+        encode_fn = (
+            (lambda d, r, t: client.encode(d, r, trace=t))
+            if args.trace else client.encode
+        )
         result = run_load(
             encode_fn, dicts, n_clients=args.clients,
             requests_per_client=args.requests, rows_per_request=args.rows,
-            width=width, seed=args.seed, histogram=True,
+            width=width, seed=args.seed, histogram=True, traced=args.trace,
         )
     else:
         from sparse_coding__tpu.serve.engine import EncodeEngine
@@ -257,16 +309,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         engine = EncodeEngine(registry, max_batch=args.max_batch).start()
         engine.warmup()
         try:
-            encode_fn = engine.encode_naive if args.naive else engine.encode
+            if args.naive:
+                encode_fn, traced = engine.encode_naive, False
+            elif args.trace:
+                from sparse_coding__tpu.telemetry.tracing import TraceContext
+
+                def encode_fn(d, r, t):
+                    return engine.encode(d, r, trace=TraceContext(t))
+
+                traced = True
+            else:
+                encode_fn, traced = engine.encode, False
             result = run_load(
                 encode_fn, dicts, n_clients=args.clients,
                 requests_per_client=args.requests, rows_per_request=args.rows,
-                width=width, seed=args.seed, histogram=True,
+                width=width, seed=args.seed, histogram=True, traced=traced,
             )
         finally:
             engine.stop()
+    rc = 0 if result["errors"] == 0 else 1
+    if args.slo:
+        from sparse_coding__tpu.telemetry.slo import (
+            evaluate_measured,
+            load_config,
+        )
+
+        slo_result = evaluate_measured(result, load_config(args.slo))
+        result["slo"] = slo_result
+        if not slo_result["ok"]:
+            rc = 1
     print(json.dumps(result, indent=1))
-    return 0 if result["errors"] == 0 else 1
+    return rc
 
 
 if __name__ == "__main__":

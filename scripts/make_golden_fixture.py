@@ -787,6 +787,13 @@ def make_bench_fixture():
         "topk_fused_is_fused": True,
         "topk_fused_speedup": 2.26,
         "control_fraction_of_peak": 0.306,
+        # ISSUE-14 sensor-layer guard: full telemetry.slo evaluations per
+        # second over a synthetic 10k-event run dir (host-side, measured on
+        # this repo's CPU CI box — the key is chip-independent). Perfdiff
+        # gates it so the SLO engine never becomes the bottleneck it is
+        # supposed to watch.
+        "slo_eval_runs_per_sec": 15.0,
+        "slo_eval_runs_per_sec_spread": [13.5, 16.5],
     }
     with open(BENCH_FIXTURE, "w") as f:
         json.dump(bench, f, indent=1)
@@ -988,7 +995,256 @@ def make_corrupt_store_fixture():
           "3 missing scale, 4 legacy torn)")
 
 
+TRACED_RUN_DIR = REPO / "tests" / "golden" / "traced_run"
+TRACED_BASE_TS = 1_754_700_000.0  # fixed: the fixture must regenerate identically
+# fixed trace ids, readable on purpose
+TRACE_RETRIED = "aaaa1111aaaa1111aaaa1111aaaa1111"
+TRACE_FAST = "bbbb2222bbbb2222bbbb2222bbbb2222"
+TRACE_TAIL = "cccc3333cccc3333cccc3333cccc3333"
+_HIST_BOUNDS = [0.25 * 2 ** i for i in range(14)]
+
+
+def make_traced_run_fixture():
+    """Deterministic request-tracing + SLO fixture (ISSUE 14): a
+    hand-stamped router + 2-replica run dir whose events carry the full
+    trace vocabulary — ``forward`` attempt spans (including one retried
+    request with child spans on BOTH replicas), per-request
+    ``request_trace`` records, trace-tagged batch spans, and snapshot
+    histograms — plus an ``slo.json`` the run satisfies and an
+    ``slo_strict.json`` it violates. Pins, in tier-1: the trace CLI's
+    reconstruction and --slowest output, the slo CLI's verdicts and exit
+    codes (0 within / 1 past budget), and the report's SLO section.
+
+    Hand-stamped, not a real run — golden fixtures must be byte-stable.
+    The modeled story: 3 requests; TRACE_RETRIED's first forward to
+    replica0 dies mid-flight (transport error), the retry lands on
+    replica1; TRACE_FAST serves from replica0 in 6 ms; TRACE_TAIL is the
+    p99 tail — 31 ms, dominated by queue wait in a crowded bucket."""
+    TRACED_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    T = TRACED_BASE_TS
+
+    def writer():
+        seq = {"n": 0}
+
+        def rec(ts, event, **fields):
+            seq["n"] += 1
+            return {"seq": seq["n"], "ts": round(ts, 4), "event": event,
+                    **fields}
+
+        return rec
+
+    fp = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+          "device_kind": "golden-cpu", "device_count": 1, "git_sha": "g0lden"}
+
+    # -- router log: forward attempt spans ----------------------------------
+    rec = writer()
+    events = [
+        rec(T, "run_start", run_name="router", generation=0,
+            config={"replicas": 2, "max_inflight": 64}, fingerprint=fp),
+    ]
+
+    def fwd(ts_start, seconds, trace_id, span_id, replica, attempt, status,
+            hedge=False):
+        return rec(
+            ts_start + seconds, "span", category="forward", name="attempt",
+            ts_start=round(ts_start, 4), seconds=seconds, trace_id=trace_id,
+            span_id=span_id, parent_span=None, replica=replica,
+            attempt=attempt, hedge=hedge, status=status,
+        )
+
+    # TRACE_FAST: one clean forward to replica0
+    events.append(fwd(T + 5.0, 0.006, TRACE_FAST, "b0b0b0b0b0b0b0b0",
+                      "replica0", 0, 200))
+    # TRACE_RETRIED: replica0 dies mid-forward, retry wins on replica1
+    events.append(fwd(T + 9.0, 0.012, TRACE_RETRIED, "a0a0a0a0a0a0a0a0",
+                      "replica0", 0, "error:ConnectionResetError"))
+    events.append(fwd(T + 9.062, 0.018, TRACE_RETRIED, "a1a1a1a1a1a1a1a1",
+                      "replica1", 1, 200))
+    # TRACE_TAIL: slow — crowded bucket on replica1
+    events.append(fwd(T + 12.0, 0.031, TRACE_TAIL, "c1c1c1c1c1c1c1c1",
+                      "replica1", 0, 200))
+    events.append(rec(T + 20.0, "snapshot", counters={
+        "router.requests": 3, "router.ok": 3, "router.retried_ok": 1,
+        "router.retries": 1, "router.forwards": 4, "router.failed": 0,
+        "router.sheds": 0, "span.forward.count": 4,
+        "span.forward.seconds": 0.067,
+    }, gauges={"router.replicas": 2, "router.live_replicas": 2,
+               "router.inflight": 0}))
+    events.append(rec(T + 20.5, "run_end", status="drained",
+                      run_name="router", generation=0, wall_seconds=20.5))
+    with open(TRACED_RUN_DIR / "router_events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # -- per-replica serve logs: request_trace + tagged batch spans ----------
+    def replica_log(rid, requests, batch_spans, counters, gauges, hists):
+        rec = writer()
+        events = [rec(
+            T + 0.1, "run_start", run_name="serve", generation=0,
+            replica=rid,
+            config={"exports": ["out/learned_dicts.pkl"],
+                    "weights": "native", "max_batch": 64,
+                    "replica_id": rid, "dict_generation": 0},
+            fingerprint=fp,
+        )]
+        for ts_start, seconds, name, traces, fields in batch_spans:
+            events.append(rec(
+                ts_start + seconds, "span", category=fields.pop("category"),
+                name=name, replica=rid, ts_start=round(ts_start, 4),
+                seconds=seconds, traces=traces, **fields,
+            ))
+        for r in requests:
+            events.append(rec(r.pop("ts"), "request_trace", replica=rid, **r))
+        events.append(rec(T + 19.0, "snapshot", replica=rid,
+                          counters=counters, gauges=gauges, hists=hists))
+        events.append(rec(T + 19.5, "run_end", status="drained", replica=rid,
+                          run_name="serve", generation=0, wall_seconds=19.4))
+        d = TRACED_RUN_DIR / rid
+        d.mkdir(parents=True, exist_ok=True)
+        with open(d / "events.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    hist0 = {"serve.latency_ms": {
+        "bounds": _HIST_BOUNDS,
+        "counts": [0, 0, 2, 18, 65, 24, 9, 2, 0, 0, 0, 0, 0, 0, 0],
+        "sum": 692.4, "count": 120}}
+    replica_log(
+        "replica0",
+        requests=[{
+            "ts": T + 5.006, "trace_id": TRACE_FAST,
+            "span_id": "f0f0f0f0f0f0f0f0", "parent_span": "b0b0b0b0b0b0b0b0",
+            "dict": "d0", "rows": 2, "ts_start": round(T + 5.001, 4),
+            "latency_ms": 4.8,
+            "phases": {"request_wait": 0.0018, "encode": 0.0028,
+                       "dequant": 0.0},
+            "bucket": 8, "lanes": 2, "n_requests": 3,
+        }],
+        batch_spans=[
+            (T + 5.0028, 0.0018, "queue",
+             [TRACE_FAST], {"category": "request_wait", "n_requests": 3,
+                            "mean_wait_ms": 1.6}),
+            (T + 5.0046, 0.0028, "encode_g2_b8",
+             [TRACE_FAST], {"category": "encode", "lanes": 2, "rows": 6,
+                            "bucket": 8, "n_requests": 3}),
+        ],
+        counters={"serve.requests": 120, "serve.rows": 240,
+                  "serve.batches": 18, "serve.padded_rows": 24,
+                  "serve.rejected": 0, "serve.errors": 1,
+                  "span.request_wait.count": 18,
+                  "span.request_wait.seconds": 0.031,
+                  "span.encode.count": 18, "span.encode.seconds": 0.052},
+        gauges={"serve.queue_depth": 1, "serve.batch_occupancy": 0.909,
+                "serve.latency_p50_ms": 4.1, "serve.latency_p95_ms": 7.9,
+                "serve.latency_p99_ms": 14.2},
+        hists=hist0,
+    )
+    hist1 = {"serve.latency_ms": {
+        "bounds": _HIST_BOUNDS,
+        "counts": [0, 0, 1, 12, 70, 38, 16, 2, 1, 0, 0, 0, 0, 0, 0],
+        "sum": 941.0, "count": 140}}
+    replica_log(
+        "replica1",
+        requests=[
+            {
+                "ts": T + 9.078, "trace_id": TRACE_RETRIED,
+                "span_id": "f1f1f1f1f1f1f1f1",
+                "parent_span": "a1a1a1a1a1a1a1a1",
+                "dict": "d0", "rows": 2, "ts_start": round(T + 9.064, 4),
+                "latency_ms": 13.5,
+                "phases": {"request_wait": 0.0061, "encode": 0.0072,
+                           "dequant": 0.0},
+                "bucket": 16, "lanes": 2, "n_requests": 6,
+            },
+            {
+                "ts": T + 12.030, "trace_id": TRACE_TAIL,
+                "span_id": "f2f2f2f2f2f2f2f2",
+                "parent_span": "c1c1c1c1c1c1c1c1",
+                "dict": "d1", "rows": 4, "ts_start": round(T + 12.001, 4),
+                "latency_ms": 28.7,
+                "phases": {"request_wait": 0.0213, "encode": 0.0071,
+                           "dequant": 0.0},
+                "bucket": 64, "lanes": 2, "n_requests": 14,
+            },
+        ],
+        batch_spans=[
+            (T + 9.0701, 0.0061, "queue",
+             [TRACE_RETRIED], {"category": "request_wait", "n_requests": 6,
+                               "mean_wait_ms": 4.9}),
+            (T + 9.0762, 0.0072, "encode_g2_b16",
+             [TRACE_RETRIED], {"category": "encode", "lanes": 2, "rows": 12,
+                               "bucket": 16, "n_requests": 6}),
+            (T + 12.0223, 0.0213, "queue",
+             [TRACE_TAIL], {"category": "request_wait", "n_requests": 14,
+                            "mean_wait_ms": 12.4}),
+            (T + 12.0294, 0.0071, "encode_g2_b64",
+             [TRACE_TAIL], {"category": "encode", "lanes": 2, "rows": 52,
+                            "bucket": 64, "n_requests": 14}),
+        ],
+        counters={"serve.requests": 140, "serve.rows": 290,
+                  "serve.batches": 21, "serve.padded_rows": 38,
+                  "serve.rejected": 1, "serve.errors": 0,
+                  "span.request_wait.count": 21,
+                  "span.request_wait.seconds": 0.084,
+                  "span.encode.count": 21, "span.encode.seconds": 0.078},
+        gauges={"serve.queue_depth": 2, "serve.batch_occupancy": 0.884,
+                "serve.latency_p50_ms": 4.6, "serve.latency_p95_ms": 11.3,
+                "serve.latency_p99_ms": 26.9},
+        hists=hist1,
+    )
+
+    # -- SLO configs: one the run satisfies, one it violates -----------------
+    slo_ok = {
+        "windows": {"fast_burn_seconds": 10.0, "slow_burn_seconds": 60.0},
+        "objectives": [
+            {"name": "availability", "type": "availability", "target": 0.99},
+            {"name": "p99_latency", "type": "latency", "percentile": 0.99,
+             "threshold_ms": 50.0},
+            {"name": "queue_depth", "type": "queue_depth", "max_depth": 8},
+        ],
+    }
+    with open(TRACED_RUN_DIR / "slo.json", "w") as f:
+        json.dump(slo_ok, f, indent=1)
+        f.write("\n")
+    slo_strict = {
+        "windows": {"fast_burn_seconds": 10.0, "slow_burn_seconds": 60.0},
+        "objectives": [
+            # 4 nines over a run carrying one error in 261: past budget
+            {"name": "availability", "type": "availability",
+             "target": 0.9999},
+            # the merged histogram's p99 bucket is 32 ms: violated at 8
+            {"name": "p99_latency", "type": "latency", "percentile": 0.99,
+             "threshold_ms": 8.0},
+        ],
+    }
+    with open(TRACED_RUN_DIR / "slo_strict.json", "w") as f:
+        json.dump(slo_strict, f, indent=1)
+        f.write("\n")
+    # -- /metrics exposition golden ------------------------------------------
+    # the Prometheus text format is a wire contract (counter/gauge/histogram
+    # lines, label escaping, stable sorted ordering): pinned byte-for-byte
+    from sparse_coding__tpu.telemetry.metrics_http import render_prometheus
+
+    text = render_prometheus(
+        counters={"serve.requests": 120, "serve.errors": 1,
+                  "router.retries": 3.5},
+        gauges={"serve.queue_depth": 2, "serve.batch_occupancy": 0.909},
+        hists={"serve.latency_ms": {
+            "bounds": [0.25, 0.5, 1.0],
+            "counts": [1, 0, 2, 1],  # last = overflow (> 1.0)
+            "sum": 3.85, "count": 4,
+        }},
+        labels={"replica": 'we"ird\\repl\nica'},  # escaping contract
+    )
+    (REPO / "tests" / "golden" / "metrics_exposition.txt").write_text(text)
+    print(f"Wrote {TRACED_RUN_DIR}/ (router + 2 replicas, slo.json + "
+          "slo_strict.json) + tests/golden/metrics_exposition.txt")
+
+
 def main():
+    if "--traced-run" in sys.argv:
+        make_traced_run_fixture()
+        return
     if "--pod-run" in sys.argv:
         make_pod_run_fixture()
         return

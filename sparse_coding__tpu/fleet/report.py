@@ -115,6 +115,40 @@ def render_fleet_markdown(fleet: Dict[str, Any]) -> str:
         lines.append("_(no workers have claimed yet)_")
     lines.append("")
 
+    # -- per-worker /metrics files (ISSUE 14) ---------------------------------
+    # workers publish their telemetry as Prometheus text to
+    # metrics/<worker>.prom (telemetry.metrics_http.write_metrics_file);
+    # the report sums the counter families into one fleet-wide view
+    prom_files = sorted(Path(fleet["dir"]).glob("metrics/*.prom"))
+    if prom_files:
+        from sparse_coding__tpu.telemetry.metrics_http import parse_prometheus
+
+        summed: Dict[str, float] = {}
+        for p in prom_files:
+            try:
+                fams = parse_prometheus(p.read_text())
+            except OSError:
+                continue
+            for name, samples in fams.items():
+                if name.endswith("_total"):
+                    summed[name] = summed.get(name, 0.0) + sum(
+                        v for _, v in samples
+                    )
+        lines.append("## Worker metrics")
+        lines.append("")
+        lines.append(
+            f"_{len(prom_files)} worker exposition file(s) under "
+            "`metrics/` (Prometheus text — point a file-sd scraper at "
+            "them, or read the fleet-wide counter sums below)._"
+        )
+        lines.append("")
+        if summed:
+            lines.append("| counter | fleet total |")
+            lines.append("|---|---:|")
+            for name, v in sorted(summed.items()):
+                lines.append(f"| `{name}` | {_fmt(v)} |")
+        lines.append("")
+
     # -- reassignment lineage -------------------------------------------------
     all_items = [
         (bucket, item)

@@ -220,6 +220,24 @@ class FleetWorker:
             if etype == "input_corrupt":
                 self.telemetry.counter_inc("fleet.input_corrupt")
 
+    def publish_metrics(self):
+        """Publish this worker's counters/gauges as Prometheus text to
+        ``<fleet_dir>/metrics/<worker_id>.prom`` (ISSUE 14). Fleet workers
+        own no HTTP listener, so the exposition rides a file the fleet
+        report aggregates — atomic, so a reader never sees a torn scrape.
+        Best-effort: metrics publishing must never fail an item."""
+        if self.telemetry is None:
+            return
+        from sparse_coding__tpu.telemetry.metrics_http import write_metrics_file
+
+        try:
+            write_metrics_file(
+                self.telemetry,
+                Path(self.queue.fleet_dir) / "metrics" / f"{self.worker_id}.prom",
+            )
+        except OSError:
+            pass
+
     @staticmethod
     def _store_signature(folder: Path):
         """Stat-level fingerprint of a chunk store: (name, size, mtime_ns)
@@ -484,6 +502,7 @@ class FleetWorker:
         idle_since: Optional[float] = None
         while True:
             outcome = self.claim_and_run()
+            self.publish_metrics()
             if outcome == "done":
                 done += 1
             if max_items is not None and done >= max_items:

@@ -32,7 +32,12 @@ Observability: ``request_wait`` / ``encode`` / ``dequant`` spans per
 micro-batch, ``serve.*`` counters (requests, rows, batches, padded rows,
 rejected, errors, compiles) and gauges (queue depth, batch occupancy,
 latency p50/p95/p99) on the telemetry bus — `monitor` renders them live,
-`report` renders the Serving section from them.
+`report` renders the Serving section from them. Requests carrying a
+`telemetry.tracing.TraceContext` additionally get per-request
+``request_trace`` records (exact per-phase seconds + batch context) and
+the batch spans a ``traces`` tag; per-phase latency histograms
+(``serve.latency_ms``, ``serve.phase.*_ms`` — fixed log-spaced buckets)
+feed the ``/metrics`` exposition (docs/observability.md §8).
 """
 
 from __future__ import annotations
@@ -89,20 +94,24 @@ def _emit_span(telemetry, category: str, name: str, ts_start: float,
 
 
 class EncodeRequest:
-    """One in-flight encode: rows in, codes (or an error) out."""
+    """One in-flight encode: rows in, codes (or an error) out. ``trace``
+    (a `telemetry.tracing.TraceContext`, optional) rides along so the
+    engine can emit this request's per-phase ``request_trace`` record."""
 
     __slots__ = ("dict_id", "rows", "t_enqueue_mono", "t_enqueue_wall",
-                 "done", "codes", "error", "latency_ms")
+                 "done", "codes", "error", "latency_ms", "trace", "wait_s")
 
-    def __init__(self, dict_id: str, rows: np.ndarray):
+    def __init__(self, dict_id: str, rows: np.ndarray, trace=None):
         self.dict_id = dict_id
         self.rows = rows
+        self.trace = trace
         self.t_enqueue_mono = time.monotonic()
         self.t_enqueue_wall = time.time()
         self.done = threading.Event()
         self.codes: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.latency_ms: Optional[float] = None
+        self.wait_s: Optional[float] = None  # enqueue → batch drain
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -307,10 +316,12 @@ class EncodeEngine:
             )
         return arr
 
-    def submit(self, dict_id: str, rows) -> EncodeRequest:
+    def submit(self, dict_id: str, rows, trace=None) -> EncodeRequest:
         """Enqueue one encode; returns the request future. Raises
         `EngineClosed` when draining (the caller maps it to a retryable
-        503), `KeyError` for an unknown dict, `ValueError` for bad rows."""
+        503), `KeyError` for an unknown dict, `ValueError` for bad rows.
+        ``trace`` is the request's `TraceContext` (docs/observability.md
+        §8) — traced requests get a ``request_trace`` per-phase record."""
         arr = self._validate(dict_id, rows)
         with self._submit_lock:
             if not self._accepting:
@@ -321,15 +332,16 @@ class EncodeEngine:
                 raise EngineClosed(
                     "engine is draining — retry against a live replica"
                 )
-            req = EncodeRequest(dict_id, arr)
+            req = EncodeRequest(dict_id, arr, trace=trace)
             self._q.put(req)
         if self.telemetry is not None:
             self.telemetry.gauge_set("serve.queue_depth", self._q.qsize())
         return req
 
-    def encode(self, dict_id: str, rows, timeout: Optional[float] = 60.0) -> np.ndarray:
+    def encode(self, dict_id: str, rows, timeout: Optional[float] = 60.0,
+               trace=None) -> np.ndarray:
         """Blocking convenience wrapper around `submit`."""
-        return self.submit(dict_id, rows).result(timeout)
+        return self.submit(dict_id, rows, trace=trace).result(timeout)
 
     # -- the naive baseline (bench comparison) ---------------------------------
 
@@ -342,7 +354,7 @@ class EncodeEngine:
         stack = self._group_stack_for(dict_id, naive=True)
         bucket = self._bucket_for(arr.shape[0])
         padded = self._pad(arr, bucket)
-        out = self._dispatch(stack, padded)
+        out, _ = self._dispatch(stack, padded)
         return np.asarray(out[0, : arr.shape[0]])
 
     # -- internals -------------------------------------------------------------
@@ -391,19 +403,31 @@ class EncodeEngine:
         stacks = self._stacks_current()
         return stacks[(entry.group_key, entry.weights)]
 
-    def _dispatch(self, stack: _Stack, padded: np.ndarray) -> jax.Array:
+    def _dispatch(
+        self, stack: _Stack, padded: np.ndarray,
+        traces: Optional[List[str]] = None,
+    ) -> Tuple[jax.Array, float]:
         """Run one micro-batch through the group's compiled step (dequant
-        first for int8-resident groups), fenced by fetching the result."""
+        first for int8-resident groups), fenced by fetching the result.
+        Returns ``(codes, dequant_seconds)`` — the dequant share is what
+        `request_trace` attributes per request."""
         batch = jnp.asarray(padded)
+        dequant_s = 0.0
         if stack.weights == "int8":
             t0 = time.time()
             t0m = time.monotonic()
             stacked = stack.dequant_fn(stack.quant)
             jax.block_until_ready(jax.tree.leaves(stacked)[0])
+            dequant_s = time.monotonic() - t0m
+            extra = {"traces": traces} if traces else {}
             _emit_span(
                 self.telemetry, "dequant", "dequant_int8", t0,
-                time.monotonic() - t0m, lanes=stack.size,
+                dequant_s, lanes=stack.size, **extra,
             )
+            if self.telemetry is not None:
+                self.telemetry.hist_observe(
+                    "serve.phase.dequant_ms", dequant_s * 1e3
+                )
         else:
             stacked = stack.stacked
         key = ("encode", stack.weights, stack.size, padded.shape)
@@ -412,7 +436,7 @@ class EncodeEngine:
             if self.telemetry is not None:
                 self.telemetry.counter_inc("serve.compiles")
         out = _vmapped_encode(stacked, batch)
-        return out
+        return out, dequant_s
 
     def _drain_once(self, block_s: float) -> bool:
         """One scheduler cycle. Returns False when the engine should exit
@@ -464,12 +488,22 @@ class EncodeEngine:
         # earliest enqueue to the drain — per-request waits overlap, and
         # the ledger must not double-count wall time
         oldest = min(r.t_enqueue_mono for r in reqs)
-        waits_ms = [(t_drain_mono - r.t_enqueue_mono) * 1e3 for r in reqs]
+        waits_ms = []
+        for r in reqs:
+            r.wait_s = t_drain_mono - r.t_enqueue_mono
+            waits_ms.append(r.wait_s * 1e3)
+            if self.telemetry is not None:
+                self.telemetry.hist_observe(
+                    "serve.phase.request_wait_ms", r.wait_s * 1e3
+                )
+        traced = [r.trace.trace_id for r in reqs if r.trace is not None]
+        extra = {"traces": traced} if traced else {}
         _emit_span(
             self.telemetry, "request_wait", "queue",
             min(r.t_enqueue_wall for r in reqs), t_drain_mono - oldest,
             n_requests=len(reqs),
             mean_wait_ms=round(sum(waits_ms) / len(waits_ms), 3),
+            **extra,
         )
         by_group: Dict[Tuple, List[EncodeRequest]] = {}
         for r in reqs:
@@ -507,16 +541,24 @@ class EncodeEngine:
         rows = np.concatenate([r.rows for r in reqs], axis=0)
         bucket = self._bucket_for(rows.shape[0])
         padded = self._pad(rows, bucket)
+        traced = [r.trace.trace_id for r in reqs if r.trace is not None]
+        extra = {"traces": traced} if traced else {}
         try:
             t0_wall, t0 = time.time(), time.monotonic()
-            out = self._dispatch(stack, padded)
+            out, dequant_s = self._dispatch(stack, padded, traces=traced or None)
             out.block_until_ready()
+            encode_s = time.monotonic() - t0
             _emit_span(
                 self.telemetry, "encode", f"encode_g{stack.size}_b{bucket}",
-                t0_wall, time.monotonic() - t0,
+                t0_wall, encode_s,
                 lanes=stack.size, rows=int(rows.shape[0]), bucket=bucket,
                 n_requests=len(reqs),
+                **extra,
             )
+            if self.telemetry is not None:
+                self.telemetry.hist_observe(
+                    "serve.phase.encode_ms", encode_s * 1e3
+                )
         except Exception as e:  # a failed dispatch must not kill the drainer
             for r in reqs:
                 self._record_error(r, e)
@@ -527,6 +569,29 @@ class EncodeEngine:
             lane = lane_of[r.dict_id]
             r._resolve(np.asarray(out[lane, start : start + n]))
             start += n
+            if r.trace is not None and self.telemetry is not None:
+                # ONE compact per-request record: this request's exact
+                # per-phase seconds (queue wait is its own; encode/dequant
+                # are the enclosing batch dispatch's) + the batch context —
+                # what `python -m sparse_coding__tpu.trace` reconstructs
+                self.telemetry.event(
+                    "request_trace",
+                    trace_id=r.trace.trace_id,
+                    span_id=r.trace.span_id,
+                    parent_span=r.trace.parent_span,
+                    dict=r.dict_id,
+                    rows=n,
+                    ts_start=round(r.t_enqueue_wall, 6),
+                    latency_ms=round(r.latency_ms, 3),
+                    phases={
+                        "request_wait": round(r.wait_s or 0.0, 6),
+                        "encode": round(encode_s, 6),
+                        "dequant": round(dequant_s, 6),
+                    },
+                    bucket=bucket,
+                    lanes=stack.size,
+                    n_requests=len(reqs),
+                )
         self._note_served(reqs, rows.shape[0], bucket)
 
     def _record_error(self, req: EncodeRequest, exc: BaseException) -> None:
@@ -546,6 +611,12 @@ class EncodeEngine:
             self._latencies.extend(
                 r.latency_ms for r in reqs if r.latency_ms is not None
             )
+            if self.telemetry is not None:
+                for r in reqs:
+                    if r.latency_ms is not None:
+                        self.telemetry.hist_observe(
+                            "serve.latency_ms", r.latency_ms
+                        )
             if len(self._latencies) > self._latency_window:
                 self._latencies = self._latencies[-self._latency_window :]
             lat = sorted(self._latencies)
@@ -578,7 +649,7 @@ class EncodeEngine:
                 break
             for b in buckets or self.buckets:
                 batch = np.zeros((int(b), int(width)), dtype=np.float32)
-                self._dispatch(stack, batch).block_until_ready()
+                self._dispatch(stack, batch)[0].block_until_ready()
                 n += 1
         return n
 

@@ -542,6 +542,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="rolling-swap trigger: when PATH appears, its "
                     "lines (export paths) roll out as the next generation "
                     "and PATH is renamed to PATH.done")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve the replicaset supervisor's own counters "
+                    "(restarts, deaths, swaps) as Prometheus text on "
+                    "http://HOST:PORT/metrics (0 = ephemeral; the router "
+                    "and every replica already mount their own /metrics)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -575,6 +580,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     rs.start()
     router.start()
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from sparse_coding__tpu.telemetry.metrics_http import serve_metrics_server
+
+        metrics_srv = serve_metrics_server(
+            rs_tel, host=args.host, port=args.metrics_port
+        )
+        print(f"[replicaset] /metrics on {metrics_srv.address}/metrics",
+              flush=True)
     if args.port_file:
         Path(args.port_file).write_text(str(router.port))
     print(f"[replicaset] router on {router.address} fronting "
@@ -608,6 +622,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         status = "drained"
         return 0
     finally:
+        if metrics_srv is not None:
+            metrics_srv.stop()
         preemption.poller_stopped()
         router_tel.close(status=status)
         rs_tel.close(status=status)

@@ -49,6 +49,13 @@ ok/retried_ok/failed``, gauges ``router.live_replicas`` /
 ``router.inflight`` / per-replica ``router.replica.<id>.p50_ms`` etc.,
 ``router_replica_state`` events; the report renders a **Router** section
 and the monitor a ``router:`` line from them.
+
+Request tracing (ISSUE 14, docs/observability.md §8): the router is the
+tier's trace edge — it mints an ``X-Trace-Id`` when the client sent none,
+emits one ``forward`` span per attempt (retries and hedges included, each
+with its own span id sent downstream as ``X-Parent-Span``), and echoes
+the trace id on the response; ``GET /metrics`` exports the counters and
+per-replica gauges as Prometheus text (`telemetry.metrics_http`).
 """
 
 from __future__ import annotations
@@ -63,8 +70,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from sparse_coding__tpu.serve.engine import _percentile
+from sparse_coding__tpu.serve.engine import _emit_span, _percentile
 from sparse_coding__tpu.serve.server import RetryableRejection, ServeClient
+from sparse_coding__tpu.telemetry import tracing as _tracing
 from sparse_coding__tpu.utils.faults import fault_point
 from sparse_coding__tpu.utils.sync import retry_with_backoff
 
@@ -179,6 +187,16 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/replicas":
             self._json(200, {"replicas": router.describe()})
             return
+        if self.path == "/metrics":
+            from sparse_coding__tpu.telemetry.metrics_http import CONTENT_TYPE
+
+            body = router.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/dicts":
             status, headers, body = router.forward_get("/dicts")
             self._respond(status, body, headers)
@@ -199,7 +217,15 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except ValueError:
             deadline_s = None
-        status, headers, out = router.route_encode(body, deadline_s=deadline_s)
+        # the router is the tier's trace edge: mint when the client sent no
+        # X-Trace-Id; parent every attempt on the client's X-Parent-Span
+        trace_id = self.headers.get(_tracing.TRACE_HEADER) or _tracing.mint_trace_id()
+        parent_span = self.headers.get(_tracing.PARENT_HEADER)
+        status, headers, out = router.route_encode(
+            body, deadline_s=deadline_s, trace_id=trace_id,
+            parent_span=parent_span,
+        )
+        headers = {**headers, _tracing.TRACE_HEADER: trace_id}
         self._respond(status, out, headers)
 
 
@@ -533,14 +559,17 @@ class Router:
             self._total_inflight = max(0, self._total_inflight - 1)
 
     def _forward_once(
-        self, t: Replica, body: bytes, timeout: float
+        self, t: Replica, body: bytes, timeout: float,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """One HTTP forward; returns (status, headers, body) for ANY HTTP
         status; raises on transport failures (conn refused, timeout)."""
         fault_point("router_forward", replica=t.rid)
         req = urllib.request.Request(
             t.url + "/encode", data=body,
-            headers={"Content-Type": "application/json"}, method="POST",
+            headers={"Content-Type": "application/json",
+                     **(extra_headers or {})},
+            method="POST",
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -567,19 +596,27 @@ class Router:
             return 0.0
 
     def _attempt(
-        self, t: Replica, body: bytes, timeout: float, exclude: Set[str]
+        self, t: Replica, body: bytes, timeout: float, exclude: Set[str],
+        trace: Optional[Dict[str, Any]] = None, attempt: int = 0,
     ) -> Tuple[int, Dict[str, str], bytes, bool, str]:
         """One (possibly hedged) forward through replica `t`. Returns
         (status, headers, body, hedged, winner_rid) for a final response;
         raises `_RetryableForward` when every raced forward failed
         retryably."""
         if self.hedge_ms is None:
-            return (*self._forward_locked(t, body, timeout), False, t.rid)
+            return (
+                *self._forward_locked(t, body, timeout, trace=trace,
+                                      attempt=attempt),
+                False, t.rid,
+            )
         results: "Queue[Tuple[Replica, Any]]" = Queue()
 
-        def run(target: Replica) -> None:
+        def run(target: Replica, hedge: bool = False) -> None:
             try:
-                results.put((target, self._forward_locked(target, body, timeout)))
+                results.put((target, self._forward_locked(
+                    target, body, timeout, trace=trace, attempt=attempt,
+                    hedge=hedge,
+                )))
             except _RetryableForward as e:
                 results.put((target, e))
             except Exception as e:  # pragma: no cover - defensive
@@ -599,7 +636,7 @@ class Router:
                 hedged = True
                 self._bump("hedges")
                 threading.Thread(
-                    target=run, args=(hedge_t,), daemon=True
+                    target=run, args=(hedge_t, True), daemon=True
                 ).start()
                 launched += 1
         last_exc: Optional[_RetryableForward] = None
@@ -623,23 +660,53 @@ class Router:
         raise last_exc or _RetryableForward("hedged forwards timed out")
 
     def _forward_locked(
-        self, t: Replica, body: bytes, timeout: float
+        self, t: Replica, body: bytes, timeout: float,
+        trace: Optional[Dict[str, Any]] = None, attempt: int = 0,
+        hedge: bool = False,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """Forward with in-flight accounting + outcome-driven state. Raises
         `_RetryableForward` on transport failure or a retryable 503/504;
-        returns final responses."""
+        returns final responses. A traced request gets ONE ``forward``
+        span per attempt (retries and hedges included) — the span id
+        travels downstream as ``X-Parent-Span``, so the replica's records
+        are provably children of THIS attempt (`telemetry.tracing`)."""
         t0 = time.monotonic()
+        t0_wall = time.time()
+        span_id = None
+        extra_headers = None
+        if trace is not None:
+            span_id = _tracing.mint_span_id()
+            extra_headers = {
+                _tracing.TRACE_HEADER: trace["trace_id"],
+                _tracing.PARENT_HEADER: span_id,
+            }
+
+        def emit(status) -> None:
+            if trace is None:
+                return
+            _emit_span(
+                self.telemetry, "forward", "attempt", t0_wall,
+                time.monotonic() - t0,
+                trace_id=trace["trace_id"], span_id=span_id,
+                parent_span=trace.get("parent_span"),
+                replica=t.rid, attempt=attempt, hedge=hedge, status=status,
+            )
+
         self._bump("forwards")
         try:
             try:
-                status, headers, out = self._forward_once(t, body, timeout)
+                status, headers, out = self._forward_once(
+                    t, body, timeout, extra_headers=extra_headers
+                )
             except Exception as e:
+                emit(f"error:{type(e).__name__}")
                 self._note_failure(t, reason=type(e).__name__)
                 raise _RetryableForward(
                     f"replica {t.rid}: {type(e).__name__}: {e}"
                 ) from None
         finally:
             self._release(t)
+        emit(status)
         floor = self._retryable_response(status, headers, out)
         if floor is not None:
             # a clean retryable hand-back (draining / saturated): not a
@@ -658,13 +725,20 @@ class Router:
         return status, headers, out
 
     def route_encode(
-        self, body: bytes, deadline_s: Optional[float] = None
+        self, body: bytes, deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None, parent_span: Optional[str] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """Route one encode request: pick → forward → (on retryable
         failure) retry against a different replica with backoff, bounded
         by ``max_attempts`` and the request deadline; shed fast when no
-        replica is routable or the router is saturated."""
+        replica is routable or the router is saturated. ``trace_id`` /
+        ``parent_span`` (the HTTP handler's X-Trace-Id/X-Parent-Span)
+        make every attempt a trace-tagged ``forward`` span."""
         self._bump("requests")
+        trace = (
+            {"trace_id": str(trace_id), "parent_span": parent_span}
+            if trace_id else None
+        )
         with self._lock:
             saturated = self._total_inflight >= self.max_inflight
         if saturated:
@@ -688,7 +762,8 @@ class Router:
             timeout = min(self.attempt_timeout, deadline - time.monotonic())
             try:
                 status, headers, out, hedged, winner = self._attempt(
-                    t, body, max(0.05, timeout), tried
+                    t, body, max(0.05, timeout), tried, trace=trace,
+                    attempt=attempt,
                 )
             except _RetryableForward:
                 tried.add(t.rid)
@@ -794,6 +869,35 @@ class Router:
         with self._lock:
             return {t.rid: t.state for t in self._targets.values()}
 
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body: the router's counters and per-replica
+        gauges in Prometheus text exposition (docs/observability.md §8).
+        With telemetry, the full bus after a fresh gauge export; without,
+        a minimal set from the stats dict + live replica states."""
+        from sparse_coding__tpu.telemetry.metrics_http import (
+            render_prometheus,
+            telemetry_metrics_text,
+        )
+
+        if self.telemetry is not None:
+            self._export_gauges()
+            return telemetry_metrics_text(self.telemetry)
+        with self._stats_lock:
+            counters = {f"router.{k}": v for k, v in self.stats.items()}
+        states = self.states()
+        gauges: Dict[str, float] = {
+            "router.replicas": float(len(states)),
+            "router.live_replicas": float(
+                sum(1 for s in states.values() if s == "live")
+            ),
+            "router.inflight": float(self._total_inflight),
+        }
+        for rid, state in states.items():
+            gauges[f"router.replica.{rid}.state"] = float(
+                REPLICA_STATES.index(state)
+            )
+        return render_prometheus(counters=counters, gauges=gauges)
+
     def health(self) -> Dict[str, Any]:
         desc = self.describe()
         live = sum(1 for d in desc if d["state"] == "live")
@@ -830,12 +934,15 @@ class RouterClient(ServeClient):
             return exc
         return super()._retryable_exc(payload, headers)
 
-    def encode_with_meta(self, dict_id: str, rows) -> Tuple[Any, Dict[str, Any]]:
+    def encode_with_meta(self, dict_id: str, rows,
+                         trace=None) -> Tuple[Any, Dict[str, Any]]:
         import numpy as np
 
         payload = {"dict": dict_id, "rows": np.asarray(rows).tolist()}
+        headers_out = self._trace_headers(trace)
         body, headers = self._with_retries(
-            lambda: self._request_full("POST", "/encode", payload)
+            lambda: self._request_full("POST", "/encode", payload,
+                                       headers=headers_out)
         )
         meta = {
             "attempts": int(headers.get("X-Router-Attempts", 1) or 1),
@@ -843,9 +950,10 @@ class RouterClient(ServeClient):
             "replica": headers.get("X-Router-Replica"),
             "generation": body.get("generation"),
             "dict": body.get("dict"),
+            "trace_id": headers.get("X-Trace-Id"),
         }
         codes = np.asarray(body["codes"], dtype=np.float32)
         return codes, meta
 
-    def encode(self, dict_id: str, rows):
-        return self.encode_with_meta(dict_id, rows)[0]
+    def encode(self, dict_id: str, rows, trace=None):
+        return self.encode_with_meta(dict_id, rows, trace=trace)[0]

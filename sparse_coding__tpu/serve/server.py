@@ -82,6 +82,16 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/dicts":
             self._json(200, {"dicts": srv.registry.describe()})
             return
+        if self.path == "/metrics":
+            body = srv.metrics_text().encode()
+            from sparse_coding__tpu.telemetry.metrics_http import CONTENT_TYPE
+
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         self._json(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
@@ -100,29 +110,46 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
             return
+        # trace propagation (docs/observability.md §8): an X-Trace-Id'd
+        # request gets a fresh server-hop span parented on the caller's
+        # X-Parent-Span (the router's attempt span), threaded into the
+        # engine so its request_trace record joins the caller's tree
+        from sparse_coding__tpu.telemetry.tracing import TraceContext
+
+        trace = TraceContext.from_headers(self.headers)
+        trace_headers = (
+            {"X-Trace-Id": trace.trace_id} if trace is not None else None
+        )
         t0 = time.monotonic()
         try:
-            codes = srv.engine.encode(dict_id, rows, timeout=srv.request_timeout)
+            codes = srv.engine.encode(
+                dict_id, rows, timeout=srv.request_timeout, trace=trace
+            )
         except EngineClosed:
             self._reject_draining()
             return
         except KeyError:
             self._json(404, {"error": f"unknown dict {dict_id!r}",
-                             "dicts": srv.registry.ids()})
+                             "dicts": srv.registry.ids()},
+                       headers=trace_headers)
             return
         except (ValueError, TypeError) as e:
-            self._json(400, {"error": str(e)})
+            self._json(400, {"error": str(e)}, headers=trace_headers)
             return
         except TimeoutError as e:
-            self._json(504, {"error": str(e), "retryable": True})
+            self._json(504, {"error": str(e), "retryable": True},
+                       headers=trace_headers)
             return
-        self._json(200, {
+        body = {
             "dict": dict_id,
             "n_rows": int(codes.shape[0]),
             "codes": np.asarray(codes).tolist(),
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
             "generation": srv.dict_generation,
-        })
+        }
+        if trace is not None:
+            body["trace_id"] = trace.trace_id
+        self._json(200, body, headers=trace_headers)
 
 
 class ServeServer:
@@ -214,6 +241,40 @@ class ServeServer:
             out["replica"] = self.replica_id
         return out
 
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body: Prometheus text exposition of this
+        replica's counters/gauges/histograms (docs/observability.md §8).
+        With telemetry, the full bus (labeled by the replica tag) plus
+        freshly-sampled queue/occupancy gauges; without, a minimal set
+        derived from the engine's stats so the endpoint always answers."""
+        from sparse_coding__tpu.telemetry.metrics_http import (
+            render_prometheus,
+            telemetry_metrics_text,
+        )
+
+        if self.telemetry is not None:
+            self.telemetry.gauge_set("serve.queue_depth", self.engine.queue_depth)
+            self.telemetry.gauge_set(
+                "serve.batch_occupancy", self.engine.batch_occupancy
+            )
+            self.telemetry.gauge_set("serve.draining", float(self.draining))
+            return telemetry_metrics_text(self.telemetry)
+        lat = self.engine.latency_snapshot()
+        stats = self.engine.stats
+        labels = {"replica": self.replica_id} if self.replica_id else None
+        return render_prometheus(
+            counters={f"serve.{k}": v for k, v in stats.items()},
+            gauges={
+                "serve.queue_depth": self.engine.queue_depth,
+                "serve.batch_occupancy": self.engine.batch_occupancy,
+                "serve.latency_p50_ms": lat["p50_ms"],
+                "serve.latency_p95_ms": lat["p95_ms"],
+                "serve.latency_p99_ms": lat["p99_ms"],
+                "serve.draining": float(self.draining),
+            },
+            labels=labels,
+        )
+
     def drain(self, timeout: float = 60.0) -> None:
         """The graceful half of shutdown: reject new encodes (503), complete
         everything already accepted. The listener stays up (answering 503s
@@ -286,6 +347,7 @@ class ServeClient:
     def _request_full(
         self, method: str, path: str,
         payload: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> tuple:
         """One HTTP round trip; returns (parsed body, response headers)."""
         import urllib.error
@@ -294,7 +356,7 @@ class ServeClient:
         req = urllib.request.Request(
             self.base_url + path,
             data=None if payload is None else json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
             method=method,
         )
         try:
@@ -331,10 +393,24 @@ class ServeClient:
             delay_floor_from=lambda e: getattr(e, "retry_after", 0.0),
         )
 
-    def encode(self, dict_id: str, rows) -> np.ndarray:
+    @staticmethod
+    def _trace_headers(trace) -> Optional[Dict[str, str]]:
+        """``trace`` is a `telemetry.tracing.TraceContext`, a bare trace-id
+        string, or None — normalized to the propagation headers."""
+        if trace is None:
+            return None
+        if isinstance(trace, str):
+            from sparse_coding__tpu.telemetry.tracing import TraceContext
+
+            trace = TraceContext(trace)
+        return trace.headers()
+
+    def encode(self, dict_id: str, rows, trace=None) -> np.ndarray:
         payload = {"dict": dict_id, "rows": np.asarray(rows).tolist()}
+        headers = self._trace_headers(trace)
         out = self._with_retries(
-            lambda: self._request("POST", "/encode", payload)
+            lambda: self._request_full("POST", "/encode", payload,
+                                       headers=headers)[0]
         )
         return np.asarray(out["codes"], dtype=np.float32)
 

@@ -1,0 +1,30 @@
+"""CLI shim: ``python -m sparse_coding__tpu.slo <run_dir> --config slo.json``.
+
+Evaluates declarative SLOs (availability, latency percentiles, queue
+depth, goodput floor) over a run directory or live ``/metrics`` endpoints
+(``--scrape URL...``), with error-budget consumption and fast/slow burn
+rates; exits **1** past budget — the serving tier's CI gate and the
+ROADMAP-3 autoscaler's sensor. Implementation:
+`sparse_coding__tpu.telemetry.slo` (docs/observability.md §8).
+"""
+
+from sparse_coding__tpu.telemetry.slo import (
+    evaluate_measured,
+    evaluate_run_dir,
+    evaluate_scrape,
+    load_config,
+    main,
+    render_slo,
+)
+
+__all__ = [
+    "evaluate_measured",
+    "evaluate_run_dir",
+    "evaluate_scrape",
+    "load_config",
+    "main",
+    "render_slo",
+]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,16 @@ Eight pieces (docs/observability.md):
   - `goodput`   — wall-time ledger across processes + resume generations
                   (+ the supervisor log), Perfetto trace export; CLI:
                   `python -m sparse_coding__tpu.timeline <run_dir>`
+  - `tracing`   — request-level distributed tracing for the serving tier
+                  (X-Trace-Id / X-Parent-Span propagation, per-attempt
+                  `forward` spans, per-request `request_trace` records);
+                  CLI: `python -m sparse_coding__tpu.trace <run_dir>`
+  - `metrics_http` — Prometheus text exposition of the live counters/
+                  gauges/histograms (`GET /metrics` on serve server,
+                  router, replicaset; per-worker .prom files for fleets)
+  - `slo`       — declarative SLO engine (availability/latency/queue/
+                  goodput objectives, error budgets, fast/slow burn
+                  rates); CLI: `python -m sparse_coding__tpu.slo`
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy
@@ -63,6 +73,7 @@ from sparse_coding__tpu.telemetry.spans import (
     Span,
     span,
 )
+from sparse_coding__tpu.telemetry.tracing import TraceContext, mint_span_id, mint_trace_id
 
 __all__ = [
     "AnomalyAbort",
@@ -75,6 +86,7 @@ __all__ = [
     "HealthConfig",
     "RunTelemetry",
     "Span",
+    "TraceContext",
     "TraceTrigger",
     "TransferViolation",
     "allowed_transfer",
@@ -88,6 +100,8 @@ __all__ = [
     "hbm_watermarks",
     "heartbeat",
     "jit_cost_fields",
+    "mint_span_id",
+    "mint_trace_id",
     "process_info",
     "read_events",
     "record_hbm_watermarks",

@@ -42,6 +42,7 @@ from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
     "RunTelemetry",
     "counter_add_float_active",
     "counter_inc_active",
@@ -51,6 +52,13 @@ __all__ = [
     "tracked_jit",
     "read_events",
 ]
+
+# fixed log-spaced latency buckets (ms): 0.25 ms … 2048 ms, each bound 2x
+# the previous — the /metrics histogram contract (docs/observability.md §8).
+# FIXED, not adaptive: histograms from different writers/generations must
+# merge by plain bucket addition, and a quantile read off the buckets is
+# then correct to within one bucket width by construction.
+DEFAULT_LATENCY_BUCKETS_MS = tuple(0.25 * 2 ** i for i in range(14))
 
 
 # Live instances receiving process-global signals (jax.monitoring, tracked_jit
@@ -243,6 +251,7 @@ class RunTelemetry:
         self._chunk_t0_mono: Optional[float] = None
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Dict[str, Any]] = {}
         self._run_end_written = False
         self._fh = None
         self.path: Optional[Path] = None
@@ -409,18 +418,79 @@ class RunTelemetry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def hist_observe(self, name: str, value: float,
+                     buckets: Optional[tuple] = None):
+        """Record one observation into a fixed-bucket histogram (created on
+        first observe; ``buckets`` only matters then). Host-side like the
+        counters — no device sync. Flushed by `snapshot` and rendered by
+        `telemetry.metrics_http` as a Prometheus histogram."""
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                bounds = tuple(
+                    float(b)
+                    for b in (buckets or DEFAULT_LATENCY_BUCKETS_MS)
+                )
+                h = self._hists[name] = {
+                    "bounds": bounds,
+                    "counts": [0] * (len(bounds) + 1),  # +1 = overflow
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            h["sum"] += v
+            h["count"] += 1
+            for i, b in enumerate(h["bounds"]):
+                if v <= b:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["counts"][-1] += 1
+
     @property
     def counters(self) -> Dict[str, float]:
         return dict(self._counters)
 
+    @property
+    def gauges(self) -> Dict[str, float]:
+        return dict(self._gauges)
+
+    @property
+    def hists(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                k: {
+                    "bounds": list(h["bounds"]),
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"],
+                    "count": h["count"],
+                }
+                for k, h in self._hists.items()
+            }
+
     def snapshot(self):
-        """ONE flush of every counter and gauge as a single event."""
+        """ONE flush of every counter and gauge as a single event (plus the
+        histograms, only when any exist — snapshot schema for runs without
+        them is a byte-stability contract)."""
         with self._lock:
             counters = {
                 k: round(v, 4) if isinstance(v, float) else v
                 for k, v in sorted(self._counters.items())
             }
             gauges = {k: v for k, v in sorted(self._gauges.items())}
+            hists = {
+                k: {
+                    "bounds": list(h["bounds"]),
+                    "counts": list(h["counts"]),
+                    "sum": round(h["sum"], 4),
+                    "count": h["count"],
+                }
+                for k, h in sorted(self._hists.items())
+            }
+        if hists:
+            return self.event(
+                "snapshot", counters=counters, gauges=gauges, hists=hists
+            )
         return self.event("snapshot", counters=counters, gauges=gauges)
 
     # -- lifetime ------------------------------------------------------------

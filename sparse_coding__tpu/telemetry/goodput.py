@@ -230,10 +230,16 @@ def _analyze_generation(gen: Dict[str, Any], idx: int) -> Dict[str, Any]:
             start = _num(r.get("ts_start"))
             if start is None:
                 start = (_num(r.get("ts")) or start_ts) - secs
-            spans.append({
+            span = {
                 "category": r["category"], "start": start, "seconds": secs,
                 "name": r.get("name"), "source": "span",
-            })
+            }
+            # trace tags (telemetry.tracing): carried through so the Chrome
+            # export can render a per-request track view
+            for key in ("trace_id", "traces", "replica"):
+                if r.get(key) is not None:
+                    span[key] = r[key]
+            spans.append(span)
         elif r.get("event") == "compile":
             # compile events double as spans: the tracked_jit wall time of
             # the dispatch that compiled, ending at the record's ts
@@ -513,6 +519,7 @@ _CNAME = {
     "compile": "thread_state_runnable",
     "data_wait": "thread_state_iowait",
     "request_wait": "thread_state_iowait",
+    "forward": "thread_state_iowait",
     "dequant": "rail_load",
     "checkpoint": "rail_idle",
     "preempt_drain": "terrible",
@@ -551,6 +558,12 @@ def to_chrome_trace(ledger: Dict[str, Any]) -> Dict[str, Any]:
                 "args": {"name": f"gen {tid}"},
             })
         name = s.get("name") or s["category"]
+        args = {"category": s["category"], "seconds": round(s["seconds"], 6)}
+        span_traces = [s["trace_id"]] if s.get("trace_id") else list(
+            s.get("traces") or ()
+        )
+        if span_traces:
+            args["traces"] = span_traces
         events.append({
             "ph": "X",
             "name": str(name),
@@ -560,9 +573,46 @@ def to_chrome_trace(ledger: Dict[str, Any]) -> Dict[str, Any]:
             "ts": round((s["start"] - base) * 1e6, 1),
             "dur": round(s["seconds"] * 1e6, 1),
             "cname": _CNAME.get(s["category"], "grey"),
-            "args": {"category": s["category"],
-                     "seconds": round(s["seconds"], 6)},
+            "args": args,
         })
+    # per-request track view (ISSUE 14): every trace-tagged span is ALSO
+    # emitted on a "requests" process, one thread per trace id, so one
+    # request's journey (router forward attempts + the replica batches it
+    # rode) reads as one horizontal track in Perfetto
+    trace_tids: Dict[str, int] = {}
+    request_events: List[Dict[str, Any]] = []
+    for s in spans:
+        span_traces = [s["trace_id"]] if s.get("trace_id") else list(
+            s.get("traces") or ()
+        )
+        for trace_id in span_traces:
+            tid = trace_tids.setdefault(str(trace_id), len(trace_tids))
+            name = s.get("name") or s["category"]
+            if s.get("replica"):
+                name = f"{name}@{s['replica']}"
+            request_events.append({
+                "ph": "X",
+                "name": str(name),
+                "cat": s["category"],
+                "pid": -2,
+                "tid": tid,
+                "ts": round((s["start"] - base) * 1e6, 1),
+                "dur": round(s["seconds"] * 1e6, 1),
+                "cname": _CNAME.get(s["category"], "grey"),
+                "args": {"category": s["category"], "trace_id": trace_id,
+                         "seconds": round(s["seconds"], 6)},
+            })
+    if request_events:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": -2, "tid": 0,
+            "args": {"name": "requests (per-trace tracks)"},
+        })
+        for trace_id, tid in trace_tids.items():
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": -2, "tid": tid,
+                "args": {"name": f"trace {trace_id[:16]}"},
+            })
+        events.extend(request_events)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -570,6 +620,7 @@ def to_chrome_trace(ledger: Dict[str, Any]) -> Dict[str, Any]:
             "run_dir": ledger.get("run_dir"),
             "goodput_frac": ledger.get("goodput_frac"),
             "trace_base_unix_ts": base,
+            "n_traces": len(trace_tids),
         },
     }
 

@@ -1,0 +1,354 @@
+"""Prometheus text-exposition export for the live telemetry bus (ISSUE 14).
+
+The `RunTelemetry` counters/gauges/histograms were write-only JSONL until
+now; this module renders them in the Prometheus text exposition format
+(version 0.0.4) so any scraper — or `monitor --scrape`, or the SLO
+engine's live mode — can pull them:
+
+  - counters  → ``sc_<name>_total`` (``# TYPE ... counter``)
+  - gauges    → ``sc_<name>``       (``# TYPE ... gauge``)
+  - histograms→ ``sc_<name>_bucket{le="..."}`` cumulative series plus
+    ``_sum``/``_count`` (`RunTelemetry.hist_observe`'s fixed log-spaced
+    buckets)
+
+Metric names are sanitized (``serve.latency_p50_ms`` →
+``sc_serve_latency_p50_ms``); label values are escaped per the spec
+(backslash, double-quote, newline). Output ordering is sorted and stable —
+a golden-file contract (tests/golden/metrics_exposition.txt).
+
+Mounted as ``GET /metrics`` on the serve server, the router, and the
+replicaset CLI (`serve_metrics_server`); fleet workers, which own no HTTP
+listener, write the same text to a per-worker ``metrics/<worker>.prom``
+file (`write_metrics_file`) that the fleet report aggregates.
+
+`parse_prometheus` / `scrape` are the read side: they turn exposition text
+back into ``{name: [(labels, value), ...]}`` families, with
+`histogram_from_families` + `histogram_quantile` recovering latency
+quantiles from the bucket series (docs/observability.md §8).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "PREFIX",
+    "CONTENT_TYPE",
+    "metric_name",
+    "render_prometheus",
+    "telemetry_metrics_text",
+    "write_metrics_file",
+    "parse_prometheus",
+    "scrape",
+    "histogram_from_families",
+    "histogram_quantile",
+    "MetricsServer",
+    "serve_metrics_server",
+]
+
+PREFIX = "sc_"
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_key(key: str) -> str:
+    """Telemetry key → exposition-safe name fragment (dots and other
+    illegal characters become underscores). THE one sanitizer — the SLO
+    engine's scrape mode maps objective keys through it so its lookups
+    can never diverge from what `metric_name` emitted."""
+    return _NAME_RE.sub("_", str(key))
+
+
+def metric_name(key: str, suffix: str = "") -> str:
+    """Telemetry key → Prometheus metric name: prefix, sanitize, suffix
+    (``serve.requests`` → ``sc_serve_requests_total``)."""
+    return PREFIX + sanitize_key(key) + suffix
+
+
+def _escape_label(v: str) -> str:
+    return (
+        str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _labels_str(labels: Optional[Dict[str, Any]],
+                extra: Optional[Dict[str, Any]] = None) -> str:
+    merged: Dict[str, Any] = {}
+    merged.update(labels or {})
+    merged.update(extra or {})
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{_NAME_RE.sub("_", str(k))}="{_escape_label(v)}"'
+        for k, v in sorted(merged.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(
+    counters: Optional[Dict[str, float]] = None,
+    gauges: Optional[Dict[str, float]] = None,
+    hists: Optional[Dict[str, Dict[str, Any]]] = None,
+    labels: Optional[Dict[str, Any]] = None,
+) -> str:
+    """The exposition text for one writer's counters/gauges/histograms.
+
+    ``hists`` entries are `RunTelemetry.hists` dicts: ``{"bounds": [...],
+    "counts": [per-bucket..., overflow], "sum": float, "count": int}`` —
+    rendered as the cumulative ``_bucket`` series the quantile math wants.
+    Ordering is sorted by metric name: byte-stable for fixed inputs.
+    """
+    lines: List[str] = []
+    for key, v in sorted((counters or {}).items()):
+        name = metric_name(key, "_total")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{_labels_str(labels)} {_fmt_value(v)}")
+    for key, v in sorted((gauges or {}).items()):
+        name = metric_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{_labels_str(labels)} {_fmt_value(v)}")
+    for key, h in sorted((hists or {}).items()):
+        name = metric_name(key)
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for bound, n in zip(h["bounds"], h["counts"]):
+            cum += int(n)
+            lines.append(
+                f"{name}_bucket"
+                f"{_labels_str(labels, {'le': _fmt_value(bound)})} {cum}"
+            )
+        cum += int(h["counts"][len(h["bounds"])])
+        lines.append(f"{name}_bucket{_labels_str(labels, {'le': '+Inf'})} {cum}")
+        lines.append(f"{name}_sum{_labels_str(labels)} {_fmt_value(h['sum'])}")
+        lines.append(f"{name}_count{_labels_str(labels)} {cum}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def telemetry_metrics_text(telemetry, uptime: bool = True) -> str:
+    """One live `RunTelemetry`'s full exposition (its constant ``tags``
+    become labels on every series; ``sc_uptime_seconds`` rides along)."""
+    gauges = dict(telemetry.gauges)
+    if uptime:
+        gauges["uptime_seconds"] = round(time.time() - telemetry._t0, 3)
+    return render_prometheus(
+        counters=telemetry.counters,
+        gauges=gauges,
+        hists=telemetry.hists,
+        labels=telemetry.tags or None,
+    )
+
+
+def write_metrics_file(telemetry, path) -> Path:
+    """Atomically publish a telemetry handle's exposition text to ``path``
+    (the fleet worker's HTTP-less export — the fleet report aggregates
+    ``metrics/*.prom``). Same-dir temp + ``os.replace``: a reader never
+    sees a torn file."""
+    import os
+
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.parent / f".{p.name}.tmp"
+    tmp.write_text(telemetry_metrics_text(telemetry))
+    os.replace(tmp, p)
+    return p
+
+
+# -- the read side ------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape_label(v: str) -> str:
+    # one left-to-right scan: chained str.replace would corrupt a literal
+    # backslash followed by 'n' (r'C:\new' round-trips wrong otherwise)
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPES.get(m.group(1), m.group(1)), v
+    )
+
+
+def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Exposition text → ``{metric_name: [(labels, value), ...]}``. Unknown
+    lines and comments are skipped (a scraper must tolerate foreign
+    families)."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = {
+            k: _unescape_label(v)
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        }
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        out.setdefault(m.group("name"), []).append((labels, value))
+    return out
+
+
+def scrape(url: str, timeout: float = 3.0) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """GET a ``/metrics`` endpoint and parse it. ``url`` may be the bare
+    server base (``http://host:port``) — ``/metrics`` is appended when
+    missing."""
+    u = url.rstrip("/")
+    if not u.endswith("/metrics"):
+        u += "/metrics"
+    with urllib.request.urlopen(u, timeout=timeout) as resp:
+        return parse_prometheus(resp.read().decode("utf-8", errors="replace"))
+
+
+def family_value(
+    families: Dict[str, List[Tuple[Dict[str, str], float]]],
+    key: str, suffix: str = "", default: Optional[float] = None,
+) -> Optional[float]:
+    """Sum of a family's samples across label sets (the common merge for a
+    counter scraped from several writers)."""
+    samples = families.get(metric_name(key, suffix))
+    if not samples:
+        return default
+    return sum(v for _, v in samples)
+
+
+def histogram_from_families(
+    families: Dict[str, List[Tuple[Dict[str, str], float]]], key: str
+) -> Optional[Dict[str, Any]]:
+    """Recover one histogram from its ``_bucket``/``_sum``/``_count``
+    series (bucket counts summed across label sets — scraping N replicas
+    merges into one tier-wide histogram). None when absent."""
+    name = metric_name(key)
+    buckets = families.get(name + "_bucket")
+    if not buckets:
+        return None
+    by_le: Dict[float, float] = {}
+    for labels, v in buckets:
+        le = labels.get("le", "+Inf")
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + v
+    bounds = sorted(b for b in by_le if b != float("inf"))
+    return {
+        "bounds": bounds,
+        "cumulative": [by_le[b] for b in bounds],
+        "count": by_le.get(float("inf"), max(by_le.values()) if by_le else 0.0),
+        "sum": family_value(families, key, "_sum", 0.0),
+    }
+
+
+def histogram_quantile(hist: Dict[str, Any], q: float) -> Optional[float]:
+    """The standard conservative bucket quantile: the upper bound of the
+    first bucket whose cumulative count reaches ``q * count``. The true
+    quantile lies within one bucket width below the returned bound —
+    exactly the tolerance the /metrics-vs-gauges acceptance pins."""
+    count = hist.get("count") or 0
+    if count <= 0:
+        return None
+    rank = q * count
+    for bound, cum in zip(hist["bounds"], hist["cumulative"]):
+        if cum >= rank:
+            return float(bound)
+    return float("inf")
+
+
+# -- the standalone metrics listener ------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # pragma: no cover - quiet by design
+        pass
+
+    def do_GET(self):
+        if self.path != "/metrics":
+            body = json.dumps({"error": f"no route {self.path}"}).encode()
+            self.send_response(404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        try:
+            body = self.server.render().encode()
+        except Exception as e:  # the exporter must never take a process down
+            body = f"# render failed: {e!r}\n".encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsServer:
+    """A tiny standalone ``GET /metrics`` listener for processes whose main
+    API has no HTTP surface of its own (the replicaset CLI) or for tests
+    that need fake scrape endpoints. ``render`` is any () → str callable."""
+
+    def __init__(self, render: Callable[[], str], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.render = render
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self.httpd.serve_forever, daemon=True,
+                name="metrics-http",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self._thread = None
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+def serve_metrics_server(telemetry, host: str = "127.0.0.1",
+                         port: int = 0) -> MetricsServer:
+    """A started `MetricsServer` exporting one telemetry handle."""
+    return MetricsServer(
+        lambda: telemetry_metrics_text(telemetry), host=host, port=port
+    ).start()

@@ -29,6 +29,12 @@ ledger (done/running/orphaned/queued/lost)::
 
       fleet: items 3 done / 1 leased / 0 pending / 0 failed | members 6 done / 2 running / 0 orphaned / 0 queued / 0 lost
       workers: w0 lease g3 (age 1.2s, expires in 28.8s); w1 idle 4.1s; w2 QUARANTINED (3 strikes)
+
+``--scrape URL...`` (ISSUE 14) renders live serving tiers from the
+``/metrics`` endpoints (`telemetry.metrics_http`) instead of tailing
+files: one line per endpoint (serve replicas and routers auto-detected),
+latency quantiles read off the scraped histograms, plus tier-wide merged
+totals — unreachable endpoints render DOWN instead of crashing.
 """
 
 from __future__ import annotations
@@ -44,7 +50,10 @@ from sparse_coding__tpu.telemetry.multihost import (
     format_bytes as _bytes,
 )
 
-__all__ = ["EventTail", "RunMonitor", "fleet_lines", "render", "main"]
+__all__ = [
+    "EventTail", "RunMonitor", "fleet_lines", "render", "scrape_render",
+    "main",
+]
 
 _EVENT_GLOBS = (
     "events.jsonl",
@@ -612,12 +621,98 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
     return "\n".join(lines)
 
 
+def _scrape_tier_lines(urls: List[str], timeout: float = 3.0) -> List[str]:
+    """The ``--scrape`` view (ISSUE 14): one line per live ``/metrics``
+    endpoint (serve and router tiers auto-detected from the families) plus
+    a tier-wide merged totals line. Unreachable endpoints render as DOWN
+    instead of killing the monitor — a dead replica is exactly what the
+    operator is here to see."""
+    from sparse_coding__tpu.telemetry import metrics_http as mh
+
+    lines: List[str] = []
+    tot_req = tot_rows = 0.0
+    merged_hist: Optional[Dict[str, Any]] = None
+    for url in urls:
+        try:
+            fams = mh.scrape(url, timeout=timeout)
+        except Exception as e:
+            lines.append(f"  {url}: DOWN ({type(e).__name__})")
+            continue
+        serve_req = mh.family_value(fams, "serve.requests", "_total")
+        router_req = mh.family_value(fams, "router.requests", "_total")
+        if router_req is not None:
+            bits = [
+                f"{int(router_req)} req routed "
+                f"({int(mh.family_value(fams, 'router.ok', '_total', 0) or 0)} ok, "
+                f"{int(mh.family_value(fams, 'router.retried_ok', '_total', 0) or 0)} retried-ok)",
+                f"{int(mh.family_value(fams, 'router.sheds', '_total', 0) or 0)} shed / "
+                f"{int(mh.family_value(fams, 'router.failed', '_total', 0) or 0)} failed",
+            ]
+            live = mh.family_value(fams, "router.live_replicas")
+            n = mh.family_value(fams, "router.replicas")
+            if live is not None and n is not None:
+                bits.append(f"replicas {int(live)}/{int(n)} live")
+            lines.append(f"  {url} [router]: " + " | ".join(bits))
+            continue
+        if serve_req is not None:
+            rows = mh.family_value(fams, "serve.rows", "_total", 0) or 0
+            tot_req += serve_req
+            tot_rows += rows
+            bits = [f"{int(serve_req)} req ({int(rows)} rows)"]
+            hist = mh.histogram_from_families(fams, "serve.latency_ms")
+            if hist and hist["count"]:
+                p50 = mh.histogram_quantile(hist, 0.50)
+                p99 = mh.histogram_quantile(hist, 0.99)
+                bits.append(f"p50 ≤{p50:g}ms p99 ≤{p99:g}ms")
+                if merged_hist is None:
+                    merged_hist = hist
+                elif merged_hist["bounds"] == hist["bounds"]:
+                    merged_hist["cumulative"] = [
+                        a + b for a, b in
+                        zip(merged_hist["cumulative"], hist["cumulative"])
+                    ]
+                    merged_hist["count"] += hist["count"]
+            depth = mh.family_value(fams, "serve.queue_depth")
+            if depth is not None:
+                bits.append(f"queue {int(depth)}")
+            occ = mh.family_value(fams, "serve.batch_occupancy")
+            if occ is not None:
+                bits.append(f"occupancy {100 * occ:.0f}%")
+            draining = mh.family_value(fams, "serve.draining")
+            if draining:
+                bits.append("DRAINING")
+            lines.append(f"  {url}: " + " | ".join(bits))
+            continue
+        lines.append(f"  {url}: up ({len(fams)} familie(s), no serve/router "
+                     "series)")
+    if tot_req:
+        bits = [f"{int(tot_req)} req ({int(tot_rows)} rows) across the tier"]
+        if merged_hist is not None and merged_hist["count"]:
+            p99 = mh.histogram_quantile(merged_hist, 0.99)
+            bits.append(f"merged p99 ≤{p99:g}ms")
+        lines.append("  tier: " + " | ".join(bits))
+    return lines
+
+
+def scrape_render(urls: List[str], now: Optional[float] = None,
+                  timeout: float = 3.0) -> str:
+    now = time.time() if now is None else now
+    lines = [
+        f"scrape — {len(urls)} endpoint(s), "
+        f"{time.strftime('%H:%M:%S', time.localtime(now))}"
+    ]
+    lines.extend(_scrape_tier_lines(urls, timeout=timeout))
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparse_coding__tpu.monitor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("run_dir", help="directory holding events JSONL file(s)")
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="directory holding events JSONL file(s) "
+                    "(omit with --scrape)")
     ap.add_argument(
         "--once", action="store_true",
         help="render one snapshot and exit (nonzero on malformed event lines)",
@@ -630,7 +725,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--refreshes", type=int, default=0,
         help="stop after N refreshes (0 = until every process writes run_end)",
     )
+    ap.add_argument(
+        "--scrape", nargs="+", default=None, metavar="URL",
+        help="render live tiers from /metrics endpoints (serve servers, "
+        "routers) instead of tailing a run dir's files",
+    )
     args = ap.parse_args(argv)
+
+    if args.scrape:
+        if args.run_dir is not None:
+            ap.error("--scrape replaces the run_dir — pass one or the other")
+        refreshes = 0
+        try:
+            while True:
+                print(scrape_render(args.scrape))
+                refreshes += 1
+                if args.once or (args.refreshes and refreshes >= args.refreshes):
+                    return 0
+                print()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    if args.run_dir is None:
+        ap.error("need a run_dir (or --scrape URL...)")
     mon = RunMonitor(args.run_dir)
 
     if args.once:

@@ -799,6 +799,47 @@ def _router_section(run, lines: List[str]):
     lines.append("")
 
 
+def _slo_section(run, lines: List[str]):
+    """SLO verdicts (ISSUE 14, docs/observability.md §8): when the run dir
+    carries an ``slo.json``, evaluate it on the spot and render the
+    objective table (availability/latency/queue/goodput, error-budget
+    consumption, burn rates); ``slo_violation`` events recorded by the slo
+    CLI or loadgen render as a timeline either way. Omitted entirely for
+    runs with neither — report output is a stability contract."""
+    violations = _events_of(run, "slo_violation")
+    cfg_path = Path(run["dir"]) / "slo.json"
+    if not violations and not cfg_path.is_file():
+        return
+    lines.append("## SLO")
+    lines.append("")
+    if cfg_path.is_file():
+        from sparse_coding__tpu.telemetry.slo import (
+            evaluate_run_dir,
+            load_config,
+            render_slo,
+        )
+
+        try:
+            result = evaluate_run_dir(run["dir"], load_config(cfg_path))
+            lines.append(render_slo(result))
+        except Exception as e:  # a bad config must not kill the report
+            lines.append(f"_slo.json present but unevaluable: {e!r}_")
+        lines.append("")
+    if violations:
+        lines.append("| objective | type | measured | budget used | detail |")
+        lines.append("|---|---|---:|---:|---|")
+        for v in violations:
+            consumed = v.get("budget_consumed_frac")
+            lines.append(
+                f"| {v.get('objective', '?')} "
+                f"| {v.get('objective_type', '?')} "
+                f"| {_fmt(v.get('measured'))} "
+                f"| {'-' if consumed is None else f'{100 * consumed:.1f}%'} "
+                f"| {_fmt(v.get('detail'))} |"
+            )
+        lines.append("")
+
+
 def _throughput_section(run, lines: List[str]):
     lines.append("## Throughput")
     lines.append("")
@@ -992,6 +1033,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _goodput_section(run, lines)
     _serving_section(run, lines)
     _router_section(run, lines)
+    _slo_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

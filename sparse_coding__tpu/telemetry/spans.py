@@ -80,6 +80,8 @@ BADPUT_CATEGORIES = (
     "restart_backoff",  # supervisor backoff sleep before a respawn
     "request_wait",   # serve: enqueue → drain-into-a-batch queueing delay
     "dequant",        # serve: int8-resident weight dequantization per batch
+    "forward",        # router: one forward attempt (retries/hedges each get
+                      # their own span, trace-tagged — telemetry.tracing)
 )
 # derived-only badput: reconstructed by telemetry.goodput from event
 # adjacency, never emitted as live spans

@@ -1,0 +1,394 @@
+"""Request-level distributed tracing for the serving tier (ISSUE 14).
+
+The goodput ledger (docs/observability.md §7) attributes wall time per
+*process*; this module attributes it per *request*. Every request entering
+the tier carries (or is minted) a **trace id**, and each hop stamps child
+spans under it, Dapper-style:
+
+  - **Headers.** ``X-Trace-Id`` (32 hex chars) identifies the request;
+    ``X-Parent-Span`` (16 hex chars) is the span id of the caller's hop.
+    The router mints a trace id when the client sent none (it is the
+    tier's edge); clients (loadgen) may mint their own to correlate with
+    client-side measurements.
+  - **Router attempts.** Every forward — first try, retries, hedges —
+    is one ``span`` event of category ``forward`` carrying ``trace_id``,
+    its own ``span_id``, ``parent_span`` (the client's, when given),
+    ``replica``, ``attempt``, ``hedge`` and the outcome ``status``. The
+    attempt's span id travels to the replica as ``X-Parent-Span``, so the
+    replica's records are provably children of *that* attempt.
+  - **Replica phases.** The engine keeps emitting its per-micro-batch
+    ``request_wait``/``encode``/``dequant`` spans (now tagged with the
+    member ``traces``), and additionally emits ONE compact
+    ``request_trace`` event per traced request at resolve time with the
+    request's exact per-phase seconds — queue wait is per-request, encode
+    and dequant are the enclosing batch dispatch's. Batch context
+    (``bucket``, ``lanes``, ``n_requests``) rides along so the tail
+    analysis can say "slow because it landed in a crowded bucket".
+
+`collect_traces` reconstructs the per-request trees from a run
+directory's merged ``events*.jsonl`` (router + replicas in one dir, the
+`serve.replicaset` layout); `python -m sparse_coding__tpu.trace` is the
+CLI: ``--trace-id`` renders one request's tree, ``--slowest N`` explains
+the latency tail by phase (docs/observability.md §8).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "TRACE_HEADER",
+    "PARENT_HEADER",
+    "TraceContext",
+    "mint_trace_id",
+    "mint_span_id",
+    "collect_traces",
+    "trace_summary",
+    "render_trace",
+    "render_slowest",
+    "main",
+]
+
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span"
+
+
+def mint_trace_id() -> str:
+    """A fresh 128-bit trace id (32 lowercase hex chars)."""
+    return uuid.uuid4().hex
+
+
+def mint_span_id() -> str:
+    """A fresh 64-bit span id (16 lowercase hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+class TraceContext:
+    """One hop's view of a trace: the trace id, this hop's span id, and
+    the parent hop's span id (None at the edge)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id) if span_id else mint_span_id()
+        self.parent_span = str(parent_span) if parent_span else None
+
+    def child(self) -> "TraceContext":
+        """The next hop's context: same trace, fresh span, parented here."""
+        return TraceContext(self.trace_id, parent_span=self.span_id)
+
+    def headers(self) -> Dict[str, str]:
+        """The propagation headers this hop sends downstream (the receiver
+        parents its records on OUR span id)."""
+        return {TRACE_HEADER: self.trace_id, PARENT_HEADER: self.span_id}
+
+    @classmethod
+    def from_headers(cls, headers) -> Optional["TraceContext"]:
+        """Parse an incoming request's trace headers into the RECEIVER's
+        context (fresh span id, parented on the sender's). None when the
+        request carries no trace id."""
+        trace_id = headers.get(TRACE_HEADER) or headers.get(TRACE_HEADER.lower())
+        if not trace_id:
+            return None
+        parent = headers.get(PARENT_HEADER) or headers.get(PARENT_HEADER.lower())
+        return cls(str(trace_id), parent_span=parent)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return (f"TraceContext({self.trace_id!r}, span={self.span_id!r}, "
+                f"parent={self.parent_span!r})")
+
+
+# -- reconstruction -----------------------------------------------------------
+
+
+def _load_records(run_dir) -> List[Dict[str, Any]]:
+    """Every record from every events*.jsonl under the run dir (the
+    goodput loader's merge, reused — router + replica logs in one sweep)."""
+    from sparse_coding__tpu.telemetry.goodput import load_streams
+
+    streams = load_streams(run_dir)
+    return [r for s in streams for r in s["records"]]
+
+
+def collect_traces(records) -> Dict[str, Dict[str, Any]]:
+    """Group trace-carrying records per trace id::
+
+        {trace_id: {"attempts": [forward span records],
+                    "requests": [request_trace records],
+                    "batch_spans": [engine batch spans tagging this trace]}}
+
+    ``attempts`` come from the router (``span`` events, category
+    ``forward``); ``requests`` from the engine (``request_trace``);
+    ``batch_spans`` are the shared micro-batch spans whose ``traces``
+    list names this trace.
+    """
+    traces: Dict[str, Dict[str, Any]] = {}
+
+    def slot(tid: str) -> Dict[str, Any]:
+        if tid not in traces:
+            traces[tid] = {"attempts": [], "requests": [], "batch_spans": []}
+        return traces[tid]
+
+    for r in records:
+        kind = r.get("event")
+        if kind == "span":
+            tid = r.get("trace_id")
+            if tid and r.get("category") == "forward":
+                slot(str(tid))["attempts"].append(r)
+            else:
+                for t in r.get("traces") or ():
+                    slot(str(t))["batch_spans"].append(r)
+        elif kind == "request_trace" and r.get("trace_id"):
+            slot(str(r["trace_id"]))["requests"].append(r)
+    for t in traces.values():
+        t["attempts"].sort(key=lambda a: a.get("ts_start") or 0.0)
+        t["requests"].sort(key=lambda a: a.get("ts_start") or 0.0)
+    return traces
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and v == v else None
+
+
+def trace_summary(trace_id: str, trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-phase totals and the end-to-end window for one trace.
+
+    ``total_seconds`` spans the earliest record start to the latest record
+    end; ``phases`` sums ``forward`` time across attempts and the
+    replica-side ``request_wait``/``encode``/``dequant`` seconds across
+    request records; ``gap`` is the remainder of the window no phase
+    covers (retry backoff, transport) — forward windows ENCLOSE the
+    replica phases, so the replica seconds are subtracted from forward
+    rather than double-counted.
+    """
+    spans: List[Dict[str, float]] = []
+    phases: Dict[str, float] = {}
+    for a in trace["attempts"]:
+        t0, secs = _num(a.get("ts_start")), _num(a.get("seconds"))
+        if secs is None:
+            continue
+        phases["forward"] = phases.get("forward", 0.0) + secs
+        if t0 is not None:
+            spans.append({"start": t0, "end": t0 + secs})
+    replica_secs = 0.0
+    for r in trace["requests"]:
+        for phase, secs in (r.get("phases") or {}).items():
+            secs = _num(secs)
+            if secs:
+                phases[phase] = phases.get(phase, 0.0) + secs
+                replica_secs += secs
+        t0 = _num(r.get("ts_start"))
+        lat = _num(r.get("latency_ms"))
+        if t0 is not None and lat is not None:
+            spans.append({"start": t0, "end": t0 + lat / 1e3})
+    if "forward" in phases:
+        # the replica's phases happen INSIDE the forward window: report
+        # forward as the router's exclusive overhead (never below 0)
+        phases["forward"] = max(0.0, phases["forward"] - replica_secs)
+    total = None
+    if spans:
+        total = max(s["end"] for s in spans) - min(s["start"] for s in spans)
+    covered = sum(phases.values())
+    gap = max(0.0, (total or 0.0) - covered)
+    replicas = sorted(
+        {str(a.get("replica")) for a in trace["attempts"] if a.get("replica")}
+        | {str(r.get("replica")) for r in trace["requests"] if r.get("replica")}
+    )
+    winner = None
+    for a in trace["attempts"]:
+        status = a.get("status")
+        if isinstance(status, int) and status == 200:
+            winner = a.get("replica")
+    return {
+        "trace_id": trace_id,
+        "n_attempts": len(trace["attempts"]),
+        "n_requests": len(trace["requests"]),
+        "replicas": replicas,
+        "winner": winner,
+        "total_seconds": total,
+        "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "gap_seconds": round(gap, 6),
+    }
+
+
+def _ms(v: Optional[float]) -> str:
+    return "?" if v is None else f"{1e3 * v:.1f} ms"
+
+
+def render_trace(trace_id: str, trace: Dict[str, Any]) -> str:
+    """One request's tree: router attempt(s) → replica → batch context."""
+    s = trace_summary(trace_id, trace)
+    lines = [
+        f"trace {trace_id} — {s['n_attempts']} attempt(s), "
+        f"{s['n_requests']} replica record(s), total {_ms(s['total_seconds'])}"
+    ]
+    attempts = trace["attempts"]
+    # replica records parented on an attempt's span id hang under it;
+    # orphans (direct-to-server traffic, no router) render at top level
+    by_parent: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for r in trace["requests"]:
+        by_parent.setdefault(r.get("parent_span"), []).append(r)
+    claimed: set = set()
+
+    def request_lines(reqs: List[Dict[str, Any]], indent: str) -> List[str]:
+        out = []
+        for r in reqs:
+            claimed.add(id(r))
+            ph = r.get("phases") or {}
+            bits = ", ".join(
+                f"{k} {_ms(_num(v))}" for k, v in ph.items() if _num(v)
+            ) or "no phases"
+            batch = (
+                f" [batch b{r.get('bucket', '?')}×g{r.get('lanes', '?')}, "
+                f"{r.get('n_requests', '?')} req]"
+            )
+            out.append(
+                f"{indent}└─ replica {r.get('replica', '?')} dict "
+                f"{r.get('dict', '?')} ({r.get('rows', '?')} rows, "
+                f"{_num(r.get('latency_ms')) or 0:.1f} ms): {bits}{batch}"
+            )
+        return out
+
+    prev_end = None
+    for i, a in enumerate(attempts):
+        t0, secs = _num(a.get("ts_start")), _num(a.get("seconds")) or 0.0
+        if prev_end is not None and t0 is not None and t0 > prev_end:
+            lines.append(f"  │  (retry gap {_ms(t0 - prev_end)})")
+        status = a.get("status", "?")
+        tag = "HEDGE " if a.get("hedge") else ""
+        lines.append(
+            f"  ├─ {tag}forward attempt {a.get('attempt', i)} → "
+            f"{a.get('replica', '?')}  [{status}]  {_ms(secs)}"
+        )
+        lines.extend(request_lines(by_parent.get(a.get("span_id"), []), "  │    "))
+        if t0 is not None:
+            prev_end = t0 + secs
+    for parent, reqs in by_parent.items():
+        reqs = [r for r in reqs if id(r) not in claimed]
+        if reqs:
+            lines.extend(request_lines(reqs, "  "))
+    phase_bits = " | ".join(
+        f"{k} {_ms(v)}" for k, v in s["phases"].items()
+    )
+    if phase_bits:
+        lines.append(
+            f"  phase totals: {phase_bits} | uncovered gap "
+            f"{_ms(s['gap_seconds'])}"
+        )
+    if s["winner"] is not None:
+        lines.append(f"  winner: {s['winner']}")
+    return "\n".join(lines)
+
+
+def render_slowest(traces: Dict[str, Dict[str, Any]], n: int) -> str:
+    """The latency tail, explained by phase: the N slowest traces ranked by
+    end-to-end window, one line each, plus a where-do-p99-milliseconds-go
+    phase aggregate over exactly that tail."""
+    summaries = [
+        trace_summary(tid, t)
+        for tid, t in traces.items()
+    ]
+    summaries = [s for s in summaries if s["total_seconds"] is not None]
+    summaries.sort(key=lambda s: -s["total_seconds"])
+    tail = summaries[: max(1, int(n))]
+    lines = [
+        f"slowest {len(tail)} of {len(summaries)} traced request(s):",
+        "",
+    ]
+    for s in tail:
+        bits = ", ".join(f"{k} {_ms(v)}" for k, v in s["phases"].items())
+        retried = f", {s['n_attempts']} attempts" if s["n_attempts"] > 1 else ""
+        lines.append(
+            f"  {s['trace_id'][:16]}…  {_ms(s['total_seconds'])}  "
+            f"({bits or 'no phases'}, gap {_ms(s['gap_seconds'])}"
+            f"{retried})"
+        )
+    agg: Dict[str, float] = {}
+    gap = 0.0
+    for s in tail:
+        for k, v in s["phases"].items():
+            agg[k] = agg.get(k, 0.0) + v
+        gap += s["gap_seconds"]
+    total = sum(agg.values()) + gap
+    if total > 0:
+        lines.append("")
+        lines.append("tail time by phase:")
+        for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {k:14s} {_ms(v):>12s}  {100 * v / total:5.1f}%")
+        lines.append(f"  {'gap':14s} {_ms(gap):>12s}  {100 * gap / total:5.1f}%")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.trace",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("run_dir", help="run dir holding events*.jsonl "
+                    "(router + replica logs merge automatically)")
+    ap.add_argument("--trace-id", default=None,
+                    help="reconstruct ONE request's tree (prefix match ok)")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="rank the N slowest traces and explain the tail "
+                    "by phase")
+    ap.add_argument("--list", action="store_true",
+                    help="list every trace id with its total latency")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable summaries instead of trees")
+    args = ap.parse_args(argv)
+
+    if not Path(args.run_dir).is_dir():
+        print(f"run dir {args.run_dir} does not exist")
+        return 3
+    traces = collect_traces(_load_records(args.run_dir))
+    if not traces:
+        print(f"no traced records under {args.run_dir} "
+              "(span[forward] / request_trace events)")
+        return 3
+
+    if args.trace_id:
+        matches = [t for t in traces if t.startswith(args.trace_id)]
+        if not matches:
+            print(f"trace {args.trace_id!r} not found "
+                  f"({len(traces)} trace(s) present)")
+            return 2
+        for tid in matches:
+            if args.json:
+                print(json.dumps(trace_summary(tid, traces[tid]), indent=1))
+            else:
+                print(render_trace(tid, traces[tid]))
+        return 0
+    if args.slowest is not None:
+        if args.json:
+            summaries = sorted(
+                (trace_summary(tid, t) for tid, t in traces.items()),
+                key=lambda s: -(s["total_seconds"] or 0.0),
+            )[: args.slowest]
+            print(json.dumps(summaries, indent=1))
+        else:
+            print(render_slowest(traces, args.slowest))
+        return 0
+    # default / --list: the trace inventory
+    summaries = sorted(
+        (trace_summary(tid, t) for tid, t in traces.items()),
+        key=lambda s: -(s["total_seconds"] or 0.0),
+    )
+    if args.json:
+        print(json.dumps(summaries, indent=1))
+        return 0
+    print(f"{len(summaries)} traced request(s) under {args.run_dir}:")
+    for s in summaries:
+        lane = "/".join(s["replicas"]) or "?"
+        print(
+            f"  {s['trace_id']}  {_ms(s['total_seconds'])}  "
+            f"{s['n_attempts']} attempt(s) via {lane}"
+        )
+    return 0

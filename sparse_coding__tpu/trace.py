@@ -1,0 +1,29 @@
+"""CLI shim: ``python -m sparse_coding__tpu.trace <run_dir> [--trace-id ID]``.
+
+Reconstructs one request's journey through the serving tier — router
+attempt(s) (retries/hedges) → replica → micro-batch — from the run
+directory's merged ``events*.jsonl``; ``--slowest N`` explains the latency
+tail by phase. Implementation: `sparse_coding__tpu.telemetry.tracing`
+(docs/observability.md §8).
+"""
+
+from sparse_coding__tpu.telemetry.tracing import (
+    TraceContext,
+    collect_traces,
+    main,
+    mint_span_id,
+    mint_trace_id,
+    render_trace,
+)
+
+__all__ = [
+    "TraceContext",
+    "collect_traces",
+    "main",
+    "mint_span_id",
+    "mint_trace_id",
+    "render_trace",
+]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

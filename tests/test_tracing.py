@@ -1,0 +1,397 @@
+"""Request-level tracing (ISSUE 14, docs/observability.md §8).
+
+Covers trace id/context minting and header propagation, the engine's
+per-request ``request_trace`` records and trace-tagged batch spans, the
+router's per-attempt ``forward`` spans (retries land on different
+replicas under ONE trace id), the trace CLI's reconstruction / --slowest
+tail analysis pinned on the golden ``traced_run`` fixture, the Chrome
+trace's per-request track view, the handle-less-span tag regression, and
+the chaos acceptance: a replica SIGKILLed mid-flight yields a retried
+request whose reconstructed trace shows child spans on BOTH replicas,
+whose winner matches ``X-Router-Replica``, and whose traced phases sum to
+the client-observed latency."""
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.models.learned_dict import TiedSAE
+from sparse_coding__tpu.serve.engine import EncodeEngine
+from sparse_coding__tpu.serve.registry import DictRegistry
+from sparse_coding__tpu.telemetry import RunTelemetry
+from sparse_coding__tpu.telemetry.tracing import (
+    PARENT_HEADER,
+    TRACE_HEADER,
+    TraceContext,
+    collect_traces,
+    mint_span_id,
+    mint_trace_id,
+    render_slowest,
+    render_trace,
+    trace_summary,
+)
+from sparse_coding__tpu.telemetry.tracing import main as trace_main
+
+pytestmark = pytest.mark.serve
+
+GOLDEN_TRACED = Path(__file__).parent / "golden" / "traced_run"
+TRACE_RETRIED = "aaaa1111aaaa1111aaaa1111aaaa1111"
+D, N = 16, 64
+
+
+def _tied(seed: int) -> TiedSAE:
+    rng = np.random.default_rng(seed)
+    return TiedSAE(
+        jnp.asarray(rng.standard_normal((N, D), dtype=np.float32)),
+        jnp.asarray(rng.standard_normal(N, dtype=np.float32) * 0.1),
+    )
+
+
+def _registry(n: int = 2) -> DictRegistry:
+    reg = DictRegistry()
+    for i in range(n):
+        reg.add(f"d{i}", _tied(i))
+    return reg
+
+
+def _rows(seed: int, n: int = 3) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal((n, D)).astype(np.float32)
+
+
+# -- ids / context -----------------------------------------------------------
+
+
+def test_mint_ids_format_and_uniqueness():
+    tids = {mint_trace_id() for _ in range(64)}
+    sids = {mint_span_id() for _ in range(64)}
+    assert len(tids) == 64 and len(sids) == 64
+    assert all(len(t) == 32 and int(t, 16) >= 0 for t in tids)
+    assert all(len(s) == 16 and int(s, 16) >= 0 for s in sids)
+
+
+def test_trace_context_header_round_trip():
+    edge = TraceContext(mint_trace_id())
+    headers = edge.headers()
+    assert headers[TRACE_HEADER] == edge.trace_id
+    assert headers[PARENT_HEADER] == edge.span_id
+    hop = TraceContext.from_headers(headers)
+    assert hop.trace_id == edge.trace_id
+    assert hop.parent_span == edge.span_id  # parented on the SENDER's span
+    assert hop.span_id != edge.span_id  # fresh span per hop
+    assert TraceContext.from_headers({}) is None
+    child = edge.child()
+    assert child.trace_id == edge.trace_id
+    assert child.parent_span == edge.span_id
+
+
+# -- engine: request_trace + tagged spans ------------------------------------
+
+
+def test_engine_emits_request_trace_with_phases(tmp_path):
+    tel = RunTelemetry(out_dir=tmp_path, run_name="serve",
+                       tags={"replica": "rX"})
+    engine = EncodeEngine(_registry(), telemetry=tel).start()
+    engine.warmup()
+    try:
+        ctx = TraceContext(mint_trace_id(), parent_span="feedfacefeedface")
+        codes = engine.encode("d0", _rows(0), trace=ctx)
+        untraced = engine.encode("d0", _rows(1))
+        assert codes.shape == (3, N) and untraced.shape == (3, N)
+    finally:
+        engine.stop()
+    tel.snapshot()
+    tel.close()
+    recs = [json.loads(l)
+            for l in (tmp_path / "events.jsonl").read_text().splitlines()]
+    traces = [r for r in recs if r.get("event") == "request_trace"]
+    assert len(traces) == 1, "exactly the traced request gets a record"
+    rt = traces[0]
+    assert rt["trace_id"] == ctx.trace_id
+    assert rt["span_id"] == ctx.span_id
+    assert rt["parent_span"] == "feedfacefeedface"
+    assert rt["replica"] == "rX"  # telemetry tags stamp trace records too
+    assert rt["dict"] == "d0" and rt["rows"] == 3
+    phases = rt["phases"]
+    assert set(phases) == {"request_wait", "encode", "dequant"}
+    # the phases are real wall time: they sum to at most the request latency
+    assert 0 < sum(phases.values()) <= rt["latency_ms"] / 1e3 + 1e-6
+    # the batch spans name the member traces
+    tagged = [r for r in recs if r.get("event") == "span" and r.get("traces")]
+    cats = {r["category"] for r in tagged}
+    assert "request_wait" in cats and "encode" in cats
+    assert all(r["traces"] == [ctx.trace_id] for r in tagged)
+    # per-phase latency histograms observed (the /metrics export source)
+    snaps = [r for r in recs if r.get("event") == "snapshot"]
+    hists = snaps[-1].get("hists") or {}
+    assert "serve.latency_ms" in hists
+    assert hists["serve.latency_ms"]["count"] == 2  # traced AND untraced
+    assert "serve.phase.request_wait_ms" in hists
+    assert "serve.phase.encode_ms" in hists
+
+
+def test_handleless_broadcast_spans_carry_tags(tmp_path):
+    """ISSUE-14 satellite regression: spans emitted through the ACTIVE
+    broadcast path (spans.py → every live RunTelemetry) must carry the
+    telemetry's constant ``tags=`` exactly like directly-emitted events —
+    the report/monitor replica merge keys on them."""
+    from sparse_coding__tpu.telemetry import spans
+
+    tel = RunTelemetry(out_dir=tmp_path, run_name="t",
+                       tags={"replica": "replica9", "zone": "a"})
+    try:
+        with spans.span(spans.ACTIVE, "data_wait", "broadcast_probe"):
+            pass
+        direct = tel.event("probe_direct")
+    finally:
+        tel.close()
+    recs = [json.loads(l)
+            for l in (tmp_path / "events.jsonl").read_text().splitlines()]
+    broadcast = [r for r in recs if r.get("event") == "span"
+                 and r.get("name") == "broadcast_probe"]
+    assert broadcast, "broadcast span never landed"
+    for key in ("replica", "zone"):
+        assert broadcast[0].get(key) == direct.get(key), (
+            f"broadcast span dropped tag {key!r}"
+        )
+
+
+# -- golden fixture: reconstruction + CLI ------------------------------------
+
+
+def _golden_records():
+    from sparse_coding__tpu.telemetry.goodput import load_streams
+
+    return [r for s in load_streams(GOLDEN_TRACED) for r in s["records"]]
+
+
+def test_collect_traces_golden():
+    traces = collect_traces(_golden_records())
+    assert len(traces) == 3
+    retried = traces[TRACE_RETRIED]
+    assert len(retried["attempts"]) == 2
+    assert [a["replica"] for a in retried["attempts"]] == [
+        "replica0", "replica1"
+    ]
+    assert len(retried["requests"]) == 1
+    # the replica record is parented on the WINNING attempt's span
+    assert retried["requests"][0]["parent_span"] == (
+        retried["attempts"][1]["span_id"]
+    )
+    s = trace_summary(TRACE_RETRIED, retried)
+    assert s["replicas"] == ["replica0", "replica1"]
+    assert s["winner"] == "replica1"
+    assert s["n_attempts"] == 2
+    assert s["total_seconds"] == pytest.approx(0.080, abs=0.002)
+    assert set(s["phases"]) == {"forward", "request_wait", "encode"}
+
+
+def test_render_trace_golden_pins_tree():
+    traces = collect_traces(_golden_records())
+    out = render_trace(TRACE_RETRIED, traces[TRACE_RETRIED])
+    assert "2 attempt(s)" in out
+    assert "forward attempt 0 → replica0  [error:ConnectionResetError]" in out
+    assert "forward attempt 1 → replica1  [200]" in out
+    assert "retry gap 50.0 ms" in out
+    assert "replica replica1 dict d0" in out
+    assert "winner: replica1" in out
+
+
+def test_render_slowest_explains_tail():
+    traces = collect_traces(_golden_records())
+    out = render_slowest(traces, 2)
+    # tail order: the retried request (80 ms) then the crowded-bucket one
+    assert out.index("aaaa1111") < out.index("cccc3333")
+    assert "bbbb2222" not in out  # N=2 keeps the fast one out
+    assert "tail time by phase:" in out
+    assert "request_wait" in out and "gap" in out
+
+
+def test_trace_cli_exit_codes(tmp_path, capsys):
+    assert trace_main([str(GOLDEN_TRACED), "--trace-id", "aaaa"]) == 0
+    assert "winner: replica1" in capsys.readouterr().out
+    assert trace_main([str(GOLDEN_TRACED), "--trace-id", "ffff"]) == 2
+    capsys.readouterr()
+    assert trace_main([str(GOLDEN_TRACED), "--slowest", "3"]) == 0
+    assert "tail time by phase:" in capsys.readouterr().out
+    assert trace_main([str(GOLDEN_TRACED)]) == 0  # inventory mode
+    capsys.readouterr()
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_main([str(empty)]) == 3
+    capsys.readouterr()
+    rc = trace_main([str(GOLDEN_TRACED), "--trace-id", "aaaa", "--json"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["winner"] == "replica1"
+
+
+def test_chrome_trace_gains_per_request_tracks():
+    from sparse_coding__tpu.telemetry.goodput import build_ledger, to_chrome_trace
+
+    trace = to_chrome_trace(build_ledger(GOLDEN_TRACED))
+    assert trace["metadata"]["n_traces"] == 3
+    procs = [e for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any(e["args"]["name"].startswith("requests") for e in procs)
+    request_events = [e for e in trace["traceEvents"]
+                      if e["ph"] == "X" and e["pid"] == -2]
+    by_trace = {}
+    for e in request_events:
+        by_trace.setdefault(e["args"]["trace_id"], []).append(e)
+    assert set(by_trace) == {
+        TRACE_RETRIED,
+        "bbbb2222bbbb2222bbbb2222bbbb2222",
+        "cccc3333cccc3333cccc3333cccc3333",
+    }
+    # the retried trace's track shows both forward attempts
+    retried_names = {e["name"] for e in by_trace[TRACE_RETRIED]}
+    assert any("attempt" in n for n in retried_names)
+    # replica-side batch spans carry the replica in the track name
+    assert any("@replica1" in n for n in retried_names)
+
+
+# -- chaos acceptance --------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_traced_retry_spans_both_replicas_chaos(tmp_path):
+    """THE ISSUE-14 chaos acceptance. 2 subprocess replicas behind the
+    router under closed-loop traced load; one replica is SIGKILLed
+    mid-flight. Asserts on the RETRIED request's reconstructed trace:
+
+      - child spans on BOTH replicas under one trace id;
+      - the winning attempt's replica matches the response's
+        ``X-Router-Replica``;
+      - the traced per-phase times sum to the client-observed latency
+        within 5% (+10 ms slack for the client→router hop the server-side
+        trace cannot see).
+    """
+    from sparse_coding__tpu.serve.replicaset import ReplicaSet
+    from sparse_coding__tpu.serve.router import Router, RouterClient
+    from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+    export_dir = tmp_path / "export"
+    export_dir.mkdir()
+    export = export_dir / "learned_dicts.pkl"
+    save_learned_dicts(export, [(_tied(0), {}), (_tied(1), {})])
+
+    run_dir = tmp_path / "tier"
+    router_tel = RunTelemetry(out_dir=run_dir, run_name="router",
+                              file_name="router_events.jsonl")
+    rs_tel = RunTelemetry(out_dir=run_dir, run_name="replicaset",
+                          file_name="replicaset_events.jsonl")
+    router = Router(
+        telemetry=router_tel, health_interval=0.25, dead_after=2,
+        max_attempts=4, retry_backoff=0.05, request_deadline=60.0,
+    )
+    rs = ReplicaSet(
+        [str(export)], n_replicas=2, run_dir=run_dir, router=router,
+        telemetry=rs_tel, max_batch=64, max_wait_ms=2.0,
+        backoff_base=0.2, backoff_max=2.0, poll_interval=0.1,
+        ready_timeout=180.0,
+        env={"JAX_PLATFORMS": "cpu", "SC_PREEMPT": "1"},
+    )
+    X = _rows(42)
+    results = []  # (trace_id, client_latency_s, meta)
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client_loop(cid: int):
+        client = RouterClient(router.address, timeout=60)
+        while not stop.is_set():
+            tid = mint_trace_id()
+            t0 = time.monotonic()
+            try:
+                _, meta = client.encode_with_meta(
+                    f"learned_dicts:{cid % 2}", X, trace=tid
+                )
+            except Exception:
+                time.sleep(0.02)
+                continue
+            with lock:
+                results.append((tid, time.monotonic() - t0, meta))
+
+    try:
+        rs.start()
+        router.start()
+        threads = [threading.Thread(target=client_loop, args=(c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+
+        def wait_results(n, timeout=120.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with lock:
+                    if len(results) >= n:
+                        return
+                time.sleep(0.05)
+            pytest.fail(f"load never produced {n} responses")
+
+        wait_results(16)  # warm: slice compiles + HTTP pools off the clock
+        victim = rs.replicas[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        # keep driving until some request visibly retried
+        deadline = time.time() + 60.0
+        retried = None
+        while time.time() < deadline and retried is None:
+            with lock:
+                for tid, lat, meta in results:
+                    if meta.get("attempts", 1) > 1:
+                        retried = (tid, lat, meta)
+                        break
+            time.sleep(0.05)
+        assert retried is not None, "SIGKILL never forced a visible retry"
+        with lock:
+            n_now = len(results)
+        wait_results(n_now + 8)  # traffic flows on across the healed set
+        stop.set()
+        for t in threads:
+            t.join(60)
+    finally:
+        stop.set()
+        rs.stop()
+        router.stop()
+        router_tel.close()
+        rs_tel.close()
+
+    tid, client_lat, meta = retried
+    traces = collect_traces(_collect_run_records(run_dir))
+    assert tid in traces, "retried request's trace never reconstructed"
+    s = trace_summary(tid, traces[tid])
+    # child spans on BOTH replicas under one trace id
+    assert len(s["replicas"]) >= 2, s
+    assert s["n_attempts"] >= 2, s
+    # the winner matches the response header
+    assert s["winner"] == meta["replica"], (s, meta)
+    # phase times sum to the client-observed latency within 5% (+10 ms for
+    # the client-side hop the server-side spans cannot see)
+    traced_total = sum(s["phases"].values()) + s["gap_seconds"]
+    assert traced_total == pytest.approx(
+        client_lat, rel=0.05, abs=0.010
+    ), (s, client_lat)
+    # and a plain (non-retried) warm request traces just as tight
+    with lock:
+        plain = next(
+            (r for r in results[8:]
+             if r[2].get("attempts", 1) == 1 and r[0] in traces),
+            None,
+        )
+    if plain is not None:
+        ps = trace_summary(plain[0], traces[plain[0]])
+        assert sum(ps["phases"].values()) + ps["gap_seconds"] == pytest.approx(
+            plain[1], rel=0.05, abs=0.010
+        )
+
+
+def _collect_run_records(run_dir):
+    from sparse_coding__tpu.telemetry.goodput import load_streams
+
+    return [r for s in load_streams(run_dir) for r in s["records"]]
