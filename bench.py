@@ -528,6 +528,144 @@ def prep_serve(stack, telemetry=None):
     return measure
 
 
+def prep_serve_wire(stack, telemetry=None):
+    """Wire-format serving keys (ISSUE 15, docs/SERVING.md "Wire formats"):
+    the SAME closed-loop HTTP load against one serve replica at the
+    n_feats=4096 geometry where the dense-JSON body dominates —
+
+      - ``serve_json_rows_per_sec``: dense JSON responses (the pre-ISSUE-15
+        wire format; every row ships 4096 decimal floats);
+      - ``serve_npz_rows_per_sec``: top-k sparse npz responses (k=16
+        indices+values computed INSIDE the compiled step — only k·rows
+        values cross device→host and the wire);
+      - ``serve_dense_json_bytes_per_row`` / ``serve_sparse_bytes_per_row``:
+        measured response bytes per served row for each (lower-is-better
+        perfdiff keys). The acceptance floor is sparse cutting ≥ 20x.
+
+    HTTP (not in-process) deliberately: JSON float serialization is host
+    CPU on the serving hot path — exactly the cost the binary format
+    exists to kill — so it must stay inside the measured window."""
+    import sys
+    from pathlib import Path
+
+    import numpy as np
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.registry import DictRegistry
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    scripts_dir = str(Path(__file__).resolve().parent / "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from loadgen import run_load
+
+    D, NF, K = 256, 4096, 16
+    rng = np.random.default_rng(21)
+    registry = DictRegistry()
+    for i in range(2):
+        registry.add(
+            f"w{i}",
+            TiedSAE(
+                jnp.asarray(rng.standard_normal((NF, D), dtype=np.float32)),
+                jnp.zeros((NF,)),
+            ),
+        )
+    srv = ServeServer(registry, max_batch=256, max_wait_ms=2.0,
+                      telemetry=telemetry).start()
+    stack.callback(srv.stop)
+    srv.engine.warmup(topk_ks=(K,))
+    client = srv.client()
+    # 8 closed-loop clients x 8 requests: measured stable on this host
+    # (16 clients bimodally starve the drainer's linger window on CPU)
+    load_kw = dict(
+        dict_ids=registry.ids(), n_clients=8, requests_per_client=8,
+        rows_per_request=2, width=D,
+        bytes_snapshot=client.bytes_snapshot,
+    )
+    json_fn = lambda d, r: client.encode(d, r, format="json")
+    npz_fn = lambda d, r: client.encode(d, r, format="npz", top_k=K)
+    # warm both paths (HTTP thread pools, codec imports) off the clock
+    run_load(json_fn, seed=4321, **load_kw)
+    run_load(npz_fn, seed=4321, **load_kw)
+    json_rounds: list = []
+    npz_rounds: list = []
+
+    def measure_json() -> float:
+        r = run_load(json_fn, seed=len(json_rounds), **load_kw)
+        json_rounds.append(r)
+        return r["rows_per_sec"]
+
+    def measure_npz() -> float:
+        r = run_load(npz_fn, seed=len(npz_rounds), **load_kw)
+        npz_rounds.append(r)
+        return r["rows_per_sec"]
+
+    # bytes keys read the SAME round's loads (dict order places them after
+    # their rows/s siblings in the interleaved loop) — no extra traffic
+    measure_json.bytes = lambda: json_rounds[-1]["response_bytes_per_row"]
+    measure_npz.bytes = lambda: npz_rounds[-1]["response_bytes_per_row"]
+    measure_json.rounds = json_rounds
+    measure_npz.rounds = npz_rounds
+    measure_json.k = K
+    measure_json.n_feats = NF
+    return measure_json, measure_npz
+
+
+def prep_features(stack, telemetry=None):
+    """``features_rows_per_sec`` (ISSUE 15): token rows/s through the fused
+    harvest→encode path — a random-init pythia-70m subject captured at
+    layer 2 residual feeding a 512→4096 dict, driven closed-loop through
+    the in-process engine (the HTTP hop is priced by the serve_* keys;
+    this key isolates the fused capture+encode dispatch)."""
+    import sys
+    from pathlib import Path
+
+    import numpy as np
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.engine import EncodeEngine
+    from sparse_coding__tpu.serve.registry import DictRegistry
+    from sparse_coding__tpu.serve.server import attach_subject_from_spec
+
+    scripts_dir = str(Path(__file__).resolve().parent / "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from loadgen import run_load
+
+    D, NF, S = 512, 4096, 32
+    rng = np.random.default_rng(31)
+    registry = DictRegistry()
+    registry.add(
+        "f0",
+        TiedSAE(
+            jnp.asarray(rng.standard_normal((NF, D), dtype=np.float32)),
+            jnp.zeros((NF,)),
+        ),
+    )
+    subj = attach_subject_from_spec(registry, "random:pythia-70m:2:residual")
+    engine = EncodeEngine(registry, max_batch=256, max_wait_ms=3.0,
+                          telemetry=telemetry).start()
+    stack.callback(engine.stop)
+    engine.warmup()
+    engine.warmup_features(S)
+    payload_fn = lambda r: np.asarray(
+        r.integers(0, subj.lm_cfg.vocab_size, size=(2, S)), dtype=np.int32
+    )
+    load_kw = dict(
+        dict_ids=["f0"], n_clients=8, requests_per_client=4,
+        rows_per_request=2, width=D,
+        payload_fn=payload_fn,
+        rows_of=lambda p: int(p.shape[0]) * int(p.shape[1]),
+    )
+    fn = lambda d, toks: engine.encode_features(d, toks)
+    run_load(fn, seed=77, **load_kw)  # warm
+
+    def measure() -> float:
+        return run_load(fn, seed=0, **load_kw)["rows_per_sec"]
+
+    return measure
+
+
 def prep_router(stack, telemetry=None):
     """Router overhead (ISSUE 13, docs/SERVING.md): rows/s of the SAME
     closed-loop HTTP load through `serve.router.Router` → replica vs
@@ -833,6 +971,14 @@ def main(argv=None):
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure
         benches["serve_naive_rows_per_sec"] = serve_measure.naive
+        wire_json, wire_npz = prep_serve_wire(stack, telemetry=telemetry)
+        benches["serve_json_rows_per_sec"] = wire_json
+        benches["serve_dense_json_bytes_per_row"] = wire_json.bytes
+        benches["serve_npz_rows_per_sec"] = wire_npz
+        benches["serve_sparse_bytes_per_row"] = wire_npz.bytes
+        benches["features_rows_per_sec"] = prep_features(
+            stack, telemetry=telemetry
+        )
         router_measure = prep_router(stack, telemetry=telemetry)
         benches["router_rows_per_sec"] = router_measure
         benches["router_direct_rows_per_sec"] = router_measure.direct
@@ -922,6 +1068,31 @@ def main(argv=None):
                 stats["rows"] / max(1, stats["rows"] + stats["padded_rows"]), 3
             ),
             "compiled_steps": len(serve_measure.engine.compiled_shapes),
+        }
+    # wire block (ISSUE 15, docs/SERVING.md "Wire formats & sparse
+    # responses"): the bytes/row evidence behind the ≥20x acceptance —
+    # dense JSON vs top-k npz at n_feats 4096, measured on real HTTP
+    # responses, plus the sparse-vs-dense throughput ratio
+    if medians.get("serve_dense_json_bytes_per_row") and medians.get(
+        "serve_sparse_bytes_per_row"
+    ):
+        out["serve_wire"] = {
+            "k": wire_json.k,
+            "n_feats": wire_json.n_feats,
+            "dense_json_bytes_per_row": round(
+                medians["serve_dense_json_bytes_per_row"], 1
+            ),
+            "sparse_npz_bytes_per_row": round(
+                medians["serve_sparse_bytes_per_row"], 1
+            ),
+            "bytes_per_row_ratio": round(
+                medians["serve_dense_json_bytes_per_row"]
+                / medians["serve_sparse_bytes_per_row"], 1
+            ),
+            "npz_speedup_vs_json": round(
+                medians["serve_npz_rows_per_sec"]
+                / medians["serve_json_rows_per_sec"], 2
+            ) if medians.get("serve_json_rows_per_sec") else None,
         }
     # router block (docs/SERVING.md "Replicas"): the overhead ratio the
     # replica-tier acceptance pins at >= 0.8x, plus the router's own

@@ -96,6 +96,9 @@ def run_load(
     histogram: bool = False,
     with_meta: bool = False,
     traced: bool = False,
+    payload_fn: Optional[Callable[[np.random.Generator], np.ndarray]] = None,
+    rows_of: Optional[Callable[[np.ndarray], int]] = None,
+    bytes_snapshot: Optional[Callable[[], Dict[str, int]]] = None,
 ) -> Dict[str, Any]:
     """Closed-loop load: ``n_clients`` threads, each sending
     ``requests_per_client`` encodes of ``rows_per_request`` rows round-robin
@@ -115,12 +118,26 @@ def run_load(
     ``per_request`` list of ``{"trace_id", "latency_ms", "outcome",
     "attempts", "replica"}`` records — join them against ``python -m
     sparse_coding__tpu.trace`` on the server-side run dir to explain any
-    individual latency. Returns the stats blob described in the module
-    docstring."""
+    individual latency.
+
+    ``payload_fn(rng)`` overrides payload generation (the /features path
+    sends int token rows, not float activations) with ``rows_of(payload)``
+    naming how many encoded rows a payload produces (token payloads expand
+    to ``n_seq × seq_len``). ``bytes_snapshot`` (e.g. a `ServeClient
+    .bytes_snapshot` bound method) is sampled before/after the run and the
+    delta lands in the result as ``request_bytes`` / ``response_bytes`` +
+    per-request/row rates — the ISSUE-15 bytes-per-row evidence. Returns
+    the stats blob described in the module docstring."""
     rng = np.random.default_rng(seed)
+    if payload_fn is None:
+        payload_fn = lambda r: r.standard_normal(
+            (rows_per_request, width)
+        ).astype(np.float32)
+    if rows_of is None:
+        rows_of = lambda p: int(p.shape[0])
     # pre-generate request payloads so generation cost never pollutes timing
     payloads = [
-        rng.standard_normal((rows_per_request, width)).astype(np.float32)
+        payload_fn(rng)
         for _ in range(min(64, n_clients * requests_per_client))
     ]
     if traced:
@@ -169,7 +186,7 @@ def run_load(
                 counts["ok"] += 1
                 if with_meta and int(meta.get("attempts", 1) or 1) > 1:
                     counts["retried_ok"] += 1
-                counts["rows"] += rows.shape[0]
+                counts["rows"] += rows_of(rows)
                 if traced:
                     rec = {
                         "trace_id": trace_id,
@@ -185,12 +202,14 @@ def run_load(
         threading.Thread(target=client, args=(c,), name=f"loadgen-{c}")
         for c in range(n_clients)
     ]
+    bytes_before = bytes_snapshot() if bytes_snapshot else None
     t0 = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.monotonic() - t0
+    bytes_after = bytes_snapshot() if bytes_snapshot else None
     out: Dict[str, Any] = {
         "clients": n_clients,
         "requests": counts["ok"],
@@ -204,6 +223,21 @@ def run_load(
         "requests_per_sec": round(counts["ok"] / wall, 1) if wall > 0 else 0.0,
         **latency_stats(latencies),
     }
+    if bytes_before is not None:
+        sent = bytes_after["bytes_sent"] - bytes_before["bytes_sent"]
+        recv = bytes_after["bytes_received"] - bytes_before["bytes_received"]
+        out["request_bytes"] = int(sent)
+        out["response_bytes"] = int(recv)
+        # per-request/row rates only for a fully-clean run: the byte
+        # counters see EVERY round trip (shed/error bodies, each retry
+        # attempt), so dividing them by ok-rows under failures would
+        # inflate the bytes/row evidence — totals stay, rates go honest
+        failures = (
+            counts["rejected"] + counts["shed"] + counts["errors"]
+        )
+        if counts["ok"] and not failures:
+            out["response_bytes_per_request"] = round(recv / counts["ok"], 1)
+            out["response_bytes_per_row"] = round(recv / counts["rows"], 1)
     if histogram:
         out["histogram"] = latency_histogram(latencies)
     if traced:
@@ -237,6 +271,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "the loaded export)")
     ap.add_argument("--max-batch", type=int, default=256,
                     help="in-process engine batch budget")
+    ap.add_argument("--format", choices=("json", "npz", "raw"),
+                    default="json",
+                    help="wire format for request AND response bodies "
+                    "(serve.wire; HTTP modes only)")
+    ap.add_argument("--endpoint", choices=("encode", "features"),
+                    default="encode",
+                    help="drive POST /encode (activation rows) or POST "
+                    "/features (raw tokens through the fused subject-LM "
+                    "capture→encode path)")
+    ap.add_argument("--top-k", type=int, default=None, dest="top_k",
+                    help="request sparse top-k responses (indices + values "
+                    "instead of dense codes)")
+    ap.add_argument("--seq-len", type=int, default=32,
+                    help="features: tokens per sequence")
+    ap.add_argument("--seqs", type=int, default=1,
+                    help="features: sequences per request")
+    ap.add_argument("--subject", default=None, metavar="SPEC",
+                    help="in-process mode: attach a subject LM "
+                    "('random:<model>:<layer>:<loc>[:seed]', see "
+                    "serve.server --subject) for --endpoint features")
     ap.add_argument("--naive", action="store_true",
                     help="in-process mode: drive the naive per-request path "
                     "instead of the micro-batched engine")
@@ -254,6 +308,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
+    fmt, top_k = args.format, args.top_k
+
+    def feature_payloads(vocab: int):
+        payload_fn = lambda r: np.asarray(
+            r.integers(0, int(vocab), size=(args.seqs, args.seq_len)),
+            dtype=np.int32,
+        )
+        rows_of = lambda p: int(p.shape[0]) * int(p.shape[1])
+        return payload_fn, rows_of
+
+    def http_fns(client):
+        """(encode_fn, load kwargs) for an HTTP client at the chosen
+        endpoint/format — bytes accounted through the client's counters."""
+        extra: Dict[str, Any] = {
+            "bytes_snapshot": client.bytes_snapshot,
+        }
+        if args.endpoint == "features":
+            subjects = client.subjects()
+            if not subjects:
+                ap.error("server has no subject LM attached — "
+                         "/features unavailable (serve.server --subject)")
+            payload_fn, rows_of = feature_payloads(subjects[0]["vocab_size"])
+            extra.update(payload_fn=payload_fn, rows_of=rows_of)
+            fn = lambda d, toks, t=None: client.encode_features(
+                d, tokens=toks, format=fmt, top_k=top_k, trace=t
+            )
+            return fn, extra
+        fn = lambda d, r, t=None: client.encode(
+            d, r, format=fmt, top_k=top_k, trace=t
+        )
+        return fn, extra
+
     if args.targets:
         from sparse_coding__tpu.serve.router import Router
 
@@ -266,15 +352,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     d["activation_size"] for d in client.dicts()
                     if d["dict"] == dicts[0]
                 )
-            encode_fn = (
-                (lambda d, r, t: client.encode_with_meta(d, r, trace=t))
-                if args.trace else client.encode_with_meta
-            )
+            with_meta = args.endpoint == "encode"
+            if with_meta:
+                fn = lambda d, r, t=None: client.encode_with_meta(
+                    d, r, trace=t, format=fmt, top_k=top_k
+                )
+                extra = {"bytes_snapshot": client.bytes_snapshot}
+            else:
+                fn, extra = http_fns(client)
+            encode_fn = fn if args.trace else (lambda d, r: fn(d, r))
             result = run_load(
                 encode_fn, dicts, n_clients=args.clients,
                 requests_per_client=args.requests, rows_per_request=args.rows,
-                width=width, seed=args.seed, histogram=True, with_meta=True,
-                traced=args.trace,
+                width=width, seed=args.seed, histogram=True,
+                with_meta=with_meta, traced=args.trace, **extra,
             )
             result["router"] = dict(router.stats)
             result["replica_states"] = router.states()
@@ -289,14 +380,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 d["activation_size"] for d in client.dicts()
                 if d["dict"] == dicts[0]
             )
-        encode_fn = (
-            (lambda d, r, t: client.encode(d, r, trace=t))
-            if args.trace else client.encode
-        )
+        fn, extra = http_fns(client)
+        encode_fn = fn if args.trace else (lambda d, r: fn(d, r))
         result = run_load(
             encode_fn, dicts, n_clients=args.clients,
             requests_per_client=args.requests, rows_per_request=args.rows,
             width=width, seed=args.seed, histogram=True, traced=args.trace,
+            **extra,
         )
     else:
         from sparse_coding__tpu.serve.engine import EncodeEngine
@@ -304,26 +394,44 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         registry = DictRegistry()
         registry.load_export(args.export)
+        if args.subject:
+            from sparse_coding__tpu.serve.server import attach_subject_from_spec
+
+            attach_subject_from_spec(registry, args.subject)
         dicts = args.dicts or registry.ids()
         width = args.width or registry.get(dicts[0]).activation_size
         engine = EncodeEngine(registry, max_batch=args.max_batch).start()
-        engine.warmup()
+        engine.warmup(topk_ks=() if top_k is None else (top_k,))
         try:
-            if args.naive:
-                encode_fn, traced = engine.encode_naive, False
-            elif args.trace:
+            extra = {}
+            traced = bool(args.trace)
+            if args.trace:
                 from sparse_coding__tpu.telemetry.tracing import TraceContext
-
-                def encode_fn(d, r, t):
-                    return engine.encode(d, r, trace=TraceContext(t))
-
-                traced = True
+            if args.endpoint == "features":
+                subj = registry.get_subject()
+                payload_fn, rows_of = feature_payloads(subj.lm_cfg.vocab_size)
+                extra.update(payload_fn=payload_fn, rows_of=rows_of)
+                engine.warmup_features(
+                    args.seq_len, topk_ks=() if top_k is None else (top_k,)
+                )
+                def encode_fn(d, toks, t=None):
+                    tr = TraceContext(t) if (traced and t) else None
+                    return engine.encode_features(d, toks, trace=tr,
+                                                  top_k=top_k)
+            elif args.naive:
+                encode_fn, traced = (
+                    lambda d, r: engine.encode_naive(d, r, top_k=top_k),
+                    False,
+                )
             else:
-                encode_fn, traced = engine.encode, False
+                def encode_fn(d, r, t=None):
+                    tr = TraceContext(t) if (traced and t) else None
+                    return engine.encode(d, r, trace=tr, top_k=top_k)
             result = run_load(
                 encode_fn, dicts, n_clients=args.clients,
                 requests_per_client=args.requests, rows_per_request=args.rows,
                 width=width, seed=args.seed, histogram=True, traced=traced,
+                **extra,
             )
         finally:
             engine.stop()

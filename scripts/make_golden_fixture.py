@@ -449,6 +449,10 @@ def make_serve_run_fixture():
             T + 0.1 + 0.01 * i, "serve_dict_added", dict=f"d{i}",
             weights="native", source="out/learned_dicts.pkl",
         ))
+    events.append(rec(
+        T + 0.2, "serve_subject_attached", subject="subject", layer=2,
+        layer_loc="residual", activation_size=512,
+    ))
     # 12 micro-batches over ~6 s: each 8 requests x 2 rows -> bucket 16
     t = T + 1.0
     for b in range(12):
@@ -465,12 +469,29 @@ def make_serve_run_fixture():
     events.append(span_rec(t, 0.006, "dequant", "dequant_int8", lanes=4))
     events.append(span_rec(t, 0.040, "encode", "encode_g4_b16",
                            lanes=4, rows=16, bucket=16, n_requests=8))
+    # one sparse top-k batch (k rides the encode span) and one fused
+    # /features batch (2 sequences x 32 tokens through the subject LM) —
+    # the ISSUE-15 event shapes the report/monitor must keep rendering
+    events.append(span_rec(t + 0.5, 0.022, "encode", "encode_g4_b16",
+                           lanes=4, rows=16, bucket=16, n_requests=8, k=16))
+    events.append(span_rec(t + 1.0, 0.055, "encode", "features_g4_s2x32",
+                           lanes=4, rows=64, bucket=64, n_requests=1,
+                           subject="subject"))
     counters = {
         "serve.requests": 96, "serve.rows": 192, "serve.batches": 13,
         "serve.padded_rows": 16, "serve.rejected": 2, "serve.errors": 0,
         "serve.compiles": 3,
+        # wire accounting (ISSUE 15): per-format requests + bytes, sparse
+        # and fused-features traffic — the report's wire lines read these
+        "serve.requests.json": 64, "serve.requests.npz": 24,
+        "serve.requests.raw": 8,
+        "serve.bytes_out.json": 6553600, "serve.bytes_out.npz": 28672,
+        "serve.bytes_out.raw": 6144,
+        "serve.bytes_in.json": 262144, "serve.bytes_in.npz": 40960,
+        "serve.bytes_in.raw": 8192,
+        "serve.sparse_requests": 32, "serve.feature_requests": 8,
         "span.request_wait.count": 12, "span.request_wait.seconds": 0.048,
-        "span.encode.count": 13, "span.encode.seconds": 0.412,
+        "span.encode.count": 15, "span.encode.seconds": 0.489,
         "span.dequant.count": 1, "span.dequant.seconds": 0.006,
     }
     gauges = {
@@ -498,11 +519,32 @@ def make_serve_run_fixture():
         "serve_rows_per_sec_spread": [395.0, 445.0],
         "serve_naive_rows_per_sec": 100.0,
         "serve_naive_rows_per_sec_spread": [92.0, 110.0],
+        # wire-format keys (ISSUE 15): r06 CPU-floor medians. The bytes
+        # keys are LOWER-is-better (perfdiff gates them inverted); the
+        # ~86x dense-JSON/sparse-npz ratio at n_feats 4096 is the
+        # measured acceptance evidence, schema-pinned here.
+        "serve_json_rows_per_sec": 210.0,
+        "serve_json_rows_per_sec_spread": [194.0, 218.0],
+        "serve_npz_rows_per_sec": 400.0,
+        "serve_npz_rows_per_sec_spread": [380.0, 424.0],
+        "serve_dense_json_bytes_per_row": 50200.0,
+        "serve_dense_json_bytes_per_row_spread": [50150.0, 50250.0],
+        "serve_sparse_bytes_per_row": 585.0,
+        "serve_sparse_bytes_per_row_spread": [580.0, 590.0],
+        "features_rows_per_sec": 2700.0,
+        "features_rows_per_sec_spread": [2600.0, 2900.0],
         "serve": {
             "p50_ms": 8.3, "p95_ms": 14.9, "p99_ms": 21.4,
             "requests_per_sec": 210.0, "speedup_vs_naive": 4.2,
             "n_dicts": 4, "batch_budget": 128, "batch_occupancy": 0.875,
             "compiled_steps": 3,
+        },
+        "serve_wire": {
+            "k": 16, "n_feats": 4096,
+            "dense_json_bytes_per_row": 50200.0,
+            "sparse_npz_bytes_per_row": 585.0,
+            "bytes_per_row_ratio": 85.8,
+            "npz_speedup_vs_json": 1.9,
         },
     }
     with open(SERVE_RUN_DIR / "bench_serve_fixture.json", "w") as f:

@@ -157,6 +157,19 @@ def _cast_params_jit(params, compute_dtype):
     the bf16 win it exists to buy."""
     return _cast_params(params, compute_dtype)
 
+def capture_fn(lm_cfg: lm_model.LMConfig, names: Sequence[str], stop_at: int,
+               compute_dtype=None, attn: str = "dense"):
+    """PUBLIC handle on the harvest pipeline's compiled capture forward
+    (`_jitted_capture` — lru-cached, fp16-cast on device). The serving
+    tier's fused ``/features`` path (`serve.engine`) runs THIS executable,
+    so its activations are bit-identical to what `make_activation_dataset`
+    / `harvest_to_device` produce for the same token batch — the
+    harvest→encode fusion contract is structural, not numerical luck."""
+    return _jitted_capture(
+        lm_cfg, tuple(names), int(stop_at), _canon_dtype(compute_dtype), attn
+    )
+
+
 def _probe_activation_size(lm_cfg, name: str, stop_at: int, seq_len: int) -> int:
     """Width of an arbitrary qualified hook point, WITHOUT running the model:
     `jax.eval_shape` traces the capture forward on abstract values. This is

@@ -23,6 +23,14 @@ chip, so the verdict is spread- and weather-aware:
 Only keys carrying a ``<key>_spread`` sibling participate (the measured
 medians); derived scalars (mfu, ratios) and metadata are ignored. The
 control key itself is reported but never gates — it IS the weather.
+
+**Direction** (ISSUE 15): keys are higher-is-better (throughputs) unless
+they end in a `LOWER_IS_BETTER_SUFFIXES` suffix (``_bytes_per_row``,
+``_bytes_per_request``, ``_bytes``, ``_ms`` — sizes and latencies), which
+gate inverted: a regression is the new median rising ABOVE the old spread
+max. Weather scaling inverts with them (a slow chip legitimately raises
+latencies by 1/ratio; wire sizes don't move with weather, but the control
+ratio is ~1 across sessions so the correction is benign).
 """
 
 from __future__ import annotations
@@ -36,6 +44,15 @@ __all__ = ["load_bench", "compare", "render_table", "main"]
 
 CONTROL_KEY = "control_matmul_tflops"
 DEFAULT_THRESHOLD = 0.05  # fraction below the weather-scaled old worst round
+
+# size/latency keys gate in the opposite direction: UP is a regression
+LOWER_IS_BETTER_SUFFIXES = (
+    "_bytes_per_row", "_bytes_per_request", "_bytes", "_ms",
+)
+
+
+def lower_is_better(key: str) -> bool:
+    return key.endswith(LOWER_IS_BETTER_SUFFIXES)
 
 
 def load_bench(path) -> Dict[str, Any]:
@@ -105,10 +122,23 @@ def compare(
             nv = float(nv)
             row["new"] = nv
             row["delta"] = nv / old_med - 1.0 if old_med else None
-            adj = (nv / ratio) if ratio > 0 else nv
+            inverted = lower_is_better(key)
+            # weather correction: a slow chip deflates throughputs (divide
+            # by ratio to compare) and inflates latencies (multiply)
+            adj = (nv * ratio) if inverted else (nv / ratio if ratio > 0 else nv)
             row["adj_delta"] = adj / old_med - 1.0 if old_med else None
             if key == control_key:
                 row["status"] = "control"
+            elif inverted:
+                scale = (1.0 / ratio) if ratio > 0 else 1.0
+                if nv > hi * scale * (1.0 + threshold):
+                    row["status"] = "regressed"
+                    regressions.append(key)
+                elif nv < lo * scale * (1.0 - threshold):
+                    row["status"] = "improved"
+                    improvements.append(key)
+                else:
+                    row["status"] = "ok"
             elif nv < lo * ratio * (1.0 - threshold):
                 row["status"] = "regressed"
                 regressions.append(key)

@@ -13,9 +13,16 @@ star (docs/SERVING.md). Three layers:
     batch-size buckets (no per-request recompiles), multi-dict multi-tenancy
     through the same vmapped fan-out the eval metrics use, per-request
     slicing back out.
-  - `serve.server` — a stdlib `ThreadingHTTPServer` JSON API (``/encode``,
-    ``/dicts``, ``/healthz``) with graceful SIGTERM drain riding the PR-5
-    preemption machinery, plus `ServeClient` for tests and `loadgen`.
+  - `serve.wire` — the wire-format codec layer (ISSUE 15): JSON / npz /
+    raw little-endian payloads with content negotiation and exact dtype
+    round trips; responses can be dense codes or in-compiled-step top-k
+    sparse (indices + values), and `POST /features` runs raw tokens
+    through the fused subject-LM capture→encode path
+    (`DictRegistry.attach_subject`).
+  - `serve.server` — a stdlib `ThreadingHTTPServer` API (``/encode``,
+    ``/features``, ``/dicts``, ``/healthz``) with graceful SIGTERM drain
+    riding the PR-5 preemption machinery, plus `ServeClient` for tests
+    and `loadgen`.
   - `serve.router` — the fault-tolerant replica front-end (ISSUE 13):
     live/draining/suspect/dead replica tracking from heartbeat probes +
     per-request outcomes, retry-against-a-different-replica on the shared
@@ -37,6 +44,7 @@ __all__ = [
     "ServeClient",
     "ServeServer",
     "ShedRejection",
+    "SubjectLM",
 ]
 
 _EXPORTS = {
@@ -49,6 +57,7 @@ _EXPORTS = {
     "ServeClient": "sparse_coding__tpu.serve.server",
     "ServeServer": "sparse_coding__tpu.serve.server",
     "ShedRejection": "sparse_coding__tpu.serve.router",
+    "SubjectLM": "sparse_coding__tpu.serve.registry",
 }
 
 

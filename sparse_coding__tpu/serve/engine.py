@@ -28,16 +28,37 @@ absmax tier (`data.chunks`), fp16 intermediate, cast back to the native
 dtype — under a ``dequant`` span, so the report attributes residency's
 bandwidth cost honestly.
 
+**Sparse top-k responses** (ISSUE 15): a request may carry ``top_k=k`` —
+the top-k (indices + values) of each row's code is then computed INSIDE
+the compiled vmapped step (`jax.lax.top_k` fused into the encode program),
+so only ``k × rows`` values cross device→host instead of
+``n_feats × rows``. ``k`` is clamped to the dict's ``n_feats`` and rounded
+up to a power-of-two *k-bucket* for dispatch (the per-request slice
+restores the exact k), so the compiled-step cache stays bounded at
+``groups × buckets × k-buckets``. Sparse values are bit-identical to the
+dense codes at those indices (tests/test_wire.py pins it).
+
+**Harvest→encode fusion** (ISSUE 15): with a `SubjectLM` attached to the
+registry, `submit_features` accepts raw token rows and runs subject-LM
+capture + dict encode in ONE engine dispatch with the activations
+HBM-resident throughout — the capture executable IS the harvest
+pipeline's (`data.activations.capture_fn`: hook name, early exit,
+on-device fp16 cast) and the encode executable IS /encode's, so the
+fused output bit-matches a two-step harvest-then-encode through the fp16
+chunk tier *structurally*. Feature requests ride the same queue/drainer,
+micro-batched by (subject, dict group, seq_len) and padded to
+power-of-two sequence-count buckets.
+
 Observability: ``request_wait`` / ``encode`` / ``dequant`` spans per
 micro-batch, ``serve.*`` counters (requests, rows, batches, padded rows,
-rejected, errors, compiles) and gauges (queue depth, batch occupancy,
-latency p50/p95/p99) on the telemetry bus — `monitor` renders them live,
-`report` renders the Serving section from them. Requests carrying a
-`telemetry.tracing.TraceContext` additionally get per-request
-``request_trace`` records (exact per-phase seconds + batch context) and
-the batch spans a ``traces`` tag; per-phase latency histograms
-(``serve.latency_ms``, ``serve.phase.*_ms`` — fixed log-spaced buckets)
-feed the ``/metrics`` exposition (docs/observability.md §8).
+rejected, errors, compiles, sparse_requests, feature_requests) and gauges
+(queue depth, batch occupancy, latency p50/p95/p99) on the telemetry bus —
+`monitor` renders them live, `report` renders the Serving section from
+them. Requests carrying a `telemetry.tracing.TraceContext` additionally
+get per-request ``request_trace`` records (exact per-phase seconds + batch
+context) and the batch spans a ``traces`` tag; per-phase latency
+histograms (``serve.latency_ms``, ``serve.phase.*_ms`` — fixed log-spaced
+buckets) feed the ``/metrics`` exposition (docs/observability.md §8).
 """
 
 from __future__ import annotations
@@ -45,13 +66,17 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["EncodeEngine", "EngineClosed", "EncodeRequest", "default_buckets"]
+__all__ = [
+    "EncodeEngine", "EngineClosed", "EncodeRequest", "default_buckets",
+    "k_bucket",
+]
 
 
 class EngineClosed(RuntimeError):
@@ -68,6 +93,27 @@ def default_buckets(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
         b *= 2
     out.append(int(max_batch))
     return tuple(out)
+
+
+def _pow2_ceil(n: int) -> int:
+    """Smallest power of two ≥ max(1, n) — THE rounding rule every padded
+    dispatch dimension shares (batch k-buckets, warmup menus, feature
+    sequence buckets), so the warmed shape menu and runtime dispatch
+    provably agree."""
+    n = max(1, int(n))
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def k_bucket(k: int, n_feats: int) -> int:
+    """Dispatch-time k for a requested top-k: the next power of two ≥ k,
+    capped at ``n_feats`` — so varied client ks hit a bounded compiled-step
+    menu and the per-request slice restores the exact k (top-k output is
+    sorted descending; the first k of a larger-K top are THE top-k)."""
+    k = max(1, min(int(k), int(n_feats)))
+    return min(_pow2_ceil(k), int(n_feats))
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -96,24 +142,44 @@ def _emit_span(telemetry, category: str, name: str, ts_start: float,
 class EncodeRequest:
     """One in-flight encode: rows in, codes (or an error) out. ``trace``
     (a `telemetry.tracing.TraceContext`, optional) rides along so the
-    engine can emit this request's per-phase ``request_trace`` record."""
+    engine can emit this request's per-phase ``request_trace`` record.
+
+    ``top_k`` (already clamped by submit) makes the result a sparse
+    ``(indices, values)`` pair instead of a dense codes array. ``kind`` is
+    ``"encode"`` (``rows`` = activation rows) or ``"features"`` (``rows``
+    = int32 token rows ``[n_seq, seq_len]``, ``subject`` names the
+    attached `SubjectLM`)."""
 
     __slots__ = ("dict_id", "rows", "t_enqueue_mono", "t_enqueue_wall",
-                 "done", "codes", "error", "latency_ms", "trace", "wait_s")
+                 "done", "codes", "error", "latency_ms", "trace", "wait_s",
+                 "top_k", "kind", "subject")
 
-    def __init__(self, dict_id: str, rows: np.ndarray, trace=None):
+    def __init__(self, dict_id: str, rows: np.ndarray, trace=None,
+                 top_k: Optional[int] = None, kind: str = "encode",
+                 subject: Optional[str] = None):
         self.dict_id = dict_id
         self.rows = rows
         self.trace = trace
+        self.top_k = top_k
+        self.kind = kind
+        self.subject = subject
         self.t_enqueue_mono = time.monotonic()
         self.t_enqueue_wall = time.time()
         self.done = threading.Event()
-        self.codes: Optional[np.ndarray] = None
+        self.codes = None  # dense np array | (indices, values) when sparse
         self.error: Optional[BaseException] = None
         self.latency_ms: Optional[float] = None
         self.wait_s: Optional[float] = None  # enqueue → batch drain
 
-    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+    @property
+    def cost_rows(self) -> int:
+        """Activation rows this request costs the batch budget: token
+        requests expand to ``n_seq × seq_len`` encoded rows."""
+        if self.kind == "features":
+            return int(self.rows.shape[0]) * int(self.rows.shape[1])
+        return int(self.rows.shape[0])
+
+    def result(self, timeout: Optional[float] = None):
         if not self.done.wait(timeout):
             raise TimeoutError(
                 f"encode request for {self.dict_id!r} timed out after {timeout}s"
@@ -122,8 +188,7 @@ class EncodeRequest:
             raise self.error
         return self.codes
 
-    def _resolve(self, codes: Optional[np.ndarray],
-                 error: Optional[BaseException] = None) -> None:
+    def _resolve(self, codes, error: Optional[BaseException] = None) -> None:
         self.codes = codes
         self.error = error
         self.latency_ms = (time.monotonic() - self.t_enqueue_mono) * 1e3
@@ -140,15 +205,45 @@ def _vmapped_encode_impl(stacked_ld, batch):
 _vmapped_encode = jax.jit(_vmapped_encode_impl)
 
 
+# sparse variant: lax.top_k FUSED into the same compiled program, so the
+# dense [G, B, n_feats] codes never leave the device — only k·rows indices
+# + values are materialized for fetch (the ISSUE-15 device→host win)
+@partial(jax.jit, static_argnames=("k",))
+def _vmapped_encode_topk(stacked_ld, batch, k: int):
+    codes = _vmapped_encode_impl(stacked_ld, batch)
+    values, indices = jax.lax.top_k(codes, k)
+    return indices.astype(jnp.int32), values
+
+
+# fused harvest→encode (ISSUE 15): subject-LM capture + dict encode in one
+# ENGINE dispatch, composed from the exact compiled programs the two-step
+# pipeline runs — `data.activations.capture_fn` (the harvest forward:
+# lru-cached jit, early exit, ON-DEVICE fp16 cast = the chunk store's
+# dtype) feeding `_vmapped_encode(_topk)` (the /encode step). The captured
+# activations never leave HBM between the two programs — the fusion win is
+# the killed device→host→device round trip — and because both executables
+# are SHARED with harvest and /encode, bit-equality with the two-step
+# pipeline is structural (a single merged XLA program measurably re-tiles
+# the dots at d_model ≥ 128 and breaks the bit-match contract).
+
+
+# request-row dtypes the engine serves verbatim (the dtype round-trip
+# contract, ISSUE 15): anything else — JSON lists arrive f64 — coerces to
+# f32, the pre-binary-wire behavior
+_NATIVE_ROW_DTYPES = ("float32", "float16", "bfloat16")
+
+
 class _Stack:
     """One group's stacked operand: dict ids in lane order + the stacked
     pytree (native) or stacked quantized leaves + a dequant closure (int8)."""
 
-    __slots__ = ("ids", "stacked", "quant", "dequant_fn", "weights", "shape_key")
+    __slots__ = ("ids", "stacked", "quant", "dequant_fn", "weights",
+                 "shape_key", "n_feats")
 
     def __init__(self, entries):
         self.ids = [e.dict_id for e in entries]
         self.weights = entries[0].weights
+        self.n_feats = int(entries[0].n_feats)
         example = entries[0]
         if self.weights == "native":
             self.stacked = jax.tree.map(
@@ -297,7 +392,16 @@ class EncodeEngine:
 
     def _validate(self, dict_id: str, rows) -> np.ndarray:
         entry = self.registry.get(dict_id)  # KeyError → 404 upstream
-        arr = np.asarray(rows, dtype=np.float32)
+        arr = np.asarray(rows)
+        # dtype round-trip contract (ISSUE 15): rows that arrive as a
+        # native-dtype array (binary wire formats, in-process callers) are
+        # encoded AS THAT DTYPE — bit-matching a direct ld.encode of the
+        # same array; anything else (JSON nested lists land f64) coerces
+        # to f32, the historical behavior
+        from sparse_coding__tpu.serve.wire import _dtype_name
+
+        if _dtype_name(arr) not in _NATIVE_ROW_DTYPES:
+            arr = np.asarray(arr, dtype=np.float32)
         if arr.ndim == 1:
             arr = arr[None, :]
         if arr.ndim != 2 or arr.shape[0] == 0:
@@ -316,13 +420,20 @@ class EncodeEngine:
             )
         return arr
 
-    def submit(self, dict_id: str, rows, trace=None) -> EncodeRequest:
-        """Enqueue one encode; returns the request future. Raises
-        `EngineClosed` when draining (the caller maps it to a retryable
-        503), `KeyError` for an unknown dict, `ValueError` for bad rows.
-        ``trace`` is the request's `TraceContext` (docs/observability.md
-        §8) — traced requests get a ``request_trace`` per-phase record."""
-        arr = self._validate(dict_id, rows)
+    def clamp_k(self, dict_id: str, top_k) -> Optional[int]:
+        """The served k for a requested top-k: clamped into
+        ``[1, n_feats]`` (the dict's config bounds it — a client asking
+        for more features than exist gets them all, sorted)."""
+        if top_k is None:
+            return None
+        entry = self.registry.get(dict_id)
+        if entry.n_feats <= 0:
+            raise ValueError(
+                f"dict {dict_id!r} reports no n_feats — top-k unsupported"
+            )
+        return max(1, min(int(top_k), int(entry.n_feats)))
+
+    def _enqueue(self, req: EncodeRequest) -> EncodeRequest:
         with self._submit_lock:
             if not self._accepting:
                 with self._lock:
@@ -332,30 +443,123 @@ class EncodeEngine:
                 raise EngineClosed(
                     "engine is draining — retry against a live replica"
                 )
-            req = EncodeRequest(dict_id, arr, trace=trace)
             self._q.put(req)
         if self.telemetry is not None:
             self.telemetry.gauge_set("serve.queue_depth", self._q.qsize())
         return req
 
+    def submit(self, dict_id: str, rows, trace=None,
+               top_k: Optional[int] = None) -> EncodeRequest:
+        """Enqueue one encode; returns the request future. Raises
+        `EngineClosed` when draining (the caller maps it to a retryable
+        503), `KeyError` for an unknown dict, `ValueError` for bad rows.
+        ``trace`` is the request's `TraceContext` (docs/observability.md
+        §8) — traced requests get a ``request_trace`` per-phase record.
+        ``top_k=k`` makes the result a sparse ``(indices, values)`` pair
+        (k clamped to the dict's n_feats, computed in the compiled step)."""
+        arr = self._validate(dict_id, rows)
+        k = self.clamp_k(dict_id, top_k)
+        return self._enqueue(EncodeRequest(dict_id, arr, trace=trace, top_k=k))
+
     def encode(self, dict_id: str, rows, timeout: Optional[float] = 60.0,
-               trace=None) -> np.ndarray:
+               trace=None, top_k: Optional[int] = None):
         """Blocking convenience wrapper around `submit`."""
-        return self.submit(dict_id, rows, trace=trace).result(timeout)
+        return self.submit(dict_id, rows, trace=trace, top_k=top_k).result(timeout)
+
+    def encode_topk(self, dict_id: str, rows, k: int,
+                    timeout: Optional[float] = 60.0,
+                    trace=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Sparse encode: ``(indices int32 [n, k], values [n, k])`` —
+        values bit-identical to the dense codes at those indices, sorted
+        descending per row (`jax.lax.top_k` tie-break: lowest index)."""
+        return self.encode(dict_id, rows, timeout=timeout, trace=trace,
+                           top_k=int(k))
+
+    # -- harvest→encode fusion (/features) -------------------------------------
+
+    def _validate_features(self, dict_id: str, tokens,
+                           subject: Optional[str]) -> Tuple[np.ndarray, str]:
+        entry = self.registry.get(dict_id)  # KeyError → 404 upstream
+        subj = self.registry.get_subject(subject)  # KeyError → 404 upstream
+        if subj.activation_size != entry.activation_size:
+            raise ValueError(
+                f"dict {dict_id!r} encodes width {entry.activation_size} but "
+                f"subject {subj.subject_id!r} captures width "
+                f"{subj.activation_size} at {subj.tensor_name}"
+            )
+        arr = np.asarray(tokens)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2 or arr.shape[0] == 0 or arr.shape[1] == 0:
+            raise ValueError(f"tokens must be [n_seq, seq_len], got {arr.shape}")
+        if arr.dtype.kind not in ("i", "u"):
+            raise ValueError(f"tokens must be integers, got dtype {arr.dtype}")
+        arr = np.ascontiguousarray(arr, dtype=np.int32)
+        if arr.shape[1] > subj.lm_cfg.n_ctx:
+            raise ValueError(
+                f"seq_len {arr.shape[1]} exceeds subject n_ctx "
+                f"{subj.lm_cfg.n_ctx}"
+            )
+        cap = self._seq_cap(arr.shape[1])
+        if arr.shape[1] > self.max_batch or arr.shape[0] > cap:
+            raise ValueError(
+                f"request of {arr.shape[0]}x{arr.shape[1]} token rows "
+                f"exceeds the {cap}-sequence dispatch cap at seq_len "
+                f"{arr.shape[1]} (max_batch {self.max_batch}) — split it "
+                "client-side"
+            )
+        return arr, subj.subject_id
+
+    def _seq_cap(self, seq_len: int) -> int:
+        """Largest power-of-two sequence count whose padded dispatch stays
+        inside the ``max_batch`` row budget at this seq_len — the shared
+        ceiling for request validation, warmup menus, and the drainer's
+        chunking, so no fused dispatch ever exceeds a warmed shape."""
+        cap = _pow2_ceil(max(1, self.max_batch // max(1, int(seq_len))))
+        while cap > 1 and cap * int(seq_len) > self.max_batch:
+            cap //= 2
+        return cap
+
+    def submit_features(self, dict_id: str, tokens, subject: Optional[str] = None,
+                        trace=None, top_k: Optional[int] = None) -> EncodeRequest:
+        """Enqueue one fused harvest→encode: int token rows ``[n_seq,
+        seq_len]`` in, codes (or sparse top-k) for all ``n_seq × seq_len``
+        positions out — subject forward and dict encode in ONE dispatch."""
+        arr, subject_id = self._validate_features(dict_id, tokens, subject)
+        k = self.clamp_k(dict_id, top_k)
+        return self._enqueue(EncodeRequest(
+            dict_id, arr, trace=trace, top_k=k, kind="features",
+            subject=subject_id,
+        ))
+
+    def encode_features(self, dict_id: str, tokens,
+                        subject: Optional[str] = None,
+                        timeout: Optional[float] = 60.0, trace=None,
+                        top_k: Optional[int] = None):
+        """Blocking convenience wrapper around `submit_features`."""
+        return self.submit_features(
+            dict_id, tokens, subject=subject, trace=trace, top_k=top_k
+        ).result(timeout)
 
     # -- the naive baseline (bench comparison) ---------------------------------
 
-    def encode_naive(self, dict_id: str, rows) -> np.ndarray:
+    def encode_naive(self, dict_id: str, rows, top_k: Optional[int] = None):
         """One dispatch for THIS request alone — the same bucket-padded
         compiled step, stack of one, no batching with neighbors. The
         baseline `bench.py`'s serve key compares the micro-batched path
         against at equal batch budget."""
         arr = self._validate(dict_id, rows)
+        k = self.clamp_k(dict_id, top_k)
         stack = self._group_stack_for(dict_id, naive=True)
         bucket = self._bucket_for(arr.shape[0])
         padded = self._pad(arr, bucket)
-        out, _ = self._dispatch(stack, padded)
-        return np.asarray(out[0, : arr.shape[0]])
+        if k is None:
+            out, _ = self._dispatch(stack, padded)
+            return np.asarray(out[0, : arr.shape[0]])
+        kb = k_bucket(k, stack.n_feats)
+        (idx, vals), _ = self._dispatch(stack, padded, k=kb)
+        return (np.asarray(idx[0, : arr.shape[0], :k]),
+                np.asarray(vals[0, : arr.shape[0], :k]))
 
     # -- internals -------------------------------------------------------------
 
@@ -403,39 +607,86 @@ class EncodeEngine:
         stacks = self._stacks_current()
         return stacks[(entry.group_key, entry.weights)]
 
-    def _dispatch(
-        self, stack: _Stack, padded: np.ndarray,
-        traces: Optional[List[str]] = None,
-    ) -> Tuple[jax.Array, float]:
-        """Run one micro-batch through the group's compiled step (dequant
-        first for int8-resident groups), fenced by fetching the result.
-        Returns ``(codes, dequant_seconds)`` — the dequant share is what
-        `request_trace` attributes per request."""
-        batch = jnp.asarray(padded)
-        dequant_s = 0.0
-        if stack.weights == "int8":
-            t0 = time.time()
-            t0m = time.monotonic()
-            stacked = stack.dequant_fn(stack.quant)
-            jax.block_until_ready(jax.tree.leaves(stacked)[0])
-            dequant_s = time.monotonic() - t0m
-            extra = {"traces": traces} if traces else {}
-            _emit_span(
-                self.telemetry, "dequant", "dequant_int8", t0,
-                dequant_s, lanes=stack.size, **extra,
+    def _dequant_stacked(self, stack: _Stack,
+                         traces: Optional[List[str]] = None):
+        """The stacked fp operand for a dispatch: int8-resident groups pay
+        a jitted per-micro-batch dequant here (fenced, span-attributed);
+        native groups return the resident stack. Returns
+        ``(stacked, dequant_seconds)``."""
+        if stack.weights != "int8":
+            return stack.stacked, 0.0
+        t0 = time.time()
+        t0m = time.monotonic()
+        stacked = stack.dequant_fn(stack.quant)
+        jax.block_until_ready(jax.tree.leaves(stacked)[0])
+        dequant_s = time.monotonic() - t0m
+        extra = {"traces": traces} if traces else {}
+        _emit_span(
+            self.telemetry, "dequant", "dequant_int8", t0,
+            dequant_s, lanes=stack.size, **extra,
+        )
+        if self.telemetry is not None:
+            self.telemetry.hist_observe(
+                "serve.phase.dequant_ms", dequant_s * 1e3
             )
-            if self.telemetry is not None:
-                self.telemetry.hist_observe(
-                    "serve.phase.dequant_ms", dequant_s * 1e3
-                )
-        else:
-            stacked = stack.stacked
-        key = ("encode", stack.weights, stack.size, padded.shape)
+        return stacked, dequant_s
+
+    def _note_compile_key(self, key: Tuple) -> None:
         if key not in self.compiled_shapes:
             self.compiled_shapes.add(key)
             if self.telemetry is not None:
                 self.telemetry.counter_inc("serve.compiles")
-        out = _vmapped_encode(stacked, batch)
+
+    def _dispatch(
+        self, stack: _Stack, padded: np.ndarray,
+        traces: Optional[List[str]] = None, k: Optional[int] = None,
+    ) -> Tuple[Any, float]:
+        """Run one micro-batch through the group's compiled step (dequant
+        first for int8-resident groups), fenced by fetching the result.
+        ``k`` selects the fused top-k step (sparse ``(indices, values)``
+        instead of dense codes). Returns ``(out, dequant_seconds)`` — the
+        dequant share is what `request_trace` attributes per request."""
+        batch = jnp.asarray(padded)
+        stacked, dequant_s = self._dequant_stacked(stack, traces)
+        # dtype belongs in the key: jit compiles per dtype, and the batch
+        # grouping deliberately separates row dtypes — the counter must
+        # see every program the cache does
+        self._note_compile_key(
+            ("encode", stack.weights, stack.size, padded.shape,
+             str(padded.dtype), k)
+        )
+        if k is None:
+            out = _vmapped_encode(stacked, batch)
+        else:
+            out = _vmapped_encode_topk(stacked, batch, k)
+        return out, dequant_s
+
+    def _dispatch_features(
+        self, subject, stack: _Stack, padded_tokens: np.ndarray,
+        traces: Optional[List[str]] = None, k: Optional[int] = None,
+    ) -> Tuple[Any, float]:
+        """One fused capture→encode dispatch: the harvest pipeline's
+        compiled capture forward over the padded token rows, then the
+        /encode path's compiled (top-k) encode over the HBM-resident
+        activations — zero host round trips in between (see the module-
+        level fusion note)."""
+        from sparse_coding__tpu.data.activations import capture_fn
+
+        capture = capture_fn(
+            subject.lm_cfg, (subject.tensor_name,), subject.stop_at
+        )
+        tokens = jnp.asarray(padded_tokens)
+        stacked, dequant_s = self._dequant_stacked(stack, traces)
+        self._note_compile_key((
+            "features", subject.subject_id, stack.weights, stack.size,
+            padded_tokens.shape, k,
+        ))
+        act = capture(subject.params, tokens)[subject.tensor_name]
+        rows = act.reshape(-1, act.shape[-1])
+        if k is None:
+            out = _vmapped_encode(stacked, rows)
+        else:
+            out = _vmapped_encode_topk(stacked, rows, k)
         return out, dequant_s
 
     def _drain_once(self, block_s: float) -> bool:
@@ -449,7 +700,7 @@ class EncodeEngine:
             # sentinel: only exit once the queue is fully drained
             return not self._q.empty()
         batch_reqs: List[EncodeRequest] = [first]
-        rows_budget = self.max_batch - first.rows.shape[0]
+        rows_budget = self.max_batch - first.cost_rows
         deadline = time.monotonic() + self.max_wait_ms / 1e3
         saw_sentinel = False
         while rows_budget > 0:
@@ -461,14 +712,14 @@ class EncodeEngine:
             if nxt is None:
                 saw_sentinel = True
                 break
-            if nxt.rows.shape[0] > rows_budget:
+            if nxt.cost_rows > rows_budget:
                 # over budget: hand it back for the next cycle (order within
                 # a dict's stream is preserved by per-request slicing, not
                 # queue position)
                 self._q.put(nxt)
                 break
             batch_reqs.append(nxt)
-            rows_budget -= nxt.rows.shape[0]
+            rows_budget -= nxt.cost_rows
         try:
             self._process(batch_reqs)
         except Exception as e:
@@ -505,48 +756,111 @@ class EncodeEngine:
             mean_wait_ms=round(sum(waits_ms) / len(waits_ms), 3),
             **extra,
         )
+        # batch grouping key: the stack identity (group_key, weights) plus
+        # everything a single dispatch must agree on — request kind, row
+        # dtype (mixed dtypes would silently promote on concat, breaking
+        # per-request bit-exactness), dense-vs-sparse, and for features the
+        # (subject, seq_len) geometry
         by_group: Dict[Tuple, List[EncodeRequest]] = {}
         for r in reqs:
             try:
                 entry = self.registry.get(r.dict_id)
-                by_group.setdefault((entry.group_key, entry.weights), []).append(r)
+                if r.kind == "features":
+                    sig = ("features", r.subject, int(r.rows.shape[1]))
+                else:
+                    sig = ("encode", str(r.rows.dtype))
+                key = (entry.group_key, entry.weights, sig,
+                       r.top_k is not None)
+                by_group.setdefault(key, []).append(r)
             except KeyError as e:
                 # removed between submit and drain (hot remove under load)
                 self._record_error(r, e)
         stacks = self._stacks_current()
         for key, group_reqs in by_group.items():
-            stack = stacks.get(key)
+            stack_key = key[:2]
+            stack = stacks.get(stack_key)
             if stack is None:
                 # registry mutated between lookup and stack build: retry once
                 self._rebuild_stacks()
-                stack = self._stacks.get(key)
+                stack = self._stacks.get(stack_key)
             if stack is None:
                 for r in group_reqs:
                     self._record_error(r, KeyError(r.dict_id))
                 continue
-            self._run_group(stack, group_reqs, t_drain_wall)
+            if key[2][0] == "features":
+                self._run_features_group(stack, group_reqs, t_drain_wall)
+            else:
+                self._run_group(stack, group_reqs, t_drain_wall)
 
-    def _run_group(self, stack: _Stack, reqs: List[EncodeRequest],
-                   t_wall: float) -> None:
+    def _filter_lanes(self, stack: _Stack, reqs: List[EncodeRequest]):
         # a dict can be hot-removed between grouping and here while its
         # group key survives (same-shape siblings remain): those requests
         # error out; the rest of the batch still serves
         lane_of = {did: i for i, did in enumerate(stack.ids)}
-        orphans = [r for r in reqs if r.dict_id not in lane_of]
-        for r in orphans:
-            self._record_error(r, KeyError(r.dict_id))
-        reqs = [r for r in reqs if r.dict_id in lane_of]
+        for r in reqs:
+            if r.dict_id not in lane_of:
+                self._record_error(r, KeyError(r.dict_id))
+        return lane_of, [r for r in reqs if r.dict_id in lane_of]
+
+    def _request_trace_record(self, r: EncodeRequest, encode_s: float,
+                              dequant_s: float, bucket: int, lanes: int,
+                              n_requests: int) -> None:
+        if r.trace is None or self.telemetry is None:
+            return
+        # ONE compact per-request record: this request's exact per-phase
+        # seconds (queue wait is its own; encode/dequant are the enclosing
+        # batch dispatch's) + the batch context — what `python -m
+        # sparse_coding__tpu.trace` reconstructs
+        fields = {}
+        if r.top_k is not None:
+            fields["k"] = int(r.top_k)
+        if r.kind == "features":
+            fields["kind"] = "features"
+        self.telemetry.event(
+            "request_trace",
+            trace_id=r.trace.trace_id,
+            span_id=r.trace.span_id,
+            parent_span=r.trace.parent_span,
+            dict=r.dict_id,
+            rows=r.cost_rows,
+            ts_start=round(r.t_enqueue_wall, 6),
+            latency_ms=round(r.latency_ms, 3),
+            phases={
+                "request_wait": round(r.wait_s or 0.0, 6),
+                "encode": round(encode_s, 6),
+                "dequant": round(dequant_s, 6),
+            },
+            bucket=bucket,
+            lanes=lanes,
+            n_requests=n_requests,
+            **fields,
+        )
+
+    def _run_group(self, stack: _Stack, reqs: List[EncodeRequest],
+                   t_wall: float) -> None:
+        lane_of, reqs = self._filter_lanes(stack, reqs)
         if not reqs:
             return
         rows = np.concatenate([r.rows for r in reqs], axis=0)
         bucket = self._bucket_for(rows.shape[0])
         padded = self._pad(rows, bucket)
+        # the whole group is sparse or dense (the batch key separates
+        # them); the dispatch k-bucket covers the largest requested k
+        sparse = reqs[0].top_k is not None
+        kb = (
+            k_bucket(max(r.top_k for r in reqs), stack.n_feats)
+            if sparse else None
+        )
         traced = [r.trace.trace_id for r in reqs if r.trace is not None]
         extra = {"traces": traced} if traced else {}
+        if kb is not None:
+            extra["k"] = kb
         try:
             t0_wall, t0 = time.time(), time.monotonic()
-            out, dequant_s = self._dispatch(stack, padded, traces=traced or None)
-            out.block_until_ready()
+            out, dequant_s = self._dispatch(
+                stack, padded, traces=traced or None, k=kb
+            )
+            jax.block_until_ready(out)
             encode_s = time.monotonic() - t0
             _emit_span(
                 self.telemetry, "encode", f"encode_g{stack.size}_b{bucket}",
@@ -559,6 +873,10 @@ class EncodeEngine:
                 self.telemetry.hist_observe(
                     "serve.phase.encode_ms", encode_s * 1e3
                 )
+                if sparse:
+                    self.telemetry.counter_inc(
+                        "serve.sparse_requests", len(reqs)
+                    )
         except Exception as e:  # a failed dispatch must not kill the drainer
             for r in reqs:
                 self._record_error(r, e)
@@ -567,32 +885,111 @@ class EncodeEngine:
         for r in reqs:
             n = r.rows.shape[0]
             lane = lane_of[r.dict_id]
-            r._resolve(np.asarray(out[lane, start : start + n]))
+            if sparse:
+                idx, vals = out
+                r._resolve((
+                    np.asarray(idx[lane, start : start + n, : r.top_k]),
+                    np.asarray(vals[lane, start : start + n, : r.top_k]),
+                ))
+            else:
+                r._resolve(np.asarray(out[lane, start : start + n]))
             start += n
-            if r.trace is not None and self.telemetry is not None:
-                # ONE compact per-request record: this request's exact
-                # per-phase seconds (queue wait is its own; encode/dequant
-                # are the enclosing batch dispatch's) + the batch context —
-                # what `python -m sparse_coding__tpu.trace` reconstructs
-                self.telemetry.event(
-                    "request_trace",
-                    trace_id=r.trace.trace_id,
-                    span_id=r.trace.span_id,
-                    parent_span=r.trace.parent_span,
-                    dict=r.dict_id,
-                    rows=n,
-                    ts_start=round(r.t_enqueue_wall, 6),
-                    latency_ms=round(r.latency_ms, 3),
-                    phases={
-                        "request_wait": round(r.wait_s or 0.0, 6),
-                        "encode": round(encode_s, 6),
-                        "dequant": round(dequant_s, 6),
-                    },
-                    bucket=bucket,
-                    lanes=stack.size,
-                    n_requests=len(reqs),
-                )
+            self._request_trace_record(
+                r, encode_s, dequant_s, bucket, stack.size, len(reqs)
+            )
         self._note_served(reqs, rows.shape[0], bucket)
+
+    def _run_features_group(self, stack: _Stack, reqs: List[EncodeRequest],
+                            t_wall: float) -> None:
+        """Fused capture→encode dispatches for a group of token requests
+        (same subject, same seq_len, same dict group — the batch key
+        guarantees it). Sequences are concatenated on the batch axis and
+        padded to a power-of-two sequence-count bucket capped by
+        `_seq_cap` — the drainer's row budget can admit more sequences
+        than one capped dispatch holds, so the group splits into chunks
+        and no dispatch ever exceeds a shape `warmup_features` warmed.
+        Attention is per-sequence, so padding sequences never changes a
+        served row."""
+        lane_of, reqs = self._filter_lanes(stack, reqs)
+        if not reqs:
+            return
+        seq_len = int(reqs[0].rows.shape[1])
+        cap = self._seq_cap(seq_len)
+        chunk: List[EncodeRequest] = []
+        n_seqs = 0
+        for r in reqs:
+            if chunk and n_seqs + r.rows.shape[0] > cap:
+                self._run_features_chunk(stack, lane_of, chunk, seq_len)
+                chunk, n_seqs = [], 0
+            chunk.append(r)
+            n_seqs += int(r.rows.shape[0])
+        if chunk:
+            self._run_features_chunk(stack, lane_of, chunk, seq_len)
+
+    def _run_features_chunk(self, stack: _Stack, lane_of: Dict[str, int],
+                            reqs: List[EncodeRequest], seq_len: int) -> None:
+        try:
+            subject = self.registry.get_subject(reqs[0].subject)
+        except KeyError as e:  # detached between submit and drain
+            for r in reqs:
+                self._record_error(r, e)
+            return
+        tokens = np.concatenate([r.rows for r in reqs], axis=0)
+        seq_bucket = _pow2_ceil(tokens.shape[0])
+        padded = self._pad(tokens, seq_bucket)
+        bucket_rows = seq_bucket * seq_len
+        sparse = reqs[0].top_k is not None
+        kb = (
+            k_bucket(max(r.top_k for r in reqs), stack.n_feats)
+            if sparse else None
+        )
+        traced = [r.trace.trace_id for r in reqs if r.trace is not None]
+        extra = {"traces": traced} if traced else {}
+        if kb is not None:
+            extra["k"] = kb
+        n_rows = int(tokens.shape[0]) * seq_len
+        try:
+            t0_wall, t0 = time.time(), time.monotonic()
+            out, dequant_s = self._dispatch_features(
+                subject, stack, padded, traces=traced or None, k=kb
+            )
+            jax.block_until_ready(out)
+            encode_s = time.monotonic() - t0
+            _emit_span(
+                self.telemetry, "encode",
+                f"features_g{stack.size}_s{seq_bucket}x{seq_len}",
+                t0_wall, encode_s,
+                lanes=stack.size, rows=n_rows, bucket=bucket_rows,
+                n_requests=len(reqs), subject=subject.subject_id,
+                **extra,
+            )
+            if self.telemetry is not None:
+                self.telemetry.hist_observe(
+                    "serve.phase.encode_ms", encode_s * 1e3
+                )
+                self.telemetry.counter_inc("serve.feature_requests", len(reqs))
+        except Exception as e:  # a failed dispatch must not kill the drainer
+            for r in reqs:
+                self._record_error(r, e)
+            return
+        seq_start = 0
+        for r in reqs:
+            n_seq = r.rows.shape[0]
+            lane = lane_of[r.dict_id]
+            lo, hi = seq_start * seq_len, (seq_start + n_seq) * seq_len
+            if sparse:
+                idx, vals = out
+                r._resolve((
+                    np.asarray(idx[lane, lo:hi, : r.top_k]),
+                    np.asarray(vals[lane, lo:hi, : r.top_k]),
+                ))
+            else:
+                r._resolve(np.asarray(out[lane, lo:hi]))
+            seq_start += n_seq
+            self._request_trace_record(
+                r, encode_s, dequant_s, bucket_rows, stack.size, len(reqs)
+            )
+        self._note_served(reqs, n_rows, bucket_rows)
 
     def _record_error(self, req: EncodeRequest, exc: BaseException) -> None:
         with self._lock:
@@ -637,20 +1034,61 @@ class EncodeEngine:
 
     # -- warmup / introspection ------------------------------------------------
 
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> int:
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               topk_ks: Sequence[int] = (),
+               dtypes: Sequence[str] = ("float32",)) -> int:
         """Pre-compile the encode (and dequant) step for every registered
-        group × bucket, so the first real request never pays a compile.
-        Returns the number of programs dispatched."""
+        group × bucket (× k-bucket × row dtype when asked), so the first
+        real request never pays a compile. ``topk_ks`` lists requested ks
+        (bucketized — warming 16 covers every k in (8, 16]). Returns the
+        number of programs dispatched."""
         n = 0
+        kbs_raw = sorted({int(k) for k in topk_ks})
         for stack in self._stacks_current().values():
             width = None
             for did in stack.ids:
                 width = self.registry.get(did).activation_size
                 break
-            for b in buckets or self.buckets:
-                batch = np.zeros((int(b), int(width)), dtype=np.float32)
-                self._dispatch(stack, batch)[0].block_until_ready()
-                n += 1
+            kbs: List[Optional[int]] = [None]
+            kbs += sorted({k_bucket(k, stack.n_feats) for k in kbs_raw})
+            for dt in dtypes:
+                from sparse_coding__tpu.serve.wire import dtype_by_name
+
+                dtype = dtype_by_name(str(dt))
+                for b in buckets or self.buckets:
+                    batch = np.zeros((int(b), int(width)), dtype=dtype)
+                    for kb in kbs:
+                        out, _ = self._dispatch(stack, batch, k=kb)
+                        jax.block_until_ready(out)
+                        n += 1
+        return n
+
+    def warmup_features(self, seq_len: int, subject: Optional[str] = None,
+                        max_seqs: Optional[int] = None,
+                        topk_ks: Sequence[int] = ()) -> int:
+        """Pre-compile the fused capture→encode step for every group ×
+        power-of-two sequence-count bucket at ``seq_len`` (and every asked
+        k-bucket). Returns the number of programs dispatched."""
+        subj = self.registry.get_subject(subject)
+        seq_len = int(seq_len)
+        cap = self._seq_cap(seq_len)
+        if max_seqs is not None:
+            cap = min(cap, _pow2_ceil(max_seqs))
+        n = 0
+        for stack in self._stacks_current().values():
+            width = self.registry.get(stack.ids[0]).activation_size
+            if width != subj.activation_size:
+                continue
+            kbs: List[Optional[int]] = [None]
+            kbs += sorted({k_bucket(int(k), stack.n_feats) for k in topk_ks})
+            b = 1
+            while b <= cap:
+                tokens = np.zeros((b, seq_len), dtype=np.int32)
+                for kb in kbs:
+                    out, _ = self._dispatch_features(subj, stack, tokens, k=kb)
+                    jax.block_until_ready(out)
+                    n += 1
+                b *= 2
         return n
 
     def latency_snapshot(self) -> Dict[str, float]:

@@ -26,6 +26,15 @@ Multi-tenancy grouping rides the eval fan-out's stacking rule
 (`metrics.standard.group_stackable_dicts`): dicts with identical pytree
 structure + leaf shapes/dtypes share a ``group_key`` and are encoded by one
 vmapped compiled step.
+
+**Subject-LM attachment** (ISSUE 15, harvest→encode fusion): a registry can
+additionally hold `SubjectLM` entries — the subject language model whose
+activations the dictionaries were trained on. ``POST /features`` then runs
+subject capture + dict encode in ONE compiled dispatch (the engine's fused
+step), turning the service into a feature-extraction API over raw tokens
+instead of a bare dict encoder. The capture point, early-exit layer and
+fp16 cast mirror the harvest pipeline (`data.activations`) exactly, so the
+fused path bit-matches harvest-then-encode.
 """
 
 from __future__ import annotations
@@ -39,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ServedDict", "DictRegistry", "group_key_of"]
+__all__ = ["ServedDict", "SubjectLM", "DictRegistry", "group_key_of"]
 
 
 def group_key_of(ld) -> Tuple[str, Tuple]:
@@ -132,14 +141,70 @@ class ServedDict:
         }
 
 
+class SubjectLM:
+    """One attached subject language model + capture point: everything the
+    engine's fused harvest→encode step needs (ISSUE 15).
+
+    The capture geometry is THE harvest pipeline's (`data.activations`):
+    `lm.model.make_tensor_name` resolves the hook point, early exit at
+    ``layer + 1``, and the captured activation is cast to fp16 on device —
+    the store dtype — so ``/features`` output bit-matches a
+    harvest-then-encode round trip through the chunk store's fp16 tier.
+
+    ``tokenize`` (optional ``text -> List[int]``) lets ``/features`` accept
+    raw text; without it the endpoint is tokens-in only (no tokenizer
+    download on the serving path by default).
+    """
+
+    __slots__ = (
+        "subject_id", "params", "lm_cfg", "layer", "layer_loc",
+        "tensor_name", "stop_at", "activation_size", "tokenize", "source",
+    )
+
+    def __init__(self, subject_id: str, params, lm_cfg, layer: int,
+                 layer_loc: str = "residual", tokenize=None, source=None):
+        from sparse_coding__tpu.lm import model as lm_model
+
+        self.subject_id = str(subject_id)
+        self.params = params
+        self.lm_cfg = lm_cfg
+        self.layer = int(layer)
+        self.layer_loc = str(layer_loc)
+        self.tensor_name = lm_model.make_tensor_name(self.layer, self.layer_loc)
+        self.stop_at = self.layer + 1
+        self.activation_size = int(
+            lm_model.get_activation_size(lm_cfg, self.layer_loc)
+        )
+        self.tokenize = tokenize
+        self.source = None if source is None else str(source)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "subject": self.subject_id,
+            "arch": self.lm_cfg.arch,
+            "n_layers": self.lm_cfg.n_layers,
+            "d_model": self.lm_cfg.d_model,
+            "layer": self.layer,
+            "layer_loc": self.layer_loc,
+            "hook": self.tensor_name,
+            "activation_size": self.activation_size,
+            "vocab_size": int(self.lm_cfg.vocab_size),
+            "n_ctx": int(self.lm_cfg.n_ctx),
+            "tokenizes": self.tokenize is not None,
+            "source": self.source,
+        }
+
+
 class DictRegistry:
     """Thread-safe id → `ServedDict` map with a generation counter the
-    engine watches to invalidate its stacked operands."""
+    engine watches to invalidate its stacked operands. Optionally also
+    holds `SubjectLM` entries for the fused ``/features`` path."""
 
     def __init__(self, telemetry=None):
         self.telemetry = telemetry
         self._lock = threading.Lock()
         self._dicts: Dict[str, ServedDict] = {}
+        self._subjects: Dict[str, SubjectLM] = {}
         self.generation = 0
 
     def __len__(self) -> int:
@@ -193,6 +258,64 @@ class DictRegistry:
             del self._dicts[dict_id]
             self.generation += 1
         self._event("serve_dict_removed", dict=dict_id)
+
+    # -- subject LMs (harvest→encode fusion) -----------------------------------
+
+    def attach_subject(self, subject_id: str, params, lm_cfg, layer: int,
+                       layer_loc: str = "residual", tokenize=None,
+                       source=None) -> SubjectLM:
+        """Attach a subject LM + capture point for the fused ``/features``
+        path. Bumps the generation (the engine rebuilds its fused-step
+        cache lazily, like dict swaps)."""
+        entry = SubjectLM(subject_id, params, lm_cfg, layer,
+                          layer_loc=layer_loc, tokenize=tokenize,
+                          source=source)
+        with self._lock:
+            if entry.subject_id in self._subjects:
+                raise ValueError(
+                    f"subject id {entry.subject_id!r} already attached"
+                )
+            self._subjects[entry.subject_id] = entry
+            self.generation += 1
+        self._event("serve_subject_attached", subject=entry.subject_id,
+                    layer=entry.layer, layer_loc=entry.layer_loc,
+                    activation_size=entry.activation_size)
+        return entry
+
+    def detach_subject(self, subject_id: str) -> None:
+        with self._lock:
+            if subject_id not in self._subjects:
+                raise KeyError(f"subject id {subject_id!r} not attached")
+            del self._subjects[subject_id]
+            self.generation += 1
+        self._event("serve_subject_detached", subject=subject_id)
+
+    def get_subject(self, subject_id: Optional[str] = None) -> SubjectLM:
+        """``subject_id=None`` resolves the registry's sole subject — the
+        common single-subject deployment needs no id in requests."""
+        with self._lock:
+            if subject_id is not None:
+                entry = self._subjects.get(str(subject_id))
+                if entry is None:
+                    raise KeyError(f"subject id {subject_id!r} not attached")
+                return entry
+            if not self._subjects:
+                raise KeyError("no subject LM attached (see attach_subject)")
+            if len(self._subjects) > 1:
+                raise KeyError(
+                    "multiple subjects attached — name one: "
+                    f"{sorted(self._subjects)}"
+                )
+            return next(iter(self._subjects.values()))
+
+    def subjects(self) -> List[str]:
+        with self._lock:
+            return sorted(self._subjects)
+
+    def describe_subjects(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            entries = list(self._subjects.values())
+        return [e.describe() for e in sorted(entries, key=lambda e: e.subject_id)]
 
     # -- reads -----------------------------------------------------------------
 

@@ -167,10 +167,17 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, body: bytes,
                  headers: Optional[Dict[str, str]] = None) -> None:
+        # the upstream replica's Content-Type passes through (binary wire
+        # formats, ISSUE 15); json only when nothing upstream set one
+        headers = dict(headers or {})
+        content_type = None
+        for k in list(headers):
+            if k.lower() == "content-type":
+                content_type = headers.pop(k)
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type or "application/json")
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        for k, v in headers.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
@@ -205,7 +212,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         router = self.server.router
-        if self.path != "/encode":
+        if self.path not in ("/encode", "/features"):
             self._json(404, {"error": f"no route {self.path}"})
             return
         length = int(self.headers.get("Content-Length", 0))
@@ -223,7 +230,9 @@ class _Handler(BaseHTTPRequestHandler):
         parent_span = self.headers.get(_tracing.PARENT_HEADER)
         status, headers, out = router.route_encode(
             body, deadline_s=deadline_s, trace_id=trace_id,
-            parent_span=parent_span,
+            parent_span=parent_span, path=self.path,
+            content_type=self.headers.get("Content-Type"),
+            accept=self.headers.get("Accept"),
         )
         headers = {**headers, _tracing.TRACE_HEADER: trace_id}
         self._respond(status, out, headers)
@@ -561,12 +570,15 @@ class Router:
     def _forward_once(
         self, t: Replica, body: bytes, timeout: float,
         extra_headers: Optional[Dict[str, str]] = None,
+        path: str = "/encode",
     ) -> Tuple[int, Dict[str, str], bytes]:
         """One HTTP forward; returns (status, headers, body) for ANY HTTP
-        status; raises on transport failures (conn refused, timeout)."""
+        status; raises on transport failures (conn refused, timeout). The
+        client's Content-Type/Accept ride in ``extra_headers`` so binary
+        wire bodies forward untouched (byte-exact passthrough contract)."""
         fault_point("router_forward", replica=t.rid)
         req = urllib.request.Request(
-            t.url + "/encode", data=body,
+            t.url + path, data=body,
             headers={"Content-Type": "application/json",
                      **(extra_headers or {})},
             method="POST",
@@ -598,6 +610,8 @@ class Router:
     def _attempt(
         self, t: Replica, body: bytes, timeout: float, exclude: Set[str],
         trace: Optional[Dict[str, Any]] = None, attempt: int = 0,
+        path: str = "/encode",
+        wire_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes, bool, str]:
         """One (possibly hedged) forward through replica `t`. Returns
         (status, headers, body, hedged, winner_rid) for a final response;
@@ -606,7 +620,8 @@ class Router:
         if self.hedge_ms is None:
             return (
                 *self._forward_locked(t, body, timeout, trace=trace,
-                                      attempt=attempt),
+                                      attempt=attempt, path=path,
+                                      wire_headers=wire_headers),
                 False, t.rid,
             )
         results: "Queue[Tuple[Replica, Any]]" = Queue()
@@ -615,7 +630,7 @@ class Router:
             try:
                 results.put((target, self._forward_locked(
                     target, body, timeout, trace=trace, attempt=attempt,
-                    hedge=hedge,
+                    hedge=hedge, path=path, wire_headers=wire_headers,
                 )))
             except _RetryableForward as e:
                 results.put((target, e))
@@ -662,7 +677,8 @@ class Router:
     def _forward_locked(
         self, t: Replica, body: bytes, timeout: float,
         trace: Optional[Dict[str, Any]] = None, attempt: int = 0,
-        hedge: bool = False,
+        hedge: bool = False, path: str = "/encode",
+        wire_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """Forward with in-flight accounting + outcome-driven state. Raises
         `_RetryableForward` on transport failure or a retryable 503/504;
@@ -673,10 +689,11 @@ class Router:
         t0 = time.monotonic()
         t0_wall = time.time()
         span_id = None
-        extra_headers = None
+        extra_headers = dict(wire_headers) if wire_headers else None
         if trace is not None:
             span_id = _tracing.mint_span_id()
             extra_headers = {
+                **(extra_headers or {}),
                 _tracing.TRACE_HEADER: trace["trace_id"],
                 _tracing.PARENT_HEADER: span_id,
             }
@@ -696,7 +713,7 @@ class Router:
         try:
             try:
                 status, headers, out = self._forward_once(
-                    t, body, timeout, extra_headers=extra_headers
+                    t, body, timeout, extra_headers=extra_headers, path=path
                 )
             except Exception as e:
                 emit(f"error:{type(e).__name__}")
@@ -727,18 +744,28 @@ class Router:
     def route_encode(
         self, body: bytes, deadline_s: Optional[float] = None,
         trace_id: Optional[str] = None, parent_span: Optional[str] = None,
+        path: str = "/encode", content_type: Optional[str] = None,
+        accept: Optional[str] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
-        """Route one encode request: pick → forward → (on retryable
-        failure) retry against a different replica with backoff, bounded
-        by ``max_attempts`` and the request deadline; shed fast when no
-        replica is routable or the router is saturated. ``trace_id`` /
-        ``parent_span`` (the HTTP handler's X-Trace-Id/X-Parent-Span)
-        make every attempt a trace-tagged ``forward`` span."""
+        """Route one encode/features request: pick → forward → (on
+        retryable failure) retry against a different replica with backoff,
+        bounded by ``max_attempts`` and the request deadline; shed fast
+        when no replica is routable or the router is saturated.
+        ``trace_id`` / ``parent_span`` (the HTTP handler's
+        X-Trace-Id/X-Parent-Span) make every attempt a trace-tagged
+        ``forward`` span. ``content_type``/``accept`` forward the client's
+        wire-format negotiation untouched — request AND response bodies
+        pass through byte-exact in every format."""
         self._bump("requests")
         trace = (
             {"trace_id": str(trace_id), "parent_span": parent_span}
             if trace_id else None
         )
+        wire_headers: Dict[str, str] = {}
+        if content_type:
+            wire_headers["Content-Type"] = content_type
+        if accept:
+            wire_headers["Accept"] = accept
         with self._lock:
             saturated = self._total_inflight >= self.max_inflight
         if saturated:
@@ -763,7 +790,8 @@ class Router:
             try:
                 status, headers, out, hedged, winner = self._attempt(
                     t, body, max(0.05, timeout), tried, trace=trace,
-                    attempt=attempt,
+                    attempt=attempt, path=path,
+                    wire_headers=wire_headers or None,
                 )
             except _RetryableForward:
                 tried.add(t.rid)
@@ -805,7 +833,7 @@ class Router:
             self._bump("client_errors")
         fwd_headers = {
             k: v for k, v in headers.items()
-            if k.lower() in ("retry-after",)
+            if k.lower() in ("retry-after", "content-type")
         }
         fwd_headers.update(self._meta_headers(state))
         return status, fwd_headers, out
@@ -934,26 +962,26 @@ class RouterClient(ServeClient):
             return exc
         return super()._retryable_exc(payload, headers)
 
-    def encode_with_meta(self, dict_id: str, rows,
-                         trace=None) -> Tuple[Any, Dict[str, Any]]:
-        import numpy as np
-
-        payload = {"dict": dict_id, "rows": np.asarray(rows).tolist()}
-        headers_out = self._trace_headers(trace)
-        body, headers = self._with_retries(
-            lambda: self._request_full("POST", "/encode", payload,
-                                       headers=headers_out)
+    def encode_with_meta(self, dict_id: str, rows, trace=None,
+                         format: str = "json",
+                         top_k=None) -> Tuple[Any, Dict[str, Any]]:
+        req_meta: Dict[str, Any] = {"dict": dict_id}
+        if top_k is not None:
+            req_meta["top_k"] = int(top_k)
+        out_arrays, out_meta, headers = self._wire_call(
+            "/encode", {"rows": rows}, req_meta, fmt=format, trace=trace
         )
         meta = {
             "attempts": int(headers.get("X-Router-Attempts", 1) or 1),
             "hedged": headers.get("X-Router-Hedged") == "1",
             "replica": headers.get("X-Router-Replica"),
-            "generation": body.get("generation"),
-            "dict": body.get("dict"),
+            "generation": out_meta.get("generation"),
+            "dict": out_meta.get("dict"),
             "trace_id": headers.get("X-Trace-Id"),
         }
-        codes = np.asarray(body["codes"], dtype=np.float32)
-        return codes, meta
+        return self._unpack_codes(out_arrays, out_meta), meta
 
-    def encode(self, dict_id: str, rows, trace=None):
-        return self.encode_with_meta(dict_id, rows, trace=trace)[0]
+    def encode(self, dict_id: str, rows, trace=None, format: str = "json",
+               top_k=None):
+        return self.encode_with_meta(dict_id, rows, trace=trace,
+                                     format=format, top_k=top_k)[0]

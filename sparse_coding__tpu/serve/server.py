@@ -2,14 +2,27 @@
 
 ``python -m sparse_coding__tpu.serve.server <export> [--port 0] ...`` loads
 learned-dict exports into a `DictRegistry`, warms the engine's compiled
-steps, and serves a JSON API (docs/SERVING.md):
+steps, and serves the API (docs/SERVING.md):
 
   - ``POST /encode``  — ``{"dict": "<id>", "rows": [[...], ...]}`` →
     ``{"dict", "n_rows", "codes", "latency_ms"}``. Unknown dict → 404;
     malformed rows → 400; draining → **503 with Retry-After and
     ``{"retryable": true}``** — the clean hand-back a load balancer retries
-    against another replica.
-  - ``GET /dicts``    — registry metadata (id, class, shape, residency).
+    against another replica. **Content negotiation** (ISSUE 15,
+    `serve.wire`): request bodies and responses ride any of JSON
+    (default), npz (``application/x-npz``), or the raw little-endian
+    format (``application/x-sc-raw``) — ``Content-Type`` names the request
+    format, ``Accept`` picks the response format, and array dtypes travel
+    exactly in every format. ``"top_k": k`` in the request meta switches
+    the response to sparse ``indices`` + ``values`` (k clamped to the
+    dict's n_feats, computed inside the compiled step).
+  - ``POST /features`` — ``{"dict": "<id>", "tokens": [[...ids...]]}``
+    (or ``"texts"`` when the attached subject tokenizes): fused subject-LM
+    capture + dict encode in ONE dispatch (`registry.SubjectLM`), returning
+    codes — dense or top-k sparse — for every token position. Same wire
+    negotiation as /encode.
+  - ``GET /dicts``    — registry metadata (id, class, shape, residency)
+    plus attached subjects.
   - ``GET /healthz``  — ``{"status": "ok"|"draining", "queue_depth", ...}``.
 
 **Drain protocol** (the PR-5 preemption machinery, re-used): SIGTERM/SIGINT
@@ -80,7 +93,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, srv.health())
             return
         if self.path == "/dicts":
-            self._json(200, {"dicts": srv.registry.describe()})
+            self._json(200, {"dicts": srv.registry.describe(),
+                             "subjects": srv.registry.describe_subjects()})
             return
         if self.path == "/metrics":
             body = srv.metrics_text().encode()
@@ -96,17 +110,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv = self.server.serve
-        if self.path != "/encode":
+        if self.path not in ("/encode", "/features"):
             self._json(404, {"error": f"no route {self.path}"})
             return
         if srv.draining:
             self._reject_draining()
             return
+        from sparse_coding__tpu.serve import wire
+
+        fmt_in = wire.format_of_content_type(self.headers.get("Content-Type"))
+        fmt_out = wire.negotiate(self.headers.get("Accept"))
         try:
             length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length))
-            dict_id = payload["dict"]
-            rows = payload["rows"]
+            raw = self.rfile.read(length)
+            arrays, meta = wire.decode_payload(fmt_in, raw)
+            dict_id = meta["dict"]
+            top_k = meta.get("top_k")
+            if top_k is not None:
+                top_k = int(top_k)
         except (ValueError, KeyError, TypeError) as e:
             self._json(400, {"error": f"bad request: {e}"})
             return
@@ -122,15 +143,29 @@ class _Handler(BaseHTTPRequestHandler):
         )
         t0 = time.monotonic()
         try:
-            codes = srv.engine.encode(
-                dict_id, rows, timeout=srv.request_timeout, trace=trace
-            )
+            if self.path == "/features":
+                tokens = self._feature_tokens(srv, arrays, meta)
+                out = srv.engine.encode_features(
+                    dict_id, tokens, subject=meta.get("subject"),
+                    timeout=srv.request_timeout, trace=trace, top_k=top_k,
+                )
+            else:
+                rows = arrays.get("rows")
+                if rows is None:
+                    rows = meta.get("rows")  # plain-JSON compat (no __dtypes__)
+                if rows is None:
+                    raise ValueError("request carries no 'rows'")
+                out = srv.engine.encode(
+                    dict_id, rows, timeout=srv.request_timeout, trace=trace,
+                    top_k=top_k,
+                )
         except EngineClosed:
             self._reject_draining()
             return
-        except KeyError:
-            self._json(404, {"error": f"unknown dict {dict_id!r}",
-                             "dicts": srv.registry.ids()},
+        except KeyError as e:
+            self._json(404, {"error": f"unknown dict or subject: {e}",
+                             "dicts": srv.registry.ids(),
+                             "subjects": srv.registry.subjects()},
                        headers=trace_headers)
             return
         except (ValueError, TypeError) as e:
@@ -140,16 +175,68 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(504, {"error": str(e), "retryable": True},
                        headers=trace_headers)
             return
-        body = {
+        if top_k is None:
+            out_arrays = {"codes": np.asarray(out)}
+            n_rows = int(out_arrays["codes"].shape[0])
+        else:
+            idx, vals = out
+            out_arrays = {"indices": np.asarray(idx), "values": np.asarray(vals)}
+            n_rows = int(out_arrays["values"].shape[0])
+        out_meta = {
             "dict": dict_id,
-            "n_rows": int(codes.shape[0]),
-            "codes": np.asarray(codes).tolist(),
+            "n_rows": n_rows,
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
             "generation": srv.dict_generation,
         }
+        if top_k is not None:
+            out_meta["sparse"] = True
+            out_meta["k"] = int(out_arrays["values"].shape[1])
         if trace is not None:
-            body["trace_id"] = trace.trace_id
-        self._json(200, body, headers=trace_headers)
+            out_meta["trace_id"] = trace.trace_id
+        body = wire.encode_payload(fmt_out, out_arrays, out_meta)
+        self.send_response(200)
+        self.send_header("Content-Type", wire.CONTENT_TYPES[fmt_out])
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (trace_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+        srv.note_wire(self.path, fmt_in, fmt_out, len(raw), len(body),
+                      out_meta["latency_ms"])
+
+    @staticmethod
+    def _feature_tokens(srv, arrays, meta):
+        """Token rows for a /features request: int ``tokens`` ride any wire
+        format; ``texts`` (list of strings) tokenizes through the subject's
+        attached tokenizer with the harvest pipeline's EOS-joined exact-
+        length chunking (`data.activations.chunk_and_tokenize_texts`)."""
+        tokens = arrays.get("tokens")
+        if tokens is None:
+            tokens = meta.get("tokens")  # plain-JSON compat
+        if tokens is not None:
+            return tokens
+        texts = meta.get("texts")
+        if texts is None:
+            raise ValueError("request carries neither 'tokens' nor 'texts'")
+        subj = srv.registry.get_subject(meta.get("subject"))
+        if subj.tokenize is None:
+            raise ValueError(
+                f"subject {subj.subject_id!r} has no tokenizer attached — "
+                "send 'tokens' instead of 'texts'"
+            )
+        from sparse_coding__tpu.data.activations import chunk_and_tokenize_texts
+
+        toks = chunk_and_tokenize_texts(
+            [str(t) for t in texts], subj.tokenize,
+            eos_id=int(meta.get("eos_id", 0)),
+            max_length=int(meta.get("seq_len", 128)),
+        )
+        if toks.shape[0] == 0:
+            raise ValueError(
+                "texts tokenized to fewer than seq_len tokens — nothing to "
+                "encode (send more text or a smaller 'seq_len')"
+            )
+        return toks
 
 
 class ServeServer:
@@ -191,6 +278,10 @@ class ServeServer:
         self.dict_generation = int(dict_generation)
         self.replica_id = replica_id
         self.draining = False
+        # wire accounting (ISSUE 15): bytes + request counts per response
+        # format — the report's wire line and the bench bytes/row evidence
+        self._wire_lock = threading.Lock()
+        self.wire_stats: Dict[str, Dict[str, float]] = {}
         self._t0 = time.time()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
@@ -214,6 +305,34 @@ class ServeServer:
         self._http_thread.start()
         return self
 
+    def note_wire(self, endpoint: str, fmt_in: str, fmt_out: str,
+                  bytes_in: int, bytes_out: int, latency_ms: float) -> None:
+        """Per-format wire accounting for one answered request:
+        ``serve.bytes_in/out.<fmt>`` + ``serve.requests.<fmt>`` counters
+        and a per-format latency histogram
+        (``serve.format.<fmt>.latency_ms``) on the telemetry bus, mirrored
+        into `wire_stats` for telemetry-less servers."""
+        # bytes_in belongs to the REQUEST format, requests/bytes_out to the
+        # response format — mirroring the telemetry counters exactly, so a
+        # cross-format request (raw in, json out) books identically in both
+        with self._wire_lock:
+            def _slot(fmt):
+                return self.wire_stats.setdefault(
+                    fmt, {"requests": 0, "bytes_in": 0, "bytes_out": 0}
+                )
+
+            out_slot = _slot(fmt_out)
+            out_slot["requests"] += 1
+            out_slot["bytes_out"] += int(bytes_out)
+            _slot(fmt_in)["bytes_in"] += int(bytes_in)
+        if self.telemetry is not None:
+            self.telemetry.counter_inc(f"serve.requests.{fmt_out}")
+            self.telemetry.counter_inc(f"serve.bytes_in.{fmt_in}", int(bytes_in))
+            self.telemetry.counter_inc(f"serve.bytes_out.{fmt_out}", int(bytes_out))
+            self.telemetry.hist_observe(
+                f"serve.format.{fmt_out}.latency_ms", float(latency_ms)
+            )
+
     def health(self) -> Dict[str, Any]:
         """The enriched healthz body (ISSUE 13): everything a router health
         probe needs in ONE response — queue depth, batch occupancy, the
@@ -236,6 +355,7 @@ class ServeServer:
             "uptime_seconds": round(time.time() - self._t0, 3),
             "latency_p50_ms": round(lat["p50_ms"], 3),
             "latency_p99_ms": round(lat["p99_ms"], 3),
+            "subjects": self.registry.subjects(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id
@@ -323,7 +443,15 @@ class ServeClient:
     a floor on each sleep and bumping a ``serve.client.retry`` counter on
     the active telemetry. Connection errors are NOT retried here: against
     a single server they mean it is gone; `serve.router.RouterClient`
-    fronting a replica set is the layer that retries those (elsewhere)."""
+    fronting a replica set is the layer that retries those (elsewhere).
+
+    Wire formats (ISSUE 15): ``format="json"|"npz"|"raw"`` selects the
+    request body AND ``Accept`` content type (`serve.wire`). Responses
+    round-trip dtype exactly in every format — the old silent
+    ``dtype=np.float32`` coercion is gone; a bf16 dict's codes come back
+    bf16. ``top_k=k`` returns sparse ``(indices, values)``. Bytes on the
+    wire are counted into `bytes_sent` / `bytes_received` (loadgen's
+    bytes-per-row accounting reads them)."""
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  retries: int = 1, backoff_base: float = 0.05):
@@ -331,6 +459,19 @@ class ServeClient:
         self.timeout = timeout
         self.retries = max(1, int(retries))
         self.backoff_base = float(backoff_base)
+        self._bytes_lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def _note_bytes(self, sent: int, received: int) -> None:
+        with self._bytes_lock:
+            self.bytes_sent += int(sent)
+            self.bytes_received += int(received)
+
+    def bytes_snapshot(self) -> Dict[str, int]:
+        with self._bytes_lock:
+            return {"bytes_sent": self.bytes_sent,
+                    "bytes_received": self.bytes_received}
 
     def _retryable_exc(self, payload: Dict[str, Any],
                        headers: Dict[str, str]) -> RetryableRejection:
@@ -346,25 +487,42 @@ class ServeClient:
 
     def _request_full(
         self, method: str, path: str,
-        payload: Optional[Dict[str, Any]] = None,
+        payload: Optional[Any] = None,
         headers: Optional[Dict[str, str]] = None,
+        raw: bool = False,
     ) -> tuple:
-        """One HTTP round trip; returns (parsed body, response headers)."""
+        """One HTTP round trip; returns (body, response headers). ``payload``
+        is a JSON-able dict or pre-encoded ``bytes`` (binary wire formats —
+        set the Content-Type via ``headers``). The success body is parsed
+        JSON unless ``raw=True`` (wire callers decode per Content-Type);
+        error bodies are always JSON, the server's error contract."""
         import urllib.error
         import urllib.request
 
+        if isinstance(payload, (bytes, bytearray)):
+            data: Optional[bytes] = bytes(payload)
+        elif payload is None:
+            data = None
+        else:
+            data = json.dumps(payload).encode()
         req = urllib.request.Request(
             self.base_url + path,
-            data=None if payload is None else json.dumps(payload).encode(),
+            data=data,
             headers={"Content-Type": "application/json", **(headers or {})},
             method=method,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read()), dict(resp.headers.items())
+                body = resp.read()
+                self._note_bytes(len(data or b""), len(body))
+                if raw:
+                    return body, dict(resp.headers.items())
+                return json.loads(body), dict(resp.headers.items())
         except urllib.error.HTTPError as e:
+            raw_body = e.read()
+            self._note_bytes(len(data or b""), len(raw_body))
             try:
-                body = json.loads(e.read())
+                body = json.loads(raw_body)
             except Exception:
                 body = {"error": str(e)}
             headers = dict(e.headers.items())
@@ -405,20 +563,124 @@ class ServeClient:
             trace = TraceContext(trace)
         return trace.headers()
 
-    def encode(self, dict_id: str, rows, trace=None) -> np.ndarray:
-        payload = {"dict": dict_id, "rows": np.asarray(rows).tolist()}
-        headers = self._trace_headers(trace)
-        out = self._with_retries(
-            lambda: self._request_full("POST", "/encode", payload,
-                                       headers=headers)[0]
+    def _wire_call(
+        self, path: str, arrays: Dict[str, Any], meta: Dict[str, Any],
+        fmt: str = "json", trace=None,
+    ) -> tuple:
+        """One wire-format POST: encode the ``(arrays, meta)`` payload in
+        ``fmt``, Accept the same format back, decode the response per its
+        Content-Type. Returns (out_arrays, out_meta, response_headers)."""
+        from sparse_coding__tpu.serve import wire
+
+        body = wire.encode_payload(
+            fmt, {k: np.asarray(v) for k, v in arrays.items()}, meta
         )
-        return np.asarray(out["codes"], dtype=np.float32)
+        headers = {
+            "Content-Type": wire.CONTENT_TYPES[fmt],
+            "Accept": wire.CONTENT_TYPES[fmt],
+            **(self._trace_headers(trace) or {}),
+        }
+        out, rheaders = self._with_retries(
+            lambda: self._request_full("POST", path, body, headers=headers,
+                                       raw=True)
+        )
+        out_arrays, out_meta = wire.decode_payload(
+            wire.format_of_content_type(rheaders.get("Content-Type")), out
+        )
+        return out_arrays, out_meta, rheaders
+
+    @staticmethod
+    def _unpack_codes(out_arrays: Dict[str, np.ndarray],
+                      out_meta: Optional[Dict[str, Any]] = None):
+        """Dense codes or the sparse ``(indices, values)`` pair — dtypes
+        exactly as the server computed them (the round-trip contract).
+        Legacy JSON bodies (no ``__dtypes__`` — pre-wire servers) fall back
+        to the historical f32 coercion."""
+        if "codes" in out_arrays:
+            return out_arrays["codes"]
+        if "indices" in out_arrays:
+            return out_arrays["indices"], out_arrays["values"]
+        meta = out_meta or {}
+        if "codes" in meta:
+            return np.asarray(meta["codes"], dtype=np.float32)
+        if "indices" in meta:
+            return (np.asarray(meta["indices"], dtype=np.int32),
+                    np.asarray(meta["values"], dtype=np.float32))
+        raise KeyError("response carries no codes")
+
+    def encode(self, dict_id: str, rows, trace=None, format: str = "json",
+               top_k: Optional[int] = None):
+        meta: Dict[str, Any] = {"dict": dict_id}
+        if top_k is not None:
+            meta["top_k"] = int(top_k)
+        out_arrays, out_meta, _ = self._wire_call(
+            "/encode", {"rows": rows}, meta, fmt=format, trace=trace
+        )
+        return self._unpack_codes(out_arrays, out_meta)
+
+    def encode_topk(self, dict_id: str, rows, k: int, trace=None,
+                    format: str = "json"):
+        """Sparse encode: ``(indices int32 [n, k], values [n, k])``."""
+        return self.encode(dict_id, rows, trace=trace, format=format,
+                           top_k=int(k))
+
+    def encode_features(self, dict_id: str, tokens=None, trace=None,
+                        format: str = "json", top_k: Optional[int] = None,
+                        subject: Optional[str] = None, texts=None,
+                        seq_len: Optional[int] = None):
+        """Fused harvest→encode over raw tokens (``[n_seq, seq_len]`` ints)
+        or ``texts`` (needs a server-side tokenizer). Returns codes for
+        every token position — dense or ``(indices, values)``."""
+        meta: Dict[str, Any] = {"dict": dict_id}
+        if top_k is not None:
+            meta["top_k"] = int(top_k)
+        if subject is not None:
+            meta["subject"] = subject
+        arrays: Dict[str, Any] = {}
+        if tokens is not None:
+            arrays["tokens"] = np.asarray(tokens, dtype=np.int32)
+        elif texts is not None:
+            meta["texts"] = list(texts)
+            if seq_len is not None:
+                meta["seq_len"] = int(seq_len)
+        else:
+            raise ValueError("pass tokens or texts")
+        out_arrays, out_meta, _ = self._wire_call(
+            "/features", arrays, meta, fmt=format, trace=trace
+        )
+        return self._unpack_codes(out_arrays, out_meta)
 
     def dicts(self) -> List[Dict[str, Any]]:
         return self._request("GET", "/dicts")["dicts"]
 
+    def subjects(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/dicts").get("subjects", [])
+
     def healthz(self) -> Dict[str, Any]:
         return self._request("GET", "/healthz")
+
+
+def attach_subject_from_spec(registry: DictRegistry, spec: str,
+                             subject_id: str = "subject"):
+    """Attach a subject LM from a CLI spec:
+    ``random:<model>:<layer>:<loc>[:seed]`` random-inits the named
+    architecture (`lm.model.config_for` geometry) — the demo/bench path;
+    production weights attach programmatically via
+    `DictRegistry.attach_subject`."""
+    kind, model, layer, rest = (str(spec).split(":", 3) + [""])[:4]
+    loc, _, seed = rest.partition(":")
+    if kind != "random":
+        raise ValueError(f"unknown subject kind {kind!r} (want 'random:...')")
+    import jax
+
+    from sparse_coding__tpu.lm import model as lm_model
+
+    lm_cfg = lm_model.config_for(model)
+    params = lm_model.init_params(jax.random.PRNGKey(int(seed or 0)), lm_cfg)
+    return registry.attach_subject(
+        subject_id, params, lm_cfg, int(layer), layer_loc=loc or "residual",
+        source=spec,
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -455,6 +717,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "stamped into every /encode response")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip bucket pre-compilation at startup")
+    ap.add_argument("--warmup-topk", type=int, action="append", default=None,
+                    metavar="K",
+                    help="additionally pre-compile the fused top-k step for "
+                    "this k (repeatable; ks share a power-of-two k-bucket "
+                    "menu, so warming 16 covers every k in (8, 16])")
+    ap.add_argument("--subject", default=None, metavar="SPEC",
+                    help="attach a subject LM for POST /features. SPEC = "
+                    "'random:<model>:<layer>:<loc>[:seed]' random-inits the "
+                    "named architecture (demo/bench geometry; production "
+                    "weights attach programmatically via "
+                    "DictRegistry.attach_subject)")
+    ap.add_argument("--subject-seq-len", type=int, default=32,
+                    help="seq_len the /features warmup pre-compiles for")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -470,11 +745,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     for exp in args.exports:
         ids = registry.load_export(exp, weights=args.weights)
         print(f"[serve] loaded {len(ids)} dict(s) from {exp}: {ids}")
+    if args.subject:
+        try:
+            subj = attach_subject_from_spec(registry, args.subject)
+            print(f"[serve] attached subject {args.subject!r} "
+                  f"(width {subj.activation_size})")
+        except (ValueError, IndexError) as e:
+            ap.error(f"bad --subject spec {args.subject!r}: {e}")
     telemetry.run_start(config={
         "exports": list(args.exports), "weights": args.weights,
         "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
         "dicts": registry.ids(), "replica_id": args.replica_id,
         "dict_generation": args.dict_generation,
+        "subjects": registry.subjects(),
     })
 
     srv = ServeServer(
@@ -485,7 +768,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     srv.engine.start()
     if not args.no_warmup:
-        n = srv.engine.warmup()
+        n = srv.engine.warmup(topk_ks=args.warmup_topk or ())
+        if registry.subjects():
+            n += srv.engine.warmup_features(
+                args.subject_seq_len, topk_ks=args.warmup_topk or ()
+            )
         print(f"[serve] warmed {n} compiled step(s)")
     srv.start()
     if args.port_file:

@@ -1,0 +1,307 @@
+"""Serving wire formats: JSON, npz, and a raw little-endian binary (ISSUE 15).
+
+The serving tier shipped every response as a dense JSON float list —
+`codes.tolist()` — so at ``n_feats >= 4096`` the response body dominated
+wire bytes and JSON float serialization dominated host CPU on the hot
+path. This module is the single codec layer every serve endpoint and
+client negotiates through:
+
+  - **json** (``application/json``) — the compatible default. Arrays ride
+    as nested lists; a ``dtype`` field preserves the native dtype (floats
+    in JSON are f64, and f64 round-trips f32/f16/bf16 values exactly, so
+    json stays *bit-exact* — just fat and slow).
+  - **npz** (``application/x-npz``) — `numpy.savez`: self-describing,
+    dtype-preserving, readable by any numpy without this repo. Metadata
+    rides as a ``__meta__`` uint8 array holding UTF-8 JSON.
+  - **raw** (``application/x-sc-raw``) — the repo's own little-endian
+    header+payload layout (below): no zip/np overhead, one parse pass,
+    the cheapest path for high-rate clients.
+
+One *payload* abstraction serves every endpoint: ``(arrays, meta)`` where
+``arrays`` is an ordered ``{name: np.ndarray}`` and ``meta`` a small JSON
+dict. Dense encode responses carry ``{"codes"}``; sparse top-k responses
+carry ``{"indices", "values"}``; encode requests carry ``{"rows"}``;
+feature requests carry ``{"tokens"}``. `encode_payload`/`decode_payload`
+round-trip **bit-exactly in every format** (tests/test_wire.py pins it
+per registered LearnedDict class).
+
+Raw layout (all integers little-endian)::
+
+    magic   4s   b"SCW1"
+    version u16  1
+    n_arr   u16  number of arrays
+    mlen    u32  meta JSON byte length
+    meta    mlen bytes of UTF-8 JSON
+    then per array:
+      nlen  u16  name byte length
+      name  nlen bytes of UTF-8
+      dtype u8   code from DTYPE_CODES
+      ndim  u8
+      shape u64 * ndim
+      data  prod(shape) * itemsize bytes (C order)
+
+bf16 support: numpy spells ml_dtypes' bfloat16 as a void dtype, so dtype
+identity travels by *name* (``jnp.dtype`` strings), never by np.dtype
+objects — the same rule `registry._quantize_leaf` follows.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FORMATS",
+    "CONTENT_TYPES",
+    "format_of_content_type",
+    "negotiate",
+    "encode_payload",
+    "decode_payload",
+    "dtype_by_name",
+]
+
+FORMATS = ("json", "npz", "raw")
+
+CONTENT_TYPES = {
+    "json": "application/json",
+    "npz": "application/x-npz",
+    "raw": "application/x-sc-raw",
+}
+_FORMAT_OF = {v: k for k, v in CONTENT_TYPES.items()}
+# permissive aliases clients in the wild send
+_FORMAT_OF["application/octet-stream"] = "raw"
+_FORMAT_OF["application/zip"] = "npz"
+
+_MAGIC = b"SCW1"
+_VERSION = 1
+
+# stable u8 dtype codes for the raw format (never renumber — wire contract)
+DTYPE_CODES = {
+    "float32": 0,
+    "float16": 1,
+    "bfloat16": 2,
+    "float64": 3,
+    "int8": 4,
+    "int16": 5,
+    "int32": 6,
+    "int64": 7,
+    "uint8": 8,
+    "uint32": 9,
+    "bool": 10,
+}
+_DTYPE_OF_CODE = {v: k for k, v in DTYPE_CODES.items()}
+
+
+def dtype_by_name(name: str):
+    """np.dtype for a wire dtype name; ``"bfloat16"`` resolves through
+    ml_dtypes (numpy alone cannot spell it)."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _dtype_name(arr: np.ndarray) -> str:
+    """The wire name of an array's dtype (bf16 reports numpy kind 'V';
+    jnp.dtype spells it 'bfloat16')."""
+    name = arr.dtype.name
+    if arr.dtype.kind == "V":
+        import ml_dtypes
+
+        if arr.dtype == np.dtype(ml_dtypes.bfloat16):
+            return "bfloat16"
+    return name
+
+
+def format_of_content_type(content_type: Optional[str]) -> str:
+    """Wire format named by a Content-Type header (parameters stripped);
+    absent/unknown → ``"json"`` (the compatible default)."""
+    if not content_type:
+        return "json"
+    base = content_type.split(";", 1)[0].strip().lower()
+    return _FORMAT_OF.get(base, "json")
+
+
+def negotiate(accept: Optional[str]) -> str:
+    """Response format for an ``Accept`` header: the first recognized
+    serve content type wins (q-values ignored — three formats don't need
+    full RFC 7231); ``*/*``/absent → json."""
+    if not accept:
+        return "json"
+    for part in accept.split(","):
+        base = part.split(";", 1)[0].strip().lower()
+        if base in _FORMAT_OF:
+            return _FORMAT_OF[base]
+    return "json"
+
+
+# -- codecs --------------------------------------------------------------------
+
+def _json_array(arr: np.ndarray):
+    """Nested lists, exactly representable: every supported dtype embeds in
+    f64 (ints included), so tolist-after-f64-cast is lossless."""
+    if arr.dtype.kind in ("i", "u", "b"):
+        return arr.tolist()
+    return np.asarray(arr, dtype=np.float64).tolist()
+
+
+def _encode_json(arrays: Dict[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
+    body = dict(meta)
+    body["__dtypes__"] = {k: _dtype_name(v) for k, v in arrays.items()}
+    for k, v in arrays.items():
+        body[k] = _json_array(v)
+    return json.dumps(body).encode()
+
+
+def _decode_json(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    body = json.loads(buf)
+    if not isinstance(body, dict):
+        raise ValueError("json payload must be an object")
+    dtypes = body.pop("__dtypes__", {})
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {}
+    for k, v in body.items():
+        if k in dtypes:
+            arrays[k] = np.asarray(v, dtype=dtype_by_name(dtypes[k]))
+        else:
+            meta[k] = v
+    return arrays, meta
+
+
+def _encode_npz(arrays: Dict[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
+    out = io.BytesIO()
+    to_save = {}
+    for k, v in arrays.items():
+        name = _dtype_name(v)
+        if v.dtype.kind == "V":
+            # np.save cannot write void dtypes: ship bf16 as its u16 bit
+            # pattern, dtype restored from __dtypes__ on decode
+            to_save[k] = v.view(np.uint16)
+        else:
+            to_save[k] = v
+    to_save["__meta__"] = np.frombuffer(
+        json.dumps({"meta": meta,
+                    "dtypes": {k: _dtype_name(v) for k, v in arrays.items()}}
+                   ).encode(),
+        dtype=np.uint8,
+    )
+    np.savez(out, **to_save)
+    return out.getvalue()
+
+
+def _decode_npz(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    with np.load(io.BytesIO(buf)) as z:
+        files = {k: z[k] for k in z.files}
+    blob = files.pop("__meta__", None)
+    info = (
+        json.loads(bytes(blob.tobytes()).decode()) if blob is not None
+        else {"meta": {}, "dtypes": {}}
+    )
+    arrays: Dict[str, np.ndarray] = {}
+    for k, v in files.items():
+        want = info["dtypes"].get(k)
+        if want and want != v.dtype.name:
+            arrays[k] = v.view(dtype_by_name(want))
+        else:
+            arrays[k] = v
+    return arrays, info.get("meta", {})
+
+
+def _encode_raw(arrays: Dict[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
+    mbytes = json.dumps(meta).encode()
+    parts = [_MAGIC, struct.pack("<HHI", _VERSION, len(arrays), len(mbytes)),
+             mbytes]
+    for name, arr in arrays.items():
+        dname = _dtype_name(arr)
+        if dname not in DTYPE_CODES:
+            raise ValueError(f"raw format cannot carry dtype {dname!r}")
+        nbytes = name.encode()
+        arr = np.ascontiguousarray(arr)
+        parts.append(struct.pack("<H", len(nbytes)))
+        parts.append(nbytes)
+        parts.append(struct.pack("<BB", DTYPE_CODES[dname], arr.ndim))
+        parts.append(struct.pack(f"<{arr.ndim}Q", *arr.shape))
+        # little-endian on the wire regardless of host (numpy native is LE
+        # everywhere we run, but the contract is explicit). astype, NOT
+        # view: view relabels the dtype without swapping the bytes —
+        # big-endian input would serialize as byte-swapped garbage
+        data = (
+            arr.astype(arr.dtype.newbyteorder("<"))
+            if arr.dtype.byteorder == ">" else arr
+        )
+        parts.append(data.tobytes())
+    return b"".join(parts)
+
+
+def _decode_raw(buf: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    if buf[:4] != _MAGIC:
+        raise ValueError("not a SCW1 raw payload (bad magic)")
+    version, n_arr, mlen = struct.unpack_from("<HHI", buf, 4)
+    if version != _VERSION:
+        raise ValueError(f"unsupported raw wire version {version}")
+    off = 12
+    meta = json.loads(buf[off : off + mlen]) if mlen else {}
+    off += mlen
+    arrays: Dict[str, np.ndarray] = {}
+    for _ in range(n_arr):
+        (nlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        name = buf[off : off + nlen].decode()
+        off += nlen
+        code, ndim = struct.unpack_from("<BB", buf, off)
+        off += 2
+        shape = struct.unpack_from(f"<{ndim}Q", buf, off)
+        off += 8 * ndim
+        dt = dtype_by_name(_DTYPE_OF_CODE[code])
+        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+        nbytes = count * dt.itemsize
+        if off + nbytes > len(buf):
+            raise ValueError("raw payload truncated")
+        arrays[name] = (
+            np.frombuffer(buf, dtype=dt, count=count, offset=off)
+            .reshape(shape)
+            .copy()  # own the memory: callers may outlive the buffer
+        )
+        off += nbytes
+    return arrays, meta
+
+
+_ENCODERS = {"json": _encode_json, "npz": _encode_npz, "raw": _encode_raw}
+_DECODERS = {"json": _decode_json, "npz": _decode_npz, "raw": _decode_raw}
+
+
+def encode_payload(
+    fmt: str, arrays: Dict[str, np.ndarray], meta: Dict[str, Any]
+) -> bytes:
+    """Serialize ``(arrays, meta)`` in wire format ``fmt``. Array dtypes
+    travel exactly (the dtype-round-trip contract); meta must be plain
+    JSON-able scalars/lists."""
+    if fmt not in _ENCODERS:
+        raise ValueError(f"unknown wire format {fmt!r} (want one of {FORMATS})")
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    return _ENCODERS[fmt](arrays, meta)
+
+
+def decode_payload(
+    fmt: str, buf: bytes
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Inverse of `encode_payload` — bit-exact for every supported dtype.
+    ANY malformed payload raises ``ValueError`` (never `struct.error` /
+    `zipfile.BadZipFile` / raw KeyErrors): the server's 400 handler
+    catches ValueError, and "unparseable body → 400" is a documented
+    contract (docs/SERVING.md failure matrix)."""
+    if fmt not in _DECODERS:
+        raise ValueError(f"unknown wire format {fmt!r} (want one of {FORMATS})")
+    try:
+        return _DECODERS[fmt](bytes(buf))
+    except ValueError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            f"malformed {fmt} payload: {type(e).__name__}: {e}"
+        ) from e

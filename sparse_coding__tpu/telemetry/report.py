@@ -667,6 +667,35 @@ def _serving_section(run, lines: List[str]):
             span_bits.append(f"{cat} {secs:.2f} s")
     if span_bits:
         lines.append("- span time: " + ", ".join(span_bits))
+    # wire formats & sparse/fused traffic (ISSUE 15, docs/SERVING.md):
+    # per-format request counts + response bytes, so a dense-JSON-heavy
+    # deployment is visible at a glance
+    def _kb(v: float) -> str:
+        v = float(v)
+        for unit in ("B", "KB", "MB", "GB"):
+            if v < 1024 or unit == "GB":
+                return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+            v /= 1024
+        return f"{v:.1f} GB"
+
+    fmt_bits = []
+    for fmt in ("json", "npz", "raw"):
+        n = counters.get(f"serve.requests.{fmt}")
+        if not n:
+            continue
+        fmt_bits.append(
+            f"{fmt} {int(n)} req / "
+            f"{_kb(counters.get(f'serve.bytes_out.{fmt}', 0))} out"
+        )
+    if fmt_bits:
+        lines.append("- wire: " + ", ".join(fmt_bits))
+    sparse = int(counters.get("serve.sparse_requests", 0))
+    feats = int(counters.get("serve.feature_requests", 0))
+    if sparse or feats:
+        lines.append(
+            f"- sparse top-k responses: {sparse}; fused /features "
+            f"requests: {feats}"
+        )
     if dict_events:
         lines.append("")
         lines.append("| dict | event | weights | source |")

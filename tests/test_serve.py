@@ -461,6 +461,9 @@ def test_report_serving_section_golden():
     assert "batch occupancy 87.5%" in md
     assert "drained clean (signal 15) after 96 request(s)" in md
     assert "| d3 | added | native |" in md
+    # ISSUE 15: per-format wire accounting + sparse/fused traffic render
+    assert "wire: json 64 req / 6.2 MB out, npz 24 req / 28.0 KB out" in md
+    assert "sparse top-k responses: 32; fused /features requests: 8" in md
 
 
 def test_monitor_serve_line_golden():
@@ -488,9 +491,21 @@ def test_perfdiff_serve_fixture_smoke():
     statuses = {r["key"]: r["status"] for r in clean["rows"]}
     assert statuses["serve_rows_per_sec"] == "ok"
     assert statuses["serve_naive_rows_per_sec"] == "ok"
+    assert statuses["serve_npz_rows_per_sec"] == "ok"
+    assert statuses["serve_sparse_bytes_per_row"] == "ok"
     slow = copy.deepcopy(bench)
     slow["serve_rows_per_sec"] = bench["serve_rows_per_sec"] * 0.5
     assert compare(bench, slow)["regressions"] == ["serve_rows_per_sec"]
+    # bytes keys gate INVERTED (lower is better): bloating the sparse
+    # response is the regression; shrinking it is an improvement
+    fat = copy.deepcopy(bench)
+    fat["serve_sparse_bytes_per_row"] = bench["serve_sparse_bytes_per_row"] * 3
+    assert compare(bench, fat)["regressions"] == ["serve_sparse_bytes_per_row"]
+    thin = copy.deepcopy(bench)
+    thin["serve_sparse_bytes_per_row"] = bench["serve_sparse_bytes_per_row"] * 0.5
+    res = compare(bench, thin)
+    assert res["regressions"] == []
+    assert "serve_sparse_bytes_per_row" in res["improvements"]
 
 
 def test_bench_serve_block_schema_pinned():
@@ -505,7 +520,20 @@ def test_bench_serve_block_schema_pinned():
         "compiled_steps",
     }
     assert bench["serve"]["n_dicts"] >= 4
-    for key in ("serve_rows_per_sec", "serve_naive_rows_per_sec"):
+    assert set(bench["serve_wire"]) == {
+        "k", "n_feats", "dense_json_bytes_per_row",
+        "sparse_npz_bytes_per_row", "bytes_per_row_ratio",
+        "npz_speedup_vs_json",
+    }
+    # THE ISSUE-15 acceptance pin: top-k npz cuts bytes/row >= 20x vs
+    # dense JSON at n_feats 4096 (measured 85.8x on the CPU floor)
+    assert bench["serve_wire"]["n_feats"] >= 4096
+    assert bench["serve_wire"]["bytes_per_row_ratio"] >= 20.0
+    assert bench["serve_npz_rows_per_sec"] > bench["serve_json_rows_per_sec"]
+    for key in ("serve_rows_per_sec", "serve_naive_rows_per_sec",
+                "serve_json_rows_per_sec", "serve_npz_rows_per_sec",
+                "serve_dense_json_bytes_per_row", "serve_sparse_bytes_per_row",
+                "features_rows_per_sec"):
         assert isinstance(bench[key], (int, float))
         assert len(bench[f"{key}_spread"]) == 2
 

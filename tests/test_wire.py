@@ -1,0 +1,773 @@
+"""Sparse top-k responses, wire formats, and harvest→encode fusion (ISSUE 15).
+
+Covers the `serve.wire` codecs (bit-exact round trips per format × dtype),
+the engine's in-step top-k (selection exactness, k clamping, bounded
+compiled-shape menu), the dtype round-trip contract (the old silent f32
+coercion, regression-tested with bf16/f16 dicts), the parametrized
+round-trip contract (sparse/dense × json/npz/raw × registry dict classes,
+bit-exact vs single-dict dense encode), router byte-exact passthrough of
+binary bodies under retry, the fused ``/features`` path bit-matching the
+two-step harvest-then-encode pipeline, and the chaos acceptance: a replica
+SIGKILLed under npz-sparse load costs zero wrong bytes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.models.learned_dict import (
+    IdentityReLU,
+    RandomDict,
+    ReverseSAE,
+    TiedSAE,
+    UntiedSAE,
+)
+from sparse_coding__tpu.serve import wire
+from sparse_coding__tpu.serve.engine import EncodeEngine, k_bucket
+from sparse_coding__tpu.serve.registry import DictRegistry
+from sparse_coding__tpu.serve.server import (
+    ServeServer,
+    attach_subject_from_spec,
+)
+from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+pytestmark = pytest.mark.serve
+
+D, N = 16, 64
+
+
+def _rows(seed: int, n: int = 5, d: int = D, dtype=np.float32) -> np.ndarray:
+    return (
+        np.random.default_rng(seed).standard_normal((n, d)).astype(dtype)
+    )
+
+
+def _dict_of(cls, seed: int = 0, d: int = D, n: int = N):
+    rng = np.random.default_rng(seed)
+    enc = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    bias = jnp.asarray(rng.standard_normal(n).astype(np.float32) * 0.1)
+    if cls is TiedSAE:
+        return TiedSAE(enc, bias)
+    if cls is UntiedSAE:
+        dec = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+        return UntiedSAE(enc, dec, bias)
+    if cls is ReverseSAE:
+        return ReverseSAE(enc, bias)
+    if cls is RandomDict:
+        return RandomDict(d, n, key=jax.random.PRNGKey(seed))
+    if cls is IdentityReLU:
+        return IdentityReLU(d, bias=jnp.asarray(
+            rng.standard_normal(d).astype(np.float32) * 0.1
+        ))
+    raise AssertionError(cls)
+
+
+# -- wire codecs ---------------------------------------------------------------
+
+@pytest.mark.parametrize("fmt", wire.FORMATS)
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.float16, ml_dtypes.bfloat16, np.int32, np.int8]
+)
+def test_codec_roundtrip_bit_exact(fmt, dtype):
+    rng = np.random.default_rng(3)
+    arr = (rng.standard_normal((4, 7)) * 3).astype(dtype)
+    meta = {"dict": "d0", "n_rows": 4, "k": 7, "nested": {"a": [1, 2]}}
+    out_arrays, out_meta = wire.decode_payload(
+        fmt, wire.encode_payload(fmt, {"codes": arr}, meta)
+    )
+    assert out_meta == meta
+    got = out_arrays["codes"]
+    assert got.dtype == arr.dtype and got.shape == arr.shape
+    # bitwise, not allclose: the round-trip contract is exactness
+    np.testing.assert_array_equal(
+        got.view(np.uint8), arr.view(np.uint8)
+    )
+
+
+def test_codec_multiple_arrays_and_empty_meta():
+    arrays = {
+        "indices": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "values": np.linspace(0, 1, 12, dtype=np.float16).reshape(3, 4),
+    }
+    for fmt in wire.FORMATS:
+        out, meta = wire.decode_payload(
+            fmt, wire.encode_payload(fmt, arrays, {})
+        )
+        assert meta == {}
+        assert set(out) == {"indices", "values"}
+        for k in arrays:
+            np.testing.assert_array_equal(out[k], arrays[k])
+            assert out[k].dtype == arrays[k].dtype
+
+
+def test_raw_format_byteswaps_big_endian_input():
+    """Review regression: the raw encoder used view() (dtype relabel, no
+    byte swap) for big-endian input, serializing garbage values. astype
+    must swap the bytes so explicitly-BE arrays round-trip by VALUE."""
+    be = np.array([[1.0, 2.5], [-3.25, 4.0]], dtype=">f4")
+    arrays, _ = wire.decode_payload(
+        "raw", wire.encode_payload("raw", {"codes": be}, {})
+    )
+    np.testing.assert_array_equal(arrays["codes"], be.astype("<f4"))
+
+
+def test_raw_format_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        wire.decode_payload("raw", b"NOPE" + b"\x00" * 32)
+    good = wire.encode_payload(
+        "raw", {"codes": np.ones((2, 2), np.float32)}, {}
+    )
+    with pytest.raises(ValueError, match="truncated"):
+        wire.decode_payload("raw", good[:-3])
+
+
+def test_malformed_binary_bodies_are_400_not_tracebacks():
+    """Review regression: a body truncated INSIDE the raw header raised
+    struct.error (not a ValueError), and garbage npz raised BadZipFile —
+    both escaped the server's 400 handler. decode_payload must normalize
+    every malformed payload to ValueError, and the server must answer
+    400."""
+    for fmt, junk in (
+        ("raw", b"SCW1\x01\x00"),           # dies inside the fixed header
+        ("raw", b"SCW1" + b"\xff" * 40),    # absurd meta length
+        ("npz", b"PK\x03\x04 not a zip"),
+        ("npz", b"total garbage"),
+        ("json", b"{not json"),
+    ):
+        with pytest.raises(ValueError):
+            wire.decode_payload(fmt, junk)
+    reg = DictRegistry()
+    reg.add("d0", _dict_of(TiedSAE, 0))
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.address + "/encode", data=b"SCW1\x01\x00",
+            headers={"Content-Type": wire.CONTENT_TYPES["raw"]},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert b"bad request" in ei.value.read()
+
+
+def test_negotiation_rules():
+    assert wire.negotiate(None) == "json"
+    assert wire.negotiate("*/*") == "json"
+    assert wire.negotiate("application/x-npz") == "npz"
+    assert wire.negotiate("application/x-sc-raw; q=0.9") == "raw"
+    assert wire.negotiate("text/html, application/x-npz") == "npz"
+    assert wire.format_of_content_type("application/json; charset=utf-8") == "json"
+    assert wire.format_of_content_type("application/octet-stream") == "raw"
+    assert wire.format_of_content_type(None) == "json"
+
+
+# -- engine: in-step top-k -----------------------------------------------------
+
+def test_k_bucket_menu():
+    assert k_bucket(1, 64) == 1
+    assert k_bucket(9, 64) == 16
+    assert k_bucket(16, 64) == 16
+    assert k_bucket(1000, 64) == 64  # clamped to n_feats
+    assert k_bucket(-3, 64) == 1
+
+
+@pytest.fixture()
+def engine1():
+    reg = DictRegistry()
+    reg.add("d0", _dict_of(TiedSAE, 0))
+    eng = EncodeEngine(reg, max_batch=64, max_wait_ms=1.0).start()
+    yield reg, eng
+    eng.stop()
+
+
+def test_topk_bit_matches_dense(engine1):
+    """THE sparse acceptance: top-k (indices, values) from the compiled
+    step are exactly the dense codes' top-k — values bitwise equal at the
+    returned indices, selection equal to argsort."""
+    _, eng = engine1
+    X = _rows(0, n=7)
+    dense = eng.encode("d0", X)
+    idx, vals = eng.encode_topk("d0", X, k=9)
+    assert idx.shape == (7, 9) and idx.dtype == np.int32
+    assert vals.dtype == dense.dtype
+    for r in range(7):
+        np.testing.assert_array_equal(vals[r], dense[r][idx[r]])
+        np.testing.assert_array_equal(
+            np.sort(idx[r]), np.sort(np.argsort(-dense[r])[:9])
+        )
+        # sorted descending (lax.top_k contract)
+        assert (np.diff(vals[r]) <= 0).all()
+    # naive per-request path agrees bit-for-bit
+    nidx, nvals = eng.encode_naive("d0", X, top_k=9)
+    np.testing.assert_array_equal(nidx, idx)
+    np.testing.assert_array_equal(nvals, vals)
+
+
+def test_topk_clamps_to_n_feats(engine1):
+    _, eng = engine1
+    X = _rows(1, n=2)
+    idx, vals = eng.encode_topk("d0", X, k=10_000)
+    assert idx.shape == (2, N)
+    dense = eng.encode("d0", X)
+    for r in range(2):
+        np.testing.assert_array_equal(vals[r], dense[r][idx[r]])
+
+
+def test_topk_compiled_shape_menu_bounded(engine1):
+    """Varied requested ks share power-of-two k-buckets: after warming one
+    k per bucket, no request-driven k may add a compiled shape."""
+    _, eng = engine1
+    eng.warmup(topk_ks=(1, 2, 4, 8, 16, 32, 64))
+    warm = set(eng.compiled_shapes)
+    for k in (1, 2, 3, 5, 7, 9, 15, 17, 30, 33, 63, 64):
+        eng.encode_topk("d0", _rows(k, n=3), k=k)
+    assert set(eng.compiled_shapes) == warm, (
+        "per-request k leaked past the k-bucket menu"
+    )
+
+
+def test_dense_and_sparse_coalesce_separately(engine1):
+    """Dense and sparse requests drained together dispatch in separate
+    groups but both resolve correctly (the batch key separates them)."""
+    _, eng = engine1
+    X = _rows(2, n=3)
+    reqs = [eng.submit("d0", X) for _ in range(2)]
+    sreqs = [eng.submit("d0", X, top_k=5) for _ in range(2)]
+    dense = [r.result(30) for r in reqs]
+    sparse = [r.result(30) for r in sreqs]
+    for out in dense:
+        np.testing.assert_array_equal(out, dense[0])
+    for idx, vals in sparse:
+        for r in range(3):
+            np.testing.assert_array_equal(vals[r], dense[0][r][idx[r]])
+
+
+# -- dtype round-trip (the ServeClient f32-coercion regression) ----------------
+
+@pytest.mark.parametrize("fmt", wire.FORMATS)
+@pytest.mark.parametrize("dtype_name", ["bfloat16", "float16"])
+def test_dtype_roundtrips_through_every_format(fmt, dtype_name):
+    """Regression (ISSUE 15 satellite): `ServeClient.encode` used to force
+    ``dtype=np.float32`` on every response. A bf16/f16 dict encoding
+    same-dtype rows must hand the client codes in the dict's dtype,
+    bit-exact vs a direct encode, through EVERY wire format."""
+    dt = wire.dtype_by_name(dtype_name)
+    rng = np.random.default_rng(0)
+    enc = jnp.asarray(rng.standard_normal((N, D)).astype(np.float32)).astype(
+        jnp.dtype(dtype_name)
+    )
+    ld = TiedSAE(enc, jnp.zeros((N,), jnp.dtype(dtype_name)))
+    reg = DictRegistry()
+    reg.add("q0", ld)
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        client = srv.client()
+        X = _rows(5, n=4).astype(dt)
+        direct = np.asarray(ld.encode(jnp.asarray(X)))
+        assert direct.dtype == dt  # the premise: codes are native dtype
+        out = client.encode("q0", X, format=fmt)
+        assert out.dtype == dt, f"{fmt} coerced {dt} -> {out.dtype}"
+        np.testing.assert_array_equal(
+            out.view(np.uint8), direct.view(np.uint8)
+        )
+        # sparse values carry the same dtype
+        idx, vals = client.encode("q0", X, format=fmt, top_k=6)
+        assert vals.dtype == dt and idx.dtype == np.int32
+        for r in range(4):
+            np.testing.assert_array_equal(
+                vals[r].view(np.uint8), direct[r][idx[r]].view(np.uint8)
+            )
+
+
+# -- round-trip contract: sparse/dense × format × dict class -------------------
+
+@pytest.fixture(scope="module")
+def contract_server():
+    classes = [TiedSAE, UntiedSAE, ReverseSAE, RandomDict, IdentityReLU]
+    reg = DictRegistry()
+    lds = {}
+    for i, cls in enumerate(classes):
+        ld = _dict_of(cls, i)
+        lds[cls.__name__] = ld
+        reg.add(cls.__name__, ld)
+    srv = ServeServer(reg, max_batch=128, max_wait_ms=1.0).start()
+    yield srv, lds
+    srv.stop()
+
+
+@pytest.mark.parametrize("fmt", wire.FORMATS)
+@pytest.mark.parametrize(
+    "cls_name",
+    ["TiedSAE", "UntiedSAE", "ReverseSAE", "RandomDict", "IdentityReLU"],
+)
+def test_roundtrip_contract(contract_server, fmt, cls_name):
+    """THE wire contract: for every registry dict class × format, dense
+    codes over the wire are bit-exact vs a single-dict direct encode, and
+    sparse top-k responses are bit-exact slices of those codes."""
+    srv, lds = contract_server
+    client = srv.client()
+    ld = lds[cls_name]
+    X = _rows(11, n=6)
+    direct = np.asarray(ld.encode(jnp.asarray(X)))
+    dense = client.encode(cls_name, X, format=fmt)
+    np.testing.assert_array_equal(dense, direct)
+    k = min(9, direct.shape[1])
+    idx, vals = client.encode(cls_name, X, format=fmt, top_k=k)
+    assert idx.shape == (6, k)
+    for r in range(6):
+        np.testing.assert_array_equal(vals[r], direct[r][idx[r]])
+        assert (np.diff(vals[r]) <= 0).all()
+
+
+# -- router: binary passthrough under retry ------------------------------------
+
+def test_router_binary_passthrough_survives_retry():
+    """ISSUE-15 router contract: binary bodies and their Content-Type pass
+    through the router BYTE-EXACT, including when the response came from a
+    transparent retry after a dead replica."""
+    from sparse_coding__tpu.serve.router import Router
+
+    reg = DictRegistry()
+    ld = _dict_of(TiedSAE, 0)
+    reg.add("d0", ld)
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        router = Router(
+            {"r0": "http://127.0.0.1:9", "r1": srv.address},
+            health_interval=30.0, max_attempts=3, retry_backoff=0.01,
+        ).start()
+        try:
+            # force the first pick into the void (the retry pattern from
+            # tests/test_router.py) so the served bytes crossed a retry
+            router._targets["r0"].state = "live"
+            router._targets["r1"].in_flight = 1
+            X = _rows(1, n=3)
+            body = wire.encode_payload(
+                "npz", {"rows": X}, {"dict": "d0", "top_k": 7}
+            )
+            import urllib.request
+
+            req = urllib.request.Request(
+                router.address + "/encode", data=body,
+                headers={"Content-Type": wire.CONTENT_TYPES["npz"],
+                         "Accept": wire.CONTENT_TYPES["npz"]},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                routed = resp.read()
+                headers = dict(resp.headers.items())
+            assert headers["Content-Type"] == wire.CONTENT_TYPES["npz"]
+            assert int(headers["X-Router-Attempts"]) == 2
+            assert router.stats["retries"] == 1
+            # byte-exact vs the replica served directly (fresh request —
+            # npz bytes are deterministic for identical payloads)
+            direct_req = urllib.request.Request(
+                srv.address + "/encode", data=body,
+                headers={"Content-Type": wire.CONTENT_TYPES["npz"],
+                         "Accept": wire.CONTENT_TYPES["npz"]},
+                method="POST",
+            )
+            with urllib.request.urlopen(direct_req, timeout=30) as resp:
+                direct = resp.read()
+            arrays_r, meta_r = wire.decode_payload("npz", routed)
+            arrays_d, meta_d = wire.decode_payload("npz", direct)
+            # latency differs per request, and the router (the trace edge)
+            # minted an X-Trace-Id for the routed request; everything else
+            # must be equal
+            for m in (meta_r, meta_d):
+                m.pop("latency_ms", None)
+                m.pop("trace_id", None)
+            assert meta_r == meta_d
+            for key in arrays_d:
+                np.testing.assert_array_equal(arrays_r[key], arrays_d[key])
+            dense = np.asarray(ld.encode(jnp.asarray(X)))
+            for r in range(3):
+                np.testing.assert_array_equal(
+                    arrays_r["values"][r], dense[r][arrays_r["indices"][r]]
+                )
+        finally:
+            router.stop()
+
+
+def test_router_routes_features():
+    """POST /features forwards through the router like /encode."""
+    from sparse_coding__tpu.serve.router import Router
+
+    reg = DictRegistry()
+    reg.add("d0", _dict_of(TiedSAE, 0, d=128, n=N))
+    subj = attach_subject_from_spec(reg, "random:pythia-14m:1:residual")
+    with ServeServer(reg, max_batch=256, max_wait_ms=1.0) as srv:
+        with Router({"r0": srv.address}, health_interval=0.2) as router:
+            client = router.client()
+            toks = np.random.default_rng(0).integers(
+                0, 1000, size=(2, 8)
+            ).astype(np.int32)
+            out = client.encode_features("d0", tokens=toks, format="npz")
+            direct = srv.engine.encode_features("d0", toks)
+            np.testing.assert_array_equal(out, direct)
+            assert subj.subject_id == "subject"
+
+
+# -- harvest→encode fusion -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def features_setup():
+    reg = DictRegistry()
+    ld = _dict_of(TiedSAE, 3, d=128, n=256)
+    reg.add("f0", ld)
+    subj = attach_subject_from_spec(reg, "random:pythia-14m:1:residual")
+    eng = EncodeEngine(reg, max_batch=256, max_wait_ms=1.0).start()
+    yield reg, ld, subj, eng
+    eng.stop()
+
+
+def test_features_bit_match_two_step_pipeline(features_setup):
+    """THE ISSUE-15 fusion acceptance: ``/features`` output bit-matches the
+    two-step harvest-then-encode pipeline — `harvest_to_device` (the fused
+    HBM harvest path, fp16 store dtype) feeding the engine's /encode step —
+    because the fused dispatch runs those very executables."""
+    from sparse_coding__tpu.data.activations import harvest_to_device
+
+    reg, ld, subj, eng = features_setup
+    toks = np.random.default_rng(7).integers(0, 2000, size=(4, 8)).astype(
+        np.int32
+    )
+    fused = eng.encode_features("f0", toks)
+    gen = harvest_to_device(
+        subj.params, subj.lm_cfg, toks, [1], ["residual"],
+        batch_size=4, chunk_size_gb=1e-5, n_chunks=1,
+    )
+    chunk = next(gen)[(1, "residual")]
+    act = np.asarray(jax.device_get(chunk))
+    assert act.dtype == np.float16  # the chunk-store tier the fusion matches
+    two_step = eng.encode("f0", act)
+    np.testing.assert_array_equal(fused, two_step)
+    # sparse features: bit-exact slices of the fused dense codes
+    idx, vals = eng.encode_features("f0", toks, top_k=11)
+    for r in range(fused.shape[0]):
+        np.testing.assert_array_equal(vals[r], fused[r][idx[r]])
+
+
+def test_features_validation(features_setup):
+    reg, ld, subj, eng = features_setup
+    with pytest.raises(ValueError, match="integers"):
+        eng.submit_features("f0", np.zeros((2, 4), np.float32))
+    with pytest.raises(ValueError, match="dispatch cap"):
+        eng.submit_features("f0", np.zeros((8, 64), np.int32))
+    with pytest.raises(KeyError):
+        eng.submit_features("f0", np.zeros((1, 4), np.int32), subject="nope")
+    # width mismatch: a dict the subject cannot feed
+    reg.add("narrow", _dict_of(TiedSAE, 9, d=16, n=32))
+    try:
+        with pytest.raises(ValueError, match="width"):
+            eng.submit_features("narrow", np.zeros((1, 4), np.int32))
+    finally:
+        reg.remove("narrow")
+
+
+def test_features_texts_path():
+    """``texts`` tokenize through the subject's attached tokenizer with the
+    harvest pipeline's EOS-joined exact-length chunking."""
+    from sparse_coding__tpu.data.activations import chunk_and_tokenize_texts
+    from sparse_coding__tpu.lm import model as lm_model
+
+    reg = DictRegistry()
+    reg.add("f0", _dict_of(TiedSAE, 3, d=128, n=256))
+    lm_cfg = lm_model.config_for("pythia-14m")
+    params = lm_model.init_params(jax.random.PRNGKey(0), lm_cfg)
+    stub_tok = lambda t: [ord(c) % 97 + 1 for c in t]
+    reg.attach_subject("subject", params, lm_cfg, 1, tokenize=stub_tok)
+    with ServeServer(reg, max_batch=256, max_wait_ms=1.0) as srv:
+        client = srv.client()
+        texts = ["hello world, this is a sentence"] * 4
+        out = client.encode_features("f0", texts=texts, seq_len=8,
+                                     format="raw")
+        toks = chunk_and_tokenize_texts(texts, stub_tok, eos_id=0,
+                                        max_length=8)
+        expected = srv.engine.encode_features("f0", toks)
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_feature_dispatch_never_exceeds_warmed_menu():
+    """Review regression: at a non-power-of-two ``max_batch // seq_len``
+    the drainer could admit more sequences than any warmed bucket and pad
+    PAST the row budget (e.g. 21 seqs → bucket 32 → 384 rows at
+    max_batch 256). The seq cap + group chunking must keep every fused
+    dispatch inside the warmup menu."""
+    reg = DictRegistry()
+    reg.add("f0", _dict_of(TiedSAE, 3, d=128, n=256))
+    attach_subject_from_spec(reg, "random:pythia-14m:1:residual")
+    eng = EncodeEngine(reg, max_batch=256, max_wait_ms=30.0).start()
+    try:
+        S = 12  # 256 // 12 = 21: not a power of two
+        cap = eng._seq_cap(S)
+        assert cap == 16 and cap * S <= 256
+        eng.warmup_features(S)
+        warm = set(eng.compiled_shapes)
+        # a single request beyond the cap is rejected, not padded past it
+        with pytest.raises(ValueError, match="dispatch cap"):
+            eng.submit_features("f0", np.zeros((cap + 1, S), np.int32))
+        # many small requests submitted together: the drainer's row budget
+        # admits 20 sequences at once; the group must CHUNK, not pad to 32
+        reqs = [
+            eng.submit_features(
+                "f0", np.full((2, S), 3 + i, np.int32)
+            )
+            for i in range(10)
+        ]
+        outs = [r.result(60) for r in reqs]
+        assert all(o.shape == (2 * S, 256) for o in outs)
+        assert set(eng.compiled_shapes) == warm, (
+            "a fused dispatch compiled a shape warmup never saw"
+        )
+        # determinism: re-submitting the identical burst reproduces every
+        # response bit-exactly (same dispatch shapes → same executables)
+        reqs2 = [
+            eng.submit_features("f0", np.full((2, S), 3 + i, np.int32))
+            for i in range(10)
+        ]
+        for out, r2 in zip(outs, reqs2):
+            np.testing.assert_array_equal(out, r2.result(60))
+        # correctness across the chunk split vs a solo encode: the subject
+        # forward is bit-stable only per batch shape (different seq
+        # buckets compile different executables), so cross-bucket
+        # agreement is ulp-level, not bitwise
+        for i, out in enumerate(outs):
+            solo = eng.encode_features("f0", np.full((2, S), 3 + i, np.int32))
+            np.testing.assert_allclose(out, solo, rtol=3e-4, atol=2e-5)
+    finally:
+        eng.stop()
+
+
+def test_compile_counter_sees_dtype_programs(engine1):
+    """Review regression: the compile-tracking key omitted the batch
+    dtype, so mixed-dtype traffic recompiled uncounted."""
+    _, eng = engine1
+    before = len(eng.compiled_shapes)
+    eng.encode("d0", _rows(0, n=3, dtype=np.float32))
+    mid = len(eng.compiled_shapes)
+    eng.encode("d0", _rows(0, n=3).astype(np.float16))
+    assert len(eng.compiled_shapes) > mid >= before + 1, (
+        "an f16 batch at the same shape is a NEW compiled program"
+    )
+
+
+def test_wire_stats_key_bytes_in_by_request_format():
+    """Review regression: wire_stats booked bytes_in under the RESPONSE
+    format; it must mirror the telemetry counters (request format)."""
+    reg = DictRegistry()
+    reg.add("d0", _dict_of(TiedSAE, 0))
+    with ServeServer(reg, max_batch=64, max_wait_ms=1.0) as srv:
+        import urllib.request
+
+        body = wire.encode_payload("raw", {"rows": _rows(1, n=2)},
+                                   {"dict": "d0"})
+        req = urllib.request.Request(
+            srv.address + "/encode", data=body,
+            headers={"Content-Type": wire.CONTENT_TYPES["raw"],
+                     "Accept": wire.CONTENT_TYPES["json"]},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        assert srv.wire_stats["raw"]["bytes_in"] == len(body)
+        assert srv.wire_stats["json"]["bytes_in"] == 0
+        assert srv.wire_stats["json"]["requests"] == 1
+        assert srv.wire_stats["json"]["bytes_out"] > 0
+
+
+def test_feature_requests_micro_batch(features_setup):
+    """Concurrent same-shape token requests coalesce into one fused
+    dispatch (the continuous micro-batching contract extends to
+    /features)."""
+    _, ld, subj, eng = features_setup
+    eng2 = EncodeEngine(features_setup[0], max_batch=256,
+                        max_wait_ms=20.0).start()
+    try:
+        eng2.warmup_features(8)
+        batches_before = eng2.stats["batches"]
+        results = [None] * 6
+        def client(i):
+            toks = np.full((1, 8), 5 + i, np.int32)
+            results[i] = eng2.encode_features("f0", toks)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r is not None and r.shape == (8, 256) for r in results)
+        assert eng2.stats["batches"] - batches_before < 6
+    finally:
+        eng2.stop()
+
+
+# -- loadgen bytes accounting --------------------------------------------------
+
+def test_loadgen_bytes_accounting(engine1):
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    from loadgen import run_load
+
+    reg, eng = engine1
+    fake = {"bytes_sent": 0, "bytes_received": 0}
+
+    def fn(d, r):
+        out = eng.encode(d, r)
+        fake["bytes_sent"] += 100
+        fake["bytes_received"] += 1000
+        return out
+
+    out = run_load(
+        fn, ["d0"], n_clients=2, requests_per_client=4, rows_per_request=2,
+        width=D, bytes_snapshot=lambda: dict(fake),
+    )
+    assert out["request_bytes"] == 8 * 100
+    assert out["response_bytes"] == 8 * 1000
+    assert out["response_bytes_per_request"] == 1000.0
+    assert out["response_bytes_per_row"] == 500.0
+
+
+# -- chaos: SIGKILL under npz-sparse load --------------------------------------
+
+@pytest.mark.chaos
+def test_replica_sigkill_under_npz_sparse_load(tmp_path):
+    """ISSUE-15 chaos satellite (the test_router.py pattern, rerun with
+    npz-sparse responses): two subprocess replicas behind a router under
+    closed-loop npz top-k load; one replica SIGKILLed mid-flight. Every
+    successful response must be bit-identical per its declared format and
+    dict generation — sparse indices AND values — and the kill must cost
+    transparent retries, never wrong bytes."""
+    K = 7
+    lds = [_dict_of(TiedSAE, i) for i in range(2)]
+    export = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(export, [(ld, {}) for ld in lds])
+    X = _rows(42, n=3)
+    expected = {}
+    for i, ld in enumerate(lds):
+        dense = np.asarray(ld.encode(jnp.asarray(X)))
+        vals, idx = jax.lax.top_k(jnp.asarray(dense), K)
+        expected[f"learned_dicts:{i}"] = (
+            np.asarray(idx, np.int32), np.asarray(vals)
+        )
+
+    from sparse_coding__tpu.serve.router import Router, RouterClient
+    from sparse_coding__tpu.serve.server import RetryableRejection
+
+    procs, ports = [], []
+    try:
+        for i in range(2):
+            port_file = tmp_path / f"port{i}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "sparse_coding__tpu.serve.server",
+                 str(export), "--port", "0", "--port-file", str(port_file),
+                 "--max-batch", "64", "--max-wait-ms", "2",
+                 "--warmup-topk", str(K), "--replica-id", f"replica{i}"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ))
+        deadline = time.time() + 180
+        for i in range(2):
+            pf = tmp_path / f"port{i}"
+            while not pf.exists() and time.time() < deadline:
+                assert procs[i].poll() is None, (
+                    f"replica {i} died early:\n{procs[i].stdout.read()}"
+                )
+                time.sleep(0.2)
+            assert pf.exists(), f"replica {i} never bound"
+            ports.append(pf.read_text().strip())
+
+        router = Router(
+            {f"replica{i}": f"http://127.0.0.1:{p}"
+             for i, p in enumerate(ports)},
+            health_interval=0.25, dead_after=2, max_attempts=4,
+            retry_backoff=0.05,
+        ).start()
+        outcomes = {"ok": 0, "retried_ok": 0, "clean_reject": 0, "bad": []}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client_loop(cid):
+            client = RouterClient(router.address, timeout=60)
+            i = 0
+            while not stop.is_set():
+                did = f"learned_dicts:{(cid + i) % 2}"
+                i += 1
+                try:
+                    (idx, vals), meta = client.encode_with_meta(
+                        did, X, format="npz", top_k=K
+                    )
+                except RetryableRejection:
+                    with lock:
+                        outcomes["clean_reject"] += 1
+                    time.sleep(0.05)
+                    continue
+                except Exception as e:
+                    with lock:
+                        outcomes["bad"].append(repr(e))
+                    continue
+                want_idx, want_vals = expected[did]
+                with lock:
+                    if meta.get("generation") != 0:
+                        outcomes["bad"].append(
+                            f"unexpected generation {meta.get('generation')}"
+                        )
+                    elif (np.array_equal(idx, want_idx)
+                          and np.array_equal(vals, want_vals)):
+                        outcomes["ok"] += 1
+                        if meta.get("attempts", 1) > 1:
+                            outcomes["retried_ok"] += 1
+                    else:
+                        outcomes["bad"].append(f"wrong sparse bytes for {did}")
+
+        threads = [threading.Thread(target=client_loop, args=(c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+
+        def wait_ok(n, timeout=120.0):
+            end = time.time() + timeout
+            while time.time() < end:
+                with lock:
+                    if outcomes["ok"] >= n:
+                        return
+                time.sleep(0.05)
+            with lock:
+                pytest.fail(f"load never reached {n} ok: {outcomes}")
+
+        wait_ok(16)
+        victim = procs[1]
+        os.kill(victim.pid, signal.SIGKILL)
+        t_kill = time.time()
+        while time.time() < t_kill + 15.0:
+            if router.states()["replica1"] in ("dead", "suspect"):
+                break
+            time.sleep(0.05)
+        assert router.states()["replica1"] in ("dead", "suspect")
+        with lock:
+            ok_now = outcomes["ok"]
+        wait_ok(ok_now + 12)  # traffic keeps flowing through the survivor
+        stop.set()
+        for t in threads:
+            t.join(60)
+        with lock:
+            assert outcomes["bad"] == [], outcomes["bad"]
+            assert outcomes["ok"] > 0
+        assert router.stats["failed"] == 0
+        router.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
