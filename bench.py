@@ -46,6 +46,7 @@ import json
 import shutil
 import tempfile
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -308,7 +309,9 @@ def prep_tied_variant(stack, optimizer_kwargs=None, recompute_code=False):
 
     okw = {"learning_rate": 1e-3, "mu_dtype": "bfloat16"}
     okw.update(optimizer_kwargs or {})
-    prev = os.environ.get("SC_RECOMPUTE_CODE")
+    from sparse_coding__tpu.utils import flags as _flags
+
+    prev = _flags.SC_RECOMPUTE_CODE.raw()
     if recompute_code:
         os.environ["SC_RECOMPUTE_CODE"] = "1"
     try:
@@ -816,6 +819,35 @@ def prep_slo_eval(stack):
     return measure
 
 
+def prep_sclint(stack):
+    """sclint static-analysis throughput (ISSUE 16): full lint passes over
+    the shipped tree (`sparse_coding__tpu/ scripts/ bench.py`), in files per
+    second. The pass gates every commit (`scripts/check.sh`) and CI, so it
+    must stay cheap enough that nobody is tempted to skip it; perfdiff
+    gates this key like any runtime key. Host-side CPU work, chip-
+    independent — same class as `slo_eval_runs_per_sec`. Each pass pays the
+    full cost a fresh CLI run pays (registry construction included), minus
+    interpreter startup."""
+    from sparse_coding__tpu.analysis.engine import lint_paths
+
+    root = Path(__file__).resolve().parent
+    targets = [root / "sparse_coding__tpu", root / "scripts", root / "bench.py"]
+    findings, n_files = lint_paths(targets)  # warm + correctness gate
+    assert not findings, (
+        "bench tree must lint clean: " + "; ".join(f.render() for f in findings)
+    )
+    assert n_files > 0
+
+    def measure() -> float:
+        reps = 2
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _, n = lint_paths(targets)
+        return reps * n / (time.perf_counter() - t0)
+
+    return measure
+
+
 def prep_bigbatch(stack):
     """acts/s of the SAME flagship ensemble at batch 16384 through the
     batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
@@ -967,6 +999,7 @@ def main(argv=None):
                 stack, recompute_code=True
             ),
             "slo_eval_runs_per_sec": prep_slo_eval(stack),
+            "sclint_files_per_sec": prep_sclint(stack),
         }
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure

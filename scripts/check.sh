@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-shot pre-merge check: static analysis, abstract contracts, generated
+# docs, then the tier-1 test suite (ROADMAP.md). Everything a PR must pass,
+# in the order that fails fastest.
+#
+#   scripts/check.sh            # full: sclint + contracts + docs + tier-1
+#   scripts/check.sh --fast     # skip the tier-1 pytest run
+#
+# Exit: nonzero on the first failing stage.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fast=0
+[ "${1:-}" = "--fast" ] && fast=1
+
+echo "== sclint (static analysis over the shipped tree) =="
+JAX_PLATFORMS=cpu python -m sparse_coding__tpu.analysis \
+    sparse_coding__tpu/ scripts/ bench.py || exit $?
+
+echo "== sclint contracts (partition coverage, span tables, flags docs) =="
+JAX_PLATFORMS=cpu python -m sparse_coding__tpu.analysis --contracts \
+    sparse_coding__tpu/analysis || exit $?
+
+echo "== generated docs (utils.flags --check-docs) =="
+JAX_PLATFORMS=cpu python -m sparse_coding__tpu.utils.flags --check-docs || exit $?
+
+if [ "$fast" = "1" ]; then
+    echo "== tier-1 tests skipped (--fast) =="
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

@@ -1,0 +1,38 @@
+"""`sclint`: repo-native static analysis for TPU-correctness contracts.
+
+Three of the nastiest bugs this repo has shipped were *statically
+detectable contract violations*: the bf16 ``dtype.kind == 'f'`` check that
+silently no-op'd int8 residency (numpy reports bfloat16 as kind ``'V'``),
+the ``dequant`` span category that was missing from ``INNER_CATEGORIES``
+and double-counted serving goodput, and the int8-nu Adam denominator
+collapse. Each one survived review because the contract it broke lived in
+another module. This package encodes those contracts as lint rules
+(`rules`), walks the tree with a single-parse AST engine (`engine`), and
+— for invariants a pure AST walk can't see — runs abstract contract checks
+(`contracts`) built on ``jax.eval_shape`` and registry introspection, so no
+TPU is needed.
+
+CLI::
+
+    python -m sparse_coding__tpu.analysis sparse_coding__tpu/ scripts/ bench.py
+
+Exit codes: 0 = clean, 1 = findings, 3 = no Python files found. ``--json``
+emits machine-readable findings, ``--baseline FILE`` grandfathers a
+reviewed allowlist, ``--contracts`` adds the abstract checks. Rule catalog
+and workflow: ``docs/STATIC_ANALYSIS.md``.
+
+Suppression: a ``# sclint: allow(SC003) <reason>`` comment on the finding's
+line, on the first line of its enclosing statement, or on a comment line
+directly above it sanctions exactly that rule there — the idiom for the serve drainer's *deliberate* host syncs
+(client response materialization), mirroring how `telemetry.audit`'s
+``allowed_transfer()`` sanctions the train loop's once-per-chunk sync.
+"""
+
+from sparse_coding__tpu.analysis.findings import Finding
+from sparse_coding__tpu.analysis.engine import (
+    iter_python_files,
+    lint_paths,
+    load_baseline,
+)
+
+__all__ = ["Finding", "iter_python_files", "lint_paths", "load_baseline"]

@@ -1,0 +1,246 @@
+"""Abstract contract checks — invariants a pure AST walk cannot see.
+
+These run real repo code against *abstract* values (``jax.eval_shape`` plus
+registry introspection), so they need no TPU, no devices, and allocate no
+arrays. The flagship check is partition-rule coverage: every leaf of the
+ensemble + optimizer state trees must be classified by an explicit
+`parallel.mesh.infer_state_specs` rule, because an unclassified leaf
+defaults to replication and the first symptom is an OOM (or a silent 4x
+memory bill) at sweep scale, not a test failure.
+
+Run via ``python -m sparse_coding__tpu.analysis --contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Tuple
+
+__all__ = ["ContractResult", "CONTRACTS", "run_contracts"]
+
+
+@dataclasses.dataclass
+class ContractResult:
+    name: str
+    ok: bool
+    summary: str
+    details: List[str] = dataclasses.field(default_factory=list)
+
+    def render(self) -> str:
+        mark = "ok" if self.ok else "FAIL"
+        lines = [f"[{mark}] {self.name}: {self.summary}"]
+        lines += [f"       {d}" for d in self.details]
+        return "\n".join(lines)
+
+
+CONTRACTS: Dict[str, Callable[[], ContractResult]] = {}
+
+
+def contract(name: str):
+    def deco(fn):
+        CONTRACTS[name] = fn
+        return fn
+
+    return deco
+
+
+# -- partition-rule coverage --------------------------------------------------
+
+class _FakeMesh:
+    """Duck-typed stand-in for `jax.sharding.Mesh`: `infer_state_specs` only
+    reads ``mesh.shape``, so the contract can run with zero devices."""
+
+    def __init__(self, shape: Dict[str, int]):
+        self.shape = shape
+
+
+def _abstract_ensemble_state(n_models: int, activation_size: int,
+                             n_dict_components: int):
+    """The real state tree — params, buffers, adam opt_state, step — built
+    abstractly: `jax.eval_shape` traces the exact constructors `Ensemble`
+    uses (sig.init → stack_pytrees → vmap(tx.init)) without allocating."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparse_coding__tpu import ensemble as ens
+    from sparse_coding__tpu.models.sae import FunctionalTiedSAE
+
+    tx = ens.optim_str_to_func("adam")(learning_rate=1e-3)
+
+    def build(key):
+        keys = jax.random.split(key, n_models)
+        models = [
+            FunctionalTiedSAE.init(
+                k, activation_size, n_dict_components, l1_alpha=1e-3
+            )
+            for k in keys
+        ]
+        params_list, buffers_list = zip(*models)
+        params = ens.stack_pytrees(list(params_list))
+        buffers = ens.stack_pytrees(list(buffers_list))
+        opt_state = jax.vmap(tx.init)(params)
+        return ens.EnsembleState(
+            params=params,
+            buffers=buffers,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    return jax.eval_shape(build, jax.random.PRNGKey(0))
+
+
+def _leaf_paths(tree) -> List[Tuple[str, Any]]:
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out.append((jax.tree_util.keystr(path), leaf))
+    return out
+
+
+@contract("partition-coverage")
+def partition_coverage(
+    n_models: int = 4, activation_size: int = 64, n_dict_components: int = 128
+) -> ContractResult:
+    """Every leaf of the ensemble + optimizer state trees must be matched by
+    an explicit `infer_state_specs` rule: stacked leaves (leading dim ==
+    n_models) get the model axis (dict axis too when their dim 1 divides the
+    dict mesh size), everything else is *deliberately* replicated (scalars,
+    step counters). A stacked leaf that comes back fully replicated means a
+    new state field slipped past the spec table — the silent-OOM class."""
+    from sparse_coding__tpu.parallel import mesh as pmesh
+
+    state = _abstract_ensemble_state(n_models, activation_size, n_dict_components)
+    fake = _FakeMesh({pmesh.MODEL_AXIS: n_models, pmesh.DICT_AXIS: 4})
+    specs = pmesh.infer_state_specs(state, n_models, fake, shard_dict=True)
+    dict_size = fake.shape[pmesh.DICT_AXIS]
+
+    leaves = _leaf_paths(state)
+    spec_leaves = dict(_leaf_paths_specs(specs))
+    uncovered: List[str] = []
+    covered = 0
+    for path, leaf in leaves:
+        shape = tuple(leaf.shape)
+        spec = spec_leaves.get(path)
+        axes = tuple(spec) if spec is not None else None
+        stacked = len(shape) >= 1 and shape[0] == n_models
+        if axes is None:
+            uncovered.append(f"{path} {shape}: no spec leaf produced")
+        elif stacked:
+            if not axes or axes[0] != pmesh.MODEL_AXIS:
+                uncovered.append(
+                    f"{path} {shape}: stacked leaf not placed on the model axis "
+                    f"(spec {axes}) — replicated n_models times"
+                )
+            elif (
+                pmesh.DICT_AXIS in axes
+                and (len(shape) < 2 or shape[1] % dict_size != 0)
+            ):
+                uncovered.append(
+                    f"{path} {shape}: dict-axis spec with indivisible dim 1"
+                )
+            else:
+                covered += 1
+        else:
+            if any(a is not None for a in axes):
+                uncovered.append(
+                    f"{path} {shape}: unstacked leaf given sharded spec {axes}"
+                )
+            else:
+                covered += 1
+
+    total = len(leaves)
+    ok = not uncovered
+    return ContractResult(
+        name="partition-coverage",
+        ok=ok,
+        summary=(
+            f"{covered}/{total} state leaves classified by an explicit "
+            f"partition rule (ensemble params+buffers+adam moments, "
+            f"n_models={n_models})"
+        ),
+        details=uncovered,
+    )
+
+
+def _leaf_paths_specs(tree) -> List[Tuple[str, Any]]:
+    """Like `_leaf_paths`, but PartitionSpec leaves: a P() is a pytree node
+    with no children under default flattening, so flatten with
+    ``is_leaf``."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    out = []
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )[0]
+    for path, leaf in flat:
+        out.append((jax.tree_util.keystr(path), leaf))
+    return out
+
+
+# -- span-table invariants ----------------------------------------------------
+
+@contract("span-tables")
+def span_tables() -> ContractResult:
+    """Structural invariants of the telemetry category registry: the three
+    tables are disjoint (a category in two tables is double-counted by
+    construction), and every nestable (INNER) category is itself emittable
+    — an INNER entry nobody can emit is a dead suppression rule."""
+    from sparse_coding__tpu.analysis.context import RepoContext
+
+    t = RepoContext().span_tables
+    good, bad, derived, inner = (
+        set(t["GOODPUT_CATEGORIES"]), set(t["BADPUT_CATEGORIES"]),
+        set(t["DERIVED_CATEGORIES"]), set(t["INNER_CATEGORIES"]),
+    )
+    problems: List[str] = []
+    for a, b, name in (
+        (good, bad, "GOODPUT∩BADPUT"),
+        (good, derived, "GOODPUT∩DERIVED"),
+        (bad, derived, "BADPUT∩DERIVED"),
+    ):
+        if a & b:
+            problems.append(f"{name} = {sorted(a & b)}")
+    dead_inner = inner - (good | bad)
+    if dead_inner:
+        problems.append(f"INNER categories nobody can emit: {sorted(dead_inner)}")
+    for table_name in ("GOODPUT_CATEGORIES", "BADPUT_CATEGORIES"):
+        seq = t[table_name]
+        if len(seq) != len(set(seq)):
+            problems.append(f"duplicates inside {table_name}")
+    return ContractResult(
+        name="span-tables",
+        ok=not problems,
+        summary=(
+            f"{len(good)} goodput / {len(bad)} badput / {len(derived)} "
+            f"derived categories, {len(inner)} nestable"
+        ),
+        details=problems,
+    )
+
+
+# -- flags/docs sync ----------------------------------------------------------
+
+@contract("flags-docs")
+def flags_docs() -> ContractResult:
+    """The flag table in docs/observability.md is generated from
+    `utils.flags.FLAGS`; this fails when the registry changed but
+    ``python -m sparse_coding__tpu.utils.flags --update-docs`` wasn't
+    re-run."""
+    from sparse_coding__tpu.utils import flags
+
+    ok = flags.check_docs()
+    return ContractResult(
+        name="flags-docs",
+        ok=ok,
+        summary=(
+            f"docs flag table in sync ({len(flags.FLAGS)} flags)" if ok
+            else "docs/observability.md flag table is stale — run "
+                 "python -m sparse_coding__tpu.utils.flags --update-docs"
+        ),
+    )
+
+
+def run_contracts() -> List[ContractResult]:
+    return [fn() for fn in CONTRACTS.values()]

@@ -1,0 +1,691 @@
+"""The sclint rule registry: seven rules, each encoding a shipped bug.
+
+| id    | contract                                                        |
+| ----- | --------------------------------------------------------------- |
+| SC001 | floating-ness via ``dtype.kind`` (bf16 is kind ``'V'`` — PR 10) |
+| SC002 | span/event category literals vs `telemetry.spans` tables        |
+| SC003 | host syncs in the train-step loop / serve drainer call graphs   |
+| SC004 | non-static jit params in shape positions (recompile hazards)    |
+| SC005 | ``SC_*`` env reads outside the `utils.flags` registry           |
+| SC006 | metric names colliding after Prometheus sanitization            |
+| SC007 | ``SC_FAULT`` specs naming sites absent from `utils.faults`      |
+
+A rule is a generator ``(module, repo) -> findings`` registered with
+:func:`rule`; ``scope="repo"`` rules instead receive the full module list
+(for cross-file contracts like SC006's collision check). Findings carry the
+AST node so the engine can honor line- and statement-anchored
+``# sclint: allow(SCxxx)`` suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set, Tuple
+
+from sparse_coding__tpu.analysis.context import RepoContext, dotted_name, _last_name
+
+__all__ = ["RULES", "RuleSpec", "RawFinding", "rule"]
+
+
+class RawFinding(NamedTuple):
+    """A rule hit before suppression/baseline filtering: the engine turns
+    these into `findings.Finding` records."""
+
+    rule: str
+    node: ast.AST
+    message: str
+
+
+class RuleSpec(NamedTuple):
+    id: str
+    title: str
+    scope: str  # "module" | "repo"
+    fn: object
+    doc: str
+
+
+RULES: Dict[str, RuleSpec] = {}
+
+
+def rule(rule_id: str, title: str, scope: str = "module"):
+    def deco(fn):
+        RULES[rule_id] = RuleSpec(rule_id, title, scope, fn, fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+# -- SC001: dtype.kind floating-ness ------------------------------------------
+
+def _is_dtype_kind(node: ast.AST) -> bool:
+    """``<x>.dtype.kind`` or ``<name containing 'dtype'>.kind``."""
+    if not (isinstance(node, ast.Attribute) and node.attr == "kind"):
+        return False
+    base = node.value
+    if isinstance(base, ast.Attribute) and base.attr == "dtype":
+        return True
+    if isinstance(base, ast.Name) and "dtype" in base.id.lower():
+        return True
+    if (
+        isinstance(base, ast.Call)
+        and _last_name(base.func) == "dtype"  # np.dtype(x).kind
+    ):
+        return True
+    return False
+
+
+def _str_values(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []
+
+
+@rule("SC001", "floating-ness tested via dtype.kind")
+def sc001(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """The PR-10 bf16 bug class: numpy reports bfloat16 as kind ``'V'``
+    (void), so ``dtype.kind == 'f'`` silently excludes the dtype this
+    codebase trains in. Floating-ness must go through
+    ``jnp.issubdtype(dtype, jnp.floating)``. Integer/raw-codec kind checks
+    (``'i'``/``'u'``/``'b'``/``'V'`` without ``'f'``) are legitimate wire
+    idioms and are not flagged."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(_is_dtype_kind(s) for s in sides):
+            continue
+        literals = [v for s in sides for v in _str_values(s)]
+        if "f" in literals:
+            yield RawFinding(
+                "SC001", node,
+                "floating-ness tested via dtype.kind — bfloat16 is numpy "
+                "kind 'V', so this check silently misses it; use "
+                "jnp.issubdtype(dtype, jnp.floating)",
+            )
+
+
+# -- SC002: span/event categories ---------------------------------------------
+
+_SPAN_FUNCS = ("span", "Span", "_emit_span")
+
+
+def _span_category_arg(call: ast.Call) -> Optional[ast.Constant]:
+    """The category literal of a span-constructor call, if any: positional
+    index 1 (after the telemetry handle) or the ``category=`` keyword."""
+    if len(call.args) > 1:
+        a = call.args[1]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a
+    for kw in call.keywords:
+        if kw.arg == "category" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value
+    return None
+
+
+@rule("SC002", "span/event category not in telemetry.spans registry")
+def sc002(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """The dequant double-count class: a span emitted with a category the
+    `telemetry.spans` tables don't know is either dropped by the goodput
+    ledger (invisible wall time) or — when it legitimately nests inside a
+    goodput span but is missing from ``INNER_CATEGORIES`` — double-counted.
+    Checks every literal category handed to ``span(...)``/``Span(...)``/
+    ``_emit_span(...)`` and every ``category=`` keyword on ``event(...)``
+    calls, plus lexically nested ``with span(...)`` blocks whose inner
+    category is not registered as nestable."""
+    emittable = repo.emittable_categories
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_name(node.func)
+        if name in _SPAN_FUNCS:
+            cat = _span_category_arg(node)
+            if cat is not None and cat.value not in emittable:
+                yield RawFinding(
+                    "SC002", cat,
+                    f"span category {cat.value!r} is not an emittable "
+                    "category in telemetry/spans.py (register it in "
+                    "GOODPUT_CATEGORIES/BADPUT_CATEGORIES — and in "
+                    "INNER_CATEGORIES if it nests — or the goodput ledger "
+                    "will drop or double-count it)",
+                )
+        elif name in ("event", "event_active"):
+            for kw in node.keywords:
+                if (
+                    kw.arg == "category"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                    and kw.value.value not in repo.all_categories
+                ):
+                    yield RawFinding(
+                        "SC002", kw.value,
+                        f"event category {kw.value.value!r} is not in "
+                        "telemetry/spans.py CATEGORIES — the goodput ledger "
+                        "will not account it",
+                    )
+
+    # lexically nested spans: an inner category that is not registered
+    # nestable double-counts against its enclosing goodput span
+    for outer in ast.walk(module.tree):
+        if not isinstance(outer, ast.With):
+            continue
+        outer_cats = [
+            c.value for item in outer.items
+            if isinstance(item.context_expr, ast.Call)
+            and _last_name(item.context_expr.func) in _SPAN_FUNCS
+            and (c := _span_category_arg(item.context_expr)) is not None
+        ]
+        if not any(c in repo.goodput_categories for c in outer_cats):
+            continue
+        for inner in ast.walk(outer):
+            if inner is outer or not isinstance(inner, ast.With):
+                continue
+            for item in inner.items:
+                if not (
+                    isinstance(item.context_expr, ast.Call)
+                    and _last_name(item.context_expr.func) in _SPAN_FUNCS
+                ):
+                    continue
+                cat = _span_category_arg(item.context_expr)
+                if cat is not None and cat.value not in repo.inner_categories:
+                    yield RawFinding(
+                        "SC002", cat,
+                        f"span category {cat.value!r} opens inside a "
+                        f"goodput span but is not in INNER_CATEGORIES — "
+                        "its seconds will be counted twice (the dequant "
+                        "bug class)",
+                    )
+
+
+# -- SC003: host syncs in hot loops -------------------------------------------
+
+# entry points whose same-module call graphs form the audited hot paths
+_HOT_ENTRIES: Dict[str, Tuple[str, ...]] = {
+    "sparse_coding__tpu/train/loop.py": ("ensemble_train_loop",),
+    "sparse_coding__tpu/serve/engine.py": ("_drain_once", "_loop"),
+}
+
+_SYNC_ATTRS = ("device_get", "block_until_ready")
+# call-chain roots that produce device values (for the float()/int() check)
+_DEVICE_ROOTS = ("jnp", "jax")
+_DEVICE_METHODS = ("step_batch", "step_scan", "step_scan_idx")
+
+
+def _collect_calls(fn: ast.AST) -> Set[str]:
+    """Bare and ``self.``-qualified callee names inside a function body."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in ("self", "cls"):
+            out.add(f.attr)
+    return out
+
+
+def _allowed_transfer_lines(fn: ast.AST) -> Set[int]:
+    """Lines covered by a ``with allowed_transfer():`` block — the repo's
+    sanctioned-sync marker (`telemetry.audit`)."""
+    lines: Set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        if any(
+            isinstance(i.context_expr, ast.Call)
+            and _last_name(i.context_expr.func) == "allowed_transfer"
+            for i in node.items
+        ):
+            lines.update(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+    return lines
+
+
+def _device_tainted_names(fn: ast.AST) -> Set[str]:
+    """Names assigned (directly or via subscript of a tainted name) from
+    jnp./jax. calls or ensemble step dispatches — candidates whose
+    ``float()``/``int()`` coercion is a device sync."""
+    tainted: Set[str] = set()
+    for _ in range(2):  # two passes: subscripts/aliases of tainted names
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            src = node.value
+            is_dev = False
+            if isinstance(src, ast.Call):
+                d = dotted_name(src.func)
+                root = d.split(".")[0]
+                if root in _DEVICE_ROOTS or _last_name(src.func) in _DEVICE_METHODS:
+                    is_dev = True
+            elif isinstance(src, ast.Subscript) and isinstance(src.value, ast.Name):
+                is_dev = src.value.id in tainted
+            elif isinstance(src, ast.Name):
+                is_dev = src.id in tainted
+            if not is_dev:
+                continue
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+    return tainted
+
+
+def _is_device_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        return d.split(".")[0] in _DEVICE_ROOTS or _last_name(node.func) in _DEVICE_METHODS
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Subscript):
+        return _is_device_expr(node.value, tainted)
+    return False
+
+
+@rule("SC003", "host sync inside a hot loop")
+def sc003(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """Host synchronization in the fused train-step loop or the serve
+    drainer stalls the dispatch pipeline (the reference's per-batch
+    ``.item()`` stall, SURVEY §2). Flags ``.item()``, ``jax.device_get``,
+    ``block_until_ready``, ``np.asarray`` and ``float()``/``int()`` on
+    device values inside the entry functions above and every same-module
+    function they (transitively) call. Sanctioned syncs must say so: either
+    a ``with allowed_transfer():`` block (`telemetry.audit`) or an inline
+    ``# sclint: allow(SC003) <why>`` on the statement. New hot loops opt in
+    by declaring ``__sclint_hot_entries__ = ("fn_name", ...)`` at module
+    top level."""
+    entries = None
+    for suffix, names in _HOT_ENTRIES.items():
+        if module.relpath.endswith(suffix):
+            entries = names
+    for node in module.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "__sclint_hot_entries__"
+        ):
+            try:
+                declared = ast.literal_eval(node.value)
+            except ValueError:
+                continue
+            entries = tuple(entries or ()) + tuple(declared)
+    if entries is None:
+        return
+
+    # same-module function table (functions + methods, by bare name)
+    table: Dict[str, ast.AST] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table.setdefault(node.name, node)
+
+    reachable: List[ast.AST] = []
+    seen: Set[str] = set()
+    frontier = [n for n in entries if n in table]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        fn = table[name]
+        reachable.append(fn)
+        for callee in _collect_calls(fn):
+            if callee in table and callee not in seen:
+                frontier.append(callee)
+
+    for fn in reachable:
+        sanctioned = _allowed_transfer_lines(fn)
+        tainted = _device_tainted_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or node.lineno in sanctioned:
+                continue
+            f = node.func
+            name = _last_name(f)
+            msg = None
+            if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+                msg = ".item() is a per-call host sync"
+            elif name in _SYNC_ATTRS:
+                msg = f"{dotted_name(f)} blocks on device completion"
+            elif name == "asarray" and isinstance(f, ast.Attribute) \
+                    and dotted_name(f.value) in ("np", "numpy"):
+                msg = "np.asarray materializes device data on the host"
+            elif isinstance(f, ast.Name) and f.id in ("float", "int") \
+                    and len(node.args) == 1 \
+                    and _is_device_expr(node.args[0], tainted):
+                msg = f"{f.id}() on a device value is a host sync"
+            if msg is not None:
+                yield RawFinding(
+                    "SC003", node,
+                    f"{msg} inside the hot path reachable from "
+                    f"{'/'.join(entries)} — move it off the step path, wrap "
+                    "a sanctioned once-per-chunk sync in allowed_transfer(), "
+                    "or annotate '# sclint: allow(SC003) <why>'",
+                )
+
+
+# -- SC004: jit recompile hazards ---------------------------------------------
+
+# (callable dotted suffix, shape-determining argument positions; None = all)
+_SHAPE_CALLS: Dict[str, Optional[Tuple[int, ...]]] = {
+    "zeros": (0,),
+    "ones": (0,),
+    "empty": (0,),
+    "full": (0,),
+    "arange": None,
+    "eye": (0, 1),
+    "reshape": None,
+    "broadcast_to": (1,),
+    "top_k": (1,),
+    "iota": (1,),
+}
+
+
+def _jit_static_names(dec: ast.Call, fn_args: List[str]) -> Set[str]:
+    """static_argnames/static_argnums of a ``partial(jax.jit, ...)`` or
+    ``jax.jit(...)`` wrapper, resolved to parameter names."""
+    static: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            static.update(_str_values(kw.value))
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                static.add(kw.value.value)
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            for i in nums:
+                if 0 <= i < len(fn_args):
+                    static.add(fn_args[i])
+    return static
+
+
+def _jitted_functions(tree: ast.AST):
+    """Yield (function_node, static_param_names) for every function the
+    module wraps in jax.jit — decorator form, ``partial(jax.jit, ...)``
+    decorator form, or ``jax.jit(fn_or_lambda, ...)`` call form."""
+    table: Dict[str, ast.AST] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted_name(dec).endswith("jit"):
+                    yield node, set()
+                elif (
+                    isinstance(dec, ast.Call)
+                    and (
+                        dotted_name(dec.func).endswith("jit")
+                        or (
+                            _last_name(dec.func) == "partial"
+                            and dec.args
+                            and dotted_name(dec.args[0]).endswith("jit")
+                        )
+                    )
+                ):
+                    args = [a.arg for a in node.args.args]
+                    yield node, _jit_static_names(dec, args)
+        elif isinstance(node, ast.Call) and dotted_name(node.func).endswith("jit") \
+                and node.args:
+            target = node.args[0]
+            fn = None
+            if isinstance(target, ast.Lambda):
+                fn = target
+            elif isinstance(target, ast.Name) and target.id in table:
+                fn = table[target.id]
+            if fn is not None:
+                args = [a.arg for a in fn.args.args]
+                yield fn, _jit_static_names(node, args)
+
+
+@rule("SC004", "non-static jit parameter in a shape position")
+def sc004(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """A Python scalar parameter of a jitted function that determines an
+    output shape is either a trace error (traced ints cannot size arrays)
+    or — once someone "fixes" it by making it static — a silent
+    recompile-per-value hazard at sweep scale. The contract: declare it in
+    ``static_argnames`` AND route caller values through the power-of-two
+    bucket helpers (`serve.engine._pow2_ceil` / ``k_bucket``) or an
+    ``lru_cache``'d builder, the idiom `train.loop._shuffler` and the serve
+    dispatch already follow. Closure-captured scalars are exempt: a cached
+    builder bakes them per-trace deliberately."""
+    for fn, static in _jitted_functions(module.tree):
+        params = {a.arg for a in fn.args.args} - static - {"self", "cls"}
+        if not params:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            if isinstance(node.func, ast.Attribute) and name == "reshape":
+                positions = None  # method form: every arg is a dim
+            elif name in _SHAPE_CALLS:
+                positions = _SHAPE_CALLS[name]
+            else:
+                continue
+            args = (
+                node.args if positions is None
+                else [node.args[i] for i in positions if i < len(node.args)]
+            )
+            for a in args:
+                # `x.shape[0]`-style reads are static at trace time even on
+                # traced arrays — only the *bare* parameter is a hazard
+                exempt: Set[int] = set()
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Attribute) and n.attr in (
+                        "shape", "dtype", "ndim", "size",
+                    ):
+                        exempt.update(id(m) for m in ast.walk(n.value))
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name) and n.id in params \
+                            and id(n) not in exempt:
+                        yield RawFinding(
+                            "SC004", n,
+                            f"parameter {n.id!r} of a jitted function is "
+                            f"used in a shape position ({name}) but is not "
+                            "in static_argnames — a trace error now, a "
+                            "recompile-per-value hazard once static; mark "
+                            "it static and bucket callers via _pow2_ceil/"
+                            "k_bucket or an lru_cache'd builder",
+                        )
+
+
+# -- SC005: SC_* env reads outside the flag registry --------------------------
+
+_FLAGS_MODULE_SUFFIX = "utils/flags.py"
+
+
+def _env_read_literal(node: ast.Call) -> Optional[ast.Constant]:
+    """The key literal of ``os.environ.get(k)`` / ``os.getenv(k)``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "get" and dotted_name(f.value).endswith("environ") and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a
+        if f.attr == "getenv" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a
+    return None
+
+
+@rule("SC005", "SC_* env flag read outside utils.flags")
+def sc005(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """Every ``SC_*`` env flag is declared once in `utils.flags.FLAGS`
+    (name, type, default, owner, doc) and read through its accessor. A
+    direct ``os.environ`` read re-scatters the default and parse to the
+    call site — the pre-registry world where 17 flags had no single source
+    of truth. Also flags ``SC_*`` names (read *or* written) that are not
+    registered at all: an unregistered flag is invisible to the generated
+    docs table and to this rule's own accounting."""
+    in_registry = module.relpath.endswith(_FLAGS_MODULE_SUFFIX)
+    registered = repo.registered_flags
+    import re as _re
+
+    flag_re = _re.compile(r"^SC_[A-Z0-9_]+$")
+    doc_lines = module.docstring_lines
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            lit = _env_read_literal(node)
+            if lit is not None and flag_re.match(lit.value) and not in_registry:
+                yield RawFinding(
+                    "SC005", lit,
+                    f"direct os.environ read of {lit.value!r} — go through "
+                    "sparse_coding__tpu.utils.flags "
+                    f"(flags.{lit.value}.get()/.raw()) so the default and "
+                    "parse live in the registry",
+                )
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and flag_re.match(node.value)
+            and node.value not in registered
+            and node.lineno not in doc_lines
+        ):
+            yield RawFinding(
+                "SC005", node,
+                f"{node.value!r} is not declared in utils/flags.py FLAGS — "
+                "register it (name, type, default, owner, doc) or the docs "
+                "table and lint accounting cannot see it",
+            )
+
+
+# -- SC006: metric name collisions after Prometheus sanitization --------------
+
+_METRIC_FUNCS = {
+    "counter_inc": "_total",
+    "counter_add_float": "_total",
+    "counter_inc_active": "_total",
+    "counter_add_float_active": "_total",
+    "gauge_set": "",
+    "gauge_set_active": "",
+    "hist_observe": "",
+}
+
+
+@rule("SC006", "metric names collide after Prometheus sanitization", scope="repo")
+def sc006(modules, repo: RepoContext) -> Iterable[Tuple[object, RawFinding]]:
+    """`telemetry.metrics_http` sanitizes telemetry keys (dots and illegal
+    characters become ``_``) and suffixes counters with ``_total``. Two
+    distinct registered names that sanitize to the same exposition name
+    silently merge into one Prometheus series — scrapes can't tell them
+    apart and SLO lookups read the wrong one. Collects every literal
+    counter/gauge/histogram name across the tree and reports each site of
+    a colliding group."""
+    by_final: Dict[str, List[Tuple[object, ast.AST, str, str]]] = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            if name not in _METRIC_FUNCS or not node.args:
+                continue
+            a = node.args[0]
+            if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+                continue
+            final = "sc_" + repo.sanitize_metric(a.value) + _METRIC_FUNCS[name]
+            by_final.setdefault(final, []).append((module, a, a.value, name))
+    for final, sites in by_final.items():
+        raws = {s[2] for s in sites}
+        if len(raws) < 2:
+            continue
+        for module, node, raw, fname in sites:
+            others = sorted(raws - {raw})
+            yield module, RawFinding(
+                "SC006", node,
+                f"metric name {raw!r} collides with {others} after "
+                f"Prometheus sanitization (both expose as {final!r}) — "
+                "rename one; the exposition would silently merge the "
+                "series",
+            )
+
+
+# -- SC007: SC_FAULT sites that don't exist -----------------------------------
+
+def _fault_spec_literals(tree: ast.AST) -> List[ast.Constant]:
+    """String literals positioned as SC_FAULT values: ``env["SC_FAULT"] =
+    v``, ``setenv("SC_FAULT", v)``, ``f(SC_FAULT=v)``, ``{"SC_FAULT": v}``."""
+    out: List[ast.Constant] = []
+
+    def is_fault_key(n: ast.AST) -> bool:
+        return isinstance(n, ast.Constant) and n.value == "SC_FAULT"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and is_fault_key(tgt.slice) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    out.append(node.value)
+        elif isinstance(node, ast.Call):
+            # positional key/value pairs only for env-writer callables —
+            # not every call that happens to mention the literal
+            if _last_name(node.func) in ("setenv", "putenv", "setdefault"):
+                for i, a in enumerate(node.args[:-1]):
+                    if is_fault_key(a) and isinstance(node.args[i + 1], ast.Constant) \
+                            and isinstance(node.args[i + 1].value, str):
+                        out.append(node.args[i + 1])
+            for kw in node.keywords:
+                if kw.arg == "SC_FAULT" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out.append(kw.value)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is not None and is_fault_key(k) \
+                        and isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.append(v)
+    return out
+
+
+@rule("SC007", "SC_FAULT spec names a nonexistent fault site")
+def sc007(module, repo: RepoContext) -> Iterable[RawFinding]:
+    """A chaos test whose ``SC_FAULT`` spec names a site no
+    ``fault_point(...)`` in the package declares injects *nothing* — the
+    test silently becomes a control run. Valid sites are the package's
+    literal fault_point call sites plus the grammar's aliases and
+    per-action defaults (`utils.faults`). Malformed specs (unknown action,
+    uninferrable site) are flagged too. Non-package files calling
+    ``fault_point`` with an unknown literal site get the same treatment."""
+    sites = repo.fault_sites
+    for lit in _fault_spec_literals(module.tree):
+        try:
+            specs = repo.parse_fault_spec(lit.value)
+        except ValueError as e:
+            yield RawFinding("SC007", lit, f"malformed SC_FAULT spec: {e}")
+            continue
+        for spec in specs:
+            if spec.site is not None and spec.site not in sites:
+                yield RawFinding(
+                    "SC007", lit,
+                    f"SC_FAULT spec {lit.value!r} selects site "
+                    f"{spec.site!r}, but no fault_point({spec.site!r}) "
+                    "exists in the package — the fault would never fire "
+                    "and the test silently runs as a control",
+                )
+    if not module.in_package:
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _last_name(node.func) == "fault_point"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value not in sites
+            ):
+                yield RawFinding(
+                    "SC007", node.args[0],
+                    f"fault_point site {node.args[0].value!r} is not "
+                    "declared by any package fault_point call",
+                )

@@ -60,6 +60,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sparse_coding__tpu.utils import flags
+
 __all__ = [
     "CHUNK_VERIFY_ENV",
     "LOSS_BUDGET_ENV",
@@ -84,11 +86,11 @@ __all__ = [
 # Unlike SC_CKPT_VERIFY (default digest — resume is rare), chunk loads are
 # the hot loop: a digest re-read of every chunk every epoch is real I/O, so
 # the default is the size tier and digest is reserved for scrub / admission.
-CHUNK_VERIFY_ENV = "SC_CHUNK_VERIFY"
+CHUNK_VERIFY_ENV = flags.SC_CHUNK_VERIFY.name
 
 # degraded-mode budget: the fraction of DISTINCT chunks a run may lose to
 # quarantine before it stops trusting the dataset and exits resumable (75)
-LOSS_BUDGET_ENV = "SC_CHUNK_LOSS_BUDGET"
+LOSS_BUDGET_ENV = flags.SC_CHUNK_LOSS_BUDGET.name
 DEFAULT_LOSS_BUDGET = 0.05
 
 QUARANTINE_DIR = "quarantine"
@@ -115,7 +117,7 @@ def chunk_manifest_path(folder, i: int) -> Path:
 
 def verify_depth(depth: Optional[str] = None) -> str:
     """Resolve a verification depth: explicit arg > SC_CHUNK_VERIFY > size."""
-    d = (depth or os.environ.get(CHUNK_VERIFY_ENV, "size")).lower()
+    d = (depth or flags.SC_CHUNK_VERIFY.get()).lower()
     if d not in ("digest", "size", "off"):
         raise ValueError(
             f"unknown {CHUNK_VERIFY_ENV} depth {d!r} (digest | size | off)"
@@ -125,7 +127,7 @@ def verify_depth(depth: Optional[str] = None) -> str:
 
 def default_loss_budget() -> float:
     """The degraded-mode loss budget fraction (SC_CHUNK_LOSS_BUDGET)."""
-    raw = os.environ.get(LOSS_BUDGET_ENV)
+    raw = flags.SC_CHUNK_LOSS_BUDGET.raw()
     if raw is None or raw == "":
         return DEFAULT_LOSS_BUDGET
     return float(raw)

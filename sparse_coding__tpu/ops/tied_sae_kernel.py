@@ -59,6 +59,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sparse_coding__tpu.utils import flags
 from sparse_coding__tpu.utils.optim import QuantMoment
 
 f32 = jnp.float32
@@ -69,7 +70,7 @@ u32 = jnp.uint32
 def recompute_code_default() -> bool:
     """The ``SC_RECOMPUTE_CODE=1`` opt-in (read at trace-build time by
     `Ensemble._build_steps`; an env flip retraces on the next build)."""
-    return os.environ.get("SC_RECOMPUTE_CODE", "0") == "1"
+    return flags.SC_RECOMPUTE_CODE.get()
 
 
 def _mix32(h):

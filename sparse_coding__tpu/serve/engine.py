@@ -618,6 +618,8 @@ class EncodeEngine:
         t0 = time.time()
         t0m = time.monotonic()
         stacked = stack.dequant_fn(stack.quant)
+        # sclint: allow(SC003) dequant span needs a completion barrier or
+        # its seconds leak into the encode span
         jax.block_until_ready(jax.tree.leaves(stacked)[0])
         dequant_s = time.monotonic() - t0m
         extra = {"traces": traces} if traces else {}
@@ -860,6 +862,8 @@ class EncodeEngine:
             out, dequant_s = self._dispatch(
                 stack, padded, traces=traced or None, k=kb
             )
+            # sclint: allow(SC003) encode-span barrier: responses resolve
+            # right after, so the sync is on the serving contract path
             jax.block_until_ready(out)
             encode_s = time.monotonic() - t0
             _emit_span(
@@ -887,12 +891,14 @@ class EncodeEngine:
             lane = lane_of[r.dict_id]
             if sparse:
                 idx, vals = out
-                r._resolve((
+                r._resolve((  # sclint: allow(SC003) response materialization
                     np.asarray(idx[lane, start : start + n, : r.top_k]),
                     np.asarray(vals[lane, start : start + n, : r.top_k]),
                 ))
             else:
-                r._resolve(np.asarray(out[lane, start : start + n]))
+                r._resolve(  # sclint: allow(SC003) response materialization
+                    np.asarray(out[lane, start : start + n])
+                )
             start += n
             self._request_trace_record(
                 r, encode_s, dequant_s, bucket, stack.size, len(reqs)
@@ -953,6 +959,8 @@ class EncodeEngine:
             out, dequant_s = self._dispatch_features(
                 subject, stack, padded, traces=traced or None, k=kb
             )
+            # sclint: allow(SC003) encode-span barrier: responses resolve
+            # right after, so the sync is on the serving contract path
             jax.block_until_ready(out)
             encode_s = time.monotonic() - t0
             _emit_span(
@@ -979,12 +987,14 @@ class EncodeEngine:
             lo, hi = seq_start * seq_len, (seq_start + n_seq) * seq_len
             if sparse:
                 idx, vals = out
-                r._resolve((
+                r._resolve((  # sclint: allow(SC003) response materialization
                     np.asarray(idx[lane, lo:hi, : r.top_k]),
                     np.asarray(vals[lane, lo:hi, : r.top_k]),
                 ))
             else:
-                r._resolve(np.asarray(out[lane, lo:hi]))
+                r._resolve(  # sclint: allow(SC003) response materialization
+                    np.asarray(out[lane, lo:hi])
+                )
             seq_start += n_seq
             self._request_trace_record(
                 r, encode_s, dequant_s, bucket_rows, stack.size, len(reqs)

@@ -55,11 +55,12 @@ from __future__ import annotations
 
 import hashlib
 import json
-import os
 import re
 import time
 import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from sparse_coding__tpu.utils import flags
 
 __all__ = [
     "process_info",
@@ -103,12 +104,12 @@ COMPARABLE_FINGERPRINT_KEYS = (
 
 # re-estimate the clock offset every Nth heartbeat (count-based, NOT
 # time-based: hosts must decide identically or the exchange rounds skew)
-CLOCK_RESYNC_EVERY_ENV = "SC_CLOCK_RESYNC_EVERY"
+CLOCK_RESYNC_EVERY_ENV = flags.SC_CLOCK_RESYNC_EVERY.name
 _CLOCK_RESYNC_DEFAULT = 16
 
 # how long one host waits for the others' KV payloads before giving up on
 # that exchange round (a missed heartbeat, not a crash)
-TIMEOUT_MS_ENV = "SC_MH_TIMEOUT_MS"
+TIMEOUT_MS_ENV = flags.SC_MH_TIMEOUT_MS.name
 _TIMEOUT_MS_DEFAULT = 60_000
 
 # module state: the most recent clock-offset estimate for this process
@@ -157,7 +158,7 @@ def _coord_client():
 
 def _timeout_ms() -> int:
     try:
-        return int(os.environ.get(TIMEOUT_MS_ENV, _TIMEOUT_MS_DEFAULT))
+        return flags.SC_MH_TIMEOUT_MS.get()
     except ValueError:
         return _TIMEOUT_MS_DEFAULT
 
@@ -292,7 +293,9 @@ def heartbeat(
 
     resync_every = _CLOCK_RESYNC_DEFAULT
     try:
-        resync_every = int(os.environ.get(CLOCK_RESYNC_EVERY_ENV, resync_every))
+        override = flags.SC_CLOCK_RESYNC_EVERY.get()
+        if override is not None:
+            resync_every = override
     except ValueError:
         pass
     if resync_every > 0 and n_beats % resync_every == 0:

@@ -40,11 +40,12 @@ perf PR needs to prove which entry point it moved:
 
 from __future__ import annotations
 
-import os
 import threading
 import warnings
 from pathlib import Path
 from typing import Any, Dict, Optional
+
+from sparse_coding__tpu.utils import flags
 
 __all__ = [
     "compiled_cost_fields",
@@ -61,11 +62,11 @@ __all__ = [
 # it entirely, "full" additionally compiles a throwaway executable for the
 # memory_analysis footprints (masked from the monitoring counters), anything
 # else (the default) reads the HLO cost analysis only — no backend compile
-COST_CAPTURE_ENV = "SC_COST_CAPTURE"
+COST_CAPTURE_ENV = flags.SC_COST_CAPTURE.name
 
 
 def _capture_mode() -> str:
-    v = os.environ.get(COST_CAPTURE_ENV, "1").lower()
+    v = flags.SC_COST_CAPTURE.get().lower()
     if v in ("0", "false", "no", "off"):
         return "off"
     if v in ("full", "2", "memory"):
@@ -379,8 +380,7 @@ class TraceTrigger:
     def from_env(cls, telemetry=None, out_dir: Optional[str] = None, env=None, **kw):
         """Build from ``SC_TRACE_WINDOW="N:M"`` / ``SC_TRACE_DIR`` (anomaly
         arming stays on by default). Malformed values warn and are ignored."""
-        env = os.environ if env is None else env
-        window = env.get("SC_TRACE_WINDOW")
+        window = flags.SC_TRACE_WINDOW.get(env)
         start = stop = None
         if window:
             try:
@@ -398,7 +398,7 @@ class TraceTrigger:
             out_dir=out_dir,
             start_step=start,
             stop_step=stop,
-            trace_dir=env.get("SC_TRACE_DIR"),
+            trace_dir=flags.SC_TRACE_DIR.get(env),
             **kw,
         )
 

@@ -50,6 +50,8 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+from sparse_coding__tpu.utils import flags
 import numpy as np
 
 from sparse_coding__tpu.utils.faults import fault_point
@@ -65,7 +67,7 @@ _WARNED_LEGACY_EXPORTS: set = set()
 #   size             — existence + byte sizes only (pod-scale states where a
 #                      full re-read is material)
 #   off              — manifest presence only
-VERIFY_ENV = "SC_CKPT_VERIFY"
+VERIFY_ENV = flags.SC_CKPT_VERIFY.name
 
 
 # -- learned-dict export (the reference's learned_dicts.pt) -------------------
@@ -244,7 +246,7 @@ def _write_manifest(ckpt_dir: Path, extra: Optional[Dict[str, Any]] = None) -> N
     # state just written); SC_CKPT_VERIFY=size skips them HERE too — the
     # knob exists exactly for pod-scale states where the re-read is
     # material, and it is paid per save, not per (rare) resume
-    digest = os.environ.get(VERIFY_ENV, "digest").lower() == "digest"
+    digest = flags.SC_CKPT_VERIFY.get().lower() == "digest"
     files = {}
     for p in sorted(ckpt_dir.rglob("*")):
         if p.is_file() and p.name != MANIFEST_NAME:
@@ -281,7 +283,7 @@ def verify_checkpoint(ckpt_dir, depth: Optional[str] = None) -> Tuple[bool, str]
     manifest = checkpoint_manifest(ckpt_dir)
     if manifest is None:
         return False, "uncommitted (no manifest)"
-    depth = (depth or os.environ.get(VERIFY_ENV, "digest")).lower()
+    depth = (depth or flags.SC_CKPT_VERIFY.get()).lower()
     if depth == "off":
         return True, "ok (manifest only)"
     for rel, meta in manifest.get("files", {}).items():

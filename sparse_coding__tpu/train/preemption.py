@@ -31,11 +31,12 @@ elsewhere installation is skipped and reported via the return value.
 
 from __future__ import annotations
 
-import os
 import signal
 import sys
 import threading
 from typing import Optional, Tuple
+
+from sparse_coding__tpu.utils import flags
 
 __all__ = [
     "RESUMABLE_EXIT_CODE",
@@ -58,11 +59,11 @@ RESUMABLE_EXIT_CODE = 75
 
 # set by the supervisor on restarted children; drivers with resume=None
 # (the default) consult it so `supervise` needs no per-driver flag plumbing
-RESUME_ENV = "SC_RESUME"
+RESUME_ENV = flags.SC_RESUME.name
 
 # SC_PREEMPT=0 opts out of signal-handler installation (e.g. a harness that
 # owns its own signal semantics)
-DISABLE_ENV = "SC_PREEMPT"
+DISABLE_ENV = flags.SC_PREEMPT.name
 
 
 class Preempted(SystemExit):
@@ -128,7 +129,7 @@ def install_signal_handlers(
     """Install the preemption handlers (idempotent). Returns True when the
     handlers are active; False when skipped (SC_PREEMPT=0, non-main thread,
     or an environment that refuses signal.signal)."""
-    if os.environ.get(DISABLE_ENV, "1").lower() in ("0", "false", "off"):
+    if not flags.SC_PREEMPT.get():
         return False
     if _STATE["installed"]:
         return True
@@ -221,4 +222,4 @@ def resume_requested(explicit: Optional[bool]) -> bool:
     every restarted child, making auto-resume zero-config."""
     if explicit is not None:
         return bool(explicit)
-    return os.environ.get(RESUME_ENV, "").lower() not in ("", "0", "false", "off")
+    return flags.SC_RESUME.get()

@@ -80,6 +80,8 @@ import os
 import signal
 from typing import Any, Dict, List, Optional
 
+from sparse_coding__tpu.utils import flags
+
 __all__ = [
     "FAULT_ENV",
     "InjectedFault",
@@ -88,7 +90,7 @@ __all__ = [
     "reset",
 ]
 
-FAULT_ENV = "SC_FAULT"
+FAULT_ENV = flags.SC_FAULT.name
 
 _ACTIONS = (
     "kill", "sigterm", "sigint", "io_error", "exc",
@@ -257,7 +259,7 @@ def fault_point(site: str, **ctx) -> None:
     when a spec matches. Call it at the top of the loop/operation the site
     names, passing positional context (chunk=, step=, attempt=, path=).
     """
-    env = os.environ.get(FAULT_ENV)
+    env = flags.SC_FAULT.raw()
     if not env:
         return
     if env != _CACHE["env"]:

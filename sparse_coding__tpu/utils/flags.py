@@ -1,0 +1,275 @@
+"""Central registry of every ``SC_*`` environment flag (ISSUE 16).
+
+Before this module, 17 distinct ``SC_*`` env flags were read by 11 modules
+with no single source of truth: each site carried its own name string,
+default, and parse — exactly how the bf16 ``dtype.kind`` class of bug ships
+(a contract that exists only as a convention scattered across call sites).
+Now every flag is *declared* here once — name, type, default, owner module,
+one-line doc — and read through a :class:`Flag` accessor. The static pass
+(`sparse_coding__tpu.analysis`, rule SC005) flags any direct
+``os.environ``/``os.getenv`` read of an ``SC_*`` literal outside this
+module, and any ``SC_*`` literal that is not registered here, so the
+registry cannot rot into "most of the truth".
+
+The docs table in ``docs/observability.md`` (between the
+``FLAGS_TABLE_BEGIN/END`` markers) is *generated* from this registry::
+
+    python -m sparse_coding__tpu.utils.flags --update-docs   # rewrite
+    python -m sparse_coding__tpu.utils.flags --check-docs    # drift gate
+
+and a tier-1 test pins the check, so docs cannot drift from code.
+
+Parse semantics are preserved exactly from the pre-registry call sites —
+e.g. ``SC_RECOMPUTE_CODE`` enables only on the literal ``"1"`` while
+``SC_RESUME`` accepts anything outside the falsy set — because flipping a
+flag's accepted spellings silently would be the very bug class this file
+exists to prevent. Call-site clamps (``max(1, retries)``) stay at the call
+site: they are policy about *use*, not about the flag's value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Mapping, Optional, Tuple
+
+__all__ = ["Flag", "FLAGS", "markdown_table", "DOCS_BEGIN", "DOCS_END"]
+
+# spellings that turn a default-on / truthy flag off — shared by SC_PREEMPT
+# (default on) and the truthy family (SC_RESUME, SC_TEST_DESYNC)
+_FALSY = ("", "0", "false", "off")
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    """One declared ``SC_*`` env flag.
+
+    ``kind`` picks the parse ``get()`` applies:
+
+    - ``str``     raw string (default applied); never None
+    - ``opt_str`` raw string or None when unset and no default
+    - ``int`` / ``float``  numeric parse of raw-or-default
+    - ``bool01``  True iff the value is exactly ``"1"``
+    - ``truthy``  True iff set to anything outside ``("", "0", "false",
+      "off")`` (case-insensitive)
+    - ``onoff``   default-ON switch: False iff set to one of ``("0",
+      "false", "off")`` (case-insensitive)
+    """
+
+    name: str
+    kind: str
+    default: Optional[str]
+    owner: str
+    help: str
+    choices: Tuple[str, ...] = ()
+
+    def raw(self, env: Optional[Mapping[str, str]] = None) -> Optional[str]:
+        """The unparsed env value, or None when unset (no default applied)."""
+        e = os.environ if env is None else env
+        return e.get(self.name)
+
+    def get(self, env: Optional[Mapping[str, str]] = None):
+        """The parsed value per ``kind`` (default applied first)."""
+        raw = self.raw(env)
+        if raw is None:
+            raw = self.default
+        if self.kind == "opt_str":
+            return raw
+        if self.kind == "str":
+            return raw if raw is not None else ""
+        if self.kind == "int":
+            return None if raw is None else int(raw)
+        if self.kind == "float":
+            return None if raw is None else float(raw)
+        if self.kind == "bool01":
+            return raw == "1"
+        if self.kind == "truthy":
+            return (raw or "").lower() not in _FALSY
+        if self.kind == "onoff":
+            return (raw or "").lower() not in ("0", "false", "off")
+        raise ValueError(f"unknown flag kind {self.kind!r} for {self.name}")
+
+
+def _flag(name, kind, default, owner, help, choices=()):
+    return Flag(name=name, kind=kind, default=default, owner=owner,
+                help=help, choices=tuple(choices))
+
+
+# The registry. Owner = the module whose behavior the flag controls (and
+# whose docstring carries the long-form semantics).
+FLAGS: Dict[str, Flag] = {
+    f.name: f
+    for f in (
+        _flag("SC_RECOMPUTE_CODE", "bool01", "0", "ops.tied_sae_kernel",
+              "Fused tied-SAE bwd rebuilds the code tile instead of "
+              "round-tripping it through HBM (the five-pass schedule)."),
+        _flag("SC_TPU_REMOTE", "str", "", "utils.sync",
+              "host:dir target for the TPU-remote file sync helpers; empty "
+              "= local filesystem."),
+        _flag("SC_SYNC_RETRIES", "int", "3", "utils.sync",
+              "Transient-read retry attempts for chunk/checkpoint reads "
+              "(clamped to >= 1 at the call site)."),
+        _flag("SC_SYNC_BACKOFF", "float", "1.0", "utils.sync",
+              "Base seconds of exponential backoff between retries "
+              "(clamped to >= 0 at the call site)."),
+        _flag("SC_MH_TIMEOUT_MS", "int", "60000", "telemetry.multihost",
+              "Pod KV-store barrier/allgather timeout in milliseconds."),
+        _flag("SC_CLOCK_RESYNC_EVERY", "int", None, "telemetry.multihost",
+              "Override the heartbeat count between cross-host clock-offset "
+              "resyncs (unset = the caller's configured cadence)."),
+        _flag("SC_COST_CAPTURE", "str", "1", "telemetry.profiling",
+              "Per-compile cost capture depth: 0/false/no/off disables, "
+              "full/2/memory adds the memory-analysis compile, anything "
+              "else = HLO cost analysis only.",
+              choices=("0", "1", "full")),
+        _flag("SC_TRACE_WINDOW", "opt_str", None, "telemetry.profiling",
+              "start:stop step window for a triggered jax.profiler trace "
+              "capture (TraceTrigger.from_env)."),
+        _flag("SC_TRACE_DIR", "opt_str", None, "telemetry.profiling",
+              "Directory a triggered trace capture writes into (default: "
+              "the run's output dir)."),
+        _flag("SC_PREEMPT", "onoff", "1", "train.preemption",
+              "Default-on master switch for SIGTERM preemption handling; "
+              "0/false/off disables the handlers."),
+        _flag("SC_RESUME", "truthy", "", "train.preemption",
+              "Set by the supervisor on respawn: drivers resume from the "
+              "latest checkpoint instead of starting fresh."),
+        _flag("SC_CKPT_VERIFY", "str", "digest", "train.checkpoint",
+              "Checkpoint verification depth on restore.",
+              choices=("digest", "size", "off")),
+        _flag("SC_CHUNK_VERIFY", "str", "size", "data.integrity",
+              "Read-side chunk verification depth.",
+              choices=("digest", "size", "off")),
+        _flag("SC_CHUNK_LOSS_BUDGET", "float", None, "data.integrity",
+              "Max fraction of a store's chunks that may be quarantined "
+              "before training aborts (unset = no budget)."),
+        _flag("SC_FAULT", "opt_str", None, "utils.faults",
+              "Fault-injection spec 'action[:site][:key=val...]' for chaos "
+              "tests (utils.faults.fault_point grammar)."),
+        _flag("SC_TEST_CHUNK_SLEEP", "float", "0", "tests._multiprocess_worker",
+              "Test-only: seconds this host sleeps inside each chunk, to "
+              "fake a straggler in multi-process tests."),
+        _flag("SC_TEST_DESYNC", "truthy", "", "tests._multiprocess_worker",
+              "Test-only: poison this host's run config with its process "
+              "id to exercise pod desync detection."),
+    )
+}
+
+# Named accessors — `flags.SC_RESUME.get()` at call sites reads as well as
+# the env name did, and a typo is an AttributeError instead of a silently
+# unset flag.
+SC_RECOMPUTE_CODE = FLAGS["SC_RECOMPUTE_CODE"]
+SC_TPU_REMOTE = FLAGS["SC_TPU_REMOTE"]
+SC_SYNC_RETRIES = FLAGS["SC_SYNC_RETRIES"]
+SC_SYNC_BACKOFF = FLAGS["SC_SYNC_BACKOFF"]
+SC_MH_TIMEOUT_MS = FLAGS["SC_MH_TIMEOUT_MS"]
+SC_CLOCK_RESYNC_EVERY = FLAGS["SC_CLOCK_RESYNC_EVERY"]
+SC_COST_CAPTURE = FLAGS["SC_COST_CAPTURE"]
+SC_TRACE_WINDOW = FLAGS["SC_TRACE_WINDOW"]
+SC_TRACE_DIR = FLAGS["SC_TRACE_DIR"]
+SC_PREEMPT = FLAGS["SC_PREEMPT"]
+SC_RESUME = FLAGS["SC_RESUME"]
+SC_CKPT_VERIFY = FLAGS["SC_CKPT_VERIFY"]
+SC_CHUNK_VERIFY = FLAGS["SC_CHUNK_VERIFY"]
+SC_CHUNK_LOSS_BUDGET = FLAGS["SC_CHUNK_LOSS_BUDGET"]
+SC_FAULT = FLAGS["SC_FAULT"]
+SC_TEST_CHUNK_SLEEP = FLAGS["SC_TEST_CHUNK_SLEEP"]
+SC_TEST_DESYNC = FLAGS["SC_TEST_DESYNC"]
+
+
+# -- docs generation ----------------------------------------------------------
+
+DOCS_BEGIN = "<!-- FLAGS_TABLE_BEGIN (generated by python -m sparse_coding__tpu.utils.flags --update-docs; do not edit by hand) -->"
+DOCS_END = "<!-- FLAGS_TABLE_END -->"
+
+_KIND_DOC = {
+    "str": "string",
+    "opt_str": "string",
+    "int": "int",
+    "float": "float",
+    "bool01": "bool (\"1\" enables)",
+    "truthy": "bool (set+non-falsy enables)",
+    "onoff": "bool (0/false/off disables)",
+}
+
+
+def markdown_table() -> str:
+    """The flags reference table, one row per registered flag."""
+    lines = [
+        "| Flag | Type | Default | Owner | Meaning |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for name in sorted(FLAGS):
+        f = FLAGS[name]
+        default = "*(unset)*" if f.default is None else f"`{f.default}`"
+        kind = _KIND_DOC[f.kind]
+        if f.choices:
+            kind += " (" + "/".join(f.choices) + ")"
+        lines.append(
+            f"| `{f.name}` | {kind} | {default} | `{f.owner}` | {f.help} |"
+        )
+    return "\n".join(lines)
+
+
+def _docs_path():
+    from pathlib import Path
+
+    return Path(__file__).resolve().parents[2] / "docs" / "observability.md"
+
+
+def render_docs_section() -> str:
+    return DOCS_BEGIN + "\n" + markdown_table() + "\n" + DOCS_END
+
+
+def check_docs(text: Optional[str] = None) -> bool:
+    """True iff the generated table in docs/observability.md is current."""
+    if text is None:
+        text = _docs_path().read_text()
+    return render_docs_section() in text
+
+
+def update_docs() -> bool:
+    """Rewrite the marked table section in docs. Returns True on change."""
+    path = _docs_path()
+    text = path.read_text()
+    start = text.index(DOCS_BEGIN)
+    end = text.index(DOCS_END) + len(DOCS_END)
+    new = text[:start] + render_docs_section() + text[end:]
+    if new != text:
+        path.write_text(new)
+        return True
+    return False
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.utils.flags",
+        description="SC_* flag registry: print / sync the docs table.",
+    )
+    g = ap.add_mutually_exclusive_group()
+    g.add_argument("--markdown", action="store_true",
+                   help="print the generated flags table")
+    g.add_argument("--check-docs", action="store_true",
+                   help="exit 1 if docs/observability.md's table is stale")
+    g.add_argument("--update-docs", action="store_true",
+                   help="rewrite the table section in docs/observability.md")
+    args = ap.parse_args(argv)
+    if args.check_docs:
+        if check_docs():
+            print("docs/observability.md flags table: up to date")
+            return 0
+        print("docs/observability.md flags table is STALE — run "
+              "python -m sparse_coding__tpu.utils.flags --update-docs")
+        return 1
+    if args.update_docs:
+        changed = update_docs()
+        print("updated" if changed else "already up to date")
+        return 0
+    print(markdown_table())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

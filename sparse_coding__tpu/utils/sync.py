@@ -27,12 +27,14 @@ import time
 from pathlib import Path
 from typing import Callable, List, Optional, Sequence, Tuple, Type
 
+from sparse_coding__tpu.utils import flags
+
 Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
 
 # env knobs for the shared retry engine (both sync and chunk reads ride it):
 # total attempts and the base delay of the exponential backoff
-RETRIES_ENV = "SC_SYNC_RETRIES"
-BACKOFF_ENV = "SC_SYNC_BACKOFF"
+RETRIES_ENV = flags.SC_SYNC_RETRIES.name
+BACKOFF_ENV = flags.SC_SYNC_BACKOFF.name
 _DEFAULT_RETRIES = 3
 _DEFAULT_BACKOFF = 1.0
 _MAX_DELAY = 8.0
@@ -41,7 +43,7 @@ _MAX_DELAY = 8.0
 def default_retries() -> int:
     """Total attempts (not re-tries) per operation: `SC_SYNC_RETRIES`, else 3."""
     try:
-        return max(1, int(os.environ.get(RETRIES_ENV, _DEFAULT_RETRIES)))
+        return max(1, flags.SC_SYNC_RETRIES.get())
     except ValueError:
         return _DEFAULT_RETRIES
 
@@ -50,7 +52,7 @@ def default_backoff() -> float:
     """Base delay (seconds) of the exponential backoff: `SC_SYNC_BACKOFF`,
     else 1.0. The k-th failure sleeps `min(base * 2**k, 8.0)`."""
     try:
-        return max(0.0, float(os.environ.get(BACKOFF_ENV, _DEFAULT_BACKOFF)))
+        return max(0.0, flags.SC_SYNC_BACKOFF.get())
     except ValueError:
         return _DEFAULT_BACKOFF
 
@@ -285,7 +287,7 @@ def _local_sync(src, dst, includes, excludes, delete):
 
 
 def _remote_base(remote: Optional[str]) -> str:
-    remote = remote or os.environ.get("SC_TPU_REMOTE", "")
+    remote = remote or flags.SC_TPU_REMOTE.get()
     if not remote:
         raise ValueError(
             "no remote given: pass remote=... or set SC_TPU_REMOTE "

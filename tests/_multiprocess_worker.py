@@ -120,10 +120,12 @@ def telemetry_main(proc_id: int):
     from sparse_coding__tpu.telemetry import RunTelemetry, check_desync, heartbeat
 
     run_dir = sys.argv[5]
-    sleep_s = float(os.environ.get("SC_TEST_CHUNK_SLEEP", "0") or 0.0)
+    from sparse_coding__tpu.utils import flags
+
+    sleep_s = flags.SC_TEST_CHUNK_SLEEP.get() or 0.0
     d_act, batch = 16, 64
     cfg = {"mode": "telemetry", "batch": batch, "d_act": d_act}
-    if os.environ.get("SC_TEST_DESYNC"):
+    if flags.SC_TEST_DESYNC.get():
         cfg["poison"] = proc_id  # hosts now deliberately disagree
     ens = build_ensemble(
         FunctionalTiedSAE,

@@ -1,0 +1,11 @@
+"""Fixture: SC001 violation — floating-ness tested via dtype.kind.
+
+Never imported; parsed by tests/test_analysis.py, which pins each finding
+to the marker-comment line.
+"""
+
+
+def keep_resident(x):
+    if x.dtype.kind == "f":  # VIOLATION
+        return x.astype("bfloat16")
+    return x

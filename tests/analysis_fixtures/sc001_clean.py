@@ -1,0 +1,14 @@
+"""Fixture: SC001 clean twin — jnp.issubdtype, plus the legitimate
+integer-kind wire idiom SC001 must not flag."""
+
+import jax.numpy as jnp
+
+
+def keep_resident(x):
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def is_raw_codec(x):
+    return x.dtype.kind in ("i", "u", "V")

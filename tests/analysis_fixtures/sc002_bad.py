@@ -1,0 +1,6 @@
+"""Fixture: SC002 violation — span category not in telemetry/spans.py."""
+
+
+def run(telemetry, span, batch):
+    with span(telemetry, "warmup"):  # VIOLATION
+        return batch * 2

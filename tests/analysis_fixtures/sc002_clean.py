@@ -1,0 +1,9 @@
+"""Fixture: SC002 clean twin — registered categories, including a
+registered-nestable inner span inside a goodput span."""
+
+
+def run(telemetry, span, batch):
+    with span(telemetry, "step"):
+        with span(telemetry, "checkpoint"):
+            pass
+        return batch * 2

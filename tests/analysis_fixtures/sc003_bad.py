@@ -1,0 +1,10 @@
+"""Fixture: SC003 violation — a host sync inside a declared hot loop."""
+
+__sclint_hot_entries__ = ("drain",)
+
+
+def drain(outputs):
+    total = 0.0
+    for out in outputs:
+        total += out.sum().item()  # VIOLATION
+    return total

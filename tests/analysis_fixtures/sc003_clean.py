@@ -1,0 +1,16 @@
+"""Fixture: SC003 clean twin — the same sync, sanctioned both ways the
+repo sanctions syncs: an allowed_transfer() block and an allow comment."""
+
+__sclint_hot_entries__ = ("drain", "drain_once")
+
+
+def drain(outputs, allowed_transfer):
+    total = 0.0
+    with allowed_transfer():
+        for out in outputs:
+            total += out.sum().item()
+    return total
+
+
+def drain_once(out):
+    return out.sum().item()  # sclint: allow(SC003) end-of-run summary

@@ -1,0 +1,9 @@
+"""Fixture: SC004 violation — non-static jit parameter sizing an array."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def make_buffer(n):
+    return jnp.zeros(n)  # VIOLATION

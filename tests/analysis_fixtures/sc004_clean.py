@@ -1,0 +1,17 @@
+"""Fixture: SC004 clean twin — static_argnames declared, and the
+trace-time-static `x.shape[...]` read SC004 must not flag."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def make_buffer(n):
+    return jnp.zeros(n)
+
+
+@jax.jit
+def zeros_like_rows(x):
+    return jnp.zeros(x.shape[0], x.dtype)

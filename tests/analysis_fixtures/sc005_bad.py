@@ -1,0 +1,8 @@
+"""Fixture: SC005 violation — direct os.environ read of a registered
+SC_* flag."""
+
+import os
+
+
+def recompute_enabled():
+    return os.environ.get("SC_RECOMPUTE_CODE") == "1"  # VIOLATION

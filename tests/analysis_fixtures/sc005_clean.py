@@ -1,0 +1,7 @@
+"""Fixture: SC005 clean twin — the registry accessor."""
+
+from sparse_coding__tpu.utils import flags
+
+
+def recompute_enabled():
+    return flags.SC_RECOMPUTE_CODE.get()

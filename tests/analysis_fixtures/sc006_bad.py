@@ -1,0 +1,7 @@
+"""Fixture: SC006 violation — two metric names that collide after
+Prometheus sanitization (both expose as ``sc_serve_queue_depth``)."""
+
+
+def publish(gauge_set, depth):
+    gauge_set("serve.queue.depth", depth)  # VIOLATION
+    gauge_set("serve_queue_depth", depth)  # VIOLATION

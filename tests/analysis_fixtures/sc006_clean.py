@@ -1,0 +1,9 @@
+"""Fixture: SC006 clean twin — distinct names stay distinct after
+sanitization; a counter and a gauge may share a stem (the counter gets
+``_total``)."""
+
+
+def publish(gauge_set, counter_inc, depth):
+    gauge_set("serve.queue.depth", depth)
+    counter_inc("serve.queue.depth")
+    gauge_set("serve.batch.rows", depth)

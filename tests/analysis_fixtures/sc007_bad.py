@@ -1,0 +1,9 @@
+"""Fixture: SC007 violation — an SC_FAULT spec naming a site no
+fault_point() in the package declares (the test silently becomes a
+control run)."""
+
+import os
+
+
+def inject():
+    os.environ["SC_FAULT"] = "exc:nonexistent_site"  # VIOLATION

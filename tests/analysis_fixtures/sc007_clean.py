@@ -1,0 +1,9 @@
+"""Fixture: SC007 clean twin — real sites, via the alias grammar and a
+default-site spec."""
+
+import os
+
+
+def inject(monkeypatch):
+    os.environ["SC_FAULT"] = "exc:step_loop"
+    monkeypatch.setenv("SC_FAULT", "kill:chunk=2")

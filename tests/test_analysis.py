@@ -1,0 +1,228 @@
+"""sclint: engine, rules, contracts, and the CI gate (tier-1).
+
+Three layers, mirroring the package:
+
+- per-rule pins: each seeded fixture (`tests/analysis_fixtures/scNNN_bad.py`)
+  must produce exactly its rule at the `# VIOLATION`-marked line via the real
+  CLI (exit 1); each clean twin must exit 0 — so a rule can neither go blind
+  nor start crying wolf without a test moving;
+- engine semantics: suppression comments, baseline round-trip, --json,
+  exit codes (including 3 = no files);
+- the gate itself: the shipped tree (`sparse_coding__tpu/ scripts/ bench.py`)
+  is pinned clean, the abstract contracts pass with 100% partition coverage,
+  and the mirrored Prometheus sanitizer is pinned against the real
+  `telemetry.metrics_http` regex so the two cannot drift.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.analysis import lint_paths, load_baseline
+from sparse_coding__tpu.analysis.engine import write_baseline
+from sparse_coding__tpu.analysis.rules import RULES
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+ALL_RULES = ("SC001", "SC002", "SC003", "SC004", "SC005", "SC006", "SC007")
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def violation_lines(path: Path):
+    return [
+        i for i, line in enumerate(path.read_text().splitlines(), start=1)
+        if "# VIOLATION" in line
+    ]
+
+
+# -- per-rule pins -------------------------------------------------------------
+
+@pytest.mark.parametrize("rule_id", ALL_RULES)
+def test_seeded_violation_fires_with_correct_rule_and_line(rule_id):
+    bad = FIXTURES / f"{rule_id.lower()}_bad.py"
+    expected = violation_lines(bad)
+    assert expected, f"{bad} has no # VIOLATION marker"
+
+    proc = run_cli(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    findings, _ = lint_paths([bad])
+    assert sorted({f.rule for f in findings}) == [rule_id]
+    assert sorted({f.line for f in findings}) == expected
+
+
+@pytest.mark.parametrize("rule_id", ALL_RULES)
+def test_clean_twin_is_silent(rule_id):
+    clean = FIXTURES / f"{rule_id.lower()}_clean.py"
+    proc = run_cli(str(clean))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    findings, n = lint_paths([clean])
+    assert findings == [] and n == 1
+
+
+def test_rule_registry_is_complete():
+    assert tuple(sorted(RULES)) == ALL_RULES
+    for spec in RULES.values():
+        assert spec.doc, f"{spec.id} has no docstring"
+        assert spec.scope in ("module", "repo")
+
+
+# -- engine semantics ----------------------------------------------------------
+
+def test_suppression_comment_forms(tmp_path):
+    # inline, statement-first-line, and preceding-comment-line forms all
+    # sanction exactly the named rule
+    src = tmp_path / "mod.py"
+    src.write_text(
+        '__sclint_hot_entries__ = ("f",)\n'
+        "def f(out):\n"
+        "    a = out.sum().item()  # sclint: allow(SC003) inline\n"
+        "    # sclint: allow(SC003) preceding comment line\n"
+        "    b = out.mean().item()\n"
+        "    c = out.max().item()\n"
+        "    return a + b + c\n"
+    )
+    findings, _ = lint_paths([src])
+    assert [f.rule for f in findings] == ["SC003"]
+    assert findings[0].line == 6  # only the unsanctioned sync survives
+
+
+def test_wrong_rule_in_allow_comment_does_not_suppress(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        '__sclint_hot_entries__ = ("f",)\n'
+        "def f(out):\n"
+        "    return out.sum().item()  # sclint: allow(SC001) wrong rule\n"
+    )
+    findings, _ = lint_paths([src])
+    assert [f.rule for f in findings] == ["SC003"]
+
+
+def test_baseline_round_trip_and_gate_on_new_findings(tmp_path):
+    bad = FIXTURES / "sc001_bad.py"
+    findings, _ = lint_paths([bad])
+    assert findings
+
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(baseline_file, findings)
+    keys = load_baseline(baseline_file)
+    assert keys == {f.key for f in findings}
+
+    # grandfathered: the same findings are dropped
+    after, _ = lint_paths([bad], baseline=keys)
+    assert after == []
+
+    # but a *different* finding still fails the gate
+    other, _ = lint_paths([FIXTURES / "sc004_bad.py"], baseline=keys)
+    assert [f.rule for f in other] == ["SC004"]
+
+
+def test_cli_baseline_flag_round_trip(tmp_path):
+    bad = FIXTURES / "sc002_bad.py"
+    baseline_file = tmp_path / "baseline.json"
+
+    wrote = run_cli(str(bad), "--write-baseline", str(baseline_file))
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    assert baseline_file.exists()
+
+    gated = run_cli(str(bad), "--baseline", str(baseline_file))
+    assert gated.returncode == 0, gated.stdout + gated.stderr
+
+
+def test_cli_json_output():
+    proc = run_cli(str(FIXTURES / "sc005_bad.py"), "--json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["files_scanned"] == 1
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "SC005"
+    assert finding["key"].startswith("SC005:")
+    assert finding["path"].endswith("sc005_bad.py")
+
+
+def test_cli_exit_3_when_no_files(tmp_path):
+    proc = run_cli(str(tmp_path))
+    assert proc.returncode == 3
+
+
+def test_cli_select_limits_rules():
+    bad = FIXTURES / "sc006_bad.py"
+    assert run_cli(str(bad), "--select", "SC006").returncode == 1
+    assert run_cli(str(bad), "--select", "SC001").returncode == 0
+    assert run_cli(str(bad), "--select", "SC999").returncode == 2
+
+
+def test_syntax_error_becomes_sc000(tmp_path):
+    src = tmp_path / "broken.py"
+    src.write_text("def f(:\n")
+    findings, n = lint_paths([src])
+    assert n == 1
+    assert [f.rule for f in findings] == ["SC000"]
+
+
+# -- registry mirrors cannot drift ---------------------------------------------
+
+def test_sanitize_metric_pinned_against_metrics_http():
+    from sparse_coding__tpu.analysis.context import RepoContext
+    from sparse_coding__tpu.telemetry import metrics_http
+
+    for name in (
+        "serve.queue.depth", "a b/c-d", "slo:window", "weirdéname", "ok_1",
+    ):
+        assert RepoContext.sanitize_metric(name) == metrics_http._NAME_RE.sub(
+            "_", name
+        )
+
+
+def test_span_tables_match_real_module():
+    from sparse_coding__tpu.analysis.context import RepoContext
+    from sparse_coding__tpu.telemetry import spans
+
+    t = RepoContext().span_tables
+    assert t["GOODPUT_CATEGORIES"] == spans.GOODPUT_CATEGORIES
+    assert t["BADPUT_CATEGORIES"] == spans.BADPUT_CATEGORIES
+    assert t["DERIVED_CATEGORIES"] == spans.DERIVED_CATEGORIES
+    assert t["INNER_CATEGORIES"] == spans.INNER_CATEGORIES
+
+
+# -- the gate ------------------------------------------------------------------
+
+def test_shipped_tree_is_clean():
+    """The acceptance gate: the CLI exits 0 over the shipped tree. Any new
+    finding must be fixed or explicitly sanctioned in-diff — there is no
+    baseline file in CI."""
+    proc = run_cli("sparse_coding__tpu/", "scripts/", "bench.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_contracts_pass_with_full_partition_coverage():
+    from sparse_coding__tpu.analysis.contracts import run_contracts
+
+    results = {c.name: c for c in run_contracts()}
+    assert set(results) == {"partition-coverage", "span-tables", "flags-docs"}
+    for c in results.values():
+        assert c.ok, c.render()
+    cov = results["partition-coverage"].summary
+    n, total = cov.split(" ")[0].split("/")
+    assert n == total, cov  # 100% leaf coverage
+
+
+def test_flag_registry_covers_all_env_reads():
+    """Every SC_* os.environ read in the tree goes through utils/flags.py —
+    i.e. SC005 over the package, scripts, bench AND tests is silent."""
+    findings, _ = lint_paths(
+        [REPO / "sparse_coding__tpu", REPO / "scripts", REPO / "bench.py",
+         REPO / "tests" / "_multiprocess_worker.py"],
+        select={"SC005"},
+    )
+    assert findings == []
