@@ -351,6 +351,66 @@ def prep_tied_variant(stack, optimizer_kwargs=None, recompute_code=False):
     return measure
 
 
+def prep_featstats(stack):
+    """``headline_featstats_acts_per_sec`` (ISSUE 17): acts/s of the tied
+    headline workload with the in-step feature sketch accumulating
+    (`build_ensemble(feature_stats=True)`), plus ``measure.off`` — the SAME
+    workload with the sketch off — as the equal-path overhead baseline.
+
+    Both runs PIN the XLA step (``fused=False``): the sketch reads the code
+    tensor, which the fused kernel never materializes to HBM, so
+    ``feature_stats`` (exactly like the health pack) executes the unfused
+    path — the path the instrumented production drivers run anyway. The
+    ≤2% acceptance floor is the on/off ratio at equal path; comparing the
+    sketch against the FUSED headline would measure the fusion gate, not
+    the sketch."""
+    from sparse_coding__tpu import build_ensemble
+    from sparse_coding__tpu.data import RandomDatasetGenerator
+    from sparse_coding__tpu.models import FunctionalTiedSAE
+
+    def build(feature_stats):
+        ens = build_ensemble(
+            FunctionalTiedSAE,
+            jax.random.PRNGKey(0),
+            [{"l1_alpha": 10 ** (-4 + 0.25 * i)} for i in range(N_MODELS)],
+            optimizer_kwargs={"learning_rate": 1e-3, "mu_dtype": "bfloat16"},
+            activation_size=D_ACT,
+            n_dict_components=N_DICT,
+            compute_dtype=jnp.bfloat16,
+            fused=False,
+            feature_stats=feature_stats,
+        )
+        return ens
+
+    gen = RandomDatasetGenerator(
+        activation_dim=D_ACT, n_ground_truth_components=2 * D_ACT,
+        batch_size=BATCH, feature_num_nonzero=8, feature_prob_decay=0.996,
+        correlated=False, key=jax.random.PRNGKey(1),
+    )
+    uniq = jnp.stack([next(gen) for _ in range(8)]).astype(jnp.bfloat16)
+    batches = jnp.tile(uniq, (SCAN_STEPS // 8, 1, 1))
+    ens_on, ens_off = build(True), build(False)
+    jax.device_get(ens_on.step_scan(batches)["loss"])  # compile
+    jax.device_get(ens_off.step_scan(batches)["loss"])
+
+    def timed(ens) -> float:
+        t0 = time.perf_counter()
+        losses = ens.step_scan(batches)
+        jax.device_get(losses["loss"])
+        return SCAN_STEPS * BATCH / (time.perf_counter() - t0)
+
+    def measure() -> float:
+        return timed(ens_on)
+
+    def measure_off() -> float:
+        return timed(ens_off)
+
+    measure.cost = ens_on.compiled_cost(batches)
+    measure.units_per_cost = BATCH
+    measure.off = measure_off
+    return measure
+
+
 def prep_stream(stack, store_dtype="float16"):
     """Rows/sec through `ChunkStore.iter_chunks` (disk → host → HBM with
     double-buffered prefetch), fenced by an on-device reduction per chunk.
@@ -460,7 +520,7 @@ def prep_control(stack):
     return measure
 
 
-def prep_serve(stack, telemetry=None):
+def prep_serve(stack, telemetry=None, feature_stats=False):
     """Rows/sec through the online encode service (`serve/`, docs/SERVING.md):
     a 4-dict multi-tenant registry behind the continuous micro-batching
     engine, driven by `scripts/loadgen.py`'s closed-loop clients. The
@@ -474,7 +534,13 @@ def prep_serve(stack, telemetry=None):
     serving regime is dispatch-bound (many small requests), not
     compute-bound — 2-row requests against 256→2048 dicts keep the compute
     small enough that the dispatch amortization under measurement IS the
-    thing micro-batching exists to win."""
+    thing micro-batching exists to win.
+
+    ``feature_stats=True`` is the ``serve_featstats_rows_per_sec`` key
+    (ISSUE 17): the same load with the engine's per-lane firing sketch
+    accumulating on-device after each dispatch — the drainer gains pure jnp
+    updates and zero host syncs, so the key should track
+    ``serve_rows_per_sec`` within noise."""
     import sys
     from pathlib import Path
 
@@ -502,7 +568,8 @@ def prep_serve(stack, telemetry=None):
             hyperparams={"bench_lane": i},
         )
     engine = EncodeEngine(
-        registry, max_batch=256, max_wait_ms=3.0, telemetry=telemetry
+        registry, max_batch=256, max_wait_ms=3.0, telemetry=telemetry,
+        feature_stats=feature_stats or None,
     ).start()
     stack.callback(engine.stop)
     engine.warmup()
@@ -513,7 +580,8 @@ def prep_serve(stack, telemetry=None):
     # warm BOTH paths (naive G=1 stacks compile on first use; thread pools
     # and jnp.asarray caches warm too) so round 1 isn't a cold outlier
     run_load(engine.encode, seed=1234, **load_kw)
-    run_load(engine.encode_naive, seed=1234, **load_kw)
+    if not feature_stats:  # the featstats variant keys only the batched path
+        run_load(engine.encode_naive, seed=1234, **load_kw)
     lat_rounds: list = []
 
     def measure() -> float:
@@ -998,12 +1066,19 @@ def main(argv=None):
             "recompute_code_acts_per_sec": prep_tied_variant(
                 stack, recompute_code=True
             ),
+            "headline_featstats_acts_per_sec": prep_featstats(stack),
             "slo_eval_runs_per_sec": prep_slo_eval(stack),
             "sclint_files_per_sec": prep_sclint(stack),
         }
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure
         benches["serve_naive_rows_per_sec"] = serve_measure.naive
+        benches["serve_featstats_rows_per_sec"] = prep_serve(
+            stack, telemetry=telemetry, feature_stats=True
+        )
+        benches["headline_nofeatstats_acts_per_sec"] = benches[
+            "headline_featstats_acts_per_sec"
+        ].off
         wire_json, wire_npz = prep_serve_wire(stack, telemetry=telemetry)
         benches["serve_json_rows_per_sec"] = wire_json
         benches["serve_dense_json_bytes_per_row"] = wire_json.bytes
@@ -1080,6 +1155,21 @@ def main(argv=None):
         out["topk_fused_speedup"] = round(
             medians["topk_fused_steps_per_sec"] / medians["topk_steps_per_sec"], 2
         )
+    # featstats block (ISSUE 17): the sketch's train overhead at equal
+    # (unfused) path — the acceptance floor is overhead_frac <= 0.02 — and
+    # the serve sketch's drag on the micro-batched encode path (~1.0)
+    if medians.get("headline_nofeatstats_acts_per_sec"):
+        out["featstats"] = {
+            "overhead_frac": round(
+                1.0
+                - medians["headline_featstats_acts_per_sec"]
+                / medians["headline_nofeatstats_acts_per_sec"], 4
+            ),
+            "serve_ratio": round(
+                medians["serve_featstats_rows_per_sec"]
+                / medians["serve_rows_per_sec"], 3
+            ) if medians.get("serve_rows_per_sec") else None,
+        }
     # serving block (docs/SERVING.md): latency percentiles are the median of
     # each round's closed-loop percentile (same interleaved-window protocol
     # as every other key), speedup is the ratio of the two gated medians

@@ -792,9 +792,13 @@ def make_bench_fixture():
         ),
         "fixture_note": (
             "perfdiff schema pin; r05 keys measured on TPU v5 lite, "
-            "round-6 keys (topk_fused/int8mom/recompute_code) are MODELED "
-            "placeholders pending a TPU session — see "
-            "scripts/make_golden_fixture.py --bench-fixture"
+            "round-6 keys (topk_fused/int8mom/recompute_code) and the "
+            "ISSUE-17 featstats keys (headline_featstats/headline_"
+            "nofeatstats/serve_featstats — both headline keys pin the "
+            "UNFUSED path, the sketch reads the code tensor the fused "
+            "kernel never materializes) are MODELED placeholders pending "
+            "a TPU session — see scripts/make_golden_fixture.py "
+            "--bench-fixture"
         ),
         "value": 818039.4,
         "unit": "activations/sec/chip",
@@ -844,6 +848,19 @@ def make_bench_fixture():
         # trips a human's patience.
         "sclint_files_per_sec": 37.0,
         "sclint_files_per_sec_spread": [25.0, 50.0],
+        # ISSUE-17 feature-sketch guards, modeled (see fixture_note). The
+        # acceptance floor is featstats.overhead_frac <= 0.02: the sketch's
+        # extra work per step is a handful of [B, F] elementwise reductions
+        # against the XLA step's matmul pair, modeled ~1.2% at the bench
+        # shape. The serve sketch adds pure on-device jnp updates after
+        # dispatch — modeled at parity with serve_rows_per_sec.
+        "headline_featstats_acts_per_sec": 553000.0,
+        "headline_featstats_acts_per_sec_spread": [545000.0, 560000.0],
+        "headline_nofeatstats_acts_per_sec": 560000.0,
+        "headline_nofeatstats_acts_per_sec_spread": [552000.0, 567000.0],
+        "serve_featstats_rows_per_sec": 415.0,
+        "serve_featstats_rows_per_sec_spread": [390.0, 440.0],
+        "featstats": {"overhead_frac": 0.0125, "serve_ratio": 0.988},
     }
     with open(BENCH_FIXTURE, "w") as f:
         json.dump(bench, f, indent=1)
@@ -1291,6 +1308,197 @@ def make_traced_run_fixture():
           "slo_strict.json) + tests/golden/metrics_exposition.txt")
 
 
+FEATURE_RUN_DIR = REPO / "tests" / "golden" / "feature_run"
+FEATURE_BASE_TS = 1_754_800_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_feature_run_fixture():
+    """Deterministic dictionary-health fixture (ISSUE 17 satellite): a run
+    dir holding REAL ``feature_stats.<gen>.npz`` snapshots (seeded arithmetic
+    sketches written through the real `FeatureSnapshot` codec) plus a
+    hand-stamped event log with their ``feature_stats`` pointer events —
+    pinning, in tier-1, the features CLI's rendering and exit codes, the
+    report's "Dictionary health" section, and the monitor's ``features:``
+    line (`tests/test_feature_stats.py`).
+
+    The modeled story: a two-member l1 sweep flushes its sketch at two chunk
+    boundaries (train0000/train0001 — nearly identical windows), then a
+    serve replica flushes one window over the same dictionaries whose
+    activation magnitudes have shifted two log-buckets up — the train↔serve
+    drift detector must read it as past the 0.25 "major" PSI line while the
+    train0000→train0001 pair stays "stable".
+
+    Byte-stability: sketches are pure arithmetic (no RNG), event timestamps
+    are hand-stamped, and the npz zip members are re-stamped to the epoch so
+    regeneration is diff-clean."""
+    import zipfile
+
+    import numpy as np
+
+    from sparse_coding__tpu.telemetry.feature_stats import (
+        FeatureStatsConfig,
+        drift_report,
+        render_features,
+        snapshot_aggregates,
+        summarize_run,
+        write_snapshot,
+    )
+
+    FEATURE_RUN_DIR.mkdir(parents=True, exist_ok=True)
+    for old in FEATURE_RUN_DIR.glob("feature_stats.*.npz"):
+        old.unlink()  # write_snapshot appends past existing generations
+    cfg = FeatureStatsConfig()
+    F, B = 32, cfg.n_buckets
+
+    def lane(rows, rate_scale, bucket_shift, dead_from):
+        """One lane's sketch from pure arithmetic: decaying firing rates
+        with a dead tail, triangular bucket profiles (integer counts that
+        sum exactly to ``fire``, as the on-device sketch guarantees)."""
+        i = np.arange(F, dtype=np.float64)
+        rate = np.clip(0.9 - 0.028 * i, 0.0, 1.0) * rate_scale
+        rate[dead_from:] = 0.0
+        fire = np.floor(rate * rows)
+        centre = np.clip(2.0 + (i % 4) + bucket_shift, 0, B - 1)
+        b = np.arange(B, dtype=np.float64)
+        w = np.maximum(0.0, 2.0 - np.abs(b[None, :] - centre[:, None]))
+        w = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+        hist = np.floor(w * fire[:, None])
+        hist[np.arange(F), centre.astype(int)] += fire - hist.sum(axis=1)
+        mag = cfg.hist_lo * cfg.hist_ratio ** (centre + 0.5)
+        return {
+            "rows": float(rows),
+            "fire": fire,
+            "sum": mag * fire,
+            "sumsq": mag * mag * fire,
+            "max": np.where(fire > 0, mag * 1.5, 0.0),
+            "hist": hist,
+        }
+
+    def host(lanes):
+        return {
+            "featstat_rows": np.array([ln["rows"] for ln in lanes]),
+            "featstat_fire": np.stack([ln["fire"] for ln in lanes]),
+            "featstat_sum": np.stack([ln["sum"] for ln in lanes]),
+            "featstat_sumsq": np.stack([ln["sumsq"] for ln in lanes]),
+            "featstat_max": np.stack([ln["max"] for ln in lanes]),
+            "featstat_hist": np.stack([ln["hist"] for ln in lanes]),
+        }
+
+    train_names = ["l1_1.00e-04", "l1_1.00e-03"]
+    snap0 = write_snapshot(
+        FEATURE_RUN_DIR, "train",
+        host([lane(4096, 1.0, 0, 30), lane(4096, 0.6, 0, 28)]),
+        train_names, cfg, meta={"step": 64},
+    )
+    snap1 = write_snapshot(
+        FEATURE_RUN_DIR, "train",
+        host([lane(4096, 0.98, 0, 30), lane(4096, 0.59, 0, 28)]),
+        train_names, cfg, meta={"step": 128},
+    )
+    # the drifted serve window: magnitudes two log-buckets up, rates moved
+    serve_snap = write_snapshot(
+        FEATURE_RUN_DIR, "serve",
+        host([lane(2048, 0.7, 2, 30), lane(2048, 0.85, 2, 28)]),
+        ["d0", "d1"], cfg, meta={"replica": "replica0"},
+    )
+
+    def restamp(path):
+        with zipfile.ZipFile(path) as z:
+            members = [(zi.filename, z.read(zi.filename)) for zi in z.infolist()]
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            for name, data in members:
+                zi = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+                zi.compress_type = zipfile.ZIP_DEFLATED
+                z.writestr(zi, data)
+
+    for p in sorted(FEATURE_RUN_DIR.glob("feature_stats.*.npz")):
+        restamp(p)
+
+    drift = drift_report(snap1, serve_snap)
+    assert drift is not None and drift["score"] > 0.25, drift
+    stable = drift_report(snap0, snap1)
+    assert stable is not None and stable["score"] < 0.1, stable
+
+    # -- event log: the pointer events a real run would have emitted --------
+    T = FEATURE_BASE_TS
+    seq = 0
+
+    def rec(ts, event, **fields):
+        nonlocal seq
+        seq += 1
+        return {"seq": seq, "ts": round(ts, 3), "event": event, **fields}
+
+    def span_rec(ts_start, seconds, category, name, **fields):
+        return rec(ts_start + seconds, "span", category=category, name=name,
+                   ts_start=round(ts_start, 3), seconds=seconds, **fields)
+
+    def flush_rec(ts, snap, drift_rep, **extra):
+        agg = snapshot_aggregates(snap)
+        fields = {
+            "scope": snap.scope, "gen": snap.gen,
+            "path": snap.meta.get("path", ""), "names": list(snap.names),
+            "n_feats": snap.n_feats,
+            **{k: round(v, 6) for k, v in agg.items()},
+        }
+        if drift_rep is not None:
+            fields["drift_score"] = round(drift_rep["score"], 6)
+            fields["drift_method"] = drift_rep["method"]
+            fields["drift_top"] = [
+                [f, round(d, 6)] for f, d in drift_rep["top"]
+            ]
+        fields.update(extra)
+        return rec(ts, "feature_stats", **fields)
+
+    fp = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+          "device_kind": "golden-cpu", "device_count": 1, "git_sha": "g0lden"}
+    agg_t = snapshot_aggregates(snap1)
+    agg_s = snapshot_aggregates(serve_snap)
+    events = [
+        rec(T, "run_start", run_name="feature_golden", generation=0,
+            config={"batch": 512, "l1_values": [1e-4, 1e-3],
+                    "feature_stats": True},
+            fingerprint=fp),
+        rec(T + 2.0, "compile", name="ensemble.step_scan", seconds=1.8),
+        rec(T + 2.1, "chunk_start", chunk=0, position=0),
+        rec(T + 5.1, "chunk_end", chunk=0, position=0, seconds=3.0, steps=64),
+        span_rec(T + 5.1, 0.02, "feature_flush", "train"),
+        flush_rec(T + 5.13, snap0, None, step=64),
+        rec(T + 5.2, "chunk_start", chunk=1, position=1),
+        rec(T + 8.2, "chunk_end", chunk=1, position=1, seconds=3.0, steps=64),
+        span_rec(T + 8.2, 0.02, "feature_flush", "train"),
+        flush_rec(T + 8.23, snap1, None, step=128),
+        # the serve tier's flush against the train baseline, same run dir
+        span_rec(T + 12.0, 0.03, "feature_flush", "serve"),
+        flush_rec(T + 12.04, serve_snap, drift, replica="replica0"),
+        rec(T + 14.0, "snapshot",
+            counters={"chunks": 2, "train.steps": 128,
+                      "train.feature.flushes": 2, "serve.feature.flushes": 1,
+                      "span.feature_flush.count": 3,
+                      "span.feature_flush.seconds": 0.07},
+            gauges={"train.feature.dead_frac": round(agg_t["dead_frac"], 6),
+                    "train.feature.gini": round(agg_t["gini"], 6),
+                    "train.feature.hot_frac": round(agg_t["hot_frac"], 6),
+                    "serve.feature.dead_frac": round(agg_s["dead_frac"], 6),
+                    "serve.feature.gini": round(agg_s["gini"], 6),
+                    "serve.feature.hot_frac": round(agg_s["hot_frac"], 6),
+                    "serve.feature.drift_score": round(drift["score"], 6)}),
+        rec(T + 14.5, "run_end", status="ok", generation=0, steps=128,
+            wall_seconds=14.5),
+    ]
+    with open(FEATURE_RUN_DIR / "events.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    # the CLI rendering pin: regenerated from the real pipeline with the
+    # run_dir normalized to the repo-relative form the test uses
+    info = summarize_run(FEATURE_RUN_DIR)
+    info["run_dir"] = "tests/golden/feature_run"
+    (FEATURE_RUN_DIR / "expected_cli.txt").write_text(render_features(info))
+    print(f"Wrote {FEATURE_RUN_DIR}/ (3 npz snapshots + events.jsonl + "
+          f"expected_cli.txt; drift {drift['score']:.3f}, "
+          f"control {stable['score']:.3f})")
+
+
 def main():
     if "--traced-run" in sys.argv:
         make_traced_run_fixture()
@@ -1318,6 +1526,9 @@ def main():
         return
     if "--bench-fixture" in sys.argv:
         make_bench_fixture()
+        return
+    if "--feature-run" in sys.argv:
+        make_feature_run_fixture()
         return
     # CPU: the fixture must evaluate identically on any dev machine / CI
     os.environ.setdefault("XLA_FLAGS", "")

@@ -42,6 +42,12 @@ from sparse_coding__tpu.telemetry.events import (
     event_active,
     tracked_jit,
 )
+from sparse_coding__tpu.telemetry.feature_stats import (
+    FEATURE_STATS_KEYS,
+    FeatureStatsConfig,
+    feature_stats_pack,
+    init_feature_stats,
+)
 from sparse_coding__tpu.telemetry.health import (
     FIRE_EMA_KEY,
     HealthConfig,
@@ -224,6 +230,7 @@ def make_ensemble_step(
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
     health: Optional[HealthConfig] = None,
+    feature_stats: Optional[FeatureStatsConfig] = None,
 ) -> Callable:
     """Build the fused train step for a stacked ensemble.
 
@@ -270,6 +277,13 @@ def make_ensemble_step(
         exist precisely to keep grads and the code tensor out of HBM —
         `Ensemble` forces ``fused=False`` when health is on, and this builder
         suppresses the fused branches defensively.
+      feature_stats: a `telemetry.feature_stats.FeatureStatsConfig` fuses the
+        per-feature firing sketch into the step: the ``featstat_*`` buffers
+        ([n_models, n_feats] counts/sums/max/histograms) accumulate from the
+        signature's code tensor ``aux["c"]`` with zero host syncs and flush
+        at chunk boundaries (`flush_ensemble_feature_stats`). Like health it
+        needs the code tensor in HBM, so the fused Pallas paths are
+        suppressed.
 
     Additionally, a ``buffers["update_mask"]`` key ([n_models] f32, 1=train /
     0=frozen — see `Ensemble.set_update_mask`) NaN-safely zeroes the masked
@@ -292,6 +306,12 @@ def make_ensemble_step(
                 )
                 loss_dict = {**loss_dict, **h}
                 extra[FIRE_EMA_KEY] = new_ema
+            if feature_stats is not None:
+                extra.update(feature_stats_pack(
+                    aux,
+                    {k: buffers[k] for k in FEATURE_STATS_KEYS},
+                    feature_stats,
+                ))
             updates, opt_state = tx.update(grads, opt_state, params)
             mask = buffers.get("update_mask")
             if mask is not None:
@@ -320,6 +340,7 @@ def make_ensemble_step(
             fused_ok = (
                 fused
                 and health is None  # health pack needs grads + aux in HBM
+                and feature_stats is None  # sketch reads the code tensor
                 and not per_model_batch
                 and not unstacked
                 and batch.shape[0] % 256 == 0
@@ -347,6 +368,7 @@ def make_ensemble_step(
                 not fused_ok
                 and fused
                 and health is None
+                and feature_stats is None
                 and not per_model_batch
                 and not unstacked
                 and hasattr(sig, "fused_grads_stacked")
@@ -443,9 +465,9 @@ def make_ensemble_step(
                 params, opt_state, loss_dict, aux, extra = jax.vmap(
                     one_model, in_axes=(0, 0, 0, batch_axis)
                 )(state.params, exec_buffers, state.opt_state, batch)
-        # health writes its firing EMA back into the STORED buffers (never
-        # the warmup-ramped exec view) — `extra` is {} otherwise, a
-        # trace-time structural no-op
+        # health writes its firing EMA (and feature_stats its sketch) back
+        # into the STORED buffers (never the warmup-ramped exec view) —
+        # `extra` is {} otherwise, a trace-time structural no-op
         buffers = {**state.buffers, **extra} if extra else state.buffers
         new_state = EnsembleState(
             params=params,
@@ -468,6 +490,7 @@ def make_ensemble_multi_step(
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
     health: Optional[HealthConfig] = None,
+    feature_stats: Optional[FeatureStatsConfig] = None,
 ) -> Callable:
     """K fused train steps under ONE compiled program via `lax.scan`.
 
@@ -483,7 +506,7 @@ def make_ensemble_multi_step(
     """
     step = make_ensemble_step(
         sig, tx, per_model_batch, unstacked, compute_dtype, fused, fused_adam,
-        l1_warmup_steps, health,
+        l1_warmup_steps, health, feature_stats,
     )
 
     def multi_step(state: EnsembleState, batches: jax.Array):
@@ -506,6 +529,7 @@ def make_ensemble_multi_step_idx(
     fused_adam: Optional[Dict[str, float]] = None,
     l1_warmup_steps: int = 0,
     health: Optional[HealthConfig] = None,
+    feature_stats: Optional[FeatureStatsConfig] = None,
 ) -> Callable:
     """`make_ensemble_multi_step`, but each step's batch is GATHERED from the
     resident dataset inside the compiled scan (`multi_step_idx(state,
@@ -529,6 +553,7 @@ def make_ensemble_multi_step_idx(
         sig, tx, per_model_batch=False, unstacked=unstacked,
         compute_dtype=compute_dtype, fused=fused, fused_adam=fused_adam,
         l1_warmup_steps=l1_warmup_steps, health=health,
+        feature_stats=feature_stats,
     )
 
     def multi_step_idx(state: EnsembleState, dataset: jax.Array, idxs: jax.Array):
@@ -582,6 +607,7 @@ class Ensemble:
         fused: Optional[bool] = None,
         l1_warmup_steps: int = 0,
         health: bool | HealthConfig = False,
+        feature_stats: bool | FeatureStatsConfig = False,
     ):
         if not models:
             raise ValueError("Ensemble requires at least one (params, buffers) model")
@@ -604,7 +630,15 @@ class Ensemble:
             health if isinstance(health, HealthConfig)
             else (HealthConfig() if health else None)
         )
-        if self.health is not None:
+        # per-feature firing sketch (opt-in): [n_models, n_feats] counts /
+        # sums / max / log-bucket histograms accumulated inside the jitted
+        # step (telemetry.feature_stats). Same HBM constraint as health:
+        # it reads the code tensor, so the fused Pallas paths go OFF.
+        self.feature_stats: Optional[FeatureStatsConfig] = (
+            feature_stats if isinstance(feature_stats, FeatureStatsConfig)
+            else (FeatureStatsConfig() if feature_stats else None)
+        )
+        if self.health is not None or self.feature_stats is not None:
             fused = False
         if fused is None:
             # auto: Pallas fused step on real TPU when the signature supports
@@ -639,6 +673,10 @@ class Ensemble:
             buffers[FIRE_EMA_KEY] = init_fire_ema(
                 self.n_models, n_feats_of(models[0][0])
             )
+        if self.feature_stats is not None:
+            buffers.update(init_feature_stats(
+                self.n_models, n_feats_of(models[0][0]), self.feature_stats
+            ))
         opt_state = jax.vmap(self.tx.init)(params)
         self.state = EnsembleState(
             params=params,
@@ -736,6 +774,7 @@ class Ensemble:
             fused_adam=fused_adam,
             l1_warmup_steps=getattr(self, "l1_warmup_steps", 0),
             health=getattr(self, "health", None),
+            feature_stats=getattr(self, "feature_stats", None),
         )
         donate_argnums = (0,) if donate else ()
 
@@ -760,6 +799,7 @@ class Ensemble:
                 None if fused_adam is None else tuple(sorted(fused_adam.items())),
                 kw["l1_warmup_steps"],
                 kw["health"],  # frozen dataclass or None: hashable
+                kw["feature_stats"],  # frozen dataclass or None: hashable
                 donate,
             )
             if cache_key in Ensemble._SHARED_STEPS:
@@ -977,6 +1017,10 @@ class Ensemble:
                 None if getattr(self, "health", None) is None
                 else dataclasses.asdict(self.health)
             ),
+            "feature_stats": (
+                None if getattr(self, "feature_stats", None) is None
+                else dataclasses.asdict(self.feature_stats)
+            ),
             "state": self.state,  # live device pytree, no host copy
         }
 
@@ -1012,6 +1056,15 @@ class Ensemble:
         self.health = (
             HealthConfig(**{k: float(v) for k, v in h.items()}) if h else None
         )
+        fs = state_dict.get("feature_stats")
+        self.feature_stats = (
+            FeatureStatsConfig(
+                n_buckets=int(fs["n_buckets"]),
+                hist_lo=float(fs["hist_lo"]),
+                hist_ratio=float(fs["hist_ratio"]),
+            )
+            if fs else None
+        )
         self.tx = tx if tx is not None else optim_str_to_func(self.optimizer_name)(**self.optimizer_kwargs)
         self.state = jax.tree.map(jnp.asarray, state_dict["state"])
         self._build_steps()
@@ -1028,6 +1081,7 @@ def build_ensemble(
     fused: Optional[bool] = None,
     l1_warmup_steps: int = 0,
     health: bool | HealthConfig = False,
+    feature_stats: bool | FeatureStatsConfig = False,
     **common_hparams,
 ) -> Ensemble:
     """Convenience: init N models of `sig` (one per hparams dict) and stack them.
@@ -1047,4 +1101,5 @@ def build_ensemble(
     return Ensemble(
         models, sig, optimizer, optimizer_kwargs, compute_dtype=compute_dtype,
         fused=fused, l1_warmup_steps=l1_warmup_steps, health=health,
+        feature_stats=feature_stats,
     )

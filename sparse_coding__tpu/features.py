@@ -1,0 +1,31 @@
+"""CLI shim: ``python -m sparse_coding__tpu.features <run_dir>``.
+
+The dictionary feature surface: lists top-firing / dead / top-drifting
+features from the ``feature_stats.<gen>.npz`` snapshots a run leaves
+behind, with ``--json`` for machines, ``--diff GEN_A GEN_B`` to compare two
+specific snapshot generations, and ``--threshold X`` as the CI gate (exit
+**1** when the train↔serve drift score reaches X; exit **3** when the run
+dir holds no snapshots at all). Implementation:
+`sparse_coding__tpu.telemetry.feature_stats` (docs/observability.md §10).
+"""
+
+from sparse_coding__tpu.telemetry.feature_stats import (
+    FeatureSnapshot,
+    drift_report,
+    load_run_snapshots,
+    main,
+    render_features,
+    summarize_run,
+)
+
+__all__ = [
+    "FeatureSnapshot",
+    "drift_report",
+    "load_run_snapshots",
+    "main",
+    "render_features",
+    "summarize_run",
+]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

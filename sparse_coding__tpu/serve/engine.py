@@ -315,9 +315,22 @@ class EncodeEngine:
         buckets: Optional[Sequence[int]] = None,
         telemetry=None,
         latency_window: int = 4096,
+        feature_stats=None,
     ):
         self.registry = registry
         self.telemetry = telemetry
+        # per-feature firing sketch (opt-in; telemetry.feature_stats): the
+        # drainer accumulates per-lane firing counts / magnitude histograms
+        # on device right after each dispatch — pure jnp updates, so the
+        # hot loop gains zero host syncs and served bytes are untouched.
+        # Truthy non-config values opt into the default config.
+        if feature_stats is not None and not hasattr(feature_stats, "cfg"):
+            from sparse_coding__tpu.telemetry.feature_stats import (
+                ServeFeatureStats,
+            )
+
+            feature_stats = ServeFeatureStats(feature_stats) if feature_stats else None
+        self.feature_stats = feature_stats
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.buckets = tuple(sorted(buckets)) if buckets else default_buckets(self.max_batch)
@@ -903,6 +916,25 @@ class EncodeEngine:
             self._request_trace_record(
                 r, encode_s, dequant_s, bucket, stack.size, len(reqs)
             )
+        if self.feature_stats is not None:
+            # per-lane validity mask: every lane encodes every padded row,
+            # but only the owning lane's slice is served — the sketch must
+            # count exactly the served (lane, row) cells. Host-side zeros +
+            # assignment; the accumulate itself is pure jnp (no host sync)
+            fmask = np.zeros((stack.size, padded.shape[0]), np.float32)
+            s = 0
+            for r in reqs:
+                fmask[lane_of[r.dict_id], s : s + r.rows.shape[0]] = 1.0
+                s += r.rows.shape[0]
+            if sparse:
+                idx, vals = out
+                self.feature_stats.accumulate_topk(
+                    stack.ids, stack.n_feats, idx, vals, fmask
+                )
+            else:
+                self.feature_stats.accumulate_dense(
+                    stack.ids, stack.n_feats, out, fmask
+                )
         self._note_served(reqs, rows.shape[0], bucket)
 
     def _run_features_group(self, stack: _Stack, reqs: List[EncodeRequest],
@@ -999,6 +1031,24 @@ class EncodeEngine:
             self._request_trace_record(
                 r, encode_s, dequant_s, bucket_rows, stack.size, len(reqs)
             )
+        if self.feature_stats is not None:
+            # token-row validity mask (see _run_group): one contiguous
+            # [lo, hi) row range per request on its owning lane
+            fmask = np.zeros((stack.size, bucket_rows), np.float32)
+            s = 0
+            for r in reqs:
+                lo, hi = s * seq_len, (s + r.rows.shape[0]) * seq_len
+                fmask[lane_of[r.dict_id], lo:hi] = 1.0
+                s += r.rows.shape[0]
+            if sparse:
+                idx, vals = out
+                self.feature_stats.accumulate_topk(
+                    stack.ids, stack.n_feats, idx, vals, fmask
+                )
+            else:
+                self.feature_stats.accumulate_dense(
+                    stack.ids, stack.n_feats, out, fmask
+                )
         self._note_served(reqs, n_rows, bucket_rows)
 
     def _record_error(self, req: EncodeRequest, exc: BaseException) -> None:

@@ -262,6 +262,9 @@ class ServeServer:
         verbose: bool = False,
         dict_generation: int = 0,
         replica_id: Optional[str] = None,
+        feature_baseline=None,
+        feature_flush_s: float = 30.0,
+        drift_policy=None,
         **engine_kwargs,
     ):
         self.registry = registry
@@ -271,6 +274,41 @@ class ServeServer:
         )
         self.request_timeout = float(request_timeout)
         self.verbose = verbose
+        # feature-level observability (docs/observability.md §10): when the
+        # engine carries a firing sketch (``feature_stats=True`` engine
+        # kwarg), this server owns its flush cadence — scrape-driven via
+        # `metrics_text` plus the drain boundary, min `feature_flush_s`
+        # apart — and runs the train↔serve drift check against
+        # `feature_baseline` (a FeatureSnapshot or path to one) through an
+        # `AnomalyGuard`. An abort-tier drift sets `drift_abort_requested`
+        # instead of raising into a scrape handler; `main`'s loop drains on
+        # it (in-process embedders poll it themselves).
+        self.feature_flush_s = float(feature_flush_s)
+        self.feature_guard = None
+        self.drift_abort_requested = False
+        fs = getattr(self.engine, "feature_stats", None)
+        if fs is not None:
+            if feature_baseline is not None:
+                from sparse_coding__tpu.telemetry.feature_stats import (
+                    FeatureSnapshot,
+                )
+
+                if not isinstance(feature_baseline, FeatureSnapshot):
+                    feature_baseline = FeatureSnapshot.load(feature_baseline)
+                fs.set_baseline(feature_baseline)
+            from sparse_coding__tpu.telemetry.anomaly import AnomalyGuard
+
+            out_dir = (
+                telemetry.path.parent
+                if telemetry is not None and telemetry.path is not None
+                else None
+            )
+            self.feature_guard = AnomalyGuard(
+                telemetry=telemetry,
+                out_dir=out_dir,
+                policy=drift_policy,
+                model_names=registry.ids(),
+            )
         # the dict generation this replica serves (a rolling swap relaunches
         # replicas with the next generation): stamped into every /encode
         # response so a client/router can SEE which rollout answered — the
@@ -361,6 +399,44 @@ class ServeServer:
             out["replica"] = self.replica_id
         return out
 
+    def maybe_flush_features(self, force: bool = False) -> List[Dict[str, Any]]:
+        """Flush the engine's firing sketch into ``feature_stats.serveNNNN.npz``
+        snapshots (+ gauges + pointer events) and run the drift check — when
+        the engine carries one, a run dir exists, and at least
+        `feature_flush_s` elapsed since the last flush (``force`` overrides
+        the interval: the drain boundary must not drop a partial window).
+        Returns the per-snapshot summaries."""
+        fs = getattr(self.engine, "feature_stats", None)
+        if fs is None:
+            return []
+        if self.telemetry is None or self.telemetry.path is None:
+            return []
+        if not force and fs.seconds_since_flush < self.feature_flush_s:
+            return []
+        extra: Dict[str, Any] = {"dict_generation": self.dict_generation}
+        if self.replica_id is not None:
+            extra["replica"] = self.replica_id
+        summaries = fs.flush(self.telemetry, self.telemetry.path.parent, extra=extra)
+        if self.feature_guard is not None:
+            from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort
+
+            for s in summaries:
+                if "drift_score" not in s:
+                    continue
+                try:
+                    self.feature_guard.observe_feature_drift(
+                        s["drift_score"],
+                        top=s.get("drift_top"),
+                        scope="serve",
+                        baseline=fs.baseline.gen if fs.baseline else None,
+                        current=s["gen"],
+                    )
+                except AnomalyAbort:
+                    # never raise into a scrape/drain path: flag it and let
+                    # the serving loop (or the embedder) drain gracefully
+                    self.drift_abort_requested = True
+        return summaries
+
     def metrics_text(self) -> str:
         """The ``GET /metrics`` body: Prometheus text exposition of this
         replica's counters/gauges/histograms (docs/observability.md §8).
@@ -372,6 +448,7 @@ class ServeServer:
             telemetry_metrics_text,
         )
 
+        self.maybe_flush_features()
         if self.telemetry is not None:
             self.telemetry.gauge_set("serve.queue_depth", self.engine.queue_depth)
             self.telemetry.gauge_set(
@@ -405,6 +482,8 @@ class ServeServer:
                 "serve_drain", queue_depth=self.engine.queue_depth
             )
         self.engine.stop(drain=True, timeout=timeout)
+        # the drained batches' firing stats must reach disk before shutdown
+        self.maybe_flush_features(force=True)
 
     def close(self) -> None:
         self.httpd.shutdown()
@@ -730,6 +809,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "DictRegistry.attach_subject)")
     ap.add_argument("--subject-seq-len", type=int, default=32,
                     help="seq_len the /features warmup pre-compiles for")
+    ap.add_argument("--feature-stats", action="store_true",
+                    help="accumulate the per-feature firing sketch on the "
+                    "drainer (docs/observability.md §10): per-lane firing "
+                    "counts / magnitude histograms, flushed to "
+                    "feature_stats.serveNNNN.npz at scrape/drain boundaries")
+    ap.add_argument("--feature-baseline", default=None, metavar="NPZ",
+                    help="training-baseline feature_stats snapshot to drift-"
+                    "check each flushed serve window against (implies "
+                    "--feature-stats)")
+    ap.add_argument("--feature-flush-s", type=float, default=30.0,
+                    help="min seconds between firing-sketch flushes")
+    ap.add_argument("--drift-warn", type=float, default=0.25,
+                    help="PSI drift score that trips a feature_drift warn")
+    ap.add_argument("--drift-abort", type=float, default=1.0,
+                    help="PSI drift score that drains this replica "
+                    "(exit 1) — the serve-side abort tier")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -760,11 +855,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "subjects": registry.subjects(),
     })
 
+    from sparse_coding__tpu.telemetry.anomaly import AnomalyPolicy
+
+    feature_stats_on = bool(args.feature_stats or args.feature_baseline)
     srv = ServeServer(
         registry, host=args.host, port=args.port, telemetry=telemetry,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         verbose=args.verbose, dict_generation=args.dict_generation,
         replica_id=args.replica_id,
+        feature_stats=feature_stats_on or None,
+        feature_baseline=args.feature_baseline,
+        feature_flush_s=args.feature_flush_s,
+        drift_policy=AnomalyPolicy(
+            drift_warn=args.drift_warn, drift_abort=args.drift_abort,
+        ) if feature_stats_on else None,
     )
     srv.engine.start()
     if not args.no_warmup:
@@ -792,6 +896,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             # SIGKILLs this replica mid-flight, deterministically
             fault_point("serve_loop", tick=tick)
             tick += 1
+            # firing-sketch flush cadence (interval-gated internally); an
+            # abort-tier train↔serve drift drains this replica — serving a
+            # distribution the dict never trained on is not a warning
+            srv.maybe_flush_features()
+            if srv.drift_abort_requested:
+                print("[serve] feature drift past abort threshold — "
+                      "draining replica", flush=True)
+                srv.drain()
+                telemetry.event("serve_drained", reason="feature_drift",
+                                requests=srv.engine.stats["requests"])
+                srv.close()
+                status = "drift_abort"
+                return 1
             time.sleep(0.05)
         sig = preemption.preemption_signal()
         print(f"[serve] drain requested (signal {sig}) — rejecting new "

@@ -59,6 +59,11 @@ class AnomalyPolicy:
     spike_window: int = 64          # trailing samples kept per (model, metric)
     spike_min_window: int = 16      # don't judge spikes before this many
     dead_jump: float = 0.25         # dead_frac rise per observation that trips
+    feature_drift: bool = True      # train↔serve drift detector (observe_feature_drift)
+    drift_warn: float = 0.25        # PSI score that warns (industry "major shift")
+    drift_abort: float = 1.0        # PSI score that escalates to abort regardless
+                                    # of `action` — a dictionary serving a
+                                    # different distribution than it trained on
     action: str = "warn"            # "warn" | "mask" | "abort"
     dump_last_k: int = 256          # metric records retained for the bundle
     max_bundles: int = 16           # stop dumping (not detecting) after this
@@ -172,10 +177,42 @@ class AnomalyGuard:
             self._last_dead[m] = v
         return out
 
+    def observe_feature_drift(
+        self,
+        score: float,
+        step: int = 0,
+        top: Optional[Sequence] = None,
+        scope: str = "serve",
+        baseline: Optional[str] = None,
+        current: Optional[str] = None,
+    ):
+        """Train↔serve drift check (telemetry.feature_stats): `score` is the
+        aggregate per-feature PSI of the current window against the training
+        baseline, `top` the top-drifting ``(feature, psi)`` pairs. Warns at
+        ``drift_warn`` under the policy action; at ``drift_abort`` the action
+        escalates to abort regardless — a dictionary serving a distribution
+        it never trained on is not a warning. Returns the detections (empty
+        when quiet)."""
+        p = self.policy
+        if not p.feature_drift or score != score or score < p.drift_warn:
+            return []
+        found = [{
+            "kind": "feature_drift", "step": int(step), "metric": "feature_drift",
+            "model": 0, "value": float(score), "scope": scope,
+            "baseline": baseline, "current": current,
+            "top": [[int(f), float(d)] for f, d in (top or [])][:16],
+            "threshold": p.drift_warn,
+        }]
+        self._trigger(
+            found, action="abort" if score >= p.drift_abort else None
+        )
+        return found
+
     # -- response ------------------------------------------------------------
 
-    def _trigger(self, found: List[Dict[str, Any]]):
+    def _trigger(self, found: List[Dict[str, Any]], action: Optional[str] = None):
         p = self.policy
+        action = action or p.action
         self.anomalies.extend(found)
         models = sorted({f["model"] for f in found})
         kinds = sorted({f["kind"] for f in found})
@@ -200,7 +237,7 @@ class AnomalyGuard:
                     model_names=[self._name(m) for m in kind_models],
                     detections=ks[:8],
                     bundle=str(bundle_path) if bundle_path else None,
-                    action=p.action,
+                    action=action,
                     trace_dir=trace_dir,
                 )
         desc = (
@@ -208,14 +245,14 @@ class AnomalyGuard:
             f"{[self._name(m) for m in models]}"
             + (f" (bundle: {bundle_path})" if bundle_path else "")
         )
-        if p.action == "mask":
+        if action == "mask":
             self.masked |= set(models)
             if self.ensemble is not None:
                 mask = np.ones((self.ensemble.n_models,), np.float32)
                 mask[sorted(self.masked)] = 0.0
                 self.ensemble.set_update_mask(mask)
             warnings.warn(desc + f" — masked models {sorted(self.masked)}", RuntimeWarning)
-        elif p.action == "abort":
+        elif action == "abort":
             warnings.warn(desc + " — aborting per policy", RuntimeWarning)
             raise AnomalyAbort(desc)
         else:

@@ -1,0 +1,868 @@
+"""Per-feature statistics: the in-step firing sketch and the drift detector.
+
+The paper's premise is that individual dictionary features are meaningful —
+yet training kept only a scalar dead fraction (`telemetry/health.py`) and
+the serving tier discarded every per-feature signal. This module is the
+missing sensor layer: a device-resident ``[n_models, n_feats]`` sketch
+accumulated INSIDE the compiled step/dispatch (zero host syncs — the host
+first sees it at a flush boundary, the same contract as the health pack's
+`MetricLogger` buffers), snapshotted to ``feature_stats.<gen>.npz``
+artifacts with ``feature_stats`` events.jsonl pointers, and compared across
+snapshots by a population-stability-index / Jensen-Shannon drift detector.
+
+Sketch layout (per model/lane; stacked with a leading ensemble axis):
+
+  - ``featstat_rows``   rows accumulated this window                  — ``[]``
+  - ``featstat_fire``   rows on which each feature fired (``c != 0``) — ``[F]``
+  - ``featstat_sum``    sum of each feature's activation              — ``[F]``
+  - ``featstat_sumsq``  sum of squared activation                     — ``[F]``
+  - ``featstat_max``    max |activation| seen this window             — ``[F]``
+  - ``featstat_hist``   fired-magnitude log-bucket counts             — ``[F, B]``
+
+The histogram buckets are fixed at trace time: bucket ``b`` holds fired
+magnitudes in ``[lo·ratio^b, lo·ratio^(b+1))`` with the first/last buckets
+absorbing under/overflow, so two snapshots are always bin-compatible and a
+per-feature firing *distribution* over ``B+1`` cells (the extra cell is
+"did not fire") falls straight out of ``rows``/``fire``/``hist``.
+
+Drift: ``psi(p, q)`` per feature between a training-baseline snapshot and a
+rolling serve window; the aggregate score is the mean per-feature PSI, and
+``drift_report`` returns it with the top-drifting-feature list. PSI reads
+on the usual industry scale (<0.1 stable, 0.1–0.25 drifting, >0.25 major).
+
+Flush protocol: one batched ``jax.device_get`` under ``allowed_transfer()``
+inside a ``feature_flush`` span, write the npz, emit the pointer event,
+reset the device sketch to zeros (rolling-window semantics). The train-side
+sketch lives in the ensemble ``state.buffers`` so it checkpoints — and
+therefore survives kill+resume — with the rest of the training state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparse_coding__tpu.telemetry.audit import allowed_transfer
+from sparse_coding__tpu.telemetry.spans import Span
+
+__all__ = [
+    "FEATURE_STATS_KEYS",
+    "FeatureStatsConfig",
+    "FeatureSnapshot",
+    "ServeFeatureStats",
+    "init_feature_stats",
+    "feature_stats_pack",
+    "update_feature_stats",
+    "snapshot_aggregates",
+    "lane_distribution",
+    "psi",
+    "js_divergence",
+    "drift_report",
+    "write_snapshot",
+    "flush_ensemble_feature_stats",
+    "next_snapshot_path",
+    "load_run_snapshots",
+    "summarize_run",
+    "render_features",
+    "main",
+]
+
+# Buffer-dict keys of the device sketch (leading axis = n_models / lanes).
+FEATURE_STATS_KEYS = (
+    "featstat_rows",
+    "featstat_fire",
+    "featstat_sum",
+    "featstat_sumsq",
+    "featstat_max",
+    "featstat_hist",
+)
+
+SNAPSHOT_PREFIX = "feature_stats."
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureStatsConfig:
+    """Trace-relevant knobs (hashable: part of the shared-step cache key).
+
+    ``n_buckets`` log-magnitude buckets starting at ``hist_lo`` with ratio
+    ``hist_ratio`` between edges. The defaults span |c| from ~1e-3 to ~64
+    in 8 buckets — wide enough for unit-norm-dictionary SAE codes while
+    keeping the sketch at ``(B+4)·F`` floats per model."""
+
+    n_buckets: int = 8
+    hist_lo: float = 2.0 ** -10
+    hist_ratio: float = 4.0
+
+    def edges(self) -> np.ndarray:
+        """Bucket edges, ``[n_buckets + 1]`` (last bucket absorbs overflow)."""
+        return self.hist_lo * self.hist_ratio ** np.arange(
+            self.n_buckets + 1, dtype=np.float64
+        )
+
+
+def _normalize(cfg) -> Optional[FeatureStatsConfig]:
+    if isinstance(cfg, FeatureStatsConfig):
+        return cfg
+    return FeatureStatsConfig() if cfg else None
+
+
+def init_feature_stats(
+    n_models: int, n_feats: int, cfg: FeatureStatsConfig
+) -> Dict[str, jax.Array]:
+    """Zeroed stacked sketch: every leaf leads with ``n_models``."""
+    f32 = jnp.float32
+    return {
+        "featstat_rows": jnp.zeros((n_models,), f32),
+        "featstat_fire": jnp.zeros((n_models, n_feats), f32),
+        "featstat_sum": jnp.zeros((n_models, n_feats), f32),
+        "featstat_sumsq": jnp.zeros((n_models, n_feats), f32),
+        "featstat_max": jnp.zeros((n_models, n_feats), f32),
+        "featstat_hist": jnp.zeros((n_models, n_feats, cfg.n_buckets), f32),
+    }
+
+
+def _bucket_index(a: jax.Array, cfg: FeatureStatsConfig) -> jax.Array:
+    """Fixed-log-bucket index of magnitudes ``a`` (clipped to [0, B-1])."""
+    safe = jnp.maximum(a, cfg.hist_lo)
+    idx = jnp.floor(
+        jnp.log(safe / cfg.hist_lo) / float(np.log(cfg.hist_ratio))
+    )
+    return jnp.clip(idx, 0, cfg.n_buckets - 1).astype(jnp.int32)
+
+
+def _hist_counts(a: jax.Array, fired: jax.Array, cfg: FeatureStatsConfig) -> jax.Array:
+    """Fired-magnitude bucket counts, ``[F, B]`` from ``a``/``fired`` [rows, F].
+
+    A trace-time Python loop over the B buckets keeps the peak temp at
+    ``[rows, F]`` bools instead of a ``[rows, F, B]`` one-hot."""
+    idx = _bucket_index(a, cfg)
+    cols = [
+        jnp.sum(
+            jnp.where(fired & (idx == b), 1.0, 0.0).astype(jnp.float32), axis=0
+        )
+        for b in range(cfg.n_buckets)
+    ]
+    return jnp.stack(cols, axis=-1)
+
+
+def update_feature_stats(
+    stats: Dict[str, jax.Array],
+    c: jax.Array,
+    cfg: FeatureStatsConfig,
+    mask: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """One window update for ONE model/lane (called inside the vmapped body).
+
+    ``stats`` is this member's sketch slice, ``c`` the ``[rows, F]`` code
+    tensor, ``mask`` an optional ``[rows]`` validity mask (serve batches are
+    padded to bucket sizes; padding rows can encode to nonzero codes and
+    must not count). Pure jnp — zero host syncs."""
+    c32 = c.astype(jnp.float32)
+    a = jnp.abs(c32)
+    fired = a > 0
+    if mask is not None:
+        valid = mask > 0
+        fired = fired & valid[:, None]
+        rows_add = jnp.sum(valid.astype(jnp.float32))
+    else:
+        rows_add = jnp.float32(c.shape[0])
+    firedf = fired.astype(jnp.float32)
+    c_live = jnp.where(fired, c32, 0.0)
+    a_live = jnp.where(fired, a, 0.0)
+    return {
+        "featstat_rows": stats["featstat_rows"] + rows_add,
+        "featstat_fire": stats["featstat_fire"] + firedf.sum(axis=0),
+        "featstat_sum": stats["featstat_sum"] + c_live.sum(axis=0),
+        "featstat_sumsq": stats["featstat_sumsq"] + jnp.sum(c_live * c_live, axis=0),
+        "featstat_max": jnp.maximum(stats["featstat_max"], a_live.max(axis=0)),
+        "featstat_hist": stats["featstat_hist"] + _hist_counts(a, fired, cfg),
+    }
+
+
+def feature_stats_pack(
+    aux, stats: Dict[str, jax.Array], cfg: FeatureStatsConfig
+) -> Dict[str, jax.Array]:
+    """Train-step hook (per-model slices, like `health_pack`): returns the
+    updated sketch, or the sketch untouched when the signature's aux carries
+    no code tensor ``"c"`` (nothing to count — same contract as the health
+    pack's NaN dead_frac path)."""
+    c = aux.get("c") if isinstance(aux, dict) else None
+    if c is None:
+        return stats
+    return update_feature_stats(stats, c, cfg)
+
+
+def _update_topk(
+    stats: Dict[str, jax.Array],
+    idx: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+    cfg: FeatureStatsConfig,
+) -> Dict[str, jax.Array]:
+    """Sparse top-k window update for one lane: ``idx``/``vals`` are the
+    ``[rows, k]`` top-k encode outputs. Only the surviving top-k magnitudes
+    contribute (documented truncation bias: sub-top-k firings are invisible
+    on this path — the dense path has no such bias)."""
+    n_feats = stats["featstat_fire"].shape[0]
+    v32 = vals.astype(jnp.float32)
+    a = jnp.abs(v32)
+    fired = (a > 0) & (mask > 0)[:, None]
+    flat_idx = idx.reshape(-1)
+
+    def scat_add(updates: jax.Array) -> jax.Array:
+        return jnp.zeros((n_feats,), jnp.float32).at[flat_idx].add(
+            updates.reshape(-1)
+        )
+
+    firedf = fired.astype(jnp.float32)
+    v_live = jnp.where(fired, v32, 0.0)
+    a_live = jnp.where(fired, a, 0.0)
+    bidx = _bucket_index(a, cfg)
+    hist_cols = [
+        scat_add(jnp.where(fired & (bidx == b), 1.0, 0.0)) for b in range(cfg.n_buckets)
+    ]
+    return {
+        "featstat_rows": stats["featstat_rows"] + jnp.sum((mask > 0).astype(jnp.float32)),
+        "featstat_fire": stats["featstat_fire"] + scat_add(firedf),
+        "featstat_sum": stats["featstat_sum"] + scat_add(v_live),
+        "featstat_sumsq": stats["featstat_sumsq"] + scat_add(v_live * v_live),
+        "featstat_max": jnp.maximum(
+            stats["featstat_max"],
+            jnp.zeros((n_feats,), jnp.float32).at[flat_idx].max(a_live.reshape(-1)),
+        ),
+        "featstat_hist": stats["featstat_hist"] + jnp.stack(hist_cols, axis=-1),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _accumulate_dense(stats, codes, mask, cfg: FeatureStatsConfig):
+    """Stacked dense update: ``codes`` [G, rows, F], ``mask`` [G, rows]."""
+    return jax.vmap(
+        lambda s, c, m: update_feature_stats(s, c, cfg, mask=m)
+    )(stats, codes, mask)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _accumulate_topk(stats, idx, vals, mask, cfg: FeatureStatsConfig):
+    """Stacked sparse update: ``idx``/``vals`` [G, rows, k], ``mask`` [G, rows]."""
+    return jax.vmap(
+        lambda s, i, v, m: _update_topk(s, i, v, m, cfg)
+    )(stats, idx, vals, mask)
+
+
+# ---------------------------------------------------------------------------
+# Snapshots (host side, numpy only past this point)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FeatureSnapshot:
+    """One flushed window of the sketch, host-resident.
+
+    ``names`` labels the leading axis (model names on the train side,
+    dict_ids / lane ids on the serve side). ``gen`` is the snapshot token
+    (``train0003``, ``serve0011``) the CLI addresses snapshots by."""
+
+    scope: str
+    gen: str
+    names: List[str]
+    rows: np.ndarray  # [M]
+    fire: np.ndarray  # [M, F]
+    sum: np.ndarray  # [M, F]
+    sumsq: np.ndarray  # [M, F]
+    max: np.ndarray  # [M, F]
+    hist: np.ndarray  # [M, F, B]
+    edges: np.ndarray  # [B + 1]
+    meta: Dict
+
+    @property
+    def n_feats(self) -> int:
+        return int(self.fire.shape[1])
+
+    def save(self, path) -> None:
+        meta = dict(self.meta)
+        meta.update(scope=self.scope, gen=self.gen, names=list(self.names))
+        np.savez_compressed(
+            path,
+            rows=self.rows.astype(np.float64),
+            fire=self.fire.astype(np.float64),
+            sum=self.sum.astype(np.float64),
+            sumsq=self.sumsq.astype(np.float64),
+            max=self.max.astype(np.float64),
+            hist=self.hist.astype(np.float64),
+            edges=self.edges.astype(np.float64),
+            meta_json=np.asarray(json.dumps(meta, sort_keys=True)),
+        )
+
+    @classmethod
+    def load(cls, path) -> "FeatureSnapshot":
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["meta_json"]))
+            return cls(
+                scope=meta.get("scope", "?"),
+                gen=meta.get("gen", "?"),
+                names=[str(n) for n in meta.get("names", [])],
+                rows=np.asarray(z["rows"], np.float64),
+                fire=np.asarray(z["fire"], np.float64),
+                sum=np.asarray(z["sum"], np.float64),
+                sumsq=np.asarray(z["sumsq"], np.float64),
+                max=np.asarray(z["max"], np.float64),
+                hist=np.asarray(z["hist"], np.float64),
+                edges=np.asarray(z["edges"], np.float64),
+                meta=meta,
+            )
+
+
+def next_snapshot_path(out_dir, scope: str) -> Tuple[Path, str]:
+    """Next ``feature_stats.<scope>NNNN.npz`` path in `out_dir` (counting
+    existing files, so a resumed run keeps appending instead of clobbering
+    the pre-kill snapshots)."""
+    out_dir = Path(out_dir)
+    n = len(list(out_dir.glob(f"{SNAPSHOT_PREFIX}{scope}[0-9][0-9][0-9][0-9].npz")))
+    gen = f"{scope}{n:04d}"
+    return out_dir / f"{SNAPSHOT_PREFIX}{gen}.npz", gen
+
+
+def write_snapshot(
+    out_dir,
+    scope: str,
+    host: Dict[str, np.ndarray],
+    names: Sequence[str],
+    cfg: FeatureStatsConfig,
+    meta: Optional[Dict] = None,
+) -> FeatureSnapshot:
+    """Build + persist one snapshot from host-fetched sketch arrays."""
+    path, gen = next_snapshot_path(out_dir, scope)
+    snap = FeatureSnapshot(
+        scope=scope,
+        gen=gen,
+        names=[str(n) for n in names],
+        rows=np.atleast_1d(np.asarray(host["featstat_rows"], np.float64)),
+        fire=np.asarray(host["featstat_fire"], np.float64),
+        sum=np.asarray(host["featstat_sum"], np.float64),
+        sumsq=np.asarray(host["featstat_sumsq"], np.float64),
+        max=np.asarray(host["featstat_max"], np.float64),
+        hist=np.asarray(host["featstat_hist"], np.float64),
+        edges=cfg.edges(),
+        meta=dict(meta or {}),
+    )
+    snap.meta["path"] = path.name
+    snap.save(path)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Aggregates + drift math
+# ---------------------------------------------------------------------------
+
+
+def _gini(x: np.ndarray) -> float:
+    """Gini coefficient of a nonnegative firing-count vector (0 = uniform
+    firing, →1 = all firings concentrated on one feature)."""
+    x = np.sort(np.asarray(x, np.float64))
+    n = x.size
+    tot = x.sum()
+    if n == 0 or tot <= 0:
+        return 0.0
+    cum = np.arange(1, n + 1) @ x
+    return float(2.0 * cum / (n * tot) - (n + 1.0) / n)
+
+
+def _hot_frac(fire: np.ndarray) -> float:
+    """Share of all firings carried by the hottest 1% of features."""
+    fire = np.asarray(fire, np.float64)
+    tot = fire.sum()
+    if tot <= 0:
+        return 0.0
+    k = max(1, fire.size // 100)
+    return float(np.sort(fire)[-k:].sum() / tot)
+
+
+def snapshot_aggregates(snap: FeatureSnapshot) -> Dict[str, float]:
+    """Window aggregates, averaged over lanes that saw any rows.
+
+    ``dead_frac``: fraction of features that never fired this window.
+    ``gini``: firing-count Gini. ``hot_frac``: top-1% firing share."""
+    dead, gini, hot = [], [], []
+    for m in range(snap.fire.shape[0]):
+        if snap.rows[m] <= 0:
+            continue
+        dead.append(float((snap.fire[m] == 0).mean()))
+        gini.append(_gini(snap.fire[m]))
+        hot.append(_hot_frac(snap.fire[m]))
+    if not dead:
+        return {"rows": float(snap.rows.sum()), "dead_frac": float("nan"),
+                "gini": float("nan"), "hot_frac": float("nan")}
+    return {
+        "rows": float(snap.rows.sum()),
+        "dead_frac": float(np.mean(dead)),
+        "gini": float(np.mean(gini)),
+        "hot_frac": float(np.mean(hot)),
+    }
+
+
+def lane_distribution(rows: float, fire: np.ndarray, hist: np.ndarray) -> np.ndarray:
+    """Per-feature firing distribution over ``B+1`` cells for one lane:
+    cell 0 is "did not fire on this row", cells 1..B the fired-magnitude
+    buckets. Rows sum to 1 (lanes with no rows return uniform)."""
+    fire = np.asarray(fire, np.float64)
+    hist = np.asarray(hist, np.float64)
+    nofire = np.maximum(float(rows) - fire, 0.0)[:, None]
+    cells = np.concatenate([nofire, hist], axis=1)
+    tot = cells.sum(axis=1, keepdims=True)
+    n_cells = cells.shape[1]
+    uniform = np.full_like(cells, 1.0 / n_cells)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        dist = np.where(tot > 0, cells / np.maximum(tot, 1e-300), uniform)
+    return dist
+
+
+def psi(p: np.ndarray, q: np.ndarray, eps: float = 1e-4) -> np.ndarray:
+    """Population stability index per feature: ``Σ (p-q)·ln(p/q)`` over the
+    smoothed cells. Symmetric, ≥0, additive over cells."""
+    p = np.asarray(p, np.float64) + eps
+    q = np.asarray(q, np.float64) + eps
+    p = p / p.sum(axis=-1, keepdims=True)
+    q = q / q.sum(axis=-1, keepdims=True)
+    return ((p - q) * np.log(p / q)).sum(axis=-1)
+
+
+def js_divergence(p: np.ndarray, q: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    """Jensen–Shannon divergence per feature (base 2, in [0, 1])."""
+    p = np.asarray(p, np.float64) + eps
+    q = np.asarray(q, np.float64) + eps
+    p = p / p.sum(axis=-1, keepdims=True)
+    q = q / q.sum(axis=-1, keepdims=True)
+    m = 0.5 * (p + q)
+    kl = lambda a, b: (a * np.log2(a / b)).sum(axis=-1)
+    return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+
+def _paired_lanes(
+    base: FeatureSnapshot, cur: FeatureSnapshot
+) -> List[Tuple[int, int]]:
+    """Lane pairing for drift: by name when the snapshots share names
+    (rolling swaps keep dict ids stable), else positional up to min(M)."""
+    by_name = {n: i for i, n in enumerate(base.names)}
+    pairs = [(by_name[n], j) for j, n in enumerate(cur.names) if n in by_name]
+    if pairs:
+        return pairs
+    m = min(base.fire.shape[0], cur.fire.shape[0])
+    return [(i, i) for i in range(m)]
+
+
+def drift_report(
+    base: FeatureSnapshot,
+    cur: FeatureSnapshot,
+    top_n: int = 10,
+    method: str = "psi",
+    min_rows: float = 1.0,
+) -> Optional[Dict]:
+    """Per-feature drift of `cur` against the baseline `base`.
+
+    Returns ``{"score", "per_feature" [F], "top" [(feat, drift)...],
+    "method", "lanes"}`` — or None when the snapshots are incomparable
+    (different feature counts / bucket layouts) or no paired lane has
+    ``min_rows`` on both sides."""
+    if base.n_feats != cur.n_feats or base.hist.shape[-1] != cur.hist.shape[-1]:
+        return None
+    div = js_divergence if method == "js" else psi
+    per_lane = []
+    lanes = []
+    for bi, ci in _paired_lanes(base, cur):
+        if base.rows[bi] < min_rows or cur.rows[ci] < min_rows:
+            continue
+        p = lane_distribution(base.rows[bi], base.fire[bi], base.hist[bi])
+        q = lane_distribution(cur.rows[ci], cur.fire[ci], cur.hist[ci])
+        per_lane.append(div(p, q))
+        lanes.append((base.names[bi] if bi < len(base.names) else str(bi),
+                      cur.names[ci] if ci < len(cur.names) else str(ci)))
+    if not per_lane:
+        return None
+    per_feature = np.mean(np.stack(per_lane, axis=0), axis=0)
+    order = np.argsort(per_feature)[::-1][: max(0, int(top_n))]
+    return {
+        "method": method,
+        "score": float(per_feature.mean()),
+        "per_feature": per_feature,
+        "top": [(int(i), float(per_feature[i])) for i in order],
+        "lanes": lanes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Flush plumbing (telemetry emission shared by train and serve)
+# ---------------------------------------------------------------------------
+
+
+def _emit_flush(telemetry, snap: FeatureSnapshot, agg: Dict[str, float],
+                drift: Optional[Dict], extra: Optional[Dict] = None) -> Dict:
+    """Gauges + the ``feature_stats`` pointer event for one flushed snapshot.
+
+    The metric names are literal per scope so sclint SC006 sees every name
+    this layer can emit (single-source with the fixtures)."""
+    summary = {
+        "scope": snap.scope,
+        "gen": snap.gen,
+        "path": snap.meta.get("path", ""),
+        "names": list(snap.names),
+        "n_feats": snap.n_feats,
+        **{k: round(v, 6) if v == v else v for k, v in agg.items()},
+    }
+    if drift is not None:
+        summary["drift_score"] = round(drift["score"], 6)
+        summary["drift_method"] = drift["method"]
+        summary["drift_top"] = [[f, round(d, 6)] for f, d in drift["top"]]
+    if extra:
+        summary.update(extra)
+    if telemetry is None:
+        return summary
+    if snap.scope == "train":
+        telemetry.counter_inc("train.feature.flushes")
+        if agg["dead_frac"] == agg["dead_frac"]:
+            telemetry.gauge_set("train.feature.dead_frac", round(agg["dead_frac"], 6))
+            telemetry.gauge_set("train.feature.gini", round(agg["gini"], 6))
+            telemetry.gauge_set("train.feature.hot_frac", round(agg["hot_frac"], 6))
+    else:
+        telemetry.counter_inc("serve.feature.flushes")
+        if agg["dead_frac"] == agg["dead_frac"]:
+            telemetry.gauge_set("serve.feature.dead_frac", round(agg["dead_frac"], 6))
+            telemetry.gauge_set("serve.feature.gini", round(agg["gini"], 6))
+            telemetry.gauge_set("serve.feature.hot_frac", round(agg["hot_frac"], 6))
+        if drift is not None:
+            telemetry.gauge_set("serve.feature.drift_score", round(drift["score"], 6))
+    telemetry.event("feature_stats", **summary)
+    return summary
+
+
+def flush_ensemble_feature_stats(
+    ens,
+    telemetry,
+    out_dir,
+    model_names: Optional[Sequence[str]] = None,
+    baseline: Optional[FeatureSnapshot] = None,
+    extra: Optional[Dict] = None,
+) -> Optional[Dict]:
+    """Train-side flush: snapshot the ensemble's sketch buffers and reset
+    them (rolling window). One batched device_get under `allowed_transfer`
+    inside a ``feature_flush`` span. No-op (None) when the ensemble was
+    built without ``feature_stats`` or the window saw no rows."""
+    cfg = getattr(ens, "feature_stats", None)
+    buffers = ens.state.buffers
+    if cfg is None or FEATURE_STATS_KEYS[0] not in buffers:
+        return None
+    fspan = Span(telemetry, "feature_flush", name="train").begin()
+    try:
+        with allowed_transfer():
+            host = jax.device_get({k: buffers[k] for k in FEATURE_STATS_KEYS})
+        if float(np.sum(host["featstat_rows"])) <= 0:
+            return None
+        names = list(model_names or [f"m{i}" for i in range(ens.n_models)])
+        snap = write_snapshot(out_dir, "train", host, names, cfg, meta=extra)
+        agg = snapshot_aggregates(snap)
+        drift = drift_report(baseline, snap) if baseline is not None else None
+        summary = _emit_flush(telemetry, snap, agg, drift, extra=extra)
+        summary["snapshot"] = snap
+        # reset the window: fresh zeros in the ensemble buffers
+        n_feats = host["featstat_fire"].shape[1]
+        new_buffers = {
+            **buffers,
+            **init_feature_stats(ens.n_models, n_feats, cfg),
+        }
+        ens.state = dataclasses.replace(ens.state, buffers=new_buffers)
+        return summary
+    finally:
+        fspan.end()
+
+
+class ServeFeatureStats:
+    """Serve-side accumulator: one device sketch per (lane-set, n_feats).
+
+    The engine calls ``accumulate_dense`` / ``accumulate_topk`` from its
+    drainer right after dispatch — pure jnp updates on device arrays, so
+    the drainer hot loop gains zero host syncs. ``flush()`` is the only
+    host-sync point (one batched device_get under `allowed_transfer`)."""
+
+    def __init__(self, cfg=None, scope: str = "serve"):
+        self.cfg = _normalize(cfg) or FeatureStatsConfig()
+        self.scope = scope
+        self.baseline: Optional[FeatureSnapshot] = None
+        self._acc: Dict[Tuple[Tuple[str, ...], int], Dict[str, jax.Array]] = {}
+        self._last_flush = time.monotonic()
+
+    def set_baseline(self, snap: Optional[FeatureSnapshot]) -> None:
+        self.baseline = snap
+
+    def _stats_for(self, ids: Tuple[str, ...], n_feats: int):
+        key = (ids, n_feats)
+        if key not in self._acc:
+            self._acc[key] = init_feature_stats(len(ids), n_feats, self.cfg)
+        return key, self._acc[key]
+
+    def accumulate_dense(self, ids, n_feats, codes, mask) -> None:
+        """``codes`` [G, rows, F] device array, ``mask`` [G, rows] host array."""
+        key, stats = self._stats_for(tuple(ids), int(n_feats))
+        self._acc[key] = _accumulate_dense(
+            stats, codes, jnp.asarray(mask, jnp.float32), self.cfg
+        )
+
+    def accumulate_topk(self, ids, n_feats, idx, vals, mask) -> None:
+        """``idx``/``vals`` [G, rows, k] device arrays, ``mask`` [G, rows]."""
+        key, stats = self._stats_for(tuple(ids), int(n_feats))
+        self._acc[key] = _accumulate_topk(
+            stats, idx, vals, jnp.asarray(mask, jnp.float32), self.cfg
+        )
+
+    @property
+    def seconds_since_flush(self) -> float:
+        return time.monotonic() - self._last_flush
+
+    def flush(self, telemetry, out_dir, extra: Optional[Dict] = None) -> List[Dict]:
+        """Snapshot + reset every accumulated lane-set. Returns the per-
+        snapshot summaries (empty when nothing accumulated any rows)."""
+        self._last_flush = time.monotonic()
+        if not self._acc:
+            return []
+        fspan = Span(telemetry, "feature_flush", name=self.scope).begin()
+        try:
+            with allowed_transfer():
+                host_all = jax.device_get(self._acc)
+            self._acc = {}
+            summaries = []
+            for (ids, n_feats), host in sorted(host_all.items()):
+                if float(np.sum(host["featstat_rows"])) <= 0:
+                    continue
+                snap = write_snapshot(
+                    out_dir, self.scope, host, list(ids), self.cfg, meta=extra
+                )
+                agg = snapshot_aggregates(snap)
+                drift = (
+                    drift_report(self.baseline, snap)
+                    if self.baseline is not None
+                    else None
+                )
+                summary = _emit_flush(telemetry, snap, agg, drift, extra=extra)
+                summary["snapshot"] = snap
+                summaries.append(summary)
+            return summaries
+        finally:
+            fspan.end()
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m sparse_coding__tpu.features <run_dir>
+# ---------------------------------------------------------------------------
+
+
+def load_run_snapshots(run_dir) -> List[FeatureSnapshot]:
+    """Every ``feature_stats.*.npz`` in `run_dir`, gen-sorted within scope
+    (``serve0000 < serve0001``; scopes sort alphabetically: serve < train)."""
+    run_dir = Path(run_dir)
+    snaps = [
+        FeatureSnapshot.load(p)
+        for p in sorted(run_dir.glob(f"{SNAPSHOT_PREFIX}*.npz"))
+    ]
+    return snaps
+
+
+def _latest(snaps: List[FeatureSnapshot], scope: str) -> Optional[FeatureSnapshot]:
+    scoped = [s for s in snaps if s.scope == scope]
+    return scoped[-1] if scoped else None
+
+
+def drift_band(score: float) -> str:
+    """The industry PSI reading: <0.1 stable, 0.1–0.25 drifting, else major."""
+    if score != score:
+        return "unknown"
+    if score < 0.1:
+        return "stable"
+    if score < 0.25:
+        return "drifting"
+    return "major"
+
+
+def summarize_run(
+    run_dir,
+    baseline: Optional[str] = None,
+    diff: Optional[Sequence[str]] = None,
+    top_n: int = 10,
+    method: str = "psi",
+) -> Optional[Dict]:
+    """The CLI's analysis payload (also the ``--json`` document).
+
+    Baseline resolution for the drift section, most to least explicit:
+    ``--diff GEN_A GEN_B`` (both addressed by gen token), ``--baseline``
+    (an npz path), latest-train → latest-serve (the train↔serve question),
+    first → last within the only scope present (did training itself move).
+    Returns None when the run dir holds no snapshots."""
+    snaps = load_run_snapshots(run_dir)
+    if not snaps:
+        return None
+    by_gen = {s.gen: s for s in snaps}
+    latest = _latest(snaps, "serve") or _latest(snaps, "train")
+
+    rate = np.zeros((latest.n_feats,), np.float64)
+    lanes = 0
+    for m in range(latest.fire.shape[0]):
+        if latest.rows[m] > 0:
+            rate += latest.fire[m] / float(latest.rows[m])
+            lanes += 1
+    rate = rate / max(lanes, 1)
+    order = np.argsort(rate)[::-1]
+    dead = np.flatnonzero(latest.fire.sum(axis=0) == 0)
+
+    base = cur = None
+    if diff:
+        gen_a, gen_b = diff
+        if gen_a not in by_gen or gen_b not in by_gen:
+            known = ", ".join(sorted(by_gen))
+            raise SystemExit(f"unknown gen in --diff (have: {known})")
+        base, cur = by_gen[gen_a], by_gen[gen_b]
+    elif baseline is not None:
+        base, cur = FeatureSnapshot.load(baseline), latest
+    elif _latest(snaps, "train") is not None and _latest(snaps, "serve") is not None:
+        base, cur = _latest(snaps, "train"), _latest(snaps, "serve")
+    else:
+        scoped = [s for s in snaps if s.scope == latest.scope]
+        if len(scoped) >= 2:
+            base, cur = scoped[0], scoped[-1]
+
+    drift = (
+        drift_report(base, cur, top_n=top_n, method=method)
+        if base is not None
+        else None
+    )
+    info = {
+        "run_dir": str(run_dir),
+        "snapshots": [
+            {"gen": s.gen, "scope": s.scope, "n_feats": s.n_feats,
+             "names": list(s.names), **snapshot_aggregates(s)}
+            for s in snaps
+        ],
+        "latest": {"gen": latest.gen, "scope": latest.scope,
+                   **snapshot_aggregates(latest)},
+        "top_firing": [
+            [int(i), round(float(rate[i]), 6)]
+            for i in order[: max(0, int(top_n))]
+            if rate[i] > 0
+        ],
+        "dead": {
+            "count": int(dead.size),
+            "frac": round(float(dead.size) / latest.n_feats, 6),
+            "features": [int(i) for i in dead[: max(0, int(top_n))]],
+        },
+        "drift": None,
+    }
+    if drift is not None:
+        info["drift"] = {
+            "baseline": base.gen,
+            "current": cur.gen,
+            "method": drift["method"],
+            "score": round(drift["score"], 6),
+            "band": drift_band(drift["score"]),
+            "top": [[f, round(d, 6)] for f, d in drift["top"]],
+        }
+    return info
+
+
+def render_features(info: Dict) -> str:
+    """Human rendering of `summarize_run`'s payload (golden-pinned — keep
+    byte-stable across refactors)."""
+    counts: Dict[str, int] = {}
+    for s in info["snapshots"]:
+        counts[s["scope"]] = counts.get(s["scope"], 0) + 1
+    lines = [f"feature surface: {info['run_dir']}"]
+    lines.append(
+        "  snapshots: "
+        + ", ".join(f"{n} {scope}" for scope, n in sorted(counts.items()))
+    )
+    la = info["latest"]
+    lines.append(
+        f"  latest {la['gen']}: rows {la['rows']:.0f}  "
+        f"dead {la['dead_frac']:.1%}  gini {la['gini']:.3f}  "
+        f"hot1% {la['hot_frac']:.1%}"
+    )
+    if info["top_firing"]:
+        lines.append(
+            "  top-firing: "
+            + ", ".join(f"{f} ({r:.1%})" for f, r in info["top_firing"][:5])
+        )
+    d = info["dead"]
+    feats = ", ".join(str(f) for f in d["features"])
+    lines.append(
+        f"  dead features: {d['count']} ({d['frac']:.1%})"
+        + (f": {feats}" if feats else "")
+    )
+    dr = info["drift"]
+    if dr is None:
+        lines.append("  drift: no comparable snapshot pair")
+    else:
+        lines.append(
+            f"  drift {dr['baseline']} -> {dr['current']} ({dr['method']}): "
+            f"score {dr['score']:.3f}  [{dr['band'].upper()}]"
+        )
+        if dr["top"]:
+            lines.append(
+                "    top drifting: "
+                + ", ".join(f"{f} ({v:.2f})" for f, v in dr["top"][:5])
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    """``python -m sparse_coding__tpu.features <run_dir>``.
+
+    Exit codes mirror the slo CLI: 0 healthy / drift below threshold,
+    1 drift score at or past ``--threshold``, 3 no feature snapshots in the
+    run dir (distinct so CI can tell "no data" from "drifted")."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m sparse_coding__tpu.features",
+        description="Dictionary feature surface: firing stats + drift "
+        "(docs/observability.md §10)",
+    )
+    ap.add_argument("run_dir", help="run directory holding feature_stats.*.npz")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--top", type=int, default=10, help="list length (default 10)")
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("GEN_A", "GEN_B"),
+        help="drift between two snapshot gens (e.g. train0000 serve0002)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline npz path (overrides latest-train as drift baseline)",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=None,
+        help="exit 1 when the drift score reaches this (PSI scale)",
+    )
+    ap.add_argument("--method", choices=("psi", "js"), default="psi")
+    args = ap.parse_args(argv)
+
+    info = summarize_run(
+        args.run_dir, baseline=args.baseline, diff=args.diff,
+        top_n=args.top, method=args.method,
+    )
+    if info is None:
+        print(f"no feature snapshots under {args.run_dir}", flush=True)
+        return 3
+    if args.json:
+        print(json.dumps(info, indent=1, sort_keys=True))
+    else:
+        print(render_features(info), end="")
+    if (
+        args.threshold is not None
+        and info["drift"] is not None
+        and info["drift"]["score"] >= args.threshold
+    ):
+        return 1
+    return 0

@@ -172,6 +172,9 @@ class RunMonitor:
         # ``replica`` tag ("" = a single un-tagged serve process) so a
         # replica tier renders ONE line per replica
         self.serve_by: Dict[str, Dict[str, Any]] = {}
+        # feature surface (docs/observability.md §10): last feature_stats
+        # flush summary per scope/replica + flush counts — the features: line
+        self.feature_by: Dict[str, Dict[str, Any]] = {}
         # router state (serve/router.py): counters + the live replica-state
         # map from the transition event timeline (per-replica latency
         # gauges are the REPORT's job — the live line stays one-glance)
@@ -284,6 +287,14 @@ class RunMonitor:
             self.chunk_skips.append(rec)
         elif kind == "loss_budget_exhausted":
             self.budget_exhausted = True
+        elif kind == "feature_stats":
+            scope = str(rec.get("scope", "?"))
+            key = scope
+            if scope == "serve" and rec.get("replica"):
+                key = f"serve[{rec['replica']}]"
+            st = self.feature_by.setdefault(key, {"flushes": 0, "last": {}})
+            st["flushes"] += 1
+            st["last"] = rec
         elif kind == "serve_drain":
             self._serve_state(rec)["draining"] = True
         elif kind == "serve_drained":
@@ -512,6 +523,29 @@ def render(mon: RunMonitor, now: Optional[float] = None) -> str:
         elif st["drained"]:
             line += " | drained clean"
         lines.append(line)
+    # feature surface line (docs/observability.md §10): the last flushed
+    # window's dictionary health per scope/replica — dead fraction, firing
+    # Gini, and the train↔serve drift score with its PSI band
+    if mon.feature_by:
+        from sparse_coding__tpu.telemetry.feature_stats import drift_band
+
+        bits = []
+        for key in sorted(mon.feature_by):
+            st = mon.feature_by[key]
+            last = st["last"]
+            piece = key
+            dead = last.get("dead_frac")
+            if isinstance(dead, (int, float)) and dead == dead:
+                piece += f" dead {100 * dead:.1f}%"
+            gini = last.get("gini")
+            if isinstance(gini, (int, float)) and gini == gini:
+                piece += f" gini {gini:.3f}"
+            score = last.get("drift_score")
+            if isinstance(score, (int, float)):
+                piece += f" drift {score:.2f} [{drift_band(score).upper()}]"
+            piece += f" ({st['flushes']} flush(es), {last.get('gen', '?')})"
+            bits.append(piece)
+        lines.append("  features: " + " | ".join(bits))
     # router line (serve/router.py): routed totals + the live replica-state
     # map — the replica tier's one-glance health view
     if mon.router_counters or mon.router_states:

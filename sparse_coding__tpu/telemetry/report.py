@@ -716,6 +716,68 @@ def _serving_section(run, lines: List[str]):
     lines.append("")
 
 
+def _feature_section(run, lines: List[str]):
+    """Dictionary health (docs/observability.md §10): one row per
+    feature-stats flush generation — window rows, dead fraction, firing
+    Gini, hot-1% concentration — plus the latest train↔serve drift verdict
+    with its top-drifting features. Omitted entirely for runs without
+    feature telemetry — report output is a stability contract."""
+    flushes = _events_of(run, "feature_stats")
+    if not flushes:
+        return
+    from sparse_coding__tpu.telemetry.feature_stats import drift_band
+
+    lines.append("## Dictionary health")
+    lines.append("")
+    n_train = sum(1 for f in flushes if f.get("scope") == "train")
+    n_serve = sum(1 for f in flushes if f.get("scope") == "serve")
+    bits = []
+    if n_train:
+        bits.append(f"{n_train} train flush(es)")
+    if n_serve:
+        bits.append(f"{n_serve} serve flush(es)")
+    lines.append("- " + ", ".join(bits))
+    lines.append("")
+
+    def _pct(v) -> str:
+        if not isinstance(v, (int, float)) or v != v:
+            return "-"
+        return f"{100 * v:.1f}%"
+
+    lines.append("| gen | scope | lanes | rows | dead | gini | hot 1% | drift |")
+    lines.append("|---|---|---|---:|---:|---:|---:|---:|")
+    for f in flushes:
+        names = [str(n) for n in (f.get("names") or [])]
+        lane_txt = ",".join(names[:4]) + ("…" if len(names) > 4 else "")
+        drift = f.get("drift_score")
+        lines.append(
+            f"| {f.get('gen', '?')} | {f.get('scope', '?')} "
+            f"| {lane_txt or '-'} | {_fmt(f.get('rows'))} "
+            f"| {_pct(f.get('dead_frac'))} | {_fmt(f.get('gini'))} "
+            f"| {_pct(f.get('hot_frac'))} "
+            f"| {_fmt(drift) if isinstance(drift, (int, float)) else '-'} |"
+        )
+    drifted = [
+        f for f in flushes if isinstance(f.get("drift_score"), (int, float))
+    ]
+    if drifted:
+        last = drifted[-1]
+        score = float(last["drift_score"])
+        lines.append("")
+        lines.append(
+            f"- drift vs training baseline "
+            f"({last.get('drift_method', 'psi')}): **{score:.3f}** "
+            f"[{drift_band(score).upper()}]"
+        )
+        top = last.get("drift_top") or []
+        if top:
+            lines.append(
+                "- top drifting features: "
+                + ", ".join(f"{int(ft)} ({d:.2f})" for ft, d in top[:8])
+            )
+    lines.append("")
+
+
 def _router_section(run, lines: List[str]):
     """Replica-tier front-end stats (ISSUE 13, docs/SERVING.md): routed
     totals (retries / hedges / sheds / failures), a per-replica table
@@ -1061,6 +1123,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _recovery_section(run, lines)
     _goodput_section(run, lines)
     _serving_section(run, lines)
+    _feature_section(run, lines)
     _router_section(run, lines)
     _slo_section(run, lines)
     _data_section(run, lines)

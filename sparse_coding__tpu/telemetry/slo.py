@@ -23,6 +23,7 @@ literature's fast/slow-burn alerting shape), over three sources:
        {"name": "p99", "type": "latency", "percentile": 0.99,
         "threshold_ms": 50.0, "histogram": "serve.latency_ms"},
        {"name": "queue", "type": "queue_depth", "max_depth": 16},
+       {"name": "drift", "type": "feature-drift", "max_score": 0.25},
        {"name": "goodput", "type": "goodput_floor", "floor_frac": 0.3}]}
 
 Semantics:
@@ -37,6 +38,10 @@ Semantics:
     (conservative upper bound — correct to within one bucket width),
     gauge fallback (``serve.latency_p99_ms``) for histogram-less runs.
   - **queue_depth**: last-snapshot gauge vs ``max_depth``.
+  - **feature-drift**: the train↔serve feature-distribution drift score
+    (``serve.feature.drift_score``, PSI scale — `telemetry.feature_stats`)
+    vs ``max_score``; skipped (not violated) when the tier never computed
+    a drift score (no baseline loaded).
   - **goodput_floor**: the goodput ledger's goodput fraction vs
     ``floor_frac`` (run-dir source only).
 
@@ -275,6 +280,26 @@ def _queue_depth(obj, gauges) -> Dict[str, Any]:
     }
 
 
+def _feature_drift(obj, gauges) -> Dict[str, Any]:
+    """Train↔serve drift objective: the serving tier's last flushed drift
+    score (PSI scale, `telemetry.feature_stats`) must stay under
+    ``max_score``. A tier that never computed a score (feature stats off,
+    or no baseline loaded) SKIPs — absence of the sensor is not a pass."""
+    gauge_key = obj.get("gauge", "serve.feature.drift_score")
+    max_score = float(obj["max_score"])
+    measured = gauges.get(gauge_key)
+    if measured is None:
+        return {"ok": None, "measured": None, "max_score": max_score,
+                "detail": f"gauge {gauge_key} not recorded (feature stats "
+                          "off or no baseline)"}
+    return {
+        "ok": measured <= max_score,
+        "measured": round(float(measured), 6),
+        "max_score": max_score,
+        "detail": f"gauge {gauge_key} (PSI scale)",
+    }
+
+
 def _goodput_floor(obj, run_dir) -> Dict[str, Any]:
     floor = float(obj["floor_frac"])
     if run_dir is None:
@@ -346,6 +371,8 @@ def evaluate_run_dir(run_dir, config: Dict[str, Any],
             r = _latency(obj, gauges, hists)
         elif typ == "queue_depth":
             r = _queue_depth(obj, gauges)
+        elif typ == "feature-drift":
+            r = _feature_drift(obj, gauges)
         elif typ == "goodput_floor":
             r = _goodput_floor(obj, run_dir)
         else:
@@ -427,6 +454,13 @@ def evaluate_scrape(urls: List[str], config: Dict[str, Any],
                 {**obj, "gauge": clean(obj.get("gauge", "serve.queue_depth"))},
                 gauges,
             )
+        elif typ == "feature-drift":
+            r = _feature_drift(
+                {**obj, "gauge": clean(
+                    obj.get("gauge", "serve.feature.drift_score")
+                )},
+                gauges,
+            )
         elif typ == "goodput_floor":
             r = _goodput_floor(obj, None)
         else:
@@ -501,8 +535,8 @@ def render_slo(result: Dict[str, Any]) -> str:
         "|---|---|---:|---:|---:|---:|---|",
     ]
     for o in result["objectives"]:
-        target = o.get("target", o.get("threshold_ms",
-                                       o.get("max_depth", o.get("floor_frac"))))
+        target = o.get("target", o.get("threshold_ms", o.get(
+            "max_depth", o.get("floor_frac", o.get("max_score")))))
         burn = o.get("burn_rates") or {}
         burn_s = (
             f"{burn.get('fast', '-')} / {burn.get('slow', '-')}"

@@ -82,6 +82,8 @@ BADPUT_CATEGORIES = (
     "dequant",        # serve: int8-resident weight dequantization per batch
     "forward",        # router: one forward attempt (retries/hedges each get
                       # their own span, trace-tagged — telemetry.tracing)
+    "feature_flush",  # feature-stats sketch flush: the one sanctioned
+                      # device_get + npz write per window (telemetry.feature_stats)
 )
 # derived-only badput: reconstructed by telemetry.goodput from event
 # adjacency, never emitted as live spans

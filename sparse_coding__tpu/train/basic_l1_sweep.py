@@ -32,6 +32,7 @@ from sparse_coding__tpu.telemetry import (
     record_hbm_watermarks,
     span,
 )
+from sparse_coding__tpu.telemetry.feature_stats import flush_ensemble_feature_stats
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
 from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
@@ -61,6 +62,7 @@ def basic_l1_sweep(
     save_after_every: bool = False,
     hbm_cache: bool = False,
     health: bool = True,
+    feature_stats: bool = True,
     anomaly_policy: Optional[AnomalyPolicy] = None,
     resume: Optional[bool] = None,
     checkpoint_every: Optional[int] = None,
@@ -79,9 +81,14 @@ def basic_l1_sweep(
     Observability (docs/observability.md): the driver writes ``events.jsonl``
     (run fingerprint, compile + chunk events, run_end) next to its metrics
     JSONL; ``health=True`` (default) fuses the per-model health pack into
-    the train step; ``anomaly_policy`` governs the flush-boundary
+    the train step; ``feature_stats=True`` (default) additionally fuses the
+    per-feature firing sketch (docs/observability.md §10) and flushes it at
+    every chunk boundary into ``feature_stats.trainNNNN.npz`` snapshots —
+    the training baseline the serve tier's drift detector compares against;
+    ``anomaly_policy`` governs the flush-boundary
     `AnomalyGuard` (default: warn + diagnostic bundle). Render the artifacts
-    with ``python -m sparse_coding__tpu.report <output_folder>``.
+    with ``python -m sparse_coding__tpu.report <output_folder>`` and the
+    feature surface with ``python -m sparse_coding__tpu.features``.
 
     Preemption safety (docs/RECOVERY.md): a SIGTERM/SIGINT sets a flag the
     driver checks at every chunk boundary; it then commits a
@@ -122,6 +129,7 @@ def basic_l1_sweep(
         activation_size=activation_width,
         n_dict_components=dict_size,
         health=health,
+        feature_stats=feature_stats,
     )
     model_names = [f"l1_{float(a):.2e}" for a in l1_values]
     run_config = dict(
@@ -281,6 +289,13 @@ def basic_l1_sweep(
                 # (host-side query, zero device syncs) + trace-window arming
                 # on the cumulative step count
                 record_hbm_watermarks(telemetry)
+                # per-feature firing sketch flush (docs/observability.md
+                # §10): the chunk boundary is the existing host-sync point,
+                # so the window's one device_get rides it
+                if feature_stats:
+                    flush_ensemble_feature_stats(
+                        ens, telemetry, output_folder, model_names=model_names,
+                    )
                 cum_steps = int(telemetry.counters.get("train.steps", 0))
                 trigger.on_step(cum_steps)
                 # pod heartbeat + straggler-skew gauges (no-op single-host;
@@ -344,6 +359,13 @@ def basic_l1_sweep(
                 status = f"error: {type(e).__name__}: {e}"
         trigger.close()  # stop any in-flight trace window before run_end
         ckpt.close()  # no longer polling: later signals terminate normally
+        if feature_stats:
+            try:  # tail window: rows accumulated since the last chunk boundary
+                flush_ensemble_feature_stats(
+                    ens, telemetry, output_folder, model_names=model_names,
+                )
+            except Exception:
+                pass  # a failed tail flush must not mask the unwinding error
         telemetry.run_end(
             status=status,
             timer_stats=timer.report(

@@ -4,3 +4,9 @@
 def run(telemetry, span, batch):
     with span(telemetry, "warmup"):  # VIOLATION
         return batch * 2
+
+
+def flush(telemetry, span, sketch):
+    # near-miss of the registered ``feature_flush`` badput category
+    with span(telemetry, "feature_snapshot"):  # VIOLATION
+        return sketch.sum()

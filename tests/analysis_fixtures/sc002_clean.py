@@ -7,3 +7,10 @@ def run(telemetry, span, batch):
         with span(telemetry, "checkpoint"):
             pass
         return batch * 2
+
+
+def flush(telemetry, span, sketch):
+    # ``feature_flush`` is registered badput (dictionary-health flushes);
+    # it is not nestable, so it sits at top level
+    with span(telemetry, "feature_flush"):
+        return sketch.sum()

@@ -5,3 +5,9 @@ Prometheus sanitization (both expose as ``sc_serve_queue_depth``)."""
 def publish(gauge_set, depth):
     gauge_set("serve.queue.depth", depth)  # VIOLATION
     gauge_set("serve_queue_depth", depth)  # VIOLATION
+
+
+def publish_features(gauge_set, dead):
+    # both expose as ``sc_serve_feature_dead_frac``
+    gauge_set("serve.feature.dead_frac", dead)  # VIOLATION
+    gauge_set("serve.feature_dead.frac", dead)  # VIOLATION

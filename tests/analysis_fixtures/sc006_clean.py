@@ -7,3 +7,13 @@ def publish(gauge_set, counter_inc, depth):
     gauge_set("serve.queue.depth", depth)
     counter_inc("serve.queue.depth")
     gauge_set("serve.batch.rows", depth)
+
+
+def publish_features(gauge_set, counter_inc, dead, gini, drift):
+    # the dictionary-health gauge family: train/serve prefixes keep the
+    # sanitized names distinct
+    gauge_set("train.feature.dead_frac", dead)
+    gauge_set("serve.feature.dead_frac", dead)
+    gauge_set("serve.feature.gini", gini)
+    gauge_set("serve.feature.drift_score", drift)
+    counter_inc("serve.feature.flushes")

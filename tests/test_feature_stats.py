@@ -1,0 +1,484 @@
+"""Feature-level observability (ISSUE 17, docs/observability.md §10).
+
+Covers the in-step firing sketch (unit math + mask semantics), snapshot
+persistence, the PSI/JS drift detector, train-side flush plumbing,
+serve-side bit-exactness per registry class (stats on == stats off),
+transfer-audit cleanliness of the accumulate/flush paths, the
+``feature_drift`` anomaly tiers, the slo ``feature-drift`` objective, the
+shifted-distribution chaos acceptance, and the golden pins for the
+``features`` CLI / report "Dictionary health" section / monitor line.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import RandomDatasetGenerator
+from sparse_coding__tpu.ensemble import build_ensemble
+from sparse_coding__tpu.models import FunctionalTiedSAE
+from sparse_coding__tpu.models.learned_dict import RandomDict, TiedSAE, UntiedSAE
+from sparse_coding__tpu.serve.engine import EncodeEngine
+from sparse_coding__tpu.serve.registry import DictRegistry
+from sparse_coding__tpu.telemetry import (
+    AnomalyAbort,
+    AnomalyGuard,
+    AnomalyPolicy,
+    RunTelemetry,
+    read_events,
+    transfer_audit,
+)
+from sparse_coding__tpu.telemetry.feature_stats import (
+    FeatureSnapshot,
+    FeatureStatsConfig,
+    ServeFeatureStats,
+    drift_band,
+    drift_report,
+    flush_ensemble_feature_stats,
+    init_feature_stats,
+    js_divergence,
+    lane_distribution,
+    load_run_snapshots,
+    next_snapshot_path,
+    psi,
+    update_feature_stats,
+    write_snapshot,
+)
+from sparse_coding__tpu.telemetry.feature_stats import main as features_main
+
+REPO = Path(__file__).parent.parent
+GOLDEN = Path(__file__).parent / "golden" / "feature_run"
+
+D_ACT, N_DICT = 32, 64
+CFG = FeatureStatsConfig()
+
+
+def _single(n_feats: int):
+    """One lane's zeroed sketch (unstacked — what the vmapped body sees)."""
+    return jax.tree.map(lambda a: a[0], init_feature_stats(1, n_feats, CFG))
+
+
+def _host(stats):
+    return {k: np.asarray(v, np.float64) for k, v in stats.items()}
+
+
+def _synth_host(rng, n_models: int, n_feats: int, rows: int, scale: float = 1.0):
+    """Synthetic host sketch by pushing random codes through the real update."""
+    stats = init_feature_stats(n_models, n_feats, CFG)
+    codes = rng.standard_normal((n_models, rows, n_feats)).astype(np.float32)
+    codes = np.where(rng.random(codes.shape) < 0.5, 0.0, np.abs(codes) * scale)
+    upd = jax.vmap(lambda s, c: update_feature_stats(s, c, CFG))
+    return _host(upd(stats, jnp.asarray(codes)))
+
+
+# -- sketch math ---------------------------------------------------------------
+
+def test_update_feature_stats_counts():
+    F = 6
+    c = np.zeros((4, F), np.float32)
+    c[0, 0], c[1, 0], c[2, 3], c[3, 5] = 0.5, -0.25, 1.0, 64.0
+    out = _host(update_feature_stats(_single(F), jnp.asarray(c), CFG))
+    assert out["featstat_rows"] == 4.0
+    np.testing.assert_array_equal(out["featstat_fire"], [2, 0, 0, 1, 0, 1])
+    # hist mass per feature equals its firing count; bucket index is the
+    # fixed log grid (hist_lo=2^-10, ratio 4): |0.5| -> bucket 4, 64 -> last
+    np.testing.assert_array_equal(out["featstat_hist"].sum(-1), out["featstat_fire"])
+    assert out["featstat_hist"][0, 4] == 2.0  # 0.5 and 0.25 share a bucket
+    assert out["featstat_hist"][5, CFG.n_buckets - 1] == 1.0  # overflow clamp
+    np.testing.assert_allclose(out["featstat_sum"][0], 0.25)  # signed sum
+    np.testing.assert_allclose(out["featstat_sumsq"][0], 0.3125)
+    np.testing.assert_array_equal(out["featstat_max"], [0.5, 0, 0, 1.0, 0, 64.0])
+
+
+def test_update_feature_stats_mask_excludes_padding():
+    F = 3
+    c = np.ones((4, F), np.float32)
+    mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    out = _host(update_feature_stats(_single(F), jnp.asarray(c), CFG, mask=mask))
+    assert out["featstat_rows"] == 2.0  # padding rows don't count
+    np.testing.assert_array_equal(out["featstat_fire"], [2, 2, 2])
+    np.testing.assert_array_equal(out["featstat_hist"].sum(-1), [2, 2, 2])
+
+
+def test_lane_distribution_rows_sum_to_one():
+    fire = np.asarray([3.0, 0.0])
+    hist = np.asarray([[1.0, 2.0, 0.0], [0.0, 0.0, 0.0]])
+    dist = lane_distribution(10.0, fire, hist)
+    assert dist.shape == (2, 4)  # B+1 cells: no-fire + B buckets
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0)
+    assert dist[0, 0] == pytest.approx(0.7)  # 7 of 10 rows did not fire
+    assert dist[1, 0] == pytest.approx(1.0)  # dead feature: all no-fire
+    # a lane that saw no rows degrades to uniform, not NaN
+    empty = lane_distribution(0.0, np.zeros(2), np.zeros((2, 3)))
+    np.testing.assert_allclose(empty, 0.25)
+
+
+def test_psi_js_properties():
+    rng = np.random.default_rng(0)
+    p = rng.random((5, 9)) + 0.01
+    q = rng.random((5, 9)) + 0.01
+    np.testing.assert_allclose(psi(p, p), 0.0, atol=1e-12)
+    np.testing.assert_allclose(psi(p, q), psi(q, p))
+    assert np.all(psi(p, q) >= 0)
+    js = js_divergence(p, q)
+    np.testing.assert_allclose(js_divergence(p, p), 0.0, atol=1e-9)
+    assert np.all((js >= 0) & (js <= 1.0))
+
+
+def test_drift_band_boundaries():
+    assert drift_band(0.05) == "stable"
+    assert drift_band(0.1) == "drifting"
+    assert drift_band(0.24) == "drifting"
+    assert drift_band(0.25) == "major"
+    assert drift_band(float("nan")) == "unknown"
+
+
+# -- snapshots -----------------------------------------------------------------
+
+def test_snapshot_roundtrip_and_gen_increment(tmp_path):
+    rng = np.random.default_rng(1)
+    host = _synth_host(rng, 2, 8, rows=32)
+    s0 = write_snapshot(tmp_path, "train", host, ["a", "b"], CFG, meta={"step": 7})
+    assert s0.gen == "train0000"
+    s1 = write_snapshot(tmp_path, "train", host, ["a", "b"], CFG)
+    assert s1.gen == "train0001"  # counting existing files: resume appends
+    assert next_snapshot_path(tmp_path, "train")[1] == "train0002"
+    back = FeatureSnapshot.load(tmp_path / "feature_stats.train0000.npz")
+    assert back.scope == "train" and back.names == ["a", "b"]
+    assert back.meta["step"] == 7 and back.n_feats == 8
+    np.testing.assert_array_equal(back.fire, host["featstat_fire"])
+    np.testing.assert_array_equal(back.hist, host["featstat_hist"])
+    np.testing.assert_array_equal(back.edges, CFG.edges())
+    assert [s.gen for s in load_run_snapshots(tmp_path)] == ["train0000", "train0001"]
+
+
+def test_drift_report_incomparable_and_shifted(tmp_path):
+    rng = np.random.default_rng(2)
+    base = write_snapshot(tmp_path, "train", _synth_host(rng, 1, 8, 64),
+                          ["m0"], CFG)
+    other = write_snapshot(tmp_path, "serve", _synth_host(rng, 1, 12, 64),
+                           ["m0"], CFG)
+    assert drift_report(base, other) is None  # different feature counts
+    # same layout, magnitudes shifted two log-buckets up: positive score,
+    # top list sorted by per-feature drift descending
+    cur = write_snapshot(tmp_path, "serve", _synth_host(rng, 1, 8, 64, scale=16.0),
+                         ["m0"], CFG)
+    rep = drift_report(base, cur)
+    assert rep is not None and rep["score"] > 0
+    tops = [d for _, d in rep["top"]]
+    assert tops == sorted(tops, reverse=True)
+    # identical window drifts ~0
+    same = drift_report(base, base)
+    assert same["score"] == pytest.approx(0.0, abs=1e-9)
+
+
+# -- train side ----------------------------------------------------------------
+
+def _gen(batch_size=64, seed=0):
+    return RandomDatasetGenerator(
+        activation_dim=D_ACT,
+        n_ground_truth_components=48,
+        batch_size=batch_size,
+        feature_num_nonzero=4,
+        feature_prob_decay=0.99,
+        correlated=False,
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+def _ens(feature_stats):
+    return build_ensemble(
+        FunctionalTiedSAE,
+        jax.random.PRNGKey(0),
+        [{"l1_alpha": 1e-4}, {"l1_alpha": 1e-3}],
+        optimizer_kwargs={"learning_rate": 1e-3},
+        activation_size=D_ACT,
+        n_dict_components=N_DICT,
+        fused=False,
+        feature_stats=feature_stats,
+    )
+
+
+def test_train_flush_writes_snapshot_event_and_resets(tmp_path):
+    ens = _ens(True)
+    gen = _gen()
+    for _ in range(3):
+        ens.step_batch(next(gen))
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="feat")
+    summary = flush_ensemble_feature_stats(
+        ens, tel, tmp_path, model_names=["lo", "hi"])
+    assert summary["scope"] == "train" and summary["gen"] == "train0000"
+    assert summary["names"] == ["lo", "hi"]
+    assert summary["rows"] == pytest.approx(2 * 3 * 64)  # per-lane rows sum
+    assert (tmp_path / "feature_stats.train0000.npz").exists()
+    assert tel.counters["train.feature.flushes"] == 1
+    assert "train.feature.dead_frac" in tel.gauges
+    # the window reset: buffers back to zero, so an immediate re-flush is a
+    # no-op (None) and writes no second snapshot
+    assert float(np.sum(np.asarray(ens.state.buffers["featstat_rows"]))) == 0.0
+    assert flush_ensemble_feature_stats(ens, tel, tmp_path) is None
+    tel.close()
+    evs = [e for e in read_events(tmp_path / "events.jsonl")
+           if e["event"] == "feature_stats"]
+    assert len(evs) == 1 and evs[0]["path"] == "feature_stats.train0000.npz"
+
+
+def test_train_step_bit_identical_with_stats_on():
+    """The sketch is observation only: losses and codes are bit-identical
+    with feature stats on vs off (both pinned to the unfused path the
+    sketch instruments)."""
+    ens_on, ens_off = _ens(True), _ens(False)
+    gen = _gen(seed=3)
+    for _ in range(4):
+        batch = next(gen)
+        loss_on, aux_on = ens_on.step_batch(batch)
+        loss_off, aux_off = ens_off.step_batch(batch)
+        np.testing.assert_array_equal(
+            np.asarray(loss_on["loss"]), np.asarray(loss_off["loss"]))
+        np.testing.assert_array_equal(
+            np.asarray(aux_on["c"]), np.asarray(aux_off["c"]))
+    # and the sketch did observe the traffic
+    rows = np.asarray(ens_on.state.buffers["featstat_rows"])
+    np.testing.assert_array_equal(rows, [4 * 64, 4 * 64])
+
+
+# -- serve side ----------------------------------------------------------------
+
+def _tied(seed: int, d: int = 16, n: int = 64) -> TiedSAE:
+    rng = np.random.default_rng(seed)
+    return TiedSAE(
+        jnp.asarray(rng.standard_normal((n, d), dtype=np.float32)),
+        jnp.asarray(rng.standard_normal(n, dtype=np.float32) * 0.1),
+    )
+
+
+def _untied(seed: int, d: int = 16, n: int = 64) -> UntiedSAE:
+    rng = np.random.default_rng(seed)
+    return UntiedSAE(
+        jnp.asarray(rng.standard_normal((n, d), dtype=np.float32)),
+        jnp.asarray(rng.standard_normal((n, d), dtype=np.float32)),
+        jnp.asarray(rng.standard_normal(n, dtype=np.float32) * 0.1),
+    )
+
+
+@pytest.mark.serve
+@pytest.mark.parametrize("make_ld", [
+    pytest.param(lambda: _tied(0), id="tied"),
+    pytest.param(lambda: _untied(1), id="untied"),
+    pytest.param(lambda: RandomDict(16, 64), id="random"),
+])
+def test_serve_encode_bit_identical_with_stats(make_ld):
+    rows = np.random.default_rng(9).standard_normal((5, 16)).astype(np.float32)
+    outs = {}
+    for on in (False, True):
+        reg = DictRegistry()
+        reg.add("d0", make_ld())
+        eng = EncodeEngine(reg, max_batch=32, max_wait_ms=1.0,
+                           feature_stats=on or None).start()
+        try:
+            outs[on] = np.asarray(eng.encode("d0", rows))
+        finally:
+            eng.stop()
+    np.testing.assert_array_equal(outs[True], outs[False])
+    direct = np.asarray(make_ld().encode(jnp.asarray(rows)))
+    np.testing.assert_array_equal(outs[True], direct)
+
+
+@pytest.mark.serve
+def test_serve_topk_bit_identical_and_rows_counted(tmp_path):
+    reg = DictRegistry()
+    for i in range(2):
+        reg.add(f"d{i}", _tied(i))
+    rows = np.random.default_rng(4).standard_normal((7, 16)).astype(np.float32)
+    eng_off = EncodeEngine(reg, max_batch=32, max_wait_ms=1.0).start()
+    eng_on = EncodeEngine(reg, max_batch=32, max_wait_ms=1.0,
+                          feature_stats=True).start()
+    try:
+        for did in ("d0", "d1"):
+            i_on, v_on = eng_on.encode_topk(did, rows, 4)
+            i_off, v_off = eng_off.encode_topk(did, rows, 4)
+            np.testing.assert_array_equal(np.asarray(i_on), np.asarray(i_off))
+            np.testing.assert_array_equal(np.asarray(v_on), np.asarray(v_off))
+    finally:
+        eng_on.stop()
+        eng_off.stop()
+    # the sketch saw exactly the served rows (padding masked out)
+    summaries = eng_on.feature_stats.flush(None, tmp_path)
+    assert summaries, "top-k traffic must accumulate into the sketch"
+    total = sum(s["rows"] for s in summaries)
+    assert total == pytest.approx(2 * 7)
+
+
+@pytest.mark.serve
+def test_serve_accumulate_and_flush_transfer_clean(tmp_path):
+    """The accumulate hooks add ZERO device->host transfers; flush's single
+    device_get is sanctioned (`allowed_transfer`) — enforced, not claimed."""
+    sfs = ServeFeatureStats()
+    codes = jnp.abs(jnp.asarray(
+        np.random.default_rng(5).standard_normal((2, 8, 32), np.float32)))
+    idx = jnp.zeros((2, 8, 4), jnp.int32)
+    vals = jnp.ones((2, 8, 4), jnp.float32)
+    mask = np.ones((2, 8), np.float32)
+    with transfer_audit():
+        sfs.accumulate_dense(("a", "b"), 32, codes, mask)
+        sfs.accumulate_topk(("a", "b"), 32, idx, vals, mask)
+        summaries = sfs.flush(None, tmp_path)
+    assert len(summaries) == 1  # same (lane-set, n_feats) key: one sketch
+    assert summaries[0]["rows"] == pytest.approx(2 * 2 * 8)
+
+
+# -- anomaly tiers -------------------------------------------------------------
+
+def test_feature_drift_anomaly_tiers():
+    assert AnomalyGuard().observe_feature_drift(0.1) == []
+    assert AnomalyGuard().observe_feature_drift(float("nan")) == []
+    with pytest.warns(RuntimeWarning, match="feature_drift"):
+        found = AnomalyGuard().observe_feature_drift(
+            0.5, top=[(3, 0.9)], baseline="train0001", current="serve0000")
+    assert found[0]["kind"] == "feature_drift"
+    assert found[0]["value"] == 0.5 and found[0]["top"] == [[3, 0.9]]
+    # past drift_abort the action escalates to abort regardless of policy
+    with pytest.raises(AnomalyAbort):
+        AnomalyGuard().observe_feature_drift(1.5)
+    # disabled detector stays quiet even at abort-grade scores
+    off = AnomalyGuard(policy=AnomalyPolicy(feature_drift=False))
+    assert off.observe_feature_drift(1.5) == []
+
+
+# -- slo objective -------------------------------------------------------------
+
+def test_slo_feature_drift_objective(tmp_path, capsys):
+    from sparse_coding__tpu.telemetry.slo import evaluate_run_dir, render_slo
+
+    config = {"objectives": [
+        {"name": "drift", "type": "feature-drift", "max_score": 0.25},
+    ]}
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="slo")
+    tel.gauge_set("serve.feature.drift_score", 0.4)
+    tel.snapshot()
+    tel.close()
+    res = evaluate_run_dir(tmp_path, config)
+    (obj,) = res["objectives"]
+    assert obj["ok"] is False and obj["measured"] == 0.4
+    assert res["verdict"] == "past_budget"
+    print(render_slo(res))
+    assert "0.25" in capsys.readouterr().out
+    # under budget
+    good = tmp_path / "good"
+    tel = RunTelemetry(out_dir=str(good), run_name="slo")
+    tel.gauge_set("serve.feature.drift_score", 0.05)
+    tel.snapshot()
+    tel.close()
+    assert evaluate_run_dir(good, config)["ok"] is True
+    # never computed (stats off / no baseline): SKIP, not a pass or fail
+    empty = tmp_path / "empty"
+    tel = RunTelemetry(out_dir=str(empty), run_name="slo")
+    tel.snapshot()
+    tel.close()
+    res = evaluate_run_dir(empty, config)
+    assert res["objectives"][0]["ok"] is None
+    assert res["verdict"] == "no_data"
+
+
+# -- chaos: shifted serve distribution -----------------------------------------
+
+def _serve_window(sfs, seed: int, scale: float, rows: int = 256):
+    rng = np.random.default_rng(seed)
+    codes = rng.standard_normal((1, rows, 32)).astype(np.float32)
+    codes = np.where(rng.random(codes.shape) < 0.5, 0.0, np.abs(codes) * scale)
+    sfs.accumulate_dense(("d0",), 32, jnp.asarray(codes),
+                         np.ones((1, rows), np.float32))
+
+
+@pytest.mark.chaos
+def test_shifted_distribution_trips_drift_within_one_flush(tmp_path):
+    """Acceptance: a serve window whose activation magnitudes shifted two
+    log-buckets trips `feature_drift` on its FIRST flush, the features CLI
+    exits 1 past threshold, and the unshifted control stays quiet."""
+    # training baseline
+    train = ServeFeatureStats(scope="train")
+    _serve_window(train, seed=10, scale=1.0)
+    (base,) = train.flush(None, tmp_path)
+    # shifted serve traffic against that baseline
+    serve = ServeFeatureStats()
+    serve.set_baseline(base["snapshot"])
+    _serve_window(serve, seed=11, scale=32.0)
+    tel = RunTelemetry(out_dir=str(tmp_path), run_name="chaos")
+    (summary,) = serve.flush(tel, tmp_path)
+    tel.close()
+    assert summary["drift_score"] >= 0.25, "one flush window must trip"
+    assert tel.gauges["serve.feature.drift_score"] == summary["drift_score"]
+    # a two-bucket magnitude shift scores past drift_abort: the guard
+    # escalates to abort, not just a warning
+    with pytest.raises(AnomalyAbort):
+        with pytest.warns(RuntimeWarning, match="feature_drift"):
+            AnomalyGuard().observe_feature_drift(summary["drift_score"])
+    assert features_main([str(tmp_path), "--threshold", "0.25"]) == 1
+    # unshifted control: same pipeline, same-scale traffic — quiet
+    ctl = tmp_path / "control"
+    ctl.mkdir()
+    train = ServeFeatureStats(scope="train")
+    _serve_window(train, seed=12, scale=1.0)
+    (base,) = train.flush(None, ctl)
+    serve = ServeFeatureStats()
+    serve.set_baseline(base["snapshot"])
+    _serve_window(serve, seed=13, scale=1.0)
+    (summary,) = serve.flush(None, ctl)
+    assert summary["drift_score"] < 0.1
+    assert AnomalyGuard().observe_feature_drift(summary["drift_score"]) == []
+    assert features_main([str(ctl), "--threshold", "0.25"]) == 0
+
+
+# -- golden pins ---------------------------------------------------------------
+
+def test_features_cli_golden_output_and_exit_codes(tmp_path, capsys, monkeypatch):
+    expected = (GOLDEN / "expected_cli.txt").read_text()
+    monkeypatch.chdir(REPO)
+    assert features_main(["tests/golden/feature_run"]) == 0
+    assert capsys.readouterr().out == expected
+    # exit 1 past threshold, 3 on a dir with no snapshots
+    assert features_main(["tests/golden/feature_run", "--threshold", "0.25"]) == 1
+    assert features_main([str(tmp_path)]) == 3
+
+
+def test_features_cli_json_and_diff(capsys):
+    assert features_main([str(GOLDEN), "--json"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["drift"]["band"] == "major"
+    assert info["drift"]["baseline"] == "train0001"
+    assert info["drift"]["current"] == "serve0000"
+    assert info["drift"]["score"] == pytest.approx(4.074, abs=1e-3)
+    assert info["dead"]["features"] == [30, 31]
+    # --diff addresses gens explicitly: the train-only control pair is stable
+    assert features_main([str(GOLDEN), "--diff", "train0000", "train0001",
+                          "--threshold", "0.25"]) == 0
+    info = json.loads("{}")  # keep capsys drained
+    out = capsys.readouterr().out
+    assert "[STABLE]" in out
+    with pytest.raises(SystemExit, match="unknown gen"):
+        features_main([str(GOLDEN), "--diff", "train0000", "nope"])
+
+
+def test_report_dictionary_health_golden(capsys):
+    from sparse_coding__tpu.report import main as report_main
+
+    assert report_main([str(GOLDEN)]) == 0
+    out = capsys.readouterr().out
+    assert "## Dictionary health" in out
+    assert "- 2 train flush(es), 1 serve flush(es)" in out
+    assert "| serve0000 | serve | d0,d1 | 4096 | 9.4% | 0.336 | 6.1% | 4.074 |" in out
+    assert "- drift vs training baseline (psi): **4.074** [MAJOR]" in out
+    assert "- top drifting features: 0 (8.61), 1 (8.28)" in out
+
+
+def test_monitor_features_line_golden(capsys):
+    from sparse_coding__tpu.monitor import main as monitor_main
+
+    monitor_main([str(GOLDEN), "--once"])
+    out = capsys.readouterr().out
+    assert ("features: serve[replica0] dead 9.4% gini 0.336 drift 4.07 [MAJOR] "
+            "(1 flush(es), serve0000) | train dead 9.4% gini 0.336 "
+            "(2 flush(es), train0001)") in out

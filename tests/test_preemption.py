@@ -495,3 +495,47 @@ def test_kill_and_resume_equivalence(tmp_path):
 
     md = render_markdown(load_run(tmp_path / "out_b"))
     assert "## Recovery" in md and "Checkpoints used to resume" in md
+
+    # ISSUE 17 satellite: dictionary-health state rides the checkpoint.
+    # The committed preempt checkpoint must carry the health firing-EMA and
+    # the feature-stats sketch buffers (they live in state.buffers, so
+    # DriverCheckpointer persists them with the rest of the training state)
+    state = ckpt_lib.restore_ensemble_checkpoint(latest)["ensembles"]["ensemble"]["state"]
+    bufs = state["buffers"] if isinstance(state, dict) else state.buffers
+    assert "health_fire_ema" in bufs, "firing EMA not checkpointed"
+    ema = np.asarray(bufs["health_fire_ema"])
+    assert ema.shape == (2, 32) and np.any(ema > 0), "EMA lost its state"
+    for k in ("featstat_rows", "featstat_fire", "featstat_sum",
+              "featstat_sumsq", "featstat_max", "featstat_hist"):
+        assert k in bufs, f"feature sketch buffer {k} not checkpointed"
+
+    # ... and must be RESTORED, not just saved: the EMA feeds
+    # health_dead_frac, so the resumed run's final health metrics must
+    # match the uninterrupted control (an EMA reset would spike dead_frac)
+    from sparse_coding__tpu.telemetry.report import final_metric_table
+
+    fin_a = final_metric_table(load_run(tmp_path / "out_a")["metrics"])
+    fin_b = final_metric_table(load_run(tmp_path / "out_b")["metrics"])
+    assert set(fin_a) == set(fin_b)
+    for series in fin_a:
+        for m, v in fin_a[series].items():
+            if m.startswith("health_"):
+                np.testing.assert_allclose(
+                    fin_b[series][m], v, atol=1e-6,
+                    err_msg=f"{series}.{m} diverged across kill+resume",
+                )
+
+    # ... and the per-feature firing snapshots line up generation for
+    # generation: the resumed run appends (never clobbers) and each
+    # window's sketch is bit-identical to the control's
+    from sparse_coding__tpu.telemetry.feature_stats import load_run_snapshots
+
+    snaps_a = load_run_snapshots(tmp_path / "out_a")
+    snaps_b = load_run_snapshots(tmp_path / "out_b")
+    assert [s.gen for s in snaps_a] == [s.gen for s in snaps_b]
+    assert len(snaps_a) == 3, "one flush per chunk boundary"
+    for sa, sb in zip(snaps_a, snaps_b):
+        np.testing.assert_array_equal(sa.rows, sb.rows)
+        np.testing.assert_array_equal(sa.fire, sb.fire)
+        np.testing.assert_array_equal(sa.hist, sb.hist)
+        np.testing.assert_allclose(sa.sum, sb.sum, atol=1e-5)
