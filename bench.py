@@ -916,6 +916,165 @@ def prep_sclint(stack):
     return measure
 
 
+def prep_tower(stack):
+    """Control-tower scrape throughput (ISSUE 18, docs/observability.md
+    §11): /metrics targets fully processed per second by
+    `telemetry.tower.Tower.poll_once` — four fake replica endpoints, each
+    exposing a realistic family set (~40 counters, gauges, two 15-bucket
+    latency histograms), scraped + parsed + merged + recorded into the
+    two-tier series store + burn-rate-rule-evaluated + persisted to
+    series.jsonl every poll. The tower watches the whole pool at one poll
+    per interval and sits inside the ROADMAP-2 autoscaler's control loop,
+    so per-target poll cost is the number that bounds fleet size;
+    perfdiff gates it like any runtime key. Host-side, chip-independent —
+    same class as `slo_eval_runs_per_sec`."""
+    import shutil
+    import tempfile
+
+    from sparse_coding__tpu.telemetry import RunTelemetry
+    from sparse_coding__tpu.telemetry.metrics_http import (
+        MetricsServer,
+        telemetry_metrics_text,
+    )
+    from sparse_coding__tpu.telemetry.tower import AlertRule, Tower
+
+    K = 4
+    servers = []
+    for t in range(K):
+        tel = RunTelemetry(out_dir=None, run_name=f"bench_replica{t}")
+        for i in range(10):
+            tel.counter_inc(f"serve.requests.fmt{i % 3}", 100 * (i + 1))
+            tel.counter_inc(f"serve.bytes_out.fmt{i % 3}", 4096 * (i + 1))
+            tel.counter_inc(f"serve.batches.b{i}", 10 * (i + 1))
+            tel.counter_inc("serve.requests", 80 * (i + 1))
+        tel.gauge_set("serve.queue_depth", t)
+        tel.gauge_set("serve.latency_p99_ms", 18.0 + t)
+        for v in range(50):
+            tel.hist_observe("serve.latency_ms", 2.0 * (v % 20) + 0.5)
+            tel.hist_observe("serve.phase.encode_ms", 1.0 * (v % 10) + 0.25)
+        stack.callback(tel.close)
+        srv = MetricsServer(lambda tel=tel: telemetry_metrics_text(tel)).start()
+        stack.callback(srv.stop)
+        servers.append(srv)
+    d = Path(tempfile.mkdtemp(prefix="bench_tower_"))
+    stack.callback(lambda: shutil.rmtree(d, ignore_errors=True))
+    tower = Tower(
+        d,
+        targets=[{"url": s.address, "label": f"replica{i}"}
+                 for i, s in enumerate(servers)],
+        rules=[
+            AlertRule({"name": "availability", "for_seconds": 10.0,
+                       "objective": {"type": "availability",
+                                     "target": 0.999}}),
+            AlertRule({"name": "p99", "for_seconds": 10.0,
+                       "objective": {"type": "latency", "percentile": 0.99,
+                                     "threshold_ms": 500.0}}),
+        ],
+        interval=1.0,
+        telemetry=RunTelemetry(out_dir=None, run_name="bench_tower"),
+    )
+    stack.callback(tower.close)
+    rec = tower.poll_once()  # warm (sockets, parser, store)
+    assert len(rec["targets"]) == K and all(
+        t["up"] for t in rec["targets"].values()
+    ), f"bench tower endpoints must scrape clean: {rec['targets']}"
+
+    def measure() -> float:
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            tower.poll_once()
+        return reps * K / (time.perf_counter() - t0)
+
+    return measure
+
+
+def prep_tower_overhead(stack, telemetry=None):
+    """The watched-vs-unwatched serve twin (ISSUE 18): the SAME closed-loop
+    HTTP encode load against one replica, measured with a control tower
+    polling the replica's /metrics at 20 Hz (``measure``) and with no
+    watcher at all (``measure.unwatched``). The derived
+    ``tower.overhead_frac`` — 1 − watched/unwatched — is the acceptance
+    contract at ≤ 2%: a 20 Hz poll is ~40× the tower's default rate, so
+    headroom at this cadence means the default watcher is free. Exposition
+    rendering runs on the replica's HTTP thread pool, which is exactly the
+    resource the encode load competes for — the twin would catch a /metrics
+    handler that serializes against the drainer."""
+    import sys
+
+    import numpy as np
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.registry import DictRegistry
+    from sparse_coding__tpu.serve.server import ServeServer
+
+    scripts_dir = str(Path(__file__).resolve().parent / "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import shutil
+    import tempfile
+    import threading
+
+    from loadgen import run_load
+
+    from sparse_coding__tpu.telemetry import RunTelemetry
+    from sparse_coding__tpu.telemetry.tower import Tower
+
+    D, NF = 256, 1024
+    rng = np.random.default_rng(33)
+    registry = DictRegistry()
+    for i in range(2):
+        registry.add(
+            f"t{i}",
+            TiedSAE(
+                jnp.asarray(rng.standard_normal((NF, D), dtype=np.float32)),
+                jnp.zeros((NF,)),
+            ),
+        )
+    srv = ServeServer(registry, max_batch=128, max_wait_ms=2.0,
+                      telemetry=telemetry).start()
+    stack.callback(srv.stop)
+    srv.engine.warmup()
+    client = srv.client()
+    d = Path(tempfile.mkdtemp(prefix="bench_tower_ovh_"))
+    stack.callback(lambda: shutil.rmtree(d, ignore_errors=True))
+    tower = Tower(
+        d, targets=[{"url": srv.address, "label": "replica0"}],
+        interval=0.05,
+        telemetry=RunTelemetry(out_dir=None, run_name="bench_tower_ovh"),
+    )
+    stack.callback(tower.close)
+    tower.poll_once()  # warm
+    load_kw = dict(
+        dict_ids=registry.ids(), n_clients=8, requests_per_client=8,
+        rows_per_request=2, width=D,
+    )
+    fn = lambda did, rows: client.encode(did, rows)
+    run_load(fn, seed=777, **load_kw)  # warm HTTP pools off the clock
+
+    def measure() -> float:
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                tower.poll_once()
+                stop.wait(0.05)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            return run_load(fn, seed=11, **load_kw)["rows_per_sec"]
+        finally:
+            stop.set()
+            watcher.join(10)
+
+    def measure_unwatched() -> float:
+        return run_load(fn, seed=11, **load_kw)["rows_per_sec"]
+
+    measure.unwatched = measure_unwatched
+    return measure
+
+
 def prep_bigbatch(stack):
     """acts/s of the SAME flagship ensemble at batch 16384 through the
     batch-tiled accumulating Adam kernel (`_bwd_adam_accum_kernel`): the
@@ -1069,7 +1228,11 @@ def main(argv=None):
             "headline_featstats_acts_per_sec": prep_featstats(stack),
             "slo_eval_runs_per_sec": prep_slo_eval(stack),
             "sclint_files_per_sec": prep_sclint(stack),
+            "tower_scrape_targets_per_sec": prep_tower(stack),
         }
+        watched_measure = prep_tower_overhead(stack, telemetry=telemetry)
+        benches["serve_watched_rows_per_sec"] = watched_measure
+        benches["serve_unwatched_rows_per_sec"] = watched_measure.unwatched
         serve_measure = prep_serve(stack, telemetry=telemetry)
         benches["serve_rows_per_sec"] = serve_measure
         benches["serve_naive_rows_per_sec"] = serve_measure.naive
@@ -1216,6 +1379,19 @@ def main(argv=None):
                 medians["serve_npz_rows_per_sec"]
                 / medians["serve_json_rows_per_sec"], 2
             ) if medians.get("serve_json_rows_per_sec") else None,
+        }
+    # tower block (ISSUE 18, docs/observability.md §11): the watcher-cost
+    # contract — the twin's overhead fraction the acceptance pins at
+    # <= 0.02 even with the tower polling at 20 Hz (~40x its default rate)
+    if medians.get("serve_unwatched_rows_per_sec"):
+        out["tower"] = {
+            "overhead_frac": round(
+                1.0
+                - medians["serve_watched_rows_per_sec"]
+                / medians["serve_unwatched_rows_per_sec"], 4
+            ),
+            "watch_hz": 20.0,
+            "scrape_targets": 4,
         }
     # router block (docs/SERVING.md "Replicas"): the overhead ratio the
     # replica-tier acceptance pins at >= 0.8x, plus the router's own

@@ -25,6 +25,10 @@ JAX_PLATFORMS=cpu python -m sparse_coding__tpu.analysis --contracts \
 echo "== generated docs (utils.flags --check-docs) =="
 JAX_PLATFORMS=cpu python -m sparse_coding__tpu.utils.flags --check-docs || exit $?
 
+echo "== tower check (alert gate over the golden tower fixture) =="
+JAX_PLATFORMS=cpu python -m sparse_coding__tpu.tower check \
+    tests/golden/tower_run || exit $?
+
 if [ "$fast" = "1" ]; then
     echo "== tier-1 tests skipped (--fast) =="
     exit 0

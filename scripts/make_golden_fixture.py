@@ -861,6 +861,23 @@ def make_bench_fixture():
         "serve_featstats_rows_per_sec": 415.0,
         "serve_featstats_rows_per_sec_spread": [390.0, 440.0],
         "featstats": {"overhead_frac": 0.0125, "serve_ratio": 0.988},
+        # ISSUE-18 control-tower guards (host-side, chip-independent;
+        # measured on this repo's CPU CI box). The scrape key is full
+        # Tower.poll_once cycles over 4 fake replica endpoints in
+        # targets/second — scrape + parse + merge + series-store record +
+        # burn-rate rule evaluation + series.jsonl append all on the
+        # clock. The twin keys run the SAME closed-loop HTTP serve load
+        # with and without a 20 Hz tower watching the replica; the
+        # acceptance contract is tower.overhead_frac <= 0.02 — the
+        # watcher must never become the load it is measuring.
+        "tower_scrape_targets_per_sec": 450.0,
+        "tower_scrape_targets_per_sec_spread": [400.0, 500.0],
+        "serve_watched_rows_per_sec": 440.0,
+        "serve_watched_rows_per_sec_spread": [415.0, 465.0],
+        "serve_unwatched_rows_per_sec": 445.0,
+        "serve_unwatched_rows_per_sec_spread": [420.0, 470.0],
+        "tower": {"overhead_frac": 0.0112, "watch_hz": 20.0,
+                  "scrape_targets": 4},
     }
     with open(BENCH_FIXTURE, "w") as f:
         json.dump(bench, f, indent=1)
@@ -1499,7 +1516,166 @@ def make_feature_run_fixture():
           f"control {stable['score']:.3f})")
 
 
+TOWER_RUN_DIR = REPO / "tests" / "golden" / "tower_run"
+TOWER_BASE_TS = 1_754_700_000.0  # fixed: the fixture must regenerate identically
+
+
+def make_tower_run_fixture():
+    """Deterministic control-tower fixture (ISSUE 18): a hand-stamped tower
+    state dir pinning the full observability chain — ``series.jsonl`` poll
+    snapshots, the pending→firing→resolved transitions in ``alerts.jsonl``
+    (driven through the REAL `AlertManager` state machine at fixed
+    timestamps), the ``incidents/INC-0001.json`` correlation record (built
+    by the real `Tower._incident_context` over hand-seeded replica
+    transitions / traces / spans), the ``state.json`` pool snapshot, and
+    the ``tower check`` exit codes.
+
+    Hand-stamped, not a real run — golden fixtures must be byte-stable.
+    The shape: 2 serve replicas behind a router, 6 polls at 5 s. replica1
+    dies between polls 1 and 2 (``router.live_replicas`` 2→1), the
+    ``replicas-live`` gauge_min rule goes pending at poll 2, fires at
+    poll 4 (``for: 6 s`` held), and resolves at poll 5 after the
+    supervisor restart brings the gauge back to 2. The latency histogram
+    carries 3 slow observations so the ``serve.latency`` slow-burn rate —
+    the number `evaluate_scrape` can never produce — pins non-None."""
+    import shutil
+
+    from sparse_coding__tpu.telemetry.tower import Tower, load_rules
+
+    if TOWER_RUN_DIR.exists():
+        shutil.rmtree(TOWER_RUN_DIR)  # alerts.jsonl appends: start clean
+    TOWER_RUN_DIR.mkdir(parents=True)
+    T = TOWER_BASE_TS
+
+    rules_doc = {
+        "windows": {"fast_burn_seconds": 300.0, "slow_burn_seconds": 3600.0},
+        "rules": [
+            {"name": "replicas-live", "for_seconds": 6.0, "severity": "page",
+             "objective": {"type": "gauge_min",
+                           "gauge": "router.live_replicas", "min_value": 2}},
+            {"name": "availability", "for_seconds": 10.0, "severity": "page",
+             "objective": {"type": "availability", "target": 0.999}},
+            {"name": "p99", "for_seconds": 10.0, "severity": "ticket",
+             "objective": {"type": "latency", "percentile": 0.99,
+                           "threshold_ms": 50.0}},
+        ],
+    }
+    with open(TOWER_RUN_DIR / "alerts.json", "w") as f:
+        json.dump(rules_doc, f, indent=1)
+    # the static estate description the CLI's --config consumes, schema-
+    # pinned alongside the state it produced
+    with open(TOWER_RUN_DIR / "tower.json", "w") as f:
+        json.dump({
+            "targets": [{"url": "http://127.0.0.1:8701", "label": "router"}],
+            "replicasets": ["runs/tier"],
+            "run_dirs": ["runs/tier"],
+            "interval_seconds": 5.0,
+            "rules": "alerts.json",
+        }, f, indent=1)
+
+    bounds = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0]
+    live = [2, 2, 1, 1, 1, 2]
+    queue = [0, 1, 2, 3, 2, 0]
+    bad_cum = [0, 0, 1, 1, 2, 3]  # slow (>50 ms) observations, cumulative
+    records = []
+    for i in range(6):
+        req = 100.0 + 60.0 * i
+        n = i + 1
+        counts = [20.0 * n, 25.0 * n, 10.0 * n, 5.0 * n, 0.0, 0.0,
+                  float(bad_cum[i]), 0.0]
+        hist = {"bounds": bounds, "counts": counts,
+                "sum": round(180.0 * n + 60.0 * bad_cum[i], 1),
+                "count": 60.0 * n + bad_cum[i]}
+        r1_up = i not in (2, 3, 4)
+        counters = {"serve.requests": req, "serve.errors": 0.0,
+                    "router.requests": req,
+                    "replica0::serve.requests": req / 2}
+        if r1_up:
+            counters["replica1::serve.requests"] = req / 2
+        gauges = {"router.live_replicas": float(live[i]),
+                  "router.replicas": 2.0,
+                  "serve.queue_depth": float(queue[i]),
+                  "replica0::serve.queue_depth": float(queue[i]),
+                  "fleet.idle_workers": 2.0, "fleet.busy_workers": 1.0,
+                  "fleet.pending_items": float(4 - i if i < 4 else 0),
+                  "fleet.leased_items": 1.0,
+                  "train.goodput_frac": 0.88}
+        targets = {
+            "replica0": {"up": True, "url": "http://127.0.0.1:8702",
+                         "kind": "serve"},
+            "replica1": (
+                {"up": True, "url": "http://127.0.0.1:8703", "kind": "serve"}
+                if r1_up else
+                {"up": False, "url": "http://127.0.0.1:8703",
+                 "error": "URLError"}
+            ),
+            "router": {"up": True, "url": "http://127.0.0.1:8701",
+                       "kind": "router"},
+        }
+        from sparse_coding__tpu.telemetry.metrics_http import sanitize_key
+        records.append({
+            "ts": round(T + 5.0 * i, 6),
+            "counters": {sanitize_key(k): v for k, v in sorted(counters.items())},
+            "gauges": {sanitize_key(k): v for k, v in sorted(gauges.items())},
+            "hists": {sanitize_key("serve.latency_ms"): hist},
+            "targets": targets,
+        })
+
+    class _NullTel:  # the fixture pins files, not the tower's own telemetry
+        def counter_inc(self, *a, **k): pass
+        def gauge_set(self, *a, **k): pass
+        def event(self, *a, **k): pass
+        def close(self, *a, **k): pass
+
+    rules_cfg = load_rules(rules_doc)
+    tower = Tower(TOWER_RUN_DIR, rules=rules_cfg["rules"],
+                  windows=rules_cfg["windows"], interval=5.0,
+                  telemetry=_NullTel(), resume=False)
+    # hand-seeded correlation state, shaped exactly like Tower._ingest_event
+    # leaves it after tailing the router/replica logs of this story
+    tower.replica_states = {"replica0": "live", "replica1": "dead"}
+    tower.replica_transitions.extend([
+        {"ts": round(T + 9.2, 3), "replica": "replica1", "from": "live",
+         "to": "suspect", "reason": "conn_refused"},
+        {"ts": round(T + 11.7, 3), "replica": "replica1", "from": "suspect",
+         "to": "dead", "reason": "health_timeout"},
+    ])
+    tower.anomalies.append({"ts": round(T + 11.9, 3), "event": "anomaly",
+                            "kind": "replica_dead", "replica": "replica1"})
+    for j, lat in enumerate((61.4, 58.9, 22.0, 14.1, 9.8, 7.2)):
+        tower.traces.append({
+            "ts": round(T + 8.0 + 0.5 * j, 3),
+            "trace_id": f"{0xa3f2c0de + j:08x}{'00' * 12}",
+            "latency_ms": lat, "replica": "replica0", "dict": "d0",
+        })
+    tower.span_seconds = {"step": 90.0, "compile": 2.0, "data_wait": 8.0}
+
+    transitions = []
+    with open(TOWER_RUN_DIR / "series.jsonl", "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+            tower.store.ingest(rec)
+            tower.target_status = rec["targets"]
+            tower.polls += 1
+            tower.last_poll_ts = rec["ts"]
+            transitions.extend(tower.alerts.evaluate(tower.store, rec["ts"]))
+    tower._write_state(records[-1]["ts"])
+
+    seq = [(t["rule"], t["from"], t["to"]) for t in transitions]
+    assert seq == [
+        ("replicas-live", "inactive", "pending"),
+        ("replicas-live", "pending", "firing"),
+        ("replicas-live", "firing", "resolved"),
+    ], f"fixture alert story drifted: {seq}"
+    assert (TOWER_RUN_DIR / "incidents" / "INC-0001.json").is_file()
+    print(f"Wrote {TOWER_RUN_DIR}/ (series.jsonl x{len(records)}, "
+          f"alerts.json(l), incidents/INC-0001.json, state.json, tower.json)")
+
+
 def main():
+    if "--tower-run" in sys.argv:
+        make_tower_run_fixture()
+        return
     if "--traced-run" in sys.argv:
         make_traced_run_fixture()
         return

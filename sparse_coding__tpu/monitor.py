@@ -6,9 +6,18 @@ lines; ``--once`` prints one snapshot and exits nonzero on malformed event
 lines. Implementation: `sparse_coding__tpu.telemetry.monitor`.
 """
 
-from sparse_coding__tpu.telemetry.monitor import EventTail, RunMonitor, main, render
+from sparse_coding__tpu.telemetry.monitor import (
+    EventTail,
+    RunMonitor,
+    TowerView,
+    main,
+    render,
+    tower_render,
+)
 
-__all__ = ["EventTail", "RunMonitor", "main", "render"]
+__all__ = [
+    "EventTail", "RunMonitor", "TowerView", "main", "render", "tower_render",
+]
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -1,17 +1,20 @@
 """CLI shim: ``python -m sparse_coding__tpu.slo <run_dir> --config slo.json``.
 
 Evaluates declarative SLOs (availability, latency percentiles, queue
-depth, goodput floor) over a run directory or live ``/metrics`` endpoints
-(``--scrape URL...``), with error-budget consumption and fast/slow burn
-rates; exits **1** past budget — the serving tier's CI gate and the
-ROADMAP-3 autoscaler's sensor. Implementation:
-`sparse_coding__tpu.telemetry.slo` (docs/observability.md §8).
+depth, gauge floors, goodput floor) over a run directory, live
+``/metrics`` endpoints (``--scrape URL...``), or control-tower history
+(``--tower DIR`` — the only live source with real fast/slow burn rates),
+with error-budget consumption and multiwindow burn accounting; exits
+**1** past budget — the serving tier's CI gate and the ROADMAP-2
+autoscaler's sensor. Implementation: `sparse_coding__tpu.telemetry.slo`
+(docs/observability.md §8, §11).
 """
 
 from sparse_coding__tpu.telemetry.slo import (
     evaluate_measured,
     evaluate_run_dir,
     evaluate_scrape,
+    evaluate_series,
     load_config,
     main,
     render_slo,
@@ -21,6 +24,7 @@ __all__ = [
     "evaluate_measured",
     "evaluate_run_dir",
     "evaluate_scrape",
+    "evaluate_series",
     "load_config",
     "main",
     "render_slo",

@@ -37,6 +37,14 @@ Eight pieces (docs/observability.md):
   - `slo`       — declarative SLO engine (availability/latency/queue/
                   goodput objectives, error budgets, fast/slow burn
                   rates); CLI: `python -m sparse_coding__tpu.slo`
+  - `tower`     — pool-wide control tower: scrapes every /metrics
+                  endpoint + fleet files + run-dir events into a retained
+                  ring-buffer time-series store, evaluates burn-rate
+                  alert rules with for:-duration hysteresis
+                  (pending→firing→resolved), snapshots incident records,
+                  and serves a live dashboard + the `Tower.pool_state()`
+                  autoscaler sensor; CLI: `python -m
+                  sparse_coding__tpu.tower run|report|check`
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy

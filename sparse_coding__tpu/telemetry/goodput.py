@@ -529,6 +529,7 @@ _CNAME = {
     "export_verify": "rail_load",
     "straggler_idle": "thread_state_sleeping",
     "reassign_gap": "black",
+    "tower_poll": "rail_load",
     "unaccounted": "grey",
 }
 

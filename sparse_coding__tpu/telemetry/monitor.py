@@ -35,6 +35,13 @@ ledger (done/running/orphaned/queued/lost)::
 files: one line per endpoint (serve replicas and routers auto-detected),
 latency quantiles read off the scraped histograms, plus tier-wide merged
 totals — unreachable endpoints render DOWN instead of crashing.
+
+``--tower URL|DIR`` (ISSUE 18) renders ONE aggregated pool view from a
+control tower (`telemetry.tower`) — per-target lines with *windowed*
+signals from tower history, fleet idle capacity, training goodput, and
+the firing alerts — instead of N history-less ``--scrape`` endpoints. An
+unreachable or stale tower renders DOWN with a last-seen age; exit
+semantics are unchanged.
 """
 
 from __future__ import annotations
@@ -51,8 +58,8 @@ from sparse_coding__tpu.telemetry.multihost import (
 )
 
 __all__ = [
-    "EventTail", "RunMonitor", "fleet_lines", "render", "scrape_render",
-    "main",
+    "EventTail", "RunMonitor", "TowerView", "fleet_lines", "render",
+    "scrape_render", "tower_render", "main",
 ]
 
 _EVENT_GLOBS = (
@@ -739,6 +746,126 @@ def scrape_render(urls: List[str], now: Optional[float] = None,
     return "\n".join(lines)
 
 
+class TowerView:
+    """The ``--tower`` view (ISSUE 18): ONE aggregated pool snapshot from a
+    control tower's ``state.json`` — per-target lines, fleet capacity,
+    training goodput, and the firing alerts — instead of N ``--scrape``
+    endpoints each carrying no history. ``src`` is a dashboard URL
+    (``http://host:port`` → ``/state.json``) or a tower state dir.
+
+    Stateful on purpose: an unreachable tower renders DOWN with the age
+    of the last state it DID serve, and a state file whose ``ts`` has
+    fallen more than 3 poll intervals behind renders DOWN (stale) — a
+    dead tower leaves its last ``state.json`` on disk, and showing it as
+    live would be lying about the whole pool at once."""
+
+    def __init__(self, src, timeout: float = 3.0):
+        self.src = str(src)
+        self.timeout = timeout
+        self.last_state: Optional[Dict[str, Any]] = None
+        self.last_ok_ts: Optional[float] = None
+
+    def fetch(self) -> Dict[str, Any]:
+        if self.src.startswith(("http://", "https://")):
+            from urllib.request import urlopen
+
+            url = self.src.rstrip("/") + "/state.json"
+            with urlopen(url, timeout=self.timeout) as r:
+                state = json.loads(r.read().decode("utf-8"))
+        else:
+            state = json.loads((Path(self.src) / "state.json").read_text())
+        if not isinstance(state, dict):
+            raise ValueError("tower state is not a JSON object")
+        return state
+
+    def render(self, now: Optional[float] = None) -> str:
+        now = time.time() if now is None else now
+        try:
+            state = self.fetch()
+        except Exception as e:
+            seen = (
+                f"last seen {_age(now, self.last_ok_ts)} ago"
+                if self.last_ok_ts is not None else "never seen"
+            )
+            return f"tower {self.src}: DOWN ({type(e).__name__}) — {seen}"
+        ts = state.get("ts")
+        interval = float(state.get("interval_seconds") or 5.0)
+        stale = (
+            isinstance(ts, (int, float)) and now - ts > 3.0 * interval
+        )
+        if not stale:
+            self.last_state, self.last_ok_ts = state, now
+        lines = [
+            f"tower {self.src}: "
+            + (f"DOWN (stale) — last poll {_age(now, ts)} ago" if stale
+               else f"{state.get('polls', 0)} poll(s), every {interval:g}s, "
+                    f"last {_age(now, ts)} ago")
+        ]
+        targets = state.get("targets") or {}
+        up = sum(1 for t in targets.values() if t.get("up"))
+        if targets:
+            lines.append(f"  targets: {up}/{len(targets)} up")
+        for label in sorted(targets):
+            t = targets[label]
+            if not t.get("up"):
+                lines.append(f"  {label}: DOWN ({t.get('error', '?')})")
+                continue
+            bits = ["up"]
+            if t.get("requests_in_window") is not None:
+                bits.append(f"{t['requests_in_window']:g} req (window)")
+            if t.get("error_frac_in_window"):
+                bits.append(f"{100 * t['error_frac_in_window']:.2f}% err")
+            if t.get("latency_p99_ms_in_window") is not None:
+                bits.append(f"p99 ≤{t['latency_p99_ms_in_window']:g}ms")
+            if t.get("queue_depth") is not None:
+                bits.append(f"queue {int(t['queue_depth'])}")
+            kind = t.get("kind", "up")
+            tag = f" [{kind}]" if kind not in ("up", "serve") else ""
+            lines.append(f"  {label}{tag}: " + " | ".join(bits))
+        router = state.get("router")
+        if router:
+            lines.append(
+                f"  router: {int(router.get('live_replicas', 0))}/"
+                f"{int(router.get('replicas', 0))} replicas live"
+            )
+        fleet = state.get("fleet")
+        if fleet:
+            lines.append(
+                f"  fleet: {int(fleet.get('idle_workers', 0))} idle / "
+                f"{int(fleet.get('busy_workers', 0))} busy workers | "
+                f"{int(fleet.get('pending_items', 0))} pending item(s)"
+            )
+        train = state.get("train")
+        if train and train.get("goodput_frac") is not None:
+            lines.append(
+                f"  train: goodput {100 * train['goodput_frac']:.1f}%"
+            )
+        alerts = state.get("alerts") or []
+        active = [a for a in alerts if a.get("state") != "inactive"]
+        if active:
+            bits = []
+            for a in active:
+                word = (
+                    a["state"].upper() if a["state"] == "firing"
+                    else a["state"]
+                )
+                bits.append(
+                    f"{a.get('rule', '?')} {word} "
+                    f"(for {_age(now, a.get('since'))})"
+                )
+            lines.append("  alerts: " + " | ".join(bits))
+        elif alerts:
+            lines.append(f"  alerts: {len(alerts)} rule(s), none active")
+        return "\n".join(lines)
+
+
+def tower_render(src, now: Optional[float] = None,
+                 timeout: float = 3.0) -> str:
+    """One-shot ``--tower`` render (stateless — follow mode keeps a
+    `TowerView` so DOWN can carry a last-seen age)."""
+    return TowerView(src, timeout=timeout).render(now=now)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparse_coding__tpu.monitor", description=__doc__,
@@ -764,8 +891,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="render live tiers from /metrics endpoints (serve servers, "
         "routers) instead of tailing a run dir's files",
     )
+    ap.add_argument(
+        "--tower", default=None, metavar="URL|DIR",
+        help="render ONE aggregated pool view from a control tower "
+        "(dashboard URL or tower state dir) instead of N --scrape "
+        "endpoints",
+    )
     args = ap.parse_args(argv)
 
+    if args.tower:
+        if args.run_dir is not None or args.scrape:
+            ap.error("--tower replaces the run_dir/--scrape — pass one source")
+        view = TowerView(args.tower)
+        refreshes = 0
+        try:
+            while True:
+                print(view.render())
+                refreshes += 1
+                if args.once or (args.refreshes and refreshes >= args.refreshes):
+                    return 0
+                print()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
     if args.scrape:
         if args.run_dir is not None:
             ap.error("--scrape replaces the run_dir — pass one or the other")
@@ -781,7 +929,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             return 0
     if args.run_dir is None:
-        ap.error("need a run_dir (or --scrape URL...)")
+        ap.error("need a run_dir (or --scrape URL... / --tower URL|DIR)")
     mon = RunMonitor(args.run_dir)
 
     if args.once:

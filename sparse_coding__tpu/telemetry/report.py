@@ -1110,6 +1110,26 @@ def _anomaly_section(run, lines: List[str]):
     lines.append("")
 
 
+def _incidents_section(run, lines: List[str]):
+    """Control-tower incidents (ISSUE 18): when the reported directory is
+    (or contains) a tower state dir, render its ``incidents/INC-*.json``
+    records — rule, open/resolve times, the dead replicas, and the
+    correlated slowest traces. Omitted entirely when no incidents exist —
+    report output is a stability contract."""
+    from sparse_coding__tpu.telemetry.tower import (
+        read_incidents,
+        render_incidents,
+    )
+
+    incidents = read_incidents(run["dir"])
+    if not incidents:
+        return
+    lines.append(f"## Incidents ({len(incidents)})")
+    lines.append("")
+    lines.extend(render_incidents(incidents))
+    lines.append("")
+
+
 def render_markdown(run: Dict[str, Any]) -> str:
     lines: List[str] = [f"# Run report — `{run['dir']}`", ""]
     lines.append(
@@ -1126,6 +1146,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _feature_section(run, lines)
     _router_section(run, lines)
     _slo_section(run, lines)
+    _incidents_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

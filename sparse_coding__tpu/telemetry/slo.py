@@ -3,14 +3,19 @@
 The latency/queue/occupancy gauges were write-only until now — nothing
 *evaluated* them. This module reads a declarative ``slo.json`` and renders
 verdicts with error-budget accounting and multi-window burn rates (the SRE
-literature's fast/slow-burn alerting shape), over three sources:
+literature's fast/slow-burn alerting shape), over four sources:
 
   - a **run directory** (``events*.jsonl`` snapshots + the goodput
     ledger) — the CI gate: ``python -m sparse_coding__tpu.slo <run_dir>
     --config slo.json`` exits **1** past budget;
   - a **live scrape** (``--scrape URL...`` over the new ``/metrics``
-    endpoints, merged across replicas) — the sensor the ROADMAP-3
-    autoscaler reads;
+    endpoints, merged across replicas) — instantaneous only, so burn
+    rates are None;
+  - a **tower series** (``--tower DIR`` / `evaluate_series` over a
+    control-tower `SeriesStore` — `telemetry.tower`): the retained
+    pool-wide history, so fast/slow burn windows are REAL on live tiers
+    (windowed counter and histogram deltas over tower retention) — the
+    sensor the ROADMAP-2 autoscaler reads;
   - a **loadgen result blob** (``scripts/loadgen.py --slo slo.json``) —
     objectives checked against the measured client-side histogram.
 
@@ -24,6 +29,8 @@ literature's fast/slow-burn alerting shape), over three sources:
         "threshold_ms": 50.0, "histogram": "serve.latency_ms"},
        {"name": "queue", "type": "queue_depth", "max_depth": 16},
        {"name": "drift", "type": "feature-drift", "max_score": 0.25},
+       {"name": "replicas", "type": "gauge_min",
+        "gauge": "router.live_replicas", "min_value": 2},
        {"name": "goodput", "type": "goodput_floor", "floor_frac": 0.3}]}
 
 Semantics:
@@ -42,8 +49,13 @@ Semantics:
     (``serve.feature.drift_score``, PSI scale — `telemetry.feature_stats`)
     vs ``max_score``; skipped (not violated) when the tier never computed
     a drift score (no baseline loaded).
+  - **gauge_min**: any gauge must stay at-or-above ``min_value`` — e.g.
+    ``router.live_replicas`` ≥ N, the liveness objective the tower's
+    availability alerting leans on (a router that transparently retries
+    around a dead replica shows no error-counter signal).
   - **goodput_floor**: the goodput ledger's goodput fraction vs
-    ``floor_frac`` (run-dir source only).
+    ``floor_frac`` (run-dir source, or the tower's live
+    ``train.goodput_frac`` gauge via `evaluate_series`).
 
 Failed objectives emit anomaly-style ``slo_violation`` events when the
 caller hands an events sink (``--events DIR``), so reports and monitors
@@ -61,6 +73,7 @@ __all__ = [
     "load_config",
     "evaluate_run_dir",
     "evaluate_scrape",
+    "evaluate_series",
     "evaluate_measured",
     "render_slo",
     "main",
@@ -243,6 +256,74 @@ def _burn_rates(obj, snaps, windows) -> Dict[str, Optional[float]]:
     return out
 
 
+def _series_burn_rates(obj, store, windows, clean) -> Dict[str, Optional[float]]:
+    """Availability burn rates over tower history: windowed counter
+    deltas from the `SeriesStore` instead of snapshot replay. Same
+    conventions as `_burn_rates` — None when the store holds no span (a
+    single poll can't burn), 0.0 on a quiet window, ``*_window_covered:
+    False`` when retention is younger than the window."""
+    good_key = clean(obj.get("good_counter", "serve.requests"))
+    bad_key = clean(obj.get("bad_counter", "serve.errors"))
+    budget = 1.0 - float(obj["target"])
+    span = store.span()
+    out: Dict[str, Optional[float]] = {}
+    for label, wkey in (("fast", "fast_burn_seconds"),
+                        ("slow", "slow_burn_seconds")):
+        w = float(windows[wkey])
+        if span is None or budget <= 0 or span[1] - span[0] <= 0:
+            out[label] = None
+            continue
+        t_end = span[1]
+        d_good = store.window_delta(good_key, t_end - w, t_end)
+        d_bad = store.window_delta(bad_key, t_end - w, t_end)
+        total = d_good + d_bad
+        if total <= 0:
+            out[label] = 0.0
+            continue
+        out[label] = round((d_bad / total) / budget, 4)
+        if span[1] - span[0] < w:
+            out[f"{label}_window_covered"] = False
+    return out
+
+
+def _series_latency_burn(obj, store, windows,
+                         clean) -> Dict[str, Optional[float]]:
+    """Latency burn rates over tower history — the signal neither the
+    run-dir nor the scrape source can produce. The budget is the fraction
+    of requests ALLOWED over the threshold (``1 - percentile``); the
+    window's bad fraction is read from the bucketwise histogram delta
+    (counts in buckets whose upper bound exceeds ``threshold_ms``, plus
+    the overflow slot). ≥2 polls make this non-None: one poll has no
+    history to delta."""
+    threshold = float(obj["threshold_ms"])
+    budget = 1.0 - float(obj.get("percentile", 0.99))
+    hist_key = clean(obj.get("histogram", "serve.latency_ms"))
+    hspan = store.hist_span(hist_key)
+    out: Dict[str, Optional[float]] = {}
+    for label, wkey in (("fast", "fast_burn_seconds"),
+                        ("slow", "slow_burn_seconds")):
+        w = float(windows[wkey])
+        if hspan is None or budget <= 0 or hspan[1] - hspan[0] <= 0:
+            out[label] = None
+            continue
+        t_end = hspan[1]
+        h = store.hist_delta(hist_key, t_end - w, t_end)
+        if h is None:
+            out[label] = None
+            continue
+        total = sum(h["counts"])
+        if total <= 0:
+            out[label] = 0.0
+            continue
+        bad = sum(
+            n for b, n in zip(h["bounds"], h["counts"]) if b > threshold
+        ) + sum(h["counts"][len(h["bounds"]):])
+        out[label] = round((bad / total) / budget, 4)
+        if hspan[1] - hspan[0] < w:
+            out[f"{label}_window_covered"] = False
+    return out
+
+
 def _latency(obj, gauges, hists) -> Dict[str, Any]:
     q = float(obj.get("percentile", 0.99))
     threshold = float(obj["threshold_ms"])
@@ -297,6 +378,26 @@ def _feature_drift(obj, gauges) -> Dict[str, Any]:
         "measured": round(float(measured), 6),
         "max_score": max_score,
         "detail": f"gauge {gauge_key} (PSI scale)",
+    }
+
+
+def _gauge_min(obj, gauges) -> Dict[str, Any]:
+    """Floor objective on any gauge: measured must stay at-or-above
+    ``min_value``. The canonical use is ``router.live_replicas`` ≥ N —
+    the router retries transparently around a SIGKILLed replica, so the
+    error counters stay flat while capacity is gone; the liveness gauge
+    is the honest availability sensor."""
+    gauge_key = obj["gauge"]
+    floor = float(obj["min_value"])
+    measured = gauges.get(gauge_key)
+    if measured is None:
+        return {"ok": None, "measured": None, "min_value": floor,
+                "detail": f"gauge {gauge_key} not recorded"}
+    return {
+        "ok": measured >= floor,
+        "measured": float(measured),
+        "min_value": floor,
+        "detail": f"gauge {gauge_key}",
     }
 
 
@@ -373,6 +474,8 @@ def evaluate_run_dir(run_dir, config: Dict[str, Any],
             r = _queue_depth(obj, gauges)
         elif typ == "feature-drift":
             r = _feature_drift(obj, gauges)
+        elif typ == "gauge_min":
+            r = _gauge_min(obj, gauges)
         elif typ == "goodput_floor":
             r = _goodput_floor(obj, run_dir)
         else:
@@ -461,6 +564,8 @@ def evaluate_scrape(urls: List[str], config: Dict[str, Any],
                 )},
                 gauges,
             )
+        elif typ == "gauge_min":
+            r = _gauge_min({**obj, "gauge": clean(obj["gauge"])}, gauges)
         elif typ == "goodput_floor":
             r = _goodput_floor(obj, None)
         else:
@@ -468,6 +573,99 @@ def evaluate_scrape(urls: List[str], config: Dict[str, Any],
                  "detail": f"unknown objective type {typ!r}"}
         out.append({**base, **r})
     return _finish(config, f"scrape:{','.join(urls)}", out, emit_to=emit_to)
+
+
+def evaluate_series(store_or_dir, config: Dict[str, Any],
+                    emit_to=None) -> Dict[str, Any]:
+    """Evaluate objectives over control-tower history — a `SeriesStore`
+    (duck-typed) or a tower directory whose ``series.jsonl`` is replayed
+    via `telemetry.tower.load_store`.
+
+    This is the source that closes the gap the scrape source documents:
+    burn rates need history, and the tower HAS history. Availability burn
+    comes from windowed counter deltas, latency burn from windowed
+    histogram deltas (`_series_latency_burn`) — both real on live tiers
+    after ≥2 polls. ``goodput_floor`` reads the tower's live
+    ``train.goodput_frac`` gauge (the span-tail approximation, not the
+    offline ledger). Keys in the store are exposition-sanitized, so
+    objective keys map through the same sanitizer the exporter used;
+    per-target series (``label::key``) are excluded — objectives judge
+    the merged pool."""
+    from sparse_coding__tpu.telemetry import metrics_http as mh
+
+    if hasattr(store_or_dir, "counters_latest"):
+        store, label = store_or_dir, "store"
+    else:
+        from sparse_coding__tpu.telemetry.tower import load_store
+
+        store, label = load_store(store_or_dir), str(store_or_dir)
+    from sparse_coding__tpu.telemetry.tower import TARGET_SEP
+
+    def merged(d):
+        return {k: v for k, v in d.items() if TARGET_SEP not in k}
+
+    counters = merged(store.counters_latest())
+    gauges = merged(store.gauges_latest())
+    hists = merged(store.hists_latest())
+    windows = config.get("windows", DEFAULT_WINDOWS)
+    clean = mh.sanitize_key
+
+    out: List[Dict[str, Any]] = []
+    for obj in config["objectives"]:
+        typ = obj.get("type")
+        base = {"name": obj.get("name", typ), "type": typ}
+        if typ == "availability":
+            r = _availability({
+                **obj,
+                "good_counter": clean(obj.get("good_counter", "serve.requests")),
+                "bad_counter": clean(obj.get("bad_counter", "serve.errors")),
+            }, counters)
+            if r["ok"] is not None:
+                r["burn_rates"] = _series_burn_rates(obj, store, windows, clean)
+        elif typ == "latency":
+            q = float(obj.get("percentile", 0.99))
+            r = _latency({
+                **obj,
+                "histogram": clean(obj.get("histogram", "serve.latency_ms")),
+                "gauge": clean(obj.get(
+                    "gauge", f"serve.latency_p{int(round(q * 100))}_ms"
+                )),
+            }, gauges, hists)
+            if r["ok"] is not None:
+                r["burn_rates"] = _series_latency_burn(
+                    obj, store, windows, clean)
+        elif typ == "queue_depth":
+            r = _queue_depth(
+                {**obj, "gauge": clean(obj.get("gauge", "serve.queue_depth"))},
+                gauges,
+            )
+        elif typ == "feature-drift":
+            r = _feature_drift(
+                {**obj, "gauge": clean(
+                    obj.get("gauge", "serve.feature.drift_score")
+                )},
+                gauges,
+            )
+        elif typ == "gauge_min":
+            r = _gauge_min({**obj, "gauge": clean(obj["gauge"])}, gauges)
+        elif typ == "goodput_floor":
+            floor = float(obj["floor_frac"])
+            frac = gauges.get(clean("train.goodput_frac"))
+            if frac is None:
+                r = {"ok": None, "measured": None, "floor_frac": floor,
+                     "detail": "tower has no train.goodput_frac gauge "
+                               "(no span-instrumented run dir tailed)"}
+            else:
+                r = {"ok": frac >= floor,
+                     "measured": round(float(frac), 4),
+                     "floor_frac": floor,
+                     "detail": "tower live goodput (span-tail "
+                               "approximation, not the offline ledger)"}
+        else:
+            r = {"ok": None, "measured": None,
+                 "detail": f"unknown objective type {typ!r}"}
+        out.append({**base, **r})
+    return _finish(config, f"series:{label}", out, emit_to=emit_to)
 
 
 def evaluate_measured(blob: Dict[str, Any], config: Dict[str, Any],
@@ -536,7 +734,8 @@ def render_slo(result: Dict[str, Any]) -> str:
     ]
     for o in result["objectives"]:
         target = o.get("target", o.get("threshold_ms", o.get(
-            "max_depth", o.get("floor_frac", o.get("max_score")))))
+            "max_depth", o.get("floor_frac", o.get(
+                "max_score", o.get("min_value"))))))
         burn = o.get("burn_rates") or {}
         burn_s = (
             f"{burn.get('fast', '-')} / {burn.get('slow', '-')}"
@@ -578,18 +777,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--scrape", nargs="+", default=None, metavar="URL",
                     help="evaluate live /metrics endpoints instead of a "
                     "run dir (merged across replicas)")
+    ap.add_argument("--tower", default=None, metavar="DIR",
+                    help="evaluate control-tower history (DIR/series.jsonl "
+                    "replay) — burn rates are real on live tiers")
     ap.add_argument("--events", default=None, metavar="DIR",
                     help="append slo_violation events + a verdict snapshot "
                     "to DIR/slo_events.jsonl")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
-    if args.run_dir is None and not args.scrape:
-        ap.error("need a run_dir or --scrape URL...")
-    if args.run_dir is not None and args.scrape:
+    n_sources = sum(
+        x is not None for x in (args.run_dir, args.scrape, args.tower)
+    )
+    if n_sources == 0:
+        ap.error("need a run_dir, --scrape URL..., or --tower DIR")
+    if n_sources > 1:
         # silently preferring one source would change the verdict's meaning
-        # (burn rates and goodput_floor are run-dir-only)
-        ap.error("--scrape replaces the run_dir — pass one or the other")
+        # (burn-rate and goodput semantics differ per source)
+        ap.error("run_dir, --scrape and --tower are exclusive — pass one")
     config = load_config(args.config)
 
     emit_to = None
@@ -602,6 +807,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.scrape:
             result = evaluate_scrape(args.scrape, config, emit_to=emit_to)
+        elif args.tower:
+            if not Path(args.tower).is_dir():
+                print(f"tower dir {args.tower} does not exist")
+                return 3
+            result = evaluate_series(args.tower, config, emit_to=emit_to)
         else:
             if not Path(args.run_dir).is_dir():
                 print(f"run dir {args.run_dir} does not exist")

@@ -84,6 +84,8 @@ BADPUT_CATEGORIES = (
                       # their own span, trace-tagged — telemetry.tracing)
     "feature_flush",  # feature-stats sketch flush: the one sanctioned
                       # device_get + npz write per window (telemetry.feature_stats)
+    "tower_poll",     # control tower: one scrape+aggregate+alert cycle over
+                      # the pool (telemetry.tower) — the watcher's own cost
 )
 # derived-only badput: reconstructed by telemetry.goodput from event
 # adjacency, never emitted as live spans

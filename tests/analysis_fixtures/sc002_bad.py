@@ -10,3 +10,9 @@ def flush(telemetry, span, sketch):
     # near-miss of the registered ``feature_flush`` badput category
     with span(telemetry, "feature_snapshot"):  # VIOLATION
         return sketch.sum()
+
+
+def poll(telemetry, span, targets):
+    # near-miss of the registered ``tower_poll`` badput category
+    with span(telemetry, "tower_scrape"):  # VIOLATION
+        return len(targets)

@@ -14,3 +14,10 @@ def flush(telemetry, span, sketch):
     # it is not nestable, so it sits at top level
     with span(telemetry, "feature_flush"):
         return sketch.sum()
+
+
+def poll(telemetry, span, targets):
+    # ``tower_poll`` is registered badput (the control tower's own
+    # scrape+aggregate+alert cycle); not nestable, top level only
+    with span(telemetry, "tower_poll"):
+        return len(targets)

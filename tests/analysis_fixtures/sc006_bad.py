@@ -11,3 +11,9 @@ def publish_features(gauge_set, dead):
     # both expose as ``sc_serve_feature_dead_frac``
     gauge_set("serve.feature.dead_frac", dead)  # VIOLATION
     gauge_set("serve.feature_dead.frac", dead)  # VIOLATION
+
+
+def publish_tower(counter_inc, n):
+    # both expose as ``sc_tower_scrape_errors_total``
+    counter_inc("tower.scrape.errors", n)  # VIOLATION
+    counter_inc("tower.scrape_errors", n)  # VIOLATION

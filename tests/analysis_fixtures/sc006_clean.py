@@ -17,3 +17,12 @@ def publish_features(gauge_set, counter_inc, dead, gini, drift):
     gauge_set("serve.feature.gini", gini)
     gauge_set("serve.feature.drift_score", drift)
     counter_inc("serve.feature.flushes")
+
+
+def publish_tower(gauge_set, counter_inc, up, total, firing):
+    # the control-tower self-metrics family: distinct stems stay distinct
+    gauge_set("tower.targets_up", up)
+    gauge_set("tower.targets_total", total)
+    gauge_set("tower.alerts_firing", firing)
+    counter_inc("tower.polls")
+    counter_inc("tower.scrape_errors")

@@ -1,0 +1,657 @@
+"""Control tower (ISSUE 18, docs/observability.md §11).
+
+Covers the two-tier ring-buffer series store (fine pruning, coarse
+retention, honest counter baselines, histogram thinning), the alert
+state machine (for:-duration hysteresis, pending flaps, webhook
+isolation), the golden ``tower_run`` fixture pins (alert timeline,
+incident record, `evaluate_series` burn rates — non-None fast/slow
+latency burn over replayed history is THE capability `--scrape`
+cannot provide), the ``tower check`` CI gate exit codes, the monitor
+``--tower`` view, the report Incidents section, the dashboard JSON
+contract, and the chaos acceptance: SIGKILL a replica under closed-loop
+load with the tower watching → pending→firing alert, an incident naming
+the dead replica with correlated trace ids and an SLO verdict, and a
+clean resolve after the supervisor restarts it.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from sparse_coding__tpu.telemetry.monitor import TowerView, tower_render
+from sparse_coding__tpu.telemetry.slo import evaluate_series
+from sparse_coding__tpu.telemetry.tower import (
+    AlertManager,
+    AlertRule,
+    SeriesStore,
+    Tower,
+    load_rules,
+    read_incidents,
+    render_incidents,
+    render_tower_report,
+    replay_alert_states,
+    tower_check,
+)
+
+GOLDEN_TOWER = Path(__file__).parent / "golden" / "tower_run"
+T0 = 1_754_700_000.0  # the fixture's hand-stamped poll clock
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+class _NullTel:
+    """Telemetry stand-in for towers under test: absorbs everything."""
+
+    def counter_inc(self, *a, **k):
+        pass
+
+    def counter_add_float(self, *a, **k):
+        pass
+
+    def gauge_set(self, *a, **k):
+        pass
+
+    def event(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+
+def _gauge_rule(for_seconds: float = 10.0) -> AlertRule:
+    return AlertRule({
+        "name": "replicas-live", "for_seconds": for_seconds,
+        "severity": "page",
+        "objective": {"type": "gauge_min", "gauge": "router.live_replicas",
+                      "min_value": 2},
+    })
+
+
+# -- SeriesStore ---------------------------------------------------------------
+
+
+def test_series_store_fine_prune_coarse_retention():
+    store = SeriesStore(retention_seconds=600.0, fine_seconds=60.0,
+                        bucket_seconds=10.0)
+    for t in range(0, 301, 5):
+        store.record("gauge", "g", float(t), float(t))
+    # fine tier holds only the last fine_seconds; older points survive as
+    # coarse buckets, so value_at still answers (last value of the last
+    # bucket wholly before t: bucket [90,100) closed with 95)
+    fine = store._points[("gauge", "g")]["fine"]
+    assert fine[0][0] >= 300.0 - 60.0
+    assert store.value_at("gauge", "g", 100.0) == 95.0
+    assert store.latest("gauge", "g") == (300.0, 300.0)
+    # series() splices coarse history before the fine window
+    pts = store.series("gauge", "g")
+    assert pts[0][0] < fine[0][0] and pts[-1] == (300.0, 300.0)
+    # retention: buckets wholly older than retention_seconds drop
+    for t in range(305, 1001, 5):
+        store.record("gauge", "g", float(t), float(t))
+    assert store.series("gauge", "g")[0][0] >= 1000.0 - 600.0 - 10.0
+
+
+def test_series_store_counter_baseline_and_window_delta():
+    store = SeriesStore()
+    store.record("counter", "c", 100.0, 5.0)
+    store.record("counter", "c", 110.0, 9.0)
+    # honest zero baseline before the first sample (slo._counter_at
+    # convention): a cold window's delta is the whole history
+    assert store.counter_at("c", 50.0) == 0.0
+    assert store.window_delta("c", 50.0, 115.0) == 9.0
+    assert store.window_delta("c", 105.0, 115.0) == 4.0
+    assert store.counters_latest() == {"c": 9.0}
+
+
+def test_series_store_hist_thinning_and_delta():
+    store = SeriesStore(retention_seconds=600.0, fine_seconds=60.0,
+                        bucket_seconds=10.0)
+    for i, t in enumerate(range(0, 301, 5)):
+        store.record_hist("h", float(t), {
+            "bounds": [10.0, 20.0],
+            "counts": [float(i), float(i), 0.0],
+            "sum": 15.0 * i, "count": 2.0 * i,
+        })
+    # beyond the fine horizon cumulative samples thin to one per coarse
+    # bucket (the latest — a windowed delta loses nothing)
+    old = [ts for ts, _ in store._hists["h"] if ts < 300.0 - 60.0]
+    buckets = {ts - (ts % 10.0) for ts in old}
+    assert len(old) == len(buckets)
+    # bucketwise delta over a window; zero baseline when the window
+    # predates history; None when the key has no sample at all by t1
+    d = store.hist_delta("h", 240.0, 300.0)
+    assert d["counts"][0] == 12.0 and d["count"] == 24.0
+    full = store.hist_delta("h", -100.0, 300.0)
+    assert full["count"] == 2.0 * 60
+    assert store.hist_delta("h", -100.0, -50.0) is None
+    assert store.hist_delta("missing", 0.0, 300.0) is None
+
+
+def test_series_store_ingest_round_trip():
+    store = SeriesStore()
+    store.ingest({"ts": 10.0, "counters": {"c": 3.0}, "gauges": {"g": 1.5},
+                  "hists": {"h": {"bounds": [1.0], "counts": [2.0, 0.0],
+                                  "sum": 1.0, "count": 2.0}}})
+    store.ingest({"ts": 20.0, "counters": {"c": 7.0}, "gauges": {"g": 2.5}})
+    assert store.span() == (10.0, 20.0)
+    assert store.n_keys() == 3
+    assert store.gauges_latest()["g"] == 2.5
+    assert store.hists_latest()["h"]["count"] == 2.0
+
+
+# -- AlertManager hysteresis ---------------------------------------------------
+
+
+def test_alert_hysteresis_pending_firing_resolved(tmp_path):
+    (tmp_path / "series.jsonl").write_text(json.dumps({"ts": 0.0}) + "\n")
+    mgr = AlertManager([_gauge_rule(for_seconds=10.0)], tower_dir=tmp_path)
+    store = SeriesStore()
+    # no sensor yet → SKIP (ok=None) never breaches
+    assert mgr.evaluate(store, 0.0) == []
+    store.record("gauge", "router_live_replicas", 0.0, 2.0)
+    assert mgr.evaluate(store, 0.0) == []
+    # breach starts the for: clock
+    store.record("gauge", "router_live_replicas", 10.0, 1.0)
+    (tr,) = mgr.evaluate(store, 10.0)
+    assert (tr["from"], tr["to"]) == ("inactive", "pending")
+    # held < for_seconds → still pending, no new transition
+    assert mgr.evaluate(store, 15.0) == []
+    assert tower_check(tmp_path, quiet=True) == 0  # pending is not firing
+    # held ≥ for_seconds → firing + incident
+    store.record("gauge", "router_live_replicas", 20.0, 1.0)
+    (tr,) = mgr.evaluate(store, 20.0)
+    assert (tr["from"], tr["to"]) == ("pending", "firing")
+    assert tr["incident"] == "INC-0001"
+    assert mgr.firing() == ["replicas-live"]
+    assert tower_check(tmp_path, quiet=True) == 1
+    inc = json.loads((tmp_path / "incidents" / "INC-0001.json").read_text())
+    assert inc["opened_ts"] == 20.0 and inc["resolved_ts"] is None
+    # recovery resolves and stamps the incident
+    store.record("gauge", "router_live_replicas", 25.0, 2.0)
+    (tr,) = mgr.evaluate(store, 25.0)
+    assert (tr["from"], tr["to"]) == ("firing", "resolved")
+    assert mgr.firing() == []
+    assert tower_check(tmp_path, quiet=True) == 0
+    inc = json.loads((tmp_path / "incidents" / "INC-0001.json").read_text())
+    assert inc["resolved_ts"] == 25.0 and inc["duration_seconds"] == 5.0
+    assert replay_alert_states(tmp_path)["replicas-live"]["state"] == "inactive"
+
+
+def test_alert_pending_flap_never_fires(tmp_path):
+    mgr = AlertManager([_gauge_rule(for_seconds=10.0)], tower_dir=tmp_path)
+    store = SeriesStore()
+    store.record("gauge", "router_live_replicas", 10.0, 1.0)
+    mgr.evaluate(store, 10.0)
+    store.record("gauge", "router_live_replicas", 14.0, 2.0)
+    (tr,) = mgr.evaluate(store, 14.0)
+    assert (tr["from"], tr["to"]) == ("pending", "inactive")
+    # a flap that never held for: opens no incident
+    assert not (tmp_path / "incidents").exists()
+
+
+def test_alert_webhook_delivery_and_failure_isolation(tmp_path):
+    sink = tmp_path / "pages.jsonl"
+    hook = tmp_path / "hook.py"
+    hook.write_text(
+        "import sys\n"
+        f"open({str(sink)!r}, 'a').write(sys.argv[1] + '\\n')\n"
+    )
+    store = SeriesStore()
+    store.record("gauge", "router_live_replicas", 10.0, 1.0)
+    mgr = AlertManager([_gauge_rule()], tower_dir=tmp_path,
+                       webhook=[sys.executable, str(hook)])
+    mgr.evaluate(store, 10.0)
+    page = json.loads(sink.read_text().splitlines()[0])
+    assert page["rule"] == "replicas-live" and page["to"] == "pending"
+    # a broken pager must never take the watcher down
+    bad_dir = tmp_path / "b"
+    bad_dir.mkdir()
+    bad = AlertManager([_gauge_rule()], tower_dir=bad_dir,
+                       webhook=["/no-such-pager-cmd"])
+    (tr,) = bad.evaluate(store, 10.0)
+    assert tr["to"] == "pending" and bad.webhook_failures == 1
+
+
+# -- golden tower_run fixture pins ---------------------------------------------
+
+
+def _golden_config():
+    cfg = load_rules(GOLDEN_TOWER / "alerts.json")
+    return {"windows": cfg["windows"],
+            "objectives": [r.objective for r in cfg["rules"]]}
+
+
+def test_golden_alert_timeline():
+    lines = (GOLDEN_TOWER / "alerts.jsonl").read_text().splitlines()
+    seq = [(t["rule"], t["from"], t["to"])
+           for t in map(json.loads, lines)]
+    assert seq == [
+        ("replicas-live", "inactive", "pending"),
+        ("replicas-live", "pending", "firing"),
+        ("replicas-live", "firing", "resolved"),
+    ]
+    # firing held exactly for_seconds after pending; replay lands inactive
+    ts = [json.loads(l)["ts"] for l in lines]
+    assert ts[1] - ts[0] >= 6.0
+    states = replay_alert_states(GOLDEN_TOWER)
+    assert states["replicas-live"]["state"] == "inactive"
+
+
+def test_golden_incident_record():
+    (inc,) = read_incidents(GOLDEN_TOWER)
+    assert inc["id"] == "INC-0001"
+    assert inc["rule"]["name"] == "replicas-live"
+    assert inc["opened_ts"] == T0 + 20.0
+    assert inc["resolved_ts"] == T0 + 25.0
+    assert inc["dead_replicas"] == ["replica1"]
+    assert inc["replica_states"]["replica1"] == "dead"
+    assert [t["to"] for t in inc["replica_transitions"]] == ["suspect", "dead"]
+    # correlation carries ≥1 trace id, sorted slowest-first
+    traces = inc["slowest_traces"]
+    assert traces and traces[0]["latency_ms"] == 61.4
+    assert all(t["trace_id"] for t in traces)
+    lats = [t["latency_ms"] for t in traces]
+    assert lats == sorted(lats, reverse=True)
+    # the SLO verdict snapshot taken at open: the gauge_min objective is
+    # the one failing (that's why the incident opened)
+    slo = inc["slo"]
+    assert slo["verdict"] == "past_budget"
+    failed = [o for o in slo["objectives"] if o["ok"] is False]
+    assert [o["type"] for o in failed] == ["gauge_min"]
+    assert inc["goodput"]["goodput_frac"] == 0.88
+    md = "\n".join(render_incidents([inc]))
+    assert "INC-0001" in md and "replica1" in md and "**OPEN**" not in md
+
+
+def test_golden_evaluate_series_burn_rates():
+    ev = evaluate_series(GOLDEN_TOWER, _golden_config())
+    assert ev["ok"] is True and ev["verdict"] == "within_budget"
+    by_type = {o["type"]: o for o in ev["objectives"]}
+    assert by_type["gauge_min"]["ok"] is True
+    assert by_type["gauge_min"]["measured"] == 2.0
+    # availability over replayed history: quiet window → burn 0.0 (not
+    # None — the window is real, just unspent)
+    avail = by_type["availability"]
+    assert avail["ok"] is True
+    assert avail["burn_rates"]["fast"] == 0.0
+    # THE acceptance pin: fast/slow latency burn is non-None from ≥2
+    # polls of replayed histogram deltas — `--scrape` can never do this
+    lat = by_type["latency"]
+    assert lat["burn_rates"]["fast"] == 0.8264
+    assert lat["burn_rates"]["slow"] == 0.8264
+    assert lat["burn_rates"]["slow_window_covered"] is False
+    assert ev["source"].startswith("series:")
+
+
+def test_golden_state_schema():
+    state = json.loads((GOLDEN_TOWER / "state.json").read_text())
+    assert set(state) == {
+        "ts", "now", "polls", "interval_seconds", "targets", "router",
+        "fleet", "train", "alerts", "firing", "series",
+    }
+    assert state["polls"] == 6 and state["firing"] == []
+    assert state["router"] == {"live_replicas": 2.0, "replicas": 2.0}
+    assert state["train"]["goodput_frac"] == 0.88
+    assert state["series"]["keys"] == 15
+    assert state["series"]["span"] == [T0, T0 + 25.0]
+    router_t = state["targets"]["router"]
+    assert router_t["up"] is True
+    assert {a["rule"] for a in state["alerts"]} == {
+        "replicas-live", "availability", "p99",
+    }
+
+
+def test_golden_fixture_resume_and_pool_state(tmp_path):
+    # a fresh tower resumed over the fixture dir rebuilds the same store
+    work = tmp_path / "tower"
+    shutil.copytree(GOLDEN_TOWER, work)
+    cfg = load_rules(work / "alerts.json")
+    tower = Tower(work, rules=cfg["rules"], windows=cfg["windows"],
+                  telemetry=_NullTel(), resume=True)
+    assert tower.store.n_keys() == 15
+    assert tower.store.span() == (T0, T0 + 25.0)
+    pool = tower.pool_state(now=T0 + 25.0)
+    assert pool["router"]["live_replicas"] == 2.0
+    assert pool["fleet"]["idle_workers"] == 2.0
+    # polling an empty target set still appends a record and re-evaluates
+    rec = tower.poll_once(now=T0 + 30.0)
+    assert rec["transitions"] == []
+    assert len((work / "series.jsonl").read_text().splitlines()) == 7
+    tower.close()
+
+
+def test_tower_check_exit_codes(tmp_path):
+    assert tower_check(GOLDEN_TOWER, quiet=True) == 0
+    # trim the resolved transition → the replayed state is still firing
+    firing = tmp_path / "firing"
+    firing.mkdir()
+    shutil.copy(GOLDEN_TOWER / "series.jsonl", firing / "series.jsonl")
+    lines = (GOLDEN_TOWER / "alerts.jsonl").read_text().splitlines()
+    (firing / "alerts.jsonl").write_text("\n".join(lines[:-1]) + "\n")
+    assert tower_check(firing, quiet=True) == 1
+    # no tower data at all is its own exit code
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tower_check(empty, quiet=True) == 3
+
+
+def test_tower_check_cli(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.tower", "check",
+         str(GOLDEN_TOWER)],
+        capture_output=True, text=True, env=_ENV, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no alert firing" in r.stdout
+
+
+def test_slo_cli_tower(tmp_path):
+    cfg = tmp_path / "slo.json"
+    golden = json.loads((GOLDEN_TOWER / "alerts.json").read_text())
+    cfg.write_text(json.dumps({
+        "windows": golden["windows"],
+        "objectives": [r["objective"] for r in golden["rules"]],
+    }))
+    r = subprocess.run(
+        [sys.executable, "-m", "sparse_coding__tpu.slo",
+         "--tower", str(GOLDEN_TOWER), "--config", str(cfg), "--json"],
+        capture_output=True, text=True, env=_ENV, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    ev = json.loads(r.stdout)
+    lat = [o for o in ev["objectives"] if o["type"] == "latency"][0]
+    assert lat["burn_rates"]["fast"] == 0.8264
+
+
+def test_render_tower_report_and_incidents_section():
+    txt = render_tower_report(GOLDEN_TOWER)
+    assert "INC-0001" in txt and "replicas-live" in txt
+    assert "pending" in txt and "firing" in txt and "resolved" in txt
+    # the run report grows an Incidents section when the directory holds
+    # tower incidents — and stays byte-identical when it doesn't
+    from sparse_coding__tpu.telemetry.report import _incidents_section
+
+    lines = []
+    _incidents_section({"dir": GOLDEN_TOWER}, lines)
+    assert lines[0] == "## Incidents (1)"
+    assert any("INC-0001" in l for l in lines)
+    empty = []
+    _incidents_section({"dir": GOLDEN_TOWER / "incidents"}, empty)
+    assert empty == []
+
+
+# -- monitor --tower -----------------------------------------------------------
+
+
+def test_tower_view_renders_pool(tmp_path):
+    out = tower_render(str(GOLDEN_TOWER), now=T0 + 26.0)
+    assert out.startswith(f"tower {GOLDEN_TOWER}: 6 poll(s)")
+    assert "targets: 3/3 up" in out
+    assert "router: 2/2 replicas live" in out
+    assert "train: goodput 88.0%" in out
+    assert "3 rule(s), none active" in out
+    # a state file whose clock has fallen >3 intervals behind is DOWN
+    # (stale) — a dead tower's last snapshot must not read as live
+    stale = tower_render(str(GOLDEN_TOWER), now=T0 + 1000.0)
+    assert "DOWN (stale)" in stale
+    # unreachable tower: DOWN with last-seen age, never crashes the view
+    view = TowerView(str(tmp_path / "nope"))
+    assert "DOWN" in view.render(now=0.0) and "never seen" in view.render(0.0)
+    dead_url = TowerView("http://127.0.0.1:9")
+    assert "DOWN" in dead_url.render(now=0.0)
+
+
+def test_monitor_cli_tower_once_exit_semantics():
+    from sparse_coding__tpu.telemetry.monitor import main as monitor_main
+
+    # --once exits 0 even when the tower is stale/DOWN: the monitor is a
+    # viewer, not a gate (that's `tower check`) — same contract as --scrape
+    assert monitor_main(["--tower", str(GOLDEN_TOWER), "--once"]) == 0
+
+
+# -- dashboard -----------------------------------------------------------------
+
+
+def test_dashboard_serves_state_html_metrics(tmp_path):
+    from urllib.request import urlopen
+
+    work = tmp_path / "tower"
+    shutil.copytree(GOLDEN_TOWER, work)
+    cfg = load_rules(work / "alerts.json")
+    tower = Tower(work, rules=cfg["rules"], windows=cfg["windows"],
+                  telemetry=_NullTel(), resume=True)
+    tower.poll_once(now=T0 + 30.0)
+    srv = tower.start_dashboard()
+    try:
+        with urlopen(f"{srv.address}/state.json", timeout=5) as r:
+            state = json.loads(r.read().decode())
+        assert state["polls"] == 1  # a resumed tower's own poll count
+        assert set(state) >= {"ts", "targets", "alerts", "firing", "series"}
+        with urlopen(srv.address + "/", timeout=5) as r:
+            html = r.read().decode()
+        assert "<html" in html and "state.json" in html
+    finally:
+        tower.close()
+
+
+# -- chaos acceptance ----------------------------------------------------------
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_tower_kill_alert_incident_resolve_chaos(tmp_path):
+    """THE ISSUE-18 acceptance. Router + 2 subprocess replicas under
+    closed-loop load with the tower watching:
+
+    1. SIGKILL one replica mid-flight → the availability rule
+       (``gauge_min`` on ``router.live_replicas``) goes
+       pending→firing once the breach holds ``for_seconds``; the
+       incident names the dead replica and carries ≥1 correlated trace
+       id plus the SLO verdict; ``tower check`` exits 1;
+    2. the supervisor restarts the replica → the alert resolves, the
+       incident is stamped, ``tower check`` exits 0;
+    3. `evaluate_series` over ≥2 polls of scraped history yields a
+       non-None slow-burn for the serve latency objective.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparse_coding__tpu.models.learned_dict import TiedSAE
+    from sparse_coding__tpu.serve.replicaset import ReplicaSet
+    from sparse_coding__tpu.serve.router import (
+        Router,
+        RouterClient,
+        ShedRejection,
+    )
+    from sparse_coding__tpu.serve.server import RetryableRejection
+    from sparse_coding__tpu.telemetry import RunTelemetry
+    from sparse_coding__tpu.train.checkpoint import save_learned_dicts
+
+    rng = np.random.default_rng(0)
+    lds = [
+        TiedSAE(
+            jnp.asarray(rng.standard_normal((64, 16), dtype=np.float32)),
+            jnp.asarray(rng.standard_normal(64, dtype=np.float32) * 0.1),
+        )
+        for _ in range(2)
+    ]
+    export = tmp_path / "learned_dicts.pkl"
+    save_learned_dicts(export, [(ld, {}) for ld in lds])
+    X = rng.standard_normal((3, 16)).astype(np.float32)
+
+    run_dir = tmp_path / "tier"
+    tower_dir = tmp_path / "tower"
+    router_tel = RunTelemetry(out_dir=run_dir, run_name="router",
+                              file_name="router_events.jsonl")
+    rs_tel = RunTelemetry(out_dir=run_dir, run_name="replicaset",
+                          file_name="replicaset_events.jsonl")
+    router = Router(
+        telemetry=router_tel, health_interval=0.25, dead_after=2,
+        max_attempts=4, retry_backoff=0.05, request_deadline=60.0,
+        attempt_timeout=30.0, snapshot_every=8,
+    )
+    rs = ReplicaSet(
+        [str(export)], n_replicas=2, run_dir=run_dir, router=router,
+        telemetry=rs_tel, max_batch=64, max_wait_ms=5.0,
+        backoff_base=0.2, backoff_max=2.0, poll_interval=0.1,
+        ready_timeout=180.0, env={"JAX_PLATFORMS": "cpu"},
+    )
+    rules = [
+        _gauge_rule(for_seconds=0.5),
+        AlertRule({
+            "name": "p99", "for_seconds": 5.0, "severity": "ticket",
+            "objective": {"type": "latency", "percentile": 0.99,
+                          "threshold_ms": 60000.0},
+        }),
+    ]
+    windows = {"fast_burn_seconds": 30.0, "slow_burn_seconds": 120.0}
+    outcomes = {"ok": 0, "bad": []}
+    lock = threading.Lock()
+    stop_clients = threading.Event()
+    transitions = []
+    tower = None
+
+    def client_loop(cid: int):
+        client = RouterClient(router.address, timeout=60)
+        i = 0
+        while not stop_clients.is_set():
+            i += 1
+            try:
+                client.encode_with_meta(f"learned_dicts:{(cid + i) % 2}", X)
+            except (ShedRejection, RetryableRejection):
+                time.sleep(0.05)
+                continue
+            except Exception as e:
+                with lock:
+                    outcomes["bad"].append(repr(e))
+                continue
+            with lock:
+                outcomes["ok"] += 1
+            time.sleep(0.02)
+
+    def pump(pred, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rec = tower.poll_once()
+            transitions.extend(rec["transitions"])
+            if pred():
+                return True
+            time.sleep(0.25)
+        return False
+
+    def seq(rule):
+        return [(t["from"], t["to"]) for t in transitions
+                if t["rule"] == rule]
+
+    try:
+        rs.start()
+        router.start()
+        tower = Tower(
+            tower_dir,
+            targets=[{"url": router.address, "label": "router"}],
+            replicasets=[run_dir], run_dirs=[run_dir],
+            rules=rules, windows=windows, interval=0.25,
+            scrape_timeout=2.0,
+        )
+        threads = [
+            threading.Thread(target=client_loop, args=(c,)) for c in range(3)
+        ]
+        for t in threads:
+            t.start()
+
+        # healthy steady state: both replicas scraped live, traffic has
+        # produced correlated traces, and ≥2 polls of history exist
+        assert pump(
+            lambda: (
+                tower.polls >= 3
+                and tower.store.gauges_latest().get(
+                    "router_live_replicas") == 2.0
+                and len(tower.traces) > 0
+                and outcomes["ok"] >= 8
+            ),
+            timeout=90.0,
+        ), (
+            f"steady state never reached: polls={tower.polls} "
+            f"gauges={tower.store.gauges_latest()} ok={outcomes['ok']}"
+        )
+        assert "replicas-live" not in tower.alerts.firing()
+
+        # acceptance: the latency slow-burn is non-None from scraped
+        # history — the thing single-snapshot --scrape cannot compute
+        ev = evaluate_series(tower.store, {
+            "windows": windows,
+            "objectives": [{"type": "latency", "percentile": 0.99,
+                            "threshold_ms": 60000.0}],
+        })
+        lat = ev["objectives"][0]
+        assert lat["burn_rates"] is not None
+        assert lat["burn_rates"]["slow"] is not None
+
+        # -- SIGKILL one replica with the tower watching -------------------
+        victim_pid = rs.replicas[1].proc.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        assert pump(
+            lambda: "replicas-live" in tower.alerts.firing(), timeout=30.0
+        ), f"alert never fired: {seq('replicas-live')}"
+        assert seq("replicas-live")[:2] == [
+            ("inactive", "pending"), ("pending", "firing"),
+        ]
+        pend, fire = [
+            t for t in transitions if t["rule"] == "replicas-live"
+        ][:2]
+        assert fire["ts"] - pend["ts"] >= 0.5  # for: hysteresis was real
+        assert tower_check(tower_dir, quiet=True) == 1
+
+        inc = read_incidents(tower_dir)[-1]
+        assert inc["resolved_ts"] is None
+        assert "replica1" in inc["dead_replicas"], inc["replica_states"]
+        assert inc["slowest_traces"] and all(
+            t["trace_id"] for t in inc["slowest_traces"]
+        )
+        assert inc["slo"]["verdict"] == "past_budget"
+
+        # -- supervisor restart resolves the alert -------------------------
+        assert pump(
+            lambda: ("resolved" in {x[1] for x in seq("replicas-live")}),
+            timeout=200.0,
+        ), (
+            f"alert never resolved: {seq('replicas-live')} "
+            f"router={router.states()} rs={rs.states()}"
+        )
+        assert "replicas-live" not in tower.alerts.firing()
+        assert tower_check(tower_dir, quiet=True) == 0
+        inc = read_incidents(tower_dir)[-1]
+        assert inc["resolved_ts"] is not None
+        assert inc["duration_seconds"] >= 0.5
+        assert replay_alert_states(tower_dir)[
+            "replicas-live"]["state"] == "inactive"
+    finally:
+        stop_clients.set()
+        for t in threads:
+            t.join(60)
+        rs.stop()
+        router.stop()
+        if tower is not None:
+            tower.close()
+        router_tel.close()
+        rs_tel.close()
+
+    with lock:
+        assert outcomes["bad"] == [], outcomes["bad"]
+
+    # the watcher accounted its own cost: tower_poll badput spans landed
+    spans = [
+        json.loads(l)
+        for l in (tower_dir / "tower_events.jsonl").read_text().splitlines()
+        if '"span"' in l
+    ]
+    polls = [s for s in spans
+             if s.get("event") == "span" and s.get("category") == "tower_poll"]
+    assert len(polls) == tower.polls
