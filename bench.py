@@ -989,6 +989,75 @@ def prep_tower(stack):
     return measure
 
 
+def prep_lineage(stack):
+    """Provenance graph build throughput (ISSUE 19): artifact nodes fully
+    reconstructed per second by `telemetry.provenance.build_graph` over a
+    realistic estate — a 200-chunk store (committed manifests + cursor),
+    a training run with events, a checkpoint, and a manifested export.
+    `lineage check` runs in CI (`scripts/check.sh`) and the tower folds
+    taint lists into incident context at alert time, so graph
+    reconstruction must stay cheap at fleet scale; perfdiff gates this
+    key like any runtime key. Host-side stdlib JSON work, chip-
+    independent — same class as `slo_eval_runs_per_sec`."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    from sparse_coding__tpu.telemetry.provenance import build_graph
+
+    d = Path(tempfile.mkdtemp(prefix="bench_lineage_"))
+    stack.callback(lambda: shutil.rmtree(d, ignore_errors=True))
+    store = d / "store"
+    store.mkdir()
+    n_chunks = 200
+    for i in range(n_chunks):
+        (store / f"sc_chunk.{i}.json").write_text(_json.dumps({
+            "format": 1, "created_at": 1.0 + i, "rows": 4096,
+            "files": {f"{i}.npy": {"bytes": 1 << 20,
+                                   "sha256": f"{i:064x}"}},
+        }))
+    (store / "sc_harvest_cursor.json").write_text(_json.dumps({
+        "format": 1, "chunk": n_chunks, "batch_cursor": 0,
+        "config_sha": "bench0bench0bench", "updated_at": 1.0,
+    }))
+    run = d / "run"
+    run.mkdir()
+    with open(run / "events.jsonl", "w") as f:
+        f.write(_json.dumps({
+            "seq": 1, "ts": 1.0, "event": "run_start",
+            "run_name": "bench_lineage",
+            "config": {"dataset_folder": "../store", "l1_values": [1e-3]},
+            "fingerprint": {"git_sha": "bench", "backend": "cpu"},
+        }) + "\n")
+        f.write(_json.dumps({
+            "seq": 2, "ts": 2.0, "event": "resume", "checkpoint": "ckpt_0",
+        }) + "\n")
+    ckpt = run / "ckpt_0"
+    ckpt.mkdir()
+    (ckpt / "sc_manifest.json").write_text(_json.dumps({
+        "format": 1, "created_at": 2.0,
+        "files": {"tree.npz": {"bytes": 64, "sha256": "c" * 64}},
+    }))
+    (run / "learned_dicts.pkl.manifest.json").write_text(_json.dumps({
+        "format": 1, "created_at": 3.0,
+        "files": {"learned_dicts.pkl": {"bytes": 64, "sha256": "d" * 64}},
+    }))
+
+    g = build_graph([d])  # warm + correctness gate
+    n_nodes = len(g.nodes)
+    assert n_nodes >= n_chunks + 4, f"bench graph too small: {n_nodes}"
+    assert not g.tainted(), "bench estate must build untainted"
+
+    def measure() -> float:
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            build_graph([d])
+        return reps * n_nodes / (time.perf_counter() - t0)
+
+    return measure
+
+
 def prep_tower_overhead(stack, telemetry=None):
     """The watched-vs-unwatched serve twin (ISSUE 18): the SAME closed-loop
     HTTP encode load against one replica, measured with a control tower
@@ -1229,6 +1298,7 @@ def main(argv=None):
             "slo_eval_runs_per_sec": prep_slo_eval(stack),
             "sclint_files_per_sec": prep_sclint(stack),
             "tower_scrape_targets_per_sec": prep_tower(stack),
+            "lineage_nodes_per_sec": prep_lineage(stack),
         }
         watched_measure = prep_tower_overhead(stack, telemetry=telemetry)
         benches["serve_watched_rows_per_sec"] = watched_measure

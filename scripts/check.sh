@@ -29,6 +29,10 @@ echo "== tower check (alert gate over the golden tower fixture) =="
 JAX_PLATFORMS=cpu python -m sparse_coding__tpu.tower check \
     tests/golden/tower_run || exit $?
 
+echo "== lineage check (taint gate over the golden lineage fixture) =="
+JAX_PLATFORMS=cpu python -m sparse_coding__tpu.lineage check \
+    tests/golden/lineage_run || exit $?
+
 if [ "$fast" = "1" ]; then
     echo "== tier-1 tests skipped (--fast) =="
     exit 0

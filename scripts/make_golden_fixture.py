@@ -878,6 +878,14 @@ def make_bench_fixture():
         "serve_unwatched_rows_per_sec_spread": [420.0, 470.0],
         "tower": {"overhead_frac": 0.0112, "watch_hz": 20.0,
                   "scrape_targets": 4},
+        # ISSUE-19 provenance guard (host-side, chip-independent; measured
+        # on this repo's CPU CI box). Artifact nodes reconstructed per
+        # second by telemetry.provenance.build_graph over a 200-chunk
+        # store + run + checkpoint + export estate — `lineage check` runs
+        # in check.sh/CI and the tower folds taint lists into incident
+        # context, so graph reconstruction must stay cheap at fleet scale.
+        "lineage_nodes_per_sec": 3600.0,
+        "lineage_nodes_per_sec_spread": [3100.0, 4100.0],
     }
     with open(BENCH_FIXTURE, "w") as f:
         json.dump(bench, f, indent=1)
@@ -1672,7 +1680,150 @@ def make_tower_run_fixture():
           f"alerts.json(l), incidents/INC-0001.json, state.json, tower.json)")
 
 
+LINEAGE_RUN_DIR = REPO / "tests" / "golden" / "lineage_run"
+LINEAGE_BASE_TS = 1_754_800_000.0  # fixed: the fixture must regenerate identically
+LINEAGE_TRACE = "feed5eedfeed5eedfeed5eedfeed5eed"  # fixed, readable trace id
+
+
+def make_lineage_run_fixture():
+    """Deterministic LEGACY provenance tree (ISSUE 19): store + run +
+    serve dirs whose manifests and events predate the ``provenance``
+    event vocabulary — the graph must reconstruct the full chain
+    (traced response → serve generation → dict → export → checkpoint →
+    training run → chunk store → harvest config) from committed
+    manifests alone. Everything is hand-stamped / re-stamped to
+    LINEAGE_BASE_TS so the tree is byte-stable; the pinned
+    ``expected_*`` files capture `lineage explain/blast/check` stdout,
+    which `tests/test_lineage.py` re-runs byte-for-byte in tier-1."""
+    import contextlib
+    import io
+    import json as _json
+    import shutil
+
+    import numpy as np
+
+    from sparse_coding__tpu.data import integrity
+    from sparse_coding__tpu.data.chunks import save_chunk
+    from sparse_coding__tpu.telemetry import provenance
+    from sparse_coding__tpu.utils.manifest import write_manifest
+
+    if LINEAGE_RUN_DIR.exists():
+        shutil.rmtree(LINEAGE_RUN_DIR)
+    t = LINEAGE_BASE_TS
+
+    # -- store/: three real committed chunks + the harvest cursor ----------
+    store = LINEAGE_RUN_DIR / "store"
+    store.mkdir(parents=True)
+    rng = np.random.default_rng(19)
+    harvest_config = {
+        "model_name": "pythia-70m", "layers": [2], "locations": ["residual"],
+        "activation_width": 64, "chunk_size": 64, "center_dataset": False,
+    }
+    harvest_sha = provenance.config_digest(harvest_config)
+    for i in range(3):
+        save_chunk(store, i, rng.standard_normal((64, 16)).astype(np.float32))
+        mp = integrity.chunk_manifest_path(store, i)
+        man = _json.loads(mp.read_text())
+        man["created_at"] = t
+        mp.write_text(_json.dumps(man))
+    integrity.write_json_atomic(store / "sc_harvest_cursor.json", {
+        "format": 1, "chunk": 3, "batch_cursor": 0,
+        "config_sha": harvest_sha, "updated_at": t,
+    })
+
+    # -- run/: events + a committed checkpoint + a LEGACY export -----------
+    run = LINEAGE_RUN_DIR / "run"
+    ckpt = run / "ckpt_0"
+    ckpt.mkdir(parents=True)
+    (ckpt / "tree.npz").write_bytes(b"golden-lineage-checkpoint-tree-v1\n")
+    write_manifest(ckpt / "sc_manifest.json", {"tree.npz": ckpt / "tree.npz"},
+                   extra={"epoch": 0, "position": 3})
+    pkl = run / "learned_dicts.pkl"
+    pkl.write_bytes(b"golden-lineage-export-pkl-v1\n")
+    # legacy sidecar: digests only, NO producer-identity block
+    write_manifest(pkl.with_name(pkl.name + ".manifest.json"),
+                   {pkl.name: pkl})
+    for mp in (ckpt / "sc_manifest.json",
+               pkl.with_name(pkl.name + ".manifest.json")):
+        man = _json.loads(mp.read_text())
+        man["created_at"] = t
+        mp.write_text(_json.dumps(man))
+
+    seq = 0
+    ts = t
+
+    def rec(event, dt=1.0, **fields):
+        nonlocal seq, ts
+        seq += 1
+        ts += dt
+        return {"seq": seq, "ts": round(ts, 3), "event": event, **fields}
+
+    fingerprint = {"python": "3.11.8", "jax": "0.6.0", "backend": "cpu",
+                   "device_kind": "golden-cpu", "device_count": 1,
+                   "git_sha": "g0lden"}
+    train_events = [
+        rec("run_start", run_name="lineage_train",
+            config={"dataset_folder": "../store", "l1_values": [1e-3],
+                    "outer_epochs": 1},
+            fingerprint=fingerprint),
+        rec("resume", checkpoint="ckpt_0",
+            cursor={"chunk": 1, "epoch": 0, "position": 1}),
+        rec("run_end", dt=40.0, status="ok", steps=24, wall_seconds=41.0),
+    ]
+    with open(run / "events.jsonl", "w") as f:
+        for e in train_events:
+            f.write(_json.dumps(e) + "\n")
+
+    # -- serve/: legacy registry events (no generation field) + a trace ----
+    serve = LINEAGE_RUN_DIR / "serve"
+    serve.mkdir(parents=True)
+    seq, ts = 0, t + 100.0
+    serve_events = [
+        rec("run_start", run_name="lineage_serve", config={"port": 0},
+            fingerprint=fingerprint),
+        rec("serve_dict_added", dict="d0",
+            source="../run/learned_dicts.pkl", weights=1.0),
+        rec("request_trace", dt=2.0, trace_id=LINEAGE_TRACE, dict="d0",
+            ts_start=ts + 2.0, latency_ms=4.2, status=200),
+        rec("run_end", dt=1.0, status="ok"),
+    ]
+    with open(serve / "events.jsonl", "w") as f:
+        for e in serve_events:
+            f.write(_json.dumps(e) + "\n")
+
+    # -- pin the CLI outputs (what tier-1 re-runs byte-for-byte) -----------
+    def capture(argv):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = provenance.main(argv)
+        return code, buf.getvalue()
+
+    root = str(LINEAGE_RUN_DIR)
+    pins = {
+        "expected_explain.md": (0, ["explain", LINEAGE_TRACE, root]),
+        "expected_blast.md": (0, ["blast", "chunk:store#0", root]),
+        "expected_check.txt": (0, ["check", root]),
+    }
+    for name, (want_code, argv) in pins.items():
+        code, out = capture(argv)
+        assert code == want_code, f"{argv}: exit {code} != {want_code}\n{out}"
+        (LINEAGE_RUN_DIR / name).write_text(out)
+
+    # the explain chain must reach every layer from the trace id alone
+    explain = (LINEAGE_RUN_DIR / "expected_explain.md").read_text()
+    for needle in (f"response:{LINEAGE_TRACE}", "generation:serve#1",
+                   "dict:serve#d0", "export:run/learned_dicts.pkl",
+                   "checkpoint:run/ckpt_0", "run:run", "store:store",
+                   "chunk:store#0", f"harvest:{harvest_sha}"):
+        assert needle in explain, f"explain chain missing {needle}"
+    print(f"Wrote {LINEAGE_RUN_DIR}/ (store x3 chunks, run + ckpt_0 + "
+          "legacy export, serve events, expected_explain/blast/check pins)")
+
+
 def main():
+    if "--lineage-run" in sys.argv:
+        make_lineage_run_fixture()
+        return
     if "--tower-run" in sys.argv:
         make_tower_run_fixture()
         return

@@ -483,6 +483,7 @@ def make_activation_dataset(
         # during init_model_dataset. The capture forward is the harvest's
         # productive window, the chunk-pair commit its checkpoint badput.
         # No live telemetry → two clock reads.
+        from sparse_coding__tpu.telemetry.events import event_active
         from sparse_coding__tpu.telemetry.spans import ACTIVE, span as _span
 
         # 1-deep pipeline: dispatch the next forward before fetching the
@@ -517,6 +518,14 @@ def make_activation_dataset(
                             "centered": bool(center_dataset),
                         }
                     },
+                )
+                # lineage commit-point event (ISSUE 19): broadcast like the
+                # spans above — joins the chunk to its harvest config in
+                # whatever run's event log is live (no-op handle-less)
+                event_active(
+                    "provenance", artifact="chunk",
+                    store=str(folders[key]), chunk=int(chunk_idx),
+                    config_sha=config_sha,
                 )
             batch_cursor += batches_per_chunk
             chunk_idx += 1

@@ -325,6 +325,12 @@ class WorkQueue:
         item["lineage"][-1].update(outcome="done", completed_ts=time.time())
         if result:
             item["result"] = result
+            # the export manifest's content digest (ISSUE 19): recorded in
+            # the lineage entry itself so the provenance graph joins this
+            # item to its export by digest even if `result` is later
+            # rewritten by a requeue_done round trip
+            if result.get("export_digest"):
+                item["lineage"][-1]["export_digest"] = result["export_digest"]
         _write_json(src, item)
         os.replace(src, self._item_path("done", item_id))
         self._lease_path(item_id).unlink(missing_ok=True)

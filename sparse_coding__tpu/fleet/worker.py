@@ -52,7 +52,11 @@ from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from sparse_coding__tpu.fleet.queue import LeaseLost, WorkQueue
-from sparse_coding__tpu.utils.manifest import verify_manifest, write_manifest
+from sparse_coding__tpu.utils.manifest import (
+    sha256_file,
+    verify_manifest,
+    write_manifest,
+)
 
 __all__ = [
     "FleetWorker",
@@ -74,15 +78,17 @@ def _export_files(run_dir: Path) -> List[Path]:
     return sorted(run_dir.rglob("learned_dicts.pkl"))
 
 
-def write_export_manifest(run_dir) -> Path:
+def write_export_manifest(run_dir, extra: Optional[Dict[str, Any]] = None) -> Path:
     """Hash every learned-dict export under the run dir into
     ``export_manifest.json`` (per-file bytes + sha256, committed atomically
     by `utils.manifest.write_manifest`). The manifest is what turns "the
     driver returned" into "the member's dict is provably on disk" —
-    completion requires it to verify."""
+    completion requires it to verify. ``extra`` merges additional top-level
+    keys (e.g. the ISSUE-19 ``provenance`` producer-identity block) —
+    backward compatible: digest-only readers ignore them."""
     run_dir = Path(run_dir)
     files = {str(p.relative_to(run_dir)): p for p in _export_files(run_dir)}
-    return write_manifest(run_dir / EXPORT_MANIFEST, files)
+    return write_manifest(run_dir / EXPORT_MANIFEST, files, extra=extra)
 
 
 def verify_export(run_dir) -> Tuple[bool, str]:
@@ -461,9 +467,19 @@ class FleetWorker:
             return "lease_lost"
         from sparse_coding__tpu.telemetry.spans import span as _span
 
+        from sparse_coding__tpu.telemetry.events import run_fingerprint
+        from sparse_coding__tpu.telemetry.provenance import producer_identity
+
         with _span(self.telemetry, "export_verify", name="export_verify",
                    item=item_id):
-            write_export_manifest(run_dir)
+            manifest_path = write_export_manifest(
+                run_dir,
+                extra={"provenance": producer_identity(
+                    config=item.get("payload"),
+                    fingerprint=run_fingerprint(),
+                    run_dir=str(run_dir),
+                )},
+            )
             ok, reason = verify_export(run_dir)
         if not ok:
             try:
@@ -478,9 +494,16 @@ class FleetWorker:
                         requeued_to=bucket)
             return "failed"
         try:
+            # the manifest-bytes digest is the item's lineage join key
+            # (ISSUE 19 satellite): `queue.complete` copies it into the
+            # item's lineage entry, so fleet-trained dicts join the
+            # provenance graph by digest instead of path guessing
             self.queue.complete(
                 item_id, self.worker_id,
-                result={"export_manifest": EXPORT_MANIFEST, "verified": True},
+                result={
+                    "export_manifest": EXPORT_MANIFEST, "verified": True,
+                    "export_digest": sha256_file(manifest_path),
+                },
             )
         except LeaseLost:
             self._event("lease_lost", item=item_id)

@@ -1,0 +1,28 @@
+"""CLI shim: ``python -m sparse_coding__tpu.scrub <store> [--repair CFG]``.
+
+Offline chunk-store integrity scrub: re-verifies every committed chunk
+at the digest tier, quarantines failures, and (``--repair``) re-harvests
+exact missing indices from a repair config. Exit 1 while unrepaired loss
+remains — the dataplane's CI gate, and the producer of the quarantine
+ledgers `python -m sparse_coding__tpu.lineage` reads as taint sources.
+Implementation: `sparse_coding__tpu.data.scrub` (docs/DATAPLANE.md).
+"""
+
+from sparse_coding__tpu.data.scrub import (
+    main,
+    render_scrub_markdown,
+    repair_from_config,
+    scrub_store,
+    store_loss,
+)
+
+__all__ = [
+    "main",
+    "render_scrub_markdown",
+    "repair_from_config",
+    "scrub_store",
+    "store_loss",
+]
+
+if __name__ == "__main__":
+    raise SystemExit(main())

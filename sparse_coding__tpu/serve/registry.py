@@ -205,6 +205,10 @@ class DictRegistry:
         self._lock = threading.Lock()
         self._dicts: Dict[str, ServedDict] = {}
         self._subjects: Dict[str, SubjectLM] = {}
+        # dict id → export-manifest content digest (ISSUE 19): the lineage
+        # join key `load_export` records and `provenance_digest()` folds
+        # into the X-Dict-Provenance response header
+        self._manifest_digests: Dict[str, Optional[str]] = {}
         self.generation = 0
 
     def __len__(self) -> int:
@@ -218,9 +222,13 @@ class DictRegistry:
     # -- mutation --------------------------------------------------------------
 
     def add(self, dict_id: str, ld, hyperparams=None, source=None,
-            weights: str = "native") -> ServedDict:
+            weights: str = "native",
+            manifest_digest: Optional[str] = None) -> ServedDict:
         """Register a new dictionary. Raises on an already-taken id — use
-        `swap` for replacement so accidental double-adds stay loud."""
+        `swap` for replacement so accidental double-adds stay loud.
+        ``manifest_digest`` (ISSUE 19) is the export's manifest content
+        digest — the lineage join key stamped into the registry's
+        ``serve_dict_added`` event and `provenance_digest()`."""
         entry = ServedDict(dict_id, ld, hyperparams=hyperparams,
                            source=source, weights=weights)
         with self._lock:
@@ -230,13 +238,17 @@ class DictRegistry:
                     "(use swap to replace it)"
                 )
             self._dicts[entry.dict_id] = entry
+            self._manifest_digests[entry.dict_id] = manifest_digest
             self.generation += 1
+            gen = self.generation
         self._event("serve_dict_added", dict=entry.dict_id,
-                    weights=weights, source=entry.source)
+                    weights=weights, source=entry.source,
+                    generation=gen, manifest_digest=manifest_digest)
         return entry
 
     def swap(self, dict_id: str, ld, hyperparams=None, source=None,
-             weights: str = "native") -> ServedDict:
+             weights: str = "native",
+             manifest_digest: Optional[str] = None) -> ServedDict:
         """Atomically replace an existing dictionary (hot swap): requests
         drained after the swap encode through the new weights; batches
         in flight finish on the stack they started with."""
@@ -246,9 +258,12 @@ class DictRegistry:
             if entry.dict_id not in self._dicts:
                 raise KeyError(f"dict id {entry.dict_id!r} not registered")
             self._dicts[entry.dict_id] = entry
+            self._manifest_digests[entry.dict_id] = manifest_digest
             self.generation += 1
+            gen = self.generation
         self._event("serve_dict_swapped", dict=entry.dict_id,
-                    weights=weights, source=entry.source)
+                    weights=weights, source=entry.source,
+                    generation=gen, manifest_digest=manifest_digest)
         return entry
 
     def remove(self, dict_id: str) -> None:
@@ -256,8 +271,28 @@ class DictRegistry:
             if dict_id not in self._dicts:
                 raise KeyError(f"dict id {dict_id!r} not registered")
             del self._dicts[dict_id]
+            self._manifest_digests.pop(dict_id, None)
             self.generation += 1
-        self._event("serve_dict_removed", dict=dict_id)
+            gen = self.generation
+        self._event("serve_dict_removed", dict=dict_id, generation=gen)
+
+    def provenance_digest(self) -> Optional[str]:
+        """One short digest over the sorted (dict id, export-manifest
+        digest) pairs of everything currently registered — the
+        ``X-Dict-Provenance`` response header value. Changes exactly when
+        the served dict set (or any member's bytes) changes; None while
+        the registry is empty. `lineage explain` resolves it back to the
+        serving generation via the registry's event log."""
+        from sparse_coding__tpu.telemetry.provenance import config_digest
+
+        with self._lock:
+            if not self._dicts:
+                return None
+            pairs = sorted(
+                (did, self._manifest_digests.get(did))
+                for did in self._dicts
+            )
+        return config_digest(pairs)[:12]
 
     # -- subject LMs (harvest→encode fusion) -----------------------------------
 
@@ -437,6 +472,9 @@ class DictRegistry:
             raise ValueError(
                 f"export ids already registered or duplicated: {sorted(set(taken))}"
             )
+        from sparse_coding__tpu.telemetry.provenance import export_digest
+
         for did, (pkl, _within, ld, hp) in zip(planned, loaded):
-            self.add(did, ld, hyperparams=hp, source=pkl, weights=weights)
+            self.add(did, ld, hyperparams=hp, source=pkl, weights=weights,
+                     manifest_digest=export_digest(pkl))
         return planned

@@ -199,6 +199,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         for k, v in (trace_headers or {}).items():
             self.send_header(k, v)
+        prov = srv.registry.provenance_digest()
+        if prov:
+            self.send_header("X-Dict-Provenance", prov)
         self.end_headers()
         self.wfile.write(body)
         srv.note_wire(self.path, fmt_in, fmt_out, len(raw), len(body),
@@ -394,6 +397,7 @@ class ServeServer:
             "latency_p50_ms": round(lat["p50_ms"], 3),
             "latency_p99_ms": round(lat["p99_ms"], 3),
             "subjects": self.registry.subjects(),
+            "dict_provenance": self.registry.provenance_digest(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id

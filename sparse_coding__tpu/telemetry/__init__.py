@@ -45,6 +45,12 @@ Eight pieces (docs/observability.md):
                   and serves a live dashboard + the `Tower.pool_state()`
                   autoscaler sensor; CLI: `python -m
                   sparse_coding__tpu.tower run|report|check`
+  - `provenance` — end-to-end artifact lineage: a typed provenance graph
+                  (harvest chunks → checkpoints → exports → serve
+                  generations → traced responses) reconstructed from
+                  manifests + run events, with taint/blast-radius
+                  analysis and digest re-verification; CLI: `python -m
+                  sparse_coding__tpu.lineage explain|blast|check|graph`
 """
 
 from sparse_coding__tpu.telemetry.anomaly import AnomalyAbort, AnomalyGuard, AnomalyPolicy

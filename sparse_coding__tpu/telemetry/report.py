@@ -1130,6 +1130,31 @@ def _incidents_section(run, lines: List[str]):
     lines.append("")
 
 
+def _provenance_section(run, lines: List[str]):
+    """Artifact lineage (ISSUE 19): build the provenance graph over the
+    reported directory and render the node/edge census plus any tainted
+    artifacts with their blast radius. Omitted entirely when the graph
+    holds nothing beyond the run's own event stream — report output is a
+    stability contract."""
+    from sparse_coding__tpu.telemetry.provenance import (
+        build_graph,
+        render_summary,
+    )
+
+    try:
+        graph = build_graph([run["dir"]])
+    except Exception:
+        return
+    if not any(
+        n["type"] != "training-run" for n in graph.nodes.values()
+    ):
+        return
+    lines.append("## Provenance")
+    lines.append("")
+    lines.extend(render_summary(graph))
+    lines.append("")
+
+
 def render_markdown(run: Dict[str, Any]) -> str:
     lines: List[str] = [f"# Run report — `{run['dir']}`", ""]
     lines.append(
@@ -1147,6 +1172,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _router_section(run, lines)
     _slo_section(run, lines)
     _incidents_section(run, lines)
+    _provenance_section(run, lines)
     _data_section(run, lines)
     _compile_section(run, lines)
     _perf_section(run, lines)

@@ -86,6 +86,8 @@ BADPUT_CATEGORIES = (
                       # device_get + npz write per window (telemetry.feature_stats)
     "tower_poll",     # control tower: one scrape+aggregate+alert cycle over
                       # the pool (telemetry.tower) — the watcher's own cost
+    "lineage_verify",  # provenance graph digest re-verification sweep
+                       # (telemetry.provenance — lineage explain/check)
 )
 # derived-only badput: reconstructed by telemetry.goodput from event
 # adjacency, never emitted as live spans

@@ -930,7 +930,29 @@ class Tower:
                 "goodput_frac": _goodput_frac(self.span_seconds),
             },
             "pool_state": self.pool_state(now),
+            "tainted_artifacts": self._tainted_artifacts(),
         }
+
+    def _tainted_artifacts(self) -> List[Dict[str, Any]]:
+        """Quarantined-artifact lineage for incident timelines: build the
+        provenance graph over the tower's run dirs and list every tainted
+        node with its downstream blast size (docs/observability.md §12).
+        Best-effort — a torn manifest must never block incident opening."""
+        if not self.run_dirs:
+            return []
+        try:
+            from sparse_coding__tpu.telemetry.provenance import build_graph
+            graph = build_graph([p for p in self.run_dirs if p.exists()])
+            out = []
+            for node in graph.tainted():
+                out.append({
+                    "id": node["id"],
+                    "reason": node.get("taint_reason"),
+                    "downstream": len(graph.closure(node["id"], "down")),
+                })
+            return out[:10]
+        except Exception:
+            return []
 
     # -- the autoscaler sensor contract ---------------------------------------
 
@@ -1365,6 +1387,14 @@ def render_incidents(incidents: List[Dict[str, Any]]) -> List[str]:
                 lines.append(
                     f"    - {t.get('replica')}: {t.get('from')} → {t.get('to')}"
                     + (f" ({t['reason']})" if t.get("reason") else "")
+                )
+        tainted = inc.get("tainted_artifacts") or []
+        if tainted:
+            lines.append("- tainted artifacts at open:")
+            for t in tainted:
+                lines.append(
+                    f"    - `{t.get('id')}` — {t.get('reason', '?')}"
+                    f" ({t.get('downstream', 0)} downstream)"
                 )
     return lines
 

@@ -32,7 +32,13 @@ from sparse_coding__tpu.telemetry import (
     record_hbm_watermarks,
     span,
 )
+from sparse_coding__tpu.telemetry.events import run_fingerprint
 from sparse_coding__tpu.telemetry.feature_stats import flush_ensemble_feature_stats
+from sparse_coding__tpu.telemetry.provenance import (
+    checkpoint_digest,
+    export_digest,
+    producer_identity,
+)
 from sparse_coding__tpu.train import checkpoint as ckpt_lib
 from sparse_coding__tpu.train.checkpoint import save_learned_dicts
 from sparse_coding__tpu.train.loop import DriverCheckpointer, ensemble_train_loop
@@ -142,6 +148,27 @@ def basic_l1_sweep(
         out_dir=output_folder, run_name="basic_l1_sweep", config=run_config,
     )
     telemetry.run_start()
+    # producer identity (ISSUE 19): stamped into checkpoint manifests and
+    # export sidecars, and echoed as `provenance` events at each commit
+    # point, so the lineage graph joins artifacts by config digest rather
+    # than by directory archaeology
+    run_ident = producer_identity(
+        config=run_config, fingerprint=run_fingerprint(), run_dir=output_folder,
+    )
+
+    def _emit_export_provenance(path):
+        latest = ckpt_lib.latest_checkpoint(output_folder)
+        inputs = [{"kind": "store", "path": str(dataset_folder)}]
+        if latest is not None:
+            inputs.append({
+                "kind": "checkpoint", "path": str(latest),
+                "digest": checkpoint_digest(latest),
+            })
+        telemetry.event(
+            "provenance", artifact="export", path=str(path),
+            digest=export_digest(path),
+            config_sha=run_ident.get("config_sha"), inputs=inputs,
+        )
     # pod runs: hosts disagreeing on config/environment is a hard anomaly,
     # caught before any training is wasted (no-op single-host)
     check_desync(telemetry, config=run_config)
@@ -309,10 +336,13 @@ def basic_l1_sweep(
                     # enumerate counter, `basic_l1_sweep.py:92,114`), NOT by the
                     # shuffled store index — chunk_{k} is always the k-th state
                     with span(telemetry, "checkpoint", name="export"):
-                        save_learned_dicts(
-                            out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl",
-                            learned_dicts,
+                        export_path = (
+                            out / f"epoch_{epoch}" / f"chunk_{pos}" / "learned_dicts.pkl"
                         )
+                        save_learned_dicts(
+                            export_path, learned_dicts, provenance=run_ident,
+                        )
+                        _emit_export_provenance(export_path)
 
                 # preemption/periodic checkpoint boundary: cursor = last
                 # COMPLETED (epoch, position) + the post-split key, so a
@@ -325,6 +355,13 @@ def basic_l1_sweep(
                             "epoch": _epoch, "position": _pos,
                             "key": np.asarray(jax.device_get(key)),
                         },
+                        provenance=run_ident,
+                    )
+                    telemetry.event(
+                        "provenance", artifact="checkpoint", path=str(path),
+                        digest=checkpoint_digest(path),
+                        config_sha=run_ident.get("config_sha"),
+                        inputs=[{"kind": "store", "path": str(dataset_folder)}],
                     )
 
                 ckpt.boundary(epoch * n_chunk_slots + pos, _save_ckpt)
@@ -334,9 +371,11 @@ def basic_l1_sweep(
             if not save_after_every and epoch >= start_epoch:
                 learned_dicts = export()
                 with span(telemetry, "checkpoint", name="export"):
+                    export_path = out / f"epoch_{epoch}" / "learned_dicts.pkl"
                     save_learned_dicts(
-                        out / f"epoch_{epoch}" / "learned_dicts.pkl", learned_dicts
+                        export_path, learned_dicts, provenance=run_ident,
                     )
+                    _emit_export_provenance(export_path)
     except ResumableAbort as e:
         status = f"resumable-abort: {e}"
         raise

@@ -76,6 +76,7 @@ def save_learned_dicts(
     path,
     learned_dicts: List[Tuple[Any, Dict[str, Any]]],
     manifest: bool = True,
+    provenance: Optional[Dict[str, Any]] = None,
 ):
     """Save a `[(LearnedDict, hyperparams), ...]` list.
 
@@ -95,6 +96,13 @@ def save_learned_dicts(
     the ONE verified export format that fleet export verification and the
     serving registry both consume. `load_learned_dicts` verifies it when
     present; legacy manifest-less exports still load, with a warning.
+
+    ``provenance`` (ISSUE 19) is the producer-identity block
+    (`telemetry.provenance.producer_identity`: run fingerprint, config
+    digest, source checkpoint digest) recorded verbatim in the sidecar —
+    backward compatible: readers that predate it ignore the extra key,
+    and digest-only sidecars still verify and still join the lineage
+    graph through path reconstruction.
     """
     from sparse_coding__tpu.models.learned_dict import LEARNED_DICT_REGISTRY
 
@@ -154,7 +162,10 @@ def save_learned_dicts(
     finally:
         tmp.unlink(missing_ok=True)
     if manifest:
-        write_manifest(export_manifest_path(path), {path.name: path})
+        write_manifest(
+            export_manifest_path(path), {path.name: path},
+            extra={"provenance": provenance} if provenance else None,
+        )
 
 
 def load_learned_dicts(
@@ -397,6 +408,7 @@ def save_ensemble_checkpoint(
     ensembles: List[Tuple[Any, Dict[str, Any], str]],
     chunk_cursor: int = 0,
     extra: Optional[Dict[str, Any]] = None,
+    provenance: Optional[Dict[str, Any]] = None,
 ):
     """Save full sweep state: every ensemble's metadata + LIVE state + cursor.
 
@@ -410,6 +422,9 @@ def save_ensemble_checkpoint(
 
     Commits atomically via `save_checkpoint_tree` (staging dir + manifest +
     rename), so a kill mid-save can never leave a directory resume trusts.
+    ``provenance`` (a `telemetry.provenance.producer_identity` block) rides
+    in the commit manifest so the lineage graph joins the checkpoint to its
+    producing run by config digest, not just by directory nesting.
     """
     tree = {
         "cursor": {"chunk": chunk_cursor, **(extra or {})},
@@ -418,7 +433,10 @@ def save_ensemble_checkpoint(
         },
         "args": {name: _args for _ens, _args, name in ensembles},
     }
-    return save_checkpoint_tree(ckpt_dir, tree)
+    return save_checkpoint_tree(
+        ckpt_dir, tree,
+        extra_manifest={"provenance": provenance} if provenance else None,
+    )
 
 
 def restore_ensemble_checkpoint(ckpt_dir, template: Optional[Dict[str, Any]] = None):

@@ -342,6 +342,19 @@ def sweep(
     # pod runs: a cross-host config/environment mismatch is a hard `desync`
     # anomaly before any pod hours burn (no-op single-host)
     check_desync(telemetry, config=run_config)
+    # producer identity (ISSUE 19): stamped into checkpoint/export manifests
+    # and echoed as `provenance` events, joining the sweep's artifacts to
+    # this run by config digest in the lineage graph
+    from sparse_coding__tpu.telemetry.events import run_fingerprint
+    from sparse_coding__tpu.telemetry.provenance import (
+        export_digest,
+        producer_identity,
+    )
+
+    run_ident = producer_identity(
+        config=run_config, fingerprint=run_fingerprint(),
+        run_dir=cfg.output_folder,
+    )
 
     # `timed` keeps the legacy `phase` event; the span is what the goodput
     # ledger classifies (dataset build/load = data-wait badput)
@@ -559,13 +572,24 @@ def sweep(
                 )
 
             def _save_ckpt(path, _i=i):
-                ckpt_lib.save_ensemble_checkpoint(path, ensembles, chunk_cursor=_i)
+                ckpt_lib.save_ensemble_checkpoint(
+                    path, ensembles, chunk_cursor=_i, provenance=run_ident,
+                )
 
             if want_save:
                 iter_folder = Path(cfg.output_folder) / f"_{i}"
                 iter_folder.mkdir(parents=True, exist_ok=True)
                 with span(telemetry, "checkpoint", name="export", chunk=i):
-                    ckpt_lib.save_learned_dicts(iter_folder / "learned_dicts.pkl", learned_dicts)
+                    export_path = iter_folder / "learned_dicts.pkl"
+                    ckpt_lib.save_learned_dicts(
+                        export_path, learned_dicts, provenance=run_ident,
+                    )
+                    telemetry.event(
+                        "provenance", artifact="export",
+                        path=str(export_path), digest=export_digest(export_path),
+                        config_sha=run_ident.get("config_sha"),
+                        inputs=[{"kind": "store", "path": str(cfg.dataset_folder)}],
+                    )
                 if hasattr(cfg, "save_yaml"):
                     cfg.save_yaml(iter_folder / "config.yaml")
                 # atomic commit + retention GC + telemetry `checkpoint` event

@@ -16,3 +16,9 @@ def poll(telemetry, span, targets):
     # near-miss of the registered ``tower_poll`` badput category
     with span(telemetry, "tower_scrape"):  # VIOLATION
         return len(targets)
+
+
+def verify(telemetry, span, graph):
+    # near-miss of the registered ``lineage_verify`` badput category
+    with span(telemetry, "lineage_scan"):  # VIOLATION
+        return len(graph.nodes)

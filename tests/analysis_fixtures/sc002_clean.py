@@ -21,3 +21,10 @@ def poll(telemetry, span, targets):
     # scrape+aggregate+alert cycle); not nestable, top level only
     with span(telemetry, "tower_poll"):
         return len(targets)
+
+
+def verify(telemetry, span, graph):
+    # ``lineage_verify`` is registered badput (provenance digest
+    # re-verification sweeps); not nestable, top level only
+    with span(telemetry, "lineage_verify"):
+        return len(graph.nodes)

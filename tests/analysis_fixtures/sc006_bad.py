@@ -17,3 +17,9 @@ def publish_tower(counter_inc, n):
     # both expose as ``sc_tower_scrape_errors_total``
     counter_inc("tower.scrape.errors", n)  # VIOLATION
     counter_inc("tower.scrape_errors", n)  # VIOLATION
+
+
+def publish_lineage(gauge_set, n):
+    # both expose as ``sc_lineage_tainted_artifacts``
+    gauge_set("lineage.tainted.artifacts", n)  # VIOLATION
+    gauge_set("lineage.tainted_artifacts", n)  # VIOLATION

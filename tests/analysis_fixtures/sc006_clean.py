@@ -26,3 +26,10 @@ def publish_tower(gauge_set, counter_inc, up, total, firing):
     gauge_set("tower.alerts_firing", firing)
     counter_inc("tower.polls")
     counter_inc("tower.scrape_errors")
+
+
+def publish_lineage(gauge_set, counter_inc, tainted):
+    # the provenance-verification family (lineage explain/check sweeps)
+    gauge_set("lineage.tainted_artifacts", tainted)
+    counter_inc("lineage.verify.checked")
+    counter_inc("lineage.verify.failures")

@@ -1,0 +1,43 @@
+"""Every ``python -m sparse_coding__tpu.<tool>`` CLI shim answers --help
+(ISSUE 19 satellite): the module imports, the argparse wiring is intact,
+and exit code is 0 — the cheapest possible guard against a refactor
+orphaning a top-level entry point."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+SHIMS = (
+    "report",
+    "monitor",
+    "timeline",
+    "trace",
+    "slo",
+    "tower",
+    "features",
+    "perfdiff",
+    "scrub",
+    "supervise",
+    "analysis",
+    "lineage",
+)
+
+
+@pytest.mark.parametrize("shim", SHIMS)
+def test_cli_shim_help_exits_zero(shim):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", f"sparse_coding__tpu.{shim}", "--help"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=str(REPO),
+    )
+    assert res.returncode == 0, (
+        f"sparse_coding__tpu.{shim} --help exited "
+        f"{res.returncode}:\n{res.stderr[-2000:]}"
+    )
+    assert res.stdout.strip(), f"{shim}: --help printed nothing"

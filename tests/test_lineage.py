@@ -1,0 +1,307 @@
+"""Provenance graph: end-to-end artifact lineage (ISSUE 19).
+
+Covers the three build modes the tentpole promises:
+  - LEGACY reconstruction from committed manifests alone — the checked-in
+    `tests/golden/lineage_run/` tree is pre-provenance-event, and the
+    pinned `expected_*` files byte-pin explain/blast/check stdout;
+  - NEW runs whose drivers emit explicit ``provenance`` events — a real
+    (tiny) `basic_l1_sweep` run resolves export → run → store with zero
+    manifest archaeology;
+  - the CHAOS acceptance chain: post-training chunk corruption → scrub
+    quarantine → `lineage blast` names the tainted export + live serving
+    generation → `lineage check` exit 1 → `only_chunks` exact-index
+    repair → exit 0, no retraining.
+"""
+
+import json
+import shutil
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparse_coding__tpu.data import RandomDatasetGenerator, save_chunk
+from sparse_coding__tpu.data.chunks import chunk_path, generate_synthetic_chunks
+from sparse_coding__tpu.telemetry.provenance import (
+    build_graph,
+    config_digest,
+    export_digest,
+    main as lineage_main,
+    manifest_files_digest,
+    producer_identity,
+    verify_graph,
+)
+
+GOLDEN_LINEAGE = Path(__file__).parent / "golden" / "lineage_run"
+TRACE = "feed5eedfeed5eedfeed5eedfeed5eed"  # pinned in the fixture
+
+
+# -- digests & identity --------------------------------------------------------
+
+def test_config_digest_canonical():
+    assert config_digest({"b": 1, "a": 2}) == config_digest({"a": 2, "b": 1})
+    assert config_digest({"a": 1}) != config_digest({"a": 2})
+    assert len(config_digest({"a": Path("/x")})) == 16  # default=str leaves
+
+
+def test_manifest_files_digest_ignores_restamp():
+    files = {"0.npy": {"bytes": 10, "sha256": "ab" * 32}}
+    assert manifest_files_digest(files) == manifest_files_digest(dict(files))
+    assert manifest_files_digest({}) is None
+
+
+def test_producer_identity_partial_fields():
+    ident = producer_identity(config={"x": 1})
+    assert ident["format"] == 1 and "fingerprint" not in ident
+    full = producer_identity(
+        config={"x": 1},
+        fingerprint={"git_sha": "g", "jax": "0.6", "backend": "cpu",
+                     "device_kind": "cpu", "device_count": 8},
+        source_checkpoint="c" * 16, run_dir="/r",
+    )
+    assert full["fingerprint"] == {"git_sha": "g", "jax": "0.6",
+                                   "backend": "cpu", "device_kind": "cpu"}
+    assert full["source_checkpoint"] == "c" * 16 and full["run_dir"] == "/r"
+
+
+# -- golden fixture: legacy manifest-only reconstruction -----------------------
+
+def test_golden_explain_from_trace_id_byte_pinned(capsys):
+    """`lineage explain <trace-id>` over the PRE-provenance-event tree
+    resolves the full chain (response → generation → dict → export →
+    checkpoint → run → store → chunks → harvest config) and renders
+    byte-identically to the pinned output."""
+    rc = lineage_main(["explain", TRACE, str(GOLDEN_LINEAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out == (GOLDEN_LINEAGE / "expected_explain.md").read_text()
+
+
+def test_golden_blast_byte_pinned(capsys):
+    rc = lineage_main(["blast", "chunk:store#0", str(GOLDEN_LINEAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out == (GOLDEN_LINEAGE / "expected_blast.md").read_text()
+
+
+def test_golden_check_byte_pinned(capsys):
+    rc = lineage_main(["check", str(GOLDEN_LINEAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out == (GOLDEN_LINEAGE / "expected_check.txt").read_text()
+
+
+def test_golden_graph_json_schema(capsys):
+    rc = lineage_main(["graph", "--json", str(GOLDEN_LINEAGE)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    types = {n["type"] for n in out["nodes"]}
+    assert {"traced-response", "registry-generation", "dict", "export",
+            "checkpoint", "training-run", "store", "chunk",
+            "harvest-run"} <= types
+    kinds = {e["kind"] for e in out["edges"]}
+    assert {"contains", "derived-from", "resumed-from"} <= kinds
+
+
+def test_cli_exit_codes_for_bad_inputs(capsys, tmp_path):
+    assert lineage_main(["check", str(tmp_path / "nope")]) == 3
+    (tmp_path / "empty").mkdir()
+    assert lineage_main(["check", str(tmp_path / "empty")]) == 3
+    assert lineage_main(["explain", "no-such-artifact",
+                         str(GOLDEN_LINEAGE)]) == 2
+    capsys.readouterr()
+
+
+def test_resolve_accepts_digest_prefix_and_path():
+    g = build_graph([GOLDEN_LINEAGE])
+    nid = "export:run/learned_dicts.pkl"
+    dig = g.nodes[nid]["digest"]
+    assert g.resolve(dig[:10]) == nid
+    assert g.resolve(str(GOLDEN_LINEAGE / "run" / "learned_dicts.pkl")) == nid
+    assert g.resolve(TRACE) == f"response:{TRACE}"
+
+
+def test_verify_graph_detects_byte_rot(tmp_path):
+    shutil.copytree(GOLDEN_LINEAGE, tmp_path / "t")
+    g = build_graph([tmp_path / "t"])
+    assert verify_graph(g, "digest") == 0
+    pkl = tmp_path / "t" / "run" / "learned_dicts.pkl"
+    pkl.write_bytes(pkl.read_bytes()[:-1] + b"X")
+    g2 = build_graph([tmp_path / "t"])
+    assert verify_graph(g2, "digest") == 1
+    n = g2.nodes["export:run/learned_dicts.pkl"]
+    assert n["verify"].startswith("FAIL")
+    # size tier can't see a same-length flip
+    g3 = build_graph([tmp_path / "t"])
+    assert verify_graph(g3, "size") == 0
+
+
+# -- new runs: explicit provenance events --------------------------------------
+
+@pytest.mark.slow
+def test_fresh_driver_run_emits_joinable_provenance(tmp_path):
+    """A real (tiny) `basic_l1_sweep` run emits ``provenance`` events and
+    manifest producer-identity blocks; the graph joins export → run →
+    store without any legacy reconstruction."""
+    from sparse_coding__tpu.train import basic_l1_sweep
+
+    gen = RandomDatasetGenerator(
+        activation_dim=24, n_ground_truth_components=48, batch_size=512,
+        feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+        key=jax.random.PRNGKey(0),
+    )
+    save_chunk(tmp_path / "chunks", 0,
+               np.asarray(jnp.concatenate([next(gen) for _ in range(2)])))
+    basic_l1_sweep(
+        str(tmp_path / "chunks"), str(tmp_path / "out"),
+        activation_width=24, l1_values=[1e-3], dict_ratio=2,
+        batch_size=256, fista_iters=10, n_epochs=1,
+    )
+    events = [json.loads(l)
+              for l in (tmp_path / "out" / "events.jsonl").open()]
+    prov = [e for e in events if e["event"] == "provenance"]
+    assert prov and all(e["artifact"] == "export" for e in prov)
+    pkl = tmp_path / "out" / "epoch_0" / "learned_dicts.pkl"
+    sidecar = json.loads(
+        pkl.with_name(pkl.name + ".manifest.json").read_text()
+    )
+    assert sidecar["provenance"]["config_sha"]
+    assert sidecar["provenance"]["run_dir"] == str(tmp_path / "out")
+    assert prov[-1]["digest"] == export_digest(pkl)
+
+    g = build_graph([tmp_path])
+    eid = f"export:out/epoch_0/{pkl.name}"
+    up = g.closure(eid, "up")
+    assert "run:out" in up and "store:chunks" in up
+
+
+# -- chaos acceptance: corrupt → quarantine → blast → repair → clean -----------
+
+GEN_KWARGS = dict(
+    activation_dim=16, n_ground_truth_components=32, batch_size=256,
+    feature_num_nonzero=5, feature_prob_decay=0.995, correlated=False,
+)
+SPEC = dict(
+    n_chunks=3, chunk_size_gb=256 * 16 * 2 / 1024**3, activation_width=16,
+)
+
+
+def _fake_serving_estate(root: Path):
+    """A store + hand-stamped run/serve event tree downstream of chunk 1:
+    cheap stand-ins for the training/serving layers (their event schemas
+    are the real ones — the golden fixture and the driver test cover the
+    real writers)."""
+    from sparse_coding__tpu.utils.manifest import write_manifest
+
+    store = root / "store"
+    gen = RandomDatasetGenerator(**GEN_KWARGS, key=jax.random.PRNGKey(3))
+    generate_synthetic_chunks(gen, store, **SPEC)
+    run = root / "run"
+    run.mkdir()
+    pkl = run / "learned_dicts.pkl"
+    pkl.write_bytes(b"chaos-export\n")
+    write_manifest(
+        pkl.with_name(pkl.name + ".manifest.json"), {pkl.name: pkl},
+        extra={"provenance": producer_identity(
+            config={"dataset_folder": "../store"}, run_dir=str(run),
+        )},
+    )
+    ev = [
+        {"seq": 1, "ts": 1.0, "event": "run_start", "run_name": "chaos",
+         "config": {"dataset_folder": "../store"}},
+        {"seq": 2, "ts": 2.0, "event": "provenance", "artifact": "export",
+         "path": str(pkl), "digest": export_digest(pkl),
+         "inputs": [{"kind": "store", "path": "../store"}]},
+    ]
+    (run / "events.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in ev)
+    )
+    serve = root / "serve"
+    serve.mkdir()
+    sev = [
+        {"seq": 1, "ts": 3.0, "event": "run_start", "run_name": "replica"},
+        {"seq": 2, "ts": 4.0, "event": "serve_dict_added", "dict": "d0",
+         "generation": 1, "source": "../run/learned_dicts.pkl",
+         "manifest_digest": export_digest(pkl)},
+    ]
+    (serve / "events.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in sev)
+    )
+    return store
+
+
+def test_chaos_corrupt_quarantine_blast_repair(tmp_path, capsys):
+    """The ISSUE 19 acceptance chain, zero retraining."""
+    from sparse_coding__tpu.data.scrub import main as scrub_main
+
+    store = _fake_serving_estate(tmp_path)
+
+    # pre-chaos: clean gate
+    assert lineage_main(["check", str(tmp_path)]) == 0
+
+    # chaos: bit rot in chunk 1, then scrub quarantines it
+    p = chunk_path(store, 1)
+    raw = bytearray(p.read_bytes())
+    raw[-1] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    assert scrub_main([str(store)]) == 1
+    capsys.readouterr()
+
+    # blast from the quarantined chunk names the export AND the live
+    # serving generation downstream
+    rc = lineage_main(["blast", f"chunk:store#1", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "tainted: quarantined" in out
+    assert "export:run/learned_dicts.pkl" in out
+    assert "generation:serve#1  (LIVE)" in out
+
+    # CI gate trips while the taint stands
+    rc = lineage_main(["check", str(tmp_path)])
+    summary = capsys.readouterr().out
+    assert rc == 1
+    assert "chunk:store#1" in summary and "live" in summary
+
+    # exact-index repair through the seeded generator...
+    config = {"kind": "synthetic",
+              "generator": {**GEN_KWARGS, "class": "RandomDatasetGenerator",
+                            "seed": 3},
+              **SPEC}
+    (tmp_path / "repair.json").write_text(json.dumps(config))
+    assert scrub_main([str(store), "--repair",
+                       str(tmp_path / "repair.json")]) == 0
+    capsys.readouterr()
+
+    # ...and the gate drops back to 0 with the ledger still on disk
+    # (repair history, not taint)
+    assert lineage_main(["check", str(tmp_path)]) == 0
+    capsys.readouterr()
+    g = build_graph([tmp_path])
+    n = g.nodes["chunk:store#1"]
+    assert not n.get("tainted") and n["meta"].get("repaired")
+
+
+# -- emitted telemetry ---------------------------------------------------------
+
+def test_verify_sweep_spans_and_counters(tmp_path):
+    """`verify_graph` books its wall time under the registered
+    ``lineage_verify`` badput span and publishes ``lineage.*`` counters
+    through the broadcast channel."""
+    from sparse_coding__tpu.telemetry import RunTelemetry
+
+    shutil.copytree(GOLDEN_LINEAGE, tmp_path / "t")
+    tel = RunTelemetry(out_dir=tmp_path / "run", run_name="lineage_test")
+    try:
+        g = build_graph([tmp_path / "t"])
+        verify_graph(g, "digest")
+    finally:
+        tel.close()
+    events = [json.loads(l)
+              for l in (tmp_path / "run" / "events.jsonl").open()]
+    spans = [e for e in events
+             if e["event"] == "span" and e["category"] == "lineage_verify"]
+    assert spans and spans[0]["tier"] == "digest"
+    assert tel.counters["lineage.verify.checked"] >= 5
+    assert "lineage.verify.failures" not in tel.counters
